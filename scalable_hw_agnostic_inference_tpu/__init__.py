@@ -1,0 +1,28 @@
+"""TPU-native hardware-agnostic inference framework.
+
+A brand-new serving stack with the capabilities of
+``aws-samples/scalable-hw-agnostic-inference`` (see SURVEY.md), re-designed
+TPU-first: JAX/XLA for compute, ``jax.sharding`` meshes + XLA collectives over
+ICI for in-model parallelism, Pallas kernels for hot ops, AOT-compiled XLA
+executables as the artifact format, and one reusable serving runtime instead
+of per-model copy-paste servers.
+
+Layer map (mirrors SURVEY.md §1, TPU-natively):
+
+- ``core``       device abstraction, mesh/topology, AOT compile cache,
+                 shape bucketing, artifact store
+- ``parallel``   sharding rules (column/row-parallel -> NamedSharding),
+                 sub-mesh placement, ring attention / sequence parallelism
+- ``ops``        compute ops; ``ops.pallas`` holds TPU Pallas kernels
+- ``models``     flax model zoo: bert, vit, yolos, t5, clip, sd21 (unet+vae),
+                 llama, flux
+- ``serve``      the single serving runtime: env contract, warmup,
+                 /health /readiness /benchmark /load, latency percentiles,
+                 metric publication, LLM engine
+- ``compilectl`` AOT compile CLI (the compile-*.py equivalent)
+- ``orchestrate``fan-out chain client (the cova equivalent)
+"""
+
+__version__ = "0.1.0"
+
+METRIC_NAMESPACE = "hw-agnostic-infer"

@@ -1,0 +1,4 @@
+from .device import resolve_device, local_devices  # noqa: F401
+from .mesh import MeshSpec, build_mesh, submesh  # noqa: F401
+from .bucketing import BucketRegistry  # noqa: F401
+from .aot import AotCache, aot_key  # noqa: F401

@@ -1,0 +1,157 @@
+"""AOT compilation cache and artifact store.
+
+The reference's artifact story: ``torch_neuronx.trace`` -> NEFF files ->
+pushed to the HF hub -> pulled at pod boot by ``COMPILED_MODEL_ID`` (reference
+``app/compile-sd2.py:18-20``, ``sd21-inf2-deploy.yaml:60-61``). The TPU-native
+equivalent has two tiers:
+
+1. **XLA persistent compilation cache** (:func:`enable_persistent_cache`) —
+   keyed by HLO fingerprint, shared via the artifact root (a PV, GCS bucket,
+   or baked image layer), so a restarted pod skips the multi-minute compile
+   the reference calls out as its 5-15 min cold start (``README.md:82``).
+2. **Exported StableHLO artifacts** (:class:`AotCache`) — portable serialized
+   functions keyed by (name, shapes, dtypes, mesh, jax version), the
+   distributable analog of per-rank NEFFs on the hub. ``compilectl`` writes
+   them at build time; servers load them at boot.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+MANIFEST = "manifest.json"
+
+
+def enable_persistent_cache(cache_dir: str) -> None:
+    """Point JAX's persistent compilation cache at the artifact root."""
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def _spec_of(x) -> Dict[str, Any]:
+    import jax.numpy as jnp  # noqa: F401
+
+    shape = tuple(getattr(x, "shape", ()))
+    dtype = str(getattr(x, "dtype", type(x).__name__))
+    return {"shape": list(shape), "dtype": dtype}
+
+
+def aot_key(name: str, args: Sequence, mesh=None, extra: str = "") -> str:
+    """Stable content key for one compiled function variant."""
+    import jax
+
+    payload = {
+        "name": name,
+        "args": [_spec_of(a) for a in args],
+        "mesh": None,
+        "jax": jax.__version__,
+        "extra": extra,
+    }
+    if mesh is not None:
+        payload["mesh"] = {
+            "axes": list(mesh.axis_names),
+            "shape": list(mesh.devices.shape),
+        }
+    blob = json.dumps(payload, sort_keys=True).encode()
+    return f"{name}-{hashlib.sha256(blob).hexdigest()[:16]}"
+
+
+class AotCache:
+    """Directory-backed store of exported (StableHLO) jitted functions.
+
+    Layout::
+
+        <root>/<key>.shlo       serialized jax.export artifact
+        <root>/manifest.json    key -> {name, specs, created, mesh}
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._manifest_path = os.path.join(root, MANIFEST)
+        self._manifest: Dict[str, Dict] = {}
+        if os.path.exists(self._manifest_path):
+            try:
+                with open(self._manifest_path) as f:
+                    self._manifest = json.load(f)
+            except Exception:
+                log.warning("corrupt AOT manifest at %s; starting fresh", self._manifest_path)
+
+    def _save_manifest(self) -> None:
+        # merge-on-save: artifact roots are shared (PV/GCS) across pods, so
+        # re-read the disk manifest and union entries before the atomic
+        # replace — concurrent writers then lose no keys (last metadata wins
+        # per key, which is fine: entries are content-addressed)
+        if os.path.exists(self._manifest_path):
+            try:
+                with open(self._manifest_path) as f:
+                    on_disk = json.load(f)
+                on_disk.update(self._manifest)
+                self._manifest = on_disk
+            except Exception:
+                pass
+        tmp = f"{self._manifest_path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._manifest, f, indent=1, sort_keys=True)
+        os.replace(tmp, self._manifest_path)
+
+    def keys(self) -> Dict[str, Dict]:
+        return dict(self._manifest)
+
+    def export(
+        self,
+        name: str,
+        fn: Callable,
+        args: Sequence,
+        mesh=None,
+        extra: str = "",
+    ) -> str:
+        """Trace+export ``fn`` at ``args``' shapes and persist it; returns key."""
+        import jax
+        from jax import export as jexport
+
+        key = aot_key(name, args, mesh=mesh, extra=extra)
+        path = os.path.join(self.root, key + ".shlo")
+        if key in self._manifest and os.path.exists(path):
+            return key
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        exported = jexport.export(jitted)(*args)
+        data = exported.serialize()
+        with open(path, "wb") as f:
+            f.write(data)
+        self._manifest[key] = {
+            "name": name,
+            "args": [_spec_of(a) for a in args],
+            "created": time.time(),
+            "bytes": len(data),
+            "extra": extra,
+        }
+        self._save_manifest()
+        log.info("AOT exported %s (%d bytes)", key, len(data))
+        return key
+
+    def load(self, key: str) -> Callable:
+        """Load an exported function; calling it compiles via the persistent
+        cache (fast when warm) and runs on the current backend."""
+        from jax import export as jexport
+
+        path = os.path.join(self.root, key + ".shlo")
+        if not os.path.exists(path):
+            raise KeyError(f"no AOT artifact {key} under {self.root}")
+        with open(path, "rb") as f:
+            exported = jexport.deserialize(f.read())
+        return exported.call
+
+    def get_or_export(self, name: str, fn: Callable, args: Sequence, mesh=None, extra: str = ""):
+        key = self.export(name, fn, args, mesh=mesh, extra=extra)
+        return self.load(key)

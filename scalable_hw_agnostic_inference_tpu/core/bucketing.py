@@ -1,0 +1,70 @@
+"""Static-shape bucketing — XLA's recompile guard.
+
+XLA (like neuronx-cc) compiles per shape; the reference handles this with
+frozen compile-time shapes and vLLM bucket lists
+(``context_encoding_buckets: [1024, 16384]``, reference
+``cova/mllama-32-11b-vllm-trn1-config.yaml:10-16``; SURVEY.md §5
+"Long-context"). Here buckets are an explicit registry: requests are padded
+up to the nearest registered bucket, and every bucket can be compile-warmed
+at boot so no live request ever eats a cold XLA compile.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+
+def pow2_buckets(lo: int, hi: int) -> List[int]:
+    """Powers of two covering [lo, hi], inclusive of a final ``hi`` bucket."""
+    out = []
+    b = 1
+    while b < lo:
+        b *= 2
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return out
+
+
+class BucketRegistry:
+    """Sorted shape buckets for one dynamic dimension (e.g. sequence length)."""
+
+    def __init__(self, buckets: Iterable[int]):
+        bs = sorted(set(int(b) for b in buckets))
+        if not bs or bs[0] < 1:
+            raise ValueError(f"invalid buckets {bs}")
+        self.buckets: List[int] = bs
+
+    @property
+    def max(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n. Raises if n exceeds the largest bucket."""
+        if n > self.max:
+            raise ValueError(f"length {n} exceeds max bucket {self.max}")
+        i = bisect.bisect_left(self.buckets, max(n, 1))
+        return self.buckets[i]
+
+    def pad_to_bucket(self, xs: Sequence, pad_value=0) -> Tuple[list, int]:
+        """Pad a 1-D python sequence up to its bucket; returns (padded, bucket)."""
+        b = self.bucket_for(len(xs))
+        return list(xs) + [pad_value] * (b - len(xs)), b
+
+    def warm(self, compile_fn: Callable[[int], None], limit: Optional[int] = None) -> int:
+        """Invoke ``compile_fn(bucket)`` for each bucket (boot-time warmup).
+
+        Returns the number of buckets warmed. This is the explicit version of
+        the reference's 'warmup inference before readiness' idiom
+        (reference ``app/run-sd.py:144-146``) generalized to every shape the
+        server will accept.
+        """
+        n = 0
+        for b in self.buckets:
+            if limit is not None and n >= limit:
+                break
+            compile_fn(b)
+            n += 1
+        return n

@@ -1,0 +1,68 @@
+"""Device abstraction: the ``DEVICE`` dispatch seam, TPU-natively.
+
+The reference branches on ``DEVICE`` at import time into four accelerator
+stacks (``xla|cuda|triton|cpu``, reference ``app/run-sd.py:41-44,104-135``).
+Here the same seam is two tiers — ``tpu`` and ``cpu`` — and the branch
+changes *nothing* about model code: JAX targets either platform with the same
+jitted functions. ``cpu`` is the test/CI tier (the reference's Graviton tier)
+and also what powers multi-chip simulation in tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+
+def resolve_device(device: str) -> str:
+    """Validate the requested tier against what this host actually has.
+
+    Falls back to ``cpu`` (with a warning) when ``tpu`` is requested but no
+    TPU is attached — the pod then still comes up and fails readiness only if
+    the operator requires TPU, mirroring how the reference pod fails its
+    startup self-test rather than crash-looping opaquely.
+    """
+    import jax
+
+    if device == "cpu":
+        return "cpu"
+    if device == "tpu":
+        platforms = {d.platform for d in jax.devices()}
+        if platforms & {"tpu", "axon"}:
+            return "tpu"
+        log.warning("DEVICE=tpu requested but no TPU present; falling back to cpu")
+        return "cpu"
+    raise ValueError(f"unknown device tier {device!r}")
+
+
+def local_devices(device: Optional[str] = None) -> List:
+    """Devices for the requested tier, in stable id order."""
+    import jax
+
+    if device in (None, ""):
+        return list(jax.devices())
+    if device == "cpu":
+        return list(jax.devices("cpu"))
+    if device != "tpu":
+        raise ValueError(f"unknown device tier {device!r}")
+    devs = [d for d in jax.devices() if d.platform in ("tpu", "axon")]
+    return devs or list(jax.devices("cpu"))
+
+
+def force_host_device_count(n: int) -> None:
+    """Configure N virtual CPU devices (tests / multi-chip dry runs).
+
+    Must run before JAX initializes its backends.
+    """
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()

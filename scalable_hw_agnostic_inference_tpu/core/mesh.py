@@ -1,0 +1,155 @@
+"""Mesh topology: named device meshes and sub-mesh placement.
+
+Two reference capabilities live here, TPU-natively:
+
+- **TP group formation** — the reference's ``neuronx_distributed``
+  ``parallel_state`` (tp rank/size, reference
+  ``app/src/transformer/model.py:143-146``) becomes a named
+  ``jax.sharding.Mesh`` with axes like ``("dp", "tp")``; collectives ride the
+  ICI ring of the slice automatically once shardings are annotated.
+- **Core placement** — ``neuron_cores_context(start_nc=, nc_count=)`` pinning
+  of sub-models to disjoint cores of one host (reference
+  ``app/flux_model_api.py:128-140,298-320``) becomes :func:`submesh` over a
+  contiguous ``jax.devices()`` slice, so e.g. CLIP+VAE live on device 0 while
+  a TP-4 transformer owns devices 4:8 of the same v5e-8.
+
+Mesh axes convention (used by ``parallel.sharding`` rules):
+``dp`` data, ``tp`` tensor/model, ``sp`` sequence/context, ``ep`` expert,
+``pp`` pipeline stage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")  # tp innermost => rides ICI neighbors
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Parsed mesh spec, e.g. ``"dp=2,tp=4"``.
+
+    Axis sizes of ``-1`` mean "all remaining devices" (at most one axis).
+    Axes are laid out with ``tp`` fastest-varying so tensor-parallel
+    collectives land on adjacent devices (ICI neighbors on a TPU slice).
+    """
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def parse(cls, spec: str) -> "MeshSpec":
+        if not spec:
+            return cls(axes=())
+        axes = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            m = re.fullmatch(r"(\w+)\s*=\s*(-1|[1-9]\d*)", part)
+            if not m:
+                raise ValueError(
+                    f"bad mesh spec component {part!r} in {spec!r} "
+                    "(sizes must be positive or -1)"
+                )
+            name, size = m.group(1), int(m.group(2))
+            if name not in AXIS_ORDER:
+                raise ValueError(f"unknown mesh axis {name!r}; expected one of {AXIS_ORDER}")
+            axes.append((name, size))
+        names = [n for n, _ in axes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate axis in {spec!r}")
+        if sum(1 for _, s in axes if s == -1) > 1:
+            raise ValueError("at most one axis may be -1")
+        # canonical order
+        axes.sort(key=lambda kv: AXIS_ORDER.index(kv[0]))
+        return cls(axes=tuple(axes))
+
+    def resolve_sizes(self, n_devices: int) -> Tuple[Tuple[str, int], ...]:
+        fixed = 1
+        for _, s in self.axes:
+            if s != -1:
+                fixed *= s
+        out = []
+        for name, s in self.axes:
+            if s == -1:
+                if n_devices % fixed:
+                    raise ValueError(
+                        f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                    )
+                s = n_devices // fixed
+            out.append((name, s))
+        total = int(np.prod([s for _, s in out])) if out else 1
+        if total > n_devices:
+            raise ValueError(f"mesh spec needs {total} devices, have {n_devices}")
+        return tuple(out)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+
+def build_mesh(
+    spec: "MeshSpec | str",
+    devices: Optional[Sequence] = None,
+):
+    """Build a ``jax.sharding.Mesh`` from a spec over the given devices.
+
+    An empty spec yields a trivial 1-device ``("dp",)`` mesh so model code can
+    be written mesh-always (single-chip is just the degenerate mesh).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if isinstance(spec, str):
+        spec = MeshSpec.parse(spec)
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if not spec.axes:
+        return Mesh(np.array(devices[:1]).reshape(1), ("dp",))
+    sizes = spec.resolve_sizes(len(devices))
+    shape = tuple(s for _, s in sizes)
+    names = tuple(n for n, _ in sizes)
+    n = int(np.prod(shape))
+    grid = np.array(devices[:n]).reshape(shape)
+    return Mesh(grid, names)
+
+
+def submesh(start: int, count: int, devices: Optional[Sequence] = None) -> List:
+    """Contiguous device slice — the ``neuron_cores_context`` equivalent.
+
+    Returns ``devices[start:start+count]`` for packing multiple models onto
+    disjoint sub-meshes of one host (reference
+    ``app/flux_model_api.py:298-320``).
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if start < 0 or start + count > len(devices):
+        raise ValueError(
+            f"submesh [{start}:{start + count}] out of range for {len(devices)} devices"
+        )
+    return devices[start : start + count]
+
+
+def parse_submesh(spec: str) -> Optional[Tuple[int, int]]:
+    """Parse ``"a:b"`` (device slice) into ``(start, count)``; "" -> None."""
+    if not spec:
+        return None
+    m = re.fullmatch(r"(\d+):(\d+)", spec.strip())
+    if not m:
+        raise ValueError(f"bad submesh spec {spec!r}; expected 'start:end'")
+    a, b = int(m.group(1)), int(m.group(2))
+    if b <= a:
+        raise ValueError(f"empty submesh {spec!r}")
+    return a, b - a
+
+
+def mesh_axis_sizes(mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,7 @@
+from .sharding import (  # noqa: F401
+    column_parallel,
+    row_parallel,
+    replicated,
+    ShardingRules,
+    shard_pytree,
+)

@@ -1,0 +1,2 @@
+from .latency import LatencyCollector, BenchmarkReport  # noqa: F401
+from .metrics import MetricsPublisher  # noqa: F401

@@ -1,0 +1,130 @@
+"""Latency percentile collection and benchmark reports.
+
+Single implementation of what the reference duplicates verbatim in eight
+servers (``LatencyCollector`` + ``benchmark()``, reference
+``app/run-sd.py:49-102``, ``app/vllm_model_api.py:61-109``, ...; see
+SURVEY.md §2.2). The report shape — p0/p50/p90/p95/p99/p100 plus throughput —
+is kept so dashboards built against the reference read identically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+PERCENTILES = (0, 50, 90, 95, 99, 100)
+
+
+class LatencyCollector:
+    """Thread-safe reservoir of request latencies with percentile readout."""
+
+    def __init__(self, max_samples: int = 100_000):
+        self._lock = threading.Lock()
+        self._samples: List[float] = []
+        self._max_samples = max_samples
+        self._total = 0
+
+    def record(self, latency_s: float) -> None:
+        with self._lock:
+            self._total += 1
+            if len(self._samples) < self._max_samples:
+                self._samples.append(latency_s)
+            else:
+                # reservoir-style overwrite keeps memory bounded under load
+                self._samples[self._total % self._max_samples] = latency_s
+
+    def timed(self, fn: Callable, *args, **kwargs):
+        """Run ``fn`` and record its wall time; returns ``fn``'s result."""
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        self.record(time.perf_counter() - t0)
+        return out
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._total
+
+    @staticmethod
+    def _interp(data: List[float], p: float) -> float:
+        if not data:
+            return 0.0
+        if p <= 0:
+            return data[0]
+        if p >= 100:
+            return data[-1]
+        # linear interpolation between closest ranks
+        rank = (p / 100.0) * (len(data) - 1)
+        lo = int(rank)
+        hi = min(lo + 1, len(data) - 1)
+        frac = rank - lo
+        return data[lo] * (1.0 - frac) + data[hi] * frac
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            data = sorted(self._samples)
+        return self._interp(data, p)
+
+    def report(self) -> Dict[str, float]:
+        # one locked snapshot + one sort, so percentiles within a report are
+        # mutually consistent under concurrent record()s
+        with self._lock:
+            data = sorted(self._samples)
+        return {f"p{p}": self._interp(data, p) for p in PERCENTILES}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+            self._total = 0
+
+
+@dataclass
+class BenchmarkReport:
+    """Result of ``run_benchmark``: percentiles + throughput."""
+
+    n_runs: int
+    total_s: float
+    latency_percentiles: Dict[str, float]
+    throughput_rps: float
+    extra: Dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict:
+        d = {
+            "n_runs": self.n_runs,
+            "total_time_s": round(self.total_s, 4),
+            "throughput_rps": round(self.throughput_rps, 4),
+        }
+        d.update({k: round(v, 4) for k, v in self.latency_percentiles.items()})
+        d.update(self.extra)
+        return d
+
+
+def run_benchmark(
+    fn: Callable[[], object],
+    n_runs: int,
+    collector: Optional[LatencyCollector] = None,
+) -> BenchmarkReport:
+    """Call ``fn`` ``n_runs`` times, measuring per-call latency.
+
+    The serving runtime exposes this via ``POST /benchmark`` and
+    ``GET /load/{n}/infer/{m}``, matching the reference's built-in
+    measurement instrument (reference ``app/run-sd.py:157-175``).
+    """
+    local = LatencyCollector()
+    t0 = time.perf_counter()
+    for _ in range(n_runs):
+        t1 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t1
+        local.record(dt)
+        if collector is not None:
+            collector.record(dt)
+    total = time.perf_counter() - t0
+    return BenchmarkReport(
+        n_runs=n_runs,
+        total_s=total,
+        latency_percentiles=local.report(),
+        throughput_rps=(n_runs / total) if total > 0 else 0.0,
+    )

@@ -1,0 +1,114 @@
+"""Metric publication — the autoscaling signal.
+
+In the reference, ``cw_pub_metric`` pushes ``{APP}-counter``, ``{NODEPOOL}``
+and ``{APP}-latency`` into CloudWatch namespace ``hw-agnostic-infer`` on every
+served request, and KEDA scales deployments on ``SUM({app}-counter)``
+(reference ``app/run-sd.py:22-37,166-173``, ``sd21-scaledobject.yaml:13-24``;
+SURVEY.md §5 "metrics ARE the control plane").
+
+TPU-native equivalent: the same three signals, published two ways at once —
+
+- **Prometheus** (pull): a ``/metrics`` endpoint KEDA's prometheus trigger
+  scrapes (``deploy/scale/*.yaml`` use
+  ``sum(rate(shai_requests_total{app=...}))``).
+- **JSON lines** (push, cloud-agnostic): one line per request on stdout that a
+  log-router (CloudWatch EMF, GCP logging metric, fluentbit) turns into a
+  counter — preserving the reference's push-model for clusters without a
+  Prometheus stack.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Optional
+
+from .. import METRIC_NAMESPACE
+
+try:  # gated: available in the serving image; optional in minimal envs
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Histogram,
+        start_http_server,
+    )
+
+    _HAVE_PROM = True
+except Exception:  # pragma: no cover
+    _HAVE_PROM = False
+
+_LATENCY_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 0.9, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class MetricsPublisher:
+    """Publishes the request counter + latency signals for one serving pod."""
+
+    def __init__(
+        self,
+        app: str,
+        nodepool: str,
+        pod_name: str = "",
+        emit_json: bool = True,
+        registry: Optional["CollectorRegistry"] = None,
+        stream=None,
+    ):
+        self.app = app
+        self.nodepool = nodepool
+        self.pod_name = pod_name
+        self.emit_json = emit_json
+        self._stream = stream or sys.stdout
+        self._lock = threading.Lock()
+        self._served = 0
+        self.registry = None
+        if _HAVE_PROM:
+            self.registry = registry or CollectorRegistry()
+            self._prom_requests = Counter(
+                "shai_requests_total",
+                "Served requests (the KEDA scaling signal)",
+                ["app", "nodepool", "pod"],
+                registry=self.registry,
+            )
+            self._prom_latency = Histogram(
+                "shai_request_latency_seconds",
+                "Per-request latency",
+                ["app", "nodepool"],
+                buckets=_LATENCY_BUCKETS,
+                registry=self.registry,
+            )
+
+    @property
+    def served(self) -> int:
+        with self._lock:
+            return self._served
+
+    def publish(self, latency_s: float, count: int = 1) -> None:
+        """Record ``count`` served requests at ``latency_s`` seconds each."""
+        with self._lock:
+            self._served += count
+        if _HAVE_PROM and self.registry is not None:
+            self._prom_requests.labels(self.app, self.nodepool, self.pod_name).inc(count)
+            self._prom_latency.labels(self.app, self.nodepool).observe(latency_s)
+        if self.emit_json:
+            # shape mirrors the reference's three CloudWatch metrics
+            line = json.dumps(
+                {
+                    "ns": METRIC_NAMESPACE,
+                    "ts": round(time.time(), 3),
+                    f"{self.app}-counter": count,
+                    self.nodepool: count,
+                    f"{self.app}-latency": round(latency_s, 4),
+                    "pod": self.pod_name,
+                }
+            )
+            print(line, file=self._stream, flush=True)
+
+    def start_exporter(self, port: int) -> bool:
+        """Start the Prometheus scrape endpoint; returns False if unavailable."""
+        if not (_HAVE_PROM and self.registry is not None):
+            return False
+        start_http_server(port, registry=self.registry)
+        return True

@@ -1,0 +1,1 @@
+from .env import ServeConfig, env_str, env_int, env_float  # noqa: F401

@@ -1,0 +1,133 @@
+"""Environment-variable config contract for serving pods.
+
+The reference uses bare ``os.environ[...]`` reads scattered through every
+server (contract enumerated in SURVEY.md §2.2; e.g. reference
+``app/run-sd.py:15-23``, ``app/flux_model_api.py:33-36``,
+``app/run-llama.py:17``). Here the contract is one typed, validated dataclass
+shared by every server, so a deployment YAML's ``env:`` block is the single
+source of pod configuration exactly as in the reference — but with defaults,
+types, and a ``describe()`` for the self-describing ``GET /`` endpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v
+
+
+def env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+def env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return float(v)
+
+
+def env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+VALID_DEVICES = ("tpu", "cpu")
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Uniform pod configuration, set from a Deployment's ``env:`` block.
+
+    Field-for-field parity with the reference env contract (SURVEY.md §2.2),
+    minus the CUDA-only knobs; ``device`` accepts ``tpu`` or ``cpu`` (the
+    reference's ``xla|cuda|triton|cpu`` seam, with the TPU tier replacing the
+    accelerator branches).
+    """
+
+    # identity / control-plane
+    app: str = "model"
+    nodepool: str = "local"
+    pod_name: str = "local-pod"
+    # model selection
+    device: str = "tpu"
+    model_id: str = ""
+    compiled_model_id: str = ""          # artifact-store key for AOT artifacts
+    hf_token: str = ""
+    # task knobs
+    num_inference_steps: int = 25        # diffusion denoise steps
+    num_of_runs_inf: int = 2             # warmup/benchmark inference count
+    max_new_tokens: int = 128
+    max_seq_len: int = 512
+    height: int = 512
+    width: int = 512
+    guidance_scale: float = 7.5
+    batch_size: int = 1
+    # mesh / parallelism
+    mesh_spec: str = ""                  # e.g. "tp=4" or "dp=2,tp=4"; "" = single device
+    submesh: str = ""                    # e.g. "0:4" — device-slice placement
+    # serving
+    port: int = 8000
+    warmup: bool = True
+    metrics_port: int = 9100
+    # artifact store root (local dir, gs://..., or hf://repo)
+    artifact_root: str = "/tmp/shai-artifacts"
+    seed: int = 0
+
+    @classmethod
+    def from_env(cls) -> "ServeConfig":
+        cfg = cls(
+            app=env_str("APP", "model"),
+            nodepool=env_str("NODEPOOL", "local"),
+            pod_name=env_str("POD_NAME", os.uname().nodename),
+            device=env_str("DEVICE", "tpu"),
+            model_id=env_str("MODEL_ID", ""),
+            compiled_model_id=env_str("COMPILED_MODEL_ID", ""),
+            hf_token=env_str("HUGGINGFACE_TOKEN", ""),
+            num_inference_steps=env_int("NUM_INFERENCE_STEPS", 25),
+            num_of_runs_inf=env_int("NUM_OF_RUNS_INF", 2),
+            max_new_tokens=env_int("MAX_NEW_TOKENS", 128),
+            max_seq_len=env_int("MAX_SEQ_LEN", 512),
+            height=env_int("HEIGHT", 512),
+            width=env_int("WIDTH", 512),
+            guidance_scale=env_float("GUIDANCE_SCALE", 7.5),
+            batch_size=env_int("BATCH_SIZE", 1),
+            mesh_spec=env_str("MESH_SPEC", ""),
+            submesh=env_str("SUBMESH", ""),
+            port=env_int("PORT", 8000),
+            warmup=env_bool("WARMUP", True),
+            metrics_port=env_int("METRICS_PORT", 9100),
+            artifact_root=env_str("ARTIFACT_ROOT", "/tmp/shai-artifacts"),
+            seed=env_int("SEED", 0),
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if self.device not in VALID_DEVICES:
+            raise ValueError(
+                f"DEVICE={self.device!r} not supported; expected one of {VALID_DEVICES}"
+            )
+        if self.height % 8 or self.width % 8:
+            raise ValueError("HEIGHT and WIDTH must be multiples of 8")
+        if self.batch_size < 1:
+            raise ValueError("BATCH_SIZE must be >= 1")
+
+    def describe(self) -> Dict[str, Any]:
+        """Redacted config for the self-describing ``GET /`` endpoint."""
+        d = dataclasses.asdict(self)
+        if d.get("hf_token"):
+            d["hf_token"] = "***"
+        return d

@@ -1,0 +1,33 @@
+"""Test config: force an 8-device virtual CPU platform before JAX inits.
+
+Multi-chip sharding logic (TP/SP meshes, ring collectives) is tested on
+virtual CPU devices exactly as the driver's dryrun does — see SURVEY.md §4's
+"multi-host logic tests via JAX multi-process simulation on CPU devices".
+"""
+
+import os
+
+# The session env pins JAX_PLATFORMS to the TPU platform and sitecustomize
+# imports jax at interpreter start, so plain env vars are captured too early —
+# update the live jax config instead (before any backend is initialized).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,120 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_hw_agnostic_inference_tpu.core.mesh import (
+    MeshSpec,
+    build_mesh,
+    mesh_axis_sizes,
+    parse_submesh,
+    submesh,
+)
+from scalable_hw_agnostic_inference_tpu.core.bucketing import BucketRegistry, pow2_buckets
+from scalable_hw_agnostic_inference_tpu.core.aot import AotCache, aot_key
+from scalable_hw_agnostic_inference_tpu.core.device import resolve_device
+
+
+class TestMeshSpec:
+    def test_parse(self):
+        spec = MeshSpec.parse("tp=4,dp=2")
+        assert spec.axes == (("dp", 2), ("tp", 4))  # canonical order, tp innermost
+
+    def test_parse_empty(self):
+        assert MeshSpec.parse("").axes == ()
+
+    def test_wildcard(self):
+        spec = MeshSpec.parse("dp=-1,tp=4")
+        assert spec.resolve_sizes(8) == (("dp", 2), ("tp", 4))
+
+    def test_bad_axis(self):
+        with pytest.raises(ValueError):
+            MeshSpec.parse("zz=2")
+
+    def test_too_many_devices(self):
+        with pytest.raises(ValueError):
+            MeshSpec.parse("tp=16").resolve_sizes(8)
+
+    def test_build_mesh(self, devices):
+        mesh = build_mesh("dp=2,tp=4")
+        assert mesh_axis_sizes(mesh) == {"dp": 2, "tp": 4}
+
+    def test_trivial_mesh(self, devices):
+        mesh = build_mesh("")
+        assert mesh.devices.size == 1
+
+    def test_submesh(self, devices):
+        devs = submesh(4, 4)
+        assert len(devs) == 4
+        assert devs == list(jax.devices())[4:8]
+        with pytest.raises(ValueError):
+            submesh(6, 4)
+
+    def test_parse_submesh(self):
+        assert parse_submesh("0:4") == (0, 4)
+        assert parse_submesh("") is None
+        with pytest.raises(ValueError):
+            parse_submesh("4:4")
+
+
+class TestBucketing:
+    def test_pow2(self):
+        assert pow2_buckets(128, 1024) == [128, 256, 512, 1024]
+        assert pow2_buckets(100, 1000) == [128, 256, 512, 1000]
+
+    def test_bucket_for(self):
+        r = BucketRegistry([1024, 16384])
+        assert r.bucket_for(1) == 1024
+        assert r.bucket_for(1024) == 1024
+        assert r.bucket_for(1025) == 16384
+        with pytest.raises(ValueError):
+            r.bucket_for(20000)
+
+    def test_pad(self):
+        r = BucketRegistry([4, 8])
+        padded, b = r.pad_to_bucket([1, 2, 3], pad_value=0)
+        assert b == 4 and padded == [1, 2, 3, 0]
+
+    def test_warm(self):
+        r = BucketRegistry([4, 8, 16])
+        seen = []
+        assert r.warm(seen.append) == 3
+        assert seen == [4, 8, 16]
+
+
+class TestAot:
+    def test_key_stable_and_shape_sensitive(self):
+        x = jnp.ones((2, 4))
+        k1 = aot_key("f", [x])
+        k2 = aot_key("f", [jnp.ones((2, 4))])
+        k3 = aot_key("f", [jnp.ones((2, 8))])
+        assert k1 == k2 and k1 != k3
+
+    def test_export_load_roundtrip(self, tmp_path):
+        cache = AotCache(str(tmp_path))
+
+        def f(x):
+            return jnp.sin(x) * 2.0
+
+        x = jnp.linspace(0, 1, 16).reshape(4, 4)
+        key = cache.export("sinx2", f, [x])
+        assert key in cache.keys()
+        g = cache.load(key)
+        np.testing.assert_allclose(np.asarray(g(x)), np.sin(np.asarray(x)) * 2.0, rtol=1e-6)
+        # second export is a no-op (same key)
+        assert cache.export("sinx2", f, [x]) == key
+
+    def test_manifest_survives_reopen(self, tmp_path):
+        cache = AotCache(str(tmp_path))
+        key = cache.export("sq", lambda x: x * x, [jnp.ones((8,))])
+        cache2 = AotCache(str(tmp_path))
+        assert key in cache2.keys()
+        g = cache2.load(key)
+        np.testing.assert_allclose(np.asarray(g(jnp.full((8,), 3.0))), np.full((8,), 9.0))
+
+
+def test_resolve_device_cpu():
+    assert resolve_device("cpu") == "cpu"
+    with pytest.raises(ValueError):
+        resolve_device("cuda")

@@ -1,0 +1,97 @@
+import io
+import json
+
+import pytest
+
+from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+from scalable_hw_agnostic_inference_tpu.serve.latency import (
+    LatencyCollector,
+    run_benchmark,
+)
+from scalable_hw_agnostic_inference_tpu.serve.metrics import MetricsPublisher
+
+
+class TestServeConfig:
+    def test_defaults(self):
+        cfg = ServeConfig()
+        assert cfg.device == "tpu"
+        assert cfg.port == 8000
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("APP", "sd21")
+        monkeypatch.setenv("NODEPOOL", "tpu-v5e")
+        monkeypatch.setenv("DEVICE", "cpu")
+        monkeypatch.setenv("HEIGHT", "768")
+        monkeypatch.setenv("GUIDANCE_SCALE", "5.0")
+        cfg = ServeConfig.from_env()
+        assert cfg.app == "sd21"
+        assert cfg.nodepool == "tpu-v5e"
+        assert cfg.device == "cpu"
+        assert cfg.height == 768
+        assert cfg.guidance_scale == 5.0
+
+    def test_bad_device_rejected(self, monkeypatch):
+        monkeypatch.setenv("DEVICE", "cuda")
+        with pytest.raises(ValueError):
+            ServeConfig.from_env()
+
+    def test_describe_redacts_token(self):
+        cfg = ServeConfig(hf_token="secret")
+        assert cfg.describe()["hf_token"] == "***"
+
+
+class TestLatencyCollector:
+    def test_percentiles(self):
+        c = LatencyCollector()
+        for v in range(1, 101):
+            c.record(v / 100.0)
+        assert c.count == 100
+        assert c.percentile(0) == pytest.approx(0.01)
+        assert c.percentile(100) == pytest.approx(1.0)
+        assert c.percentile(50) == pytest.approx(0.505, abs=0.01)
+        rep = c.report()
+        assert set(rep) == {"p0", "p50", "p90", "p95", "p99", "p100"}
+        assert rep["p90"] <= rep["p95"] <= rep["p99"]
+
+    def test_empty(self):
+        c = LatencyCollector()
+        assert c.percentile(50) == 0.0
+
+    def test_reservoir_bound(self):
+        c = LatencyCollector(max_samples=10)
+        for v in range(1000):
+            c.record(float(v))
+        assert c.count == 1000
+        assert len(c._samples) == 10
+
+    def test_benchmark(self):
+        calls = []
+        rep = run_benchmark(lambda: calls.append(1), n_runs=5)
+        assert rep.n_runs == 5 and len(calls) == 5
+        assert rep.throughput_rps > 0
+        d = rep.to_dict()
+        assert "p50" in d and d["n_runs"] == 5
+
+
+class TestMetrics:
+    def test_publish_json_lines(self):
+        buf = io.StringIO()
+        pub = MetricsPublisher("sd21", "tpu-v5e", pod_name="p0", stream=buf)
+        pub.publish(0.25)
+        pub.publish(0.5, count=3)
+        assert pub.served == 4
+        lines = [json.loads(l) for l in buf.getvalue().strip().splitlines()]
+        assert lines[0]["sd21-counter"] == 1
+        assert lines[0]["tpu-v5e"] == 1
+        assert lines[1]["sd21-counter"] == 3
+        assert lines[0]["ns"] == "hw-agnostic-infer"
+
+    def test_prometheus_counter(self):
+        pub = MetricsPublisher("sd21", "np", emit_json=False)
+        pub.publish(0.1)
+        if pub.registry is not None:
+            val = pub.registry.get_sample_value(
+                "shai_requests_total",
+                {"app": "sd21", "nodepool": "np", "pod": ""},
+            )
+            assert val == 1.0
