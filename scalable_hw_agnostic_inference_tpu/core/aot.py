@@ -80,6 +80,9 @@ class AotCache:
         os.makedirs(root, exist_ok=True)
         self._manifest_path = os.path.join(root, MANIFEST)
         self._manifest: Dict[str, Dict] = {}
+        # freshly-exported callables, so get_or_export need not re-deserialize
+        # and re-compile what was just traced (the cold-start path)
+        self._live: Dict[str, Callable] = {}
         if os.path.exists(self._manifest_path):
             try:
                 with open(self._manifest_path) as f:
@@ -126,6 +129,7 @@ class AotCache:
             return key
         jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
         exported = jexport.export(jitted)(*args)
+        self._live[key] = exported.call
         data = exported.serialize()
         with open(path, "wb") as f:
             f.write(data)
@@ -154,4 +158,5 @@ class AotCache:
 
     def get_or_export(self, name: str, fn: Callable, args: Sequence, mesh=None, extra: str = ""):
         key = self.export(name, fn, args, mesh=mesh, extra=extra)
-        return self.load(key)
+        live = self._live.get(key)
+        return live if live is not None else self.load(key)

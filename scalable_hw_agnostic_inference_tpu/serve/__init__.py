@@ -1,2 +1,5 @@
 from .latency import LatencyCollector, BenchmarkReport  # noqa: F401
 from .metrics import MetricsPublisher  # noqa: F401
+from .asgi import App, Request, Response, HTTPError  # noqa: F401
+from .app import ModelService, create_app, serve_forever  # noqa: F401
+from .httpd import Server  # noqa: F401

@@ -1,0 +1,237 @@
+"""The single serving runtime: one app factory for every model.
+
+The reference copy-pastes ~200-line FastAPI servers per model
+(``run-{sd,bert,vit,llama,yolo}.py``, ``*_model_api.py``; SURVEY.md §2.2).
+Here the shared surface lives once, and a model contributes only a
+:class:`ModelService` (load + warmup + infer + extra routes).
+
+Uniform HTTP surface (reference parity, ``app/run-sd.py:148-203``):
+
+- ``GET  /``                      self-describing config (redacted)
+- ``GET  /health``                liveness
+- ``GET  /readiness``             readiness — 503 until loaded + warm
+- ``POST /benchmark``             ``{"n_runs": N}`` → percentile report
+- ``GET  /load/{n}/infer/{m}``    benchmark + metric publication
+- ``GET  /metrics``               Prometheus text (the KEDA signal)
+- task routes from the service (``/genimage``, ``/generate``, ``/predict``…)
+
+Model calls run on a single-worker executor so the event loop keeps serving
+probes while a denoise loop holds the chip; device access is serialized,
+matching one-model-per-pod semantics (one deployment unit == one model
+replica, reference ``README.md:158-159``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.env import ServeConfig
+from .asgi import App, HTTPError, Request, Response
+from .latency import LatencyCollector, run_benchmark
+from .metrics import MetricsPublisher
+
+log = logging.getLogger(__name__)
+
+
+class ModelService:
+    """One model behind the uniform runtime. Subclasses implement the hooks.
+
+    Lifecycle: ``load()`` (build params + jitted fns, pull artifacts) →
+    ``warmup()`` (one synthetic inference per compiled shape, the readiness
+    gate; reference ``app/run-sd.py:144-146``) → ``infer(payload)`` per
+    request.
+    """
+
+    #: task name for the self-describing root endpoint
+    task: str = "generic"
+    #: route the default POST handler mounts at
+    infer_route: str = "/infer"
+
+    def __init__(self, cfg: ServeConfig):
+        self.cfg = cfg
+
+    def load(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def warmup(self) -> None:
+        """One synthetic end-to-end inference; override for model specifics."""
+        self.infer(self.example_payload())
+
+    def example_payload(self) -> Dict[str, Any]:
+        """Payload used by warmup and the benchmark endpoints."""
+        return {}
+
+    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:  # pragma: no cover
+        raise NotImplementedError
+
+    def extra_routes(self) -> List[Tuple[str, Tuple[str, ...], Callable]]:
+        """Additional (pattern, methods, handler(request)) routes."""
+        return []
+
+
+def create_app(
+    cfg: ServeConfig,
+    service: ModelService,
+    publisher: Optional[MetricsPublisher] = None,
+) -> App:
+    app = App(title=cfg.app)
+    collector = LatencyCollector()
+    pub = publisher or MetricsPublisher(cfg.app, cfg.nodepool, cfg.pod_name)
+    state = {"loaded": False, "warm": False, "load_error": None}
+    # single lane to the accelerator: model calls are serialized, probes are not
+    lane = concurrent.futures.ThreadPoolExecutor(max_workers=1, thread_name_prefix="model")
+
+    app.state.update(cfg=cfg, service=service, collector=collector, publisher=pub,
+                     status=state)
+
+    def _do_load_and_warm():
+        t0 = time.perf_counter()
+        try:
+            service.load()
+            state["loaded"] = True
+            log.info("%s: model loaded in %.1fs", cfg.app, time.perf_counter() - t0)
+            if cfg.warmup:
+                t1 = time.perf_counter()
+                service.warmup()
+                log.info("%s: warmup done in %.1fs", cfg.app, time.perf_counter() - t1)
+            state["warm"] = True
+        except Exception as e:
+            # pod stays alive but never ready — the reference's fail-fast
+            # startup self-test semantics (SURVEY.md §4.1) without a crash loop
+            state["load_error"] = f"{type(e).__name__}: {e}"
+            log.exception("%s: startup failed", cfg.app)
+
+    @app.startup
+    def _kick_off_load():
+        # Loading runs on the model lane, NOT the event loop: the listen
+        # socket binds immediately and /health + /readiness answer during the
+        # multi-minute cold compile (/readiness returns 503 "loading").
+        state["load_future"] = lane.submit(_do_load_and_warm)
+
+    async def _run_model(fn: Callable, *args):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(lane, fn, *args)
+
+    def _require_ready():
+        if state["load_error"]:
+            raise HTTPError(500, f"model failed to load: {state['load_error']}")
+        if not (state["loaded"] and state["warm"]):
+            raise HTTPError(503, "model not ready")
+
+    # -- uniform surface ---------------------------------------------------
+    @app.get("/")
+    def root(request: Request):
+        return {
+            "app": cfg.app,
+            "task": service.task,
+            "model_id": cfg.model_id,
+            "device": cfg.device,
+            "endpoints": sorted({r.pattern for r in app.routes}),
+            "config": cfg.describe(),
+            "served": pub.served,
+        }
+
+    @app.get("/health")
+    def health(request: Request):
+        return {"status": "ok"}
+
+    @app.get("/readiness")
+    def readiness(request: Request):
+        if state["load_error"]:
+            return Response({"status": "failed", "error": state["load_error"]}, status=500)
+        if state["loaded"] and state["warm"]:
+            return {"status": "ready"}
+        return Response({"status": "loading"}, status=503)
+
+    @app.post(service.infer_route)
+    async def task_infer(request: Request):
+        _require_ready()
+        payload = request.json()
+        t0 = time.perf_counter()
+        out = await _run_model(service.infer, payload)
+        dt = time.perf_counter() - t0
+        collector.record(dt)
+        pub.publish(dt)
+        if isinstance(out, dict):
+            out.setdefault("latency_s", round(dt, 4))
+        return out
+
+    @app.post("/benchmark")
+    async def benchmark(request: Request):
+        _require_ready()
+        payload = request.json()
+        n_runs = int(payload.get("n_runs", cfg.num_of_runs_inf))
+        if n_runs < 1 or n_runs > 10_000:
+            raise HTTPError(400, "n_runs must be in [1, 10000]")
+        example = payload.get("payload") or service.example_payload()
+        report = await _run_model(
+            lambda: run_benchmark(lambda: service.infer(example), n_runs, collector)
+        )
+        return {"app": cfg.app, "report": report.to_dict()}
+
+    @app.get("/load/{n_runs:int}/infer/{n_inf:int}")
+    async def load_infer(request: Request, n_runs: int, n_inf: int):
+        """Reference parity: N benchmark rounds of M inferences each, with
+        metric publication per round (reference ``app/run-sd.py:157-175``)."""
+        _require_ready()
+        if n_runs < 1 or n_inf < 1 or n_runs * n_inf > 100_000:
+            raise HTTPError(400, "bad load shape")
+        example = service.example_payload()
+        reports = []
+
+        def _one_round():
+            # per-sample publication keeps the request counter and the
+            # latency histogram in lockstep (1 observation per inference)
+            return run_benchmark(
+                lambda: service.infer(example), n_inf, collector, on_sample=pub.publish
+            )
+
+        for _ in range(n_runs):
+            rep = await _run_model(_one_round)
+            reports.append(rep.to_dict())
+        return {"app": cfg.app, "rounds": reports, "served_total": pub.served}
+
+    @app.get("/metrics")
+    def metrics(request: Request):
+        if pub.registry is None:
+            raise HTTPError(404, "prometheus_client not available")
+        from prometheus_client import generate_latest
+
+        return Response(generate_latest(pub.registry), media_type="text/plain; version=0.0.4")
+
+    @app.get("/stats")
+    def stats(request: Request):
+        return {
+            "served": pub.served,
+            "latency": collector.report(),
+            "count": collector.count,
+        }
+
+    # -- model-specific routes --------------------------------------------
+    for pattern, methods, handler in service.extra_routes():
+        def _wrap(h):
+            async def _handler(request: Request, **params):
+                _require_ready()
+                t0 = time.perf_counter()
+                out = await _run_model(lambda: h(request, **params))
+                dt = time.perf_counter() - t0
+                collector.record(dt)
+                pub.publish(dt)
+                return out
+            return _handler
+        app.route(pattern, tuple(methods))(_wrap(handler))
+
+    return app
+
+
+def serve_forever(cfg: ServeConfig, service: ModelService) -> None:
+    """Pod entrypoint: build the app, start the metrics exporter, serve."""
+    from .httpd import Server
+
+    pub = MetricsPublisher(cfg.app, cfg.nodepool, cfg.pod_name)
+    app = create_app(cfg, service, publisher=pub)
+    Server(app, port=cfg.port).run()

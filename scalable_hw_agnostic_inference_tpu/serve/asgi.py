@@ -1,0 +1,249 @@
+"""Minimal ASGI micro-framework — the serving runtime's HTTP substrate.
+
+The reference serves every model through FastAPI+uvicorn installed at pod
+start (reference ``app/run-sd.sh:3-14``, ``app/run-sd.py:148-151``). This
+framework ships its own substrate instead: a dependency-free ASGI-3 router
+(this module) plus a stdlib asyncio HTTP server (``serve.httpd``). Apps built
+here are standard ASGI apps, so they also run under any external ASGI server
+and are unit-testable in-process via ``httpx.ASGITransport``.
+
+Route patterns support ``{name}`` (string) and ``{name:int}`` segments, e.g.
+the reference's benchmark surface ``GET /load/{n_runs}/infer/{n_inf}``
+(reference ``app/run-sd.py:157-175``).
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import logging
+import re
+import traceback
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qsl
+
+log = logging.getLogger(__name__)
+
+
+class HTTPError(Exception):
+    """Raise inside a handler to return a non-200 JSON error."""
+
+    def __init__(self, status: int, detail: str):
+        super().__init__(detail)
+        self.status = status
+        self.detail = detail
+
+
+class Request:
+    """One HTTP request as seen by a handler."""
+
+    def __init__(self, scope: Dict, body: bytes):
+        self.method: str = scope["method"].upper()
+        self.path: str = scope["path"]
+        self.headers: Dict[str, str] = {
+            k.decode("latin-1").lower(): v.decode("latin-1")
+            for k, v in scope.get("headers", [])
+        }
+        self.query: Dict[str, str] = dict(
+            parse_qsl(scope.get("query_string", b"").decode("latin-1"))
+        )
+        self.path_params: Dict[str, Any] = {}
+        self.body: bytes = body
+
+    def json(self) -> Any:
+        if not self.body:
+            return {}
+        try:
+            return json.loads(self.body)
+        except json.JSONDecodeError as e:
+            raise HTTPError(400, f"invalid JSON body: {e}") from e
+
+
+class Response:
+    def __init__(
+        self,
+        content: Any = None,
+        status: int = 200,
+        media_type: str = "application/json",
+        headers: Optional[Dict[str, str]] = None,
+    ):
+        self.status = status
+        self.headers = dict(headers or {})
+        if isinstance(content, (bytes, bytearray)):
+            self.body = bytes(content)
+            self.headers.setdefault("content-type", media_type)
+        elif isinstance(content, str):
+            self.body = content.encode()
+            self.headers.setdefault(
+                "content-type",
+                media_type if media_type != "application/json" else "text/plain; charset=utf-8",
+            )
+        else:
+            self.body = json.dumps(content).encode()
+            self.headers.setdefault("content-type", "application/json")
+        self.headers.setdefault("content-length", str(len(self.body)))
+
+
+_SEGMENT = re.compile(r"\{(\w+)(?::(int|float|path))?\}")
+_CASTS = {"int": int, "float": float, None: str, "path": str}
+
+
+def _compile_pattern(pattern: str) -> Tuple[re.Pattern, Dict[str, Callable]]:
+    casts: Dict[str, Callable] = {}
+    out = []
+    last = 0
+    for m in _SEGMENT.finditer(pattern):
+        out.append(re.escape(pattern[last : m.start()]))
+        name, kind = m.group(1), m.group(2)
+        casts[name] = _CASTS[kind]
+        out.append(f"(?P<{name}>{'.+' if kind == 'path' else '[^/]+'})")
+        last = m.end()
+    out.append(re.escape(pattern[last:]))
+    return re.compile("^" + "".join(out) + "$"), casts
+
+
+class Route:
+    def __init__(self, method: str, pattern: str, handler: Callable):
+        self.method = method.upper()
+        self.pattern = pattern
+        self.regex, self.casts = _compile_pattern(pattern)
+        self.handler = handler
+
+    def match_path(self, path: str) -> Optional[Dict[str, Any]]:
+        """Params dict when path + casts match, else None (method-agnostic)."""
+        m = self.regex.match(path)
+        if not m:
+            return None
+        params: Dict[str, Any] = {}
+        for k, v in m.groupdict().items():
+            try:
+                params[k] = self.casts[k](v)
+            except ValueError:
+                return None
+        return params
+
+
+class App:
+    """ASGI-3 application with decorator routing and startup hooks."""
+
+    def __init__(self, title: str = "shai-tpu"):
+        self.title = title
+        self.routes: List[Route] = []
+        self.on_startup: List[Callable[[], Any]] = []
+        self.on_shutdown: List[Callable[[], Any]] = []
+        self.state: Dict[str, Any] = {}
+        self._started = False
+
+    # -- registration ------------------------------------------------------
+    def route(self, pattern: str, methods: Tuple[str, ...] = ("GET",)):
+        def deco(fn):
+            for m in methods:
+                self.routes.append(Route(m, pattern, fn))
+            return fn
+
+        return deco
+
+    def get(self, pattern: str):
+        return self.route(pattern, ("GET",))
+
+    def post(self, pattern: str):
+        return self.route(pattern, ("POST",))
+
+    def startup(self, fn):
+        self.on_startup.append(fn)
+        return fn
+
+    def shutdown(self, fn):
+        self.on_shutdown.append(fn)
+        return fn
+
+    # -- lifecycle ---------------------------------------------------------
+    async def _run_startup(self):
+        if self._started:
+            return
+        self._started = True
+        for fn in self.on_startup:
+            r = fn()
+            if inspect.isawaitable(r):
+                await r
+
+    async def _run_shutdown(self):
+        for fn in self.on_shutdown:
+            r = fn()
+            if inspect.isawaitable(r):
+                await r
+
+    # -- dispatch ----------------------------------------------------------
+    async def _dispatch(self, request: Request) -> Response:
+        allowed: List[str] = []
+        for route in self.routes:
+            params = route.match_path(request.path)
+            if params is None:
+                continue
+            if request.method != route.method:
+                allowed.append(route.method)
+                continue
+            request.path_params = params
+            result = route.handler(request, **params)
+            if inspect.isawaitable(result):
+                result = await result
+            if isinstance(result, Response):
+                return result
+            return Response(result)
+        if allowed:
+            return Response({"detail": "method not allowed"}, status=405)
+        return Response({"detail": f"not found: {request.path}"}, status=404)
+
+    async def __call__(self, scope: Dict, receive: Callable[[], Awaitable], send: Callable):
+        if scope["type"] == "lifespan":
+            while True:
+                message = await receive()
+                if message["type"] == "lifespan.startup":
+                    try:
+                        await self._run_startup()
+                        await send({"type": "lifespan.startup.complete"})
+                    except Exception as e:  # pragma: no cover
+                        await send({"type": "lifespan.startup.failed", "message": str(e)})
+                elif message["type"] == "lifespan.shutdown":
+                    await self._run_shutdown()
+                    await send({"type": "lifespan.shutdown.complete"})
+                    return
+            return
+        if scope["type"] != "http":  # pragma: no cover
+            raise RuntimeError(f"unsupported scope type {scope['type']}")
+
+        # Serving under httpx.ASGITransport (tests) never sends lifespan —
+        # run startup lazily so in-process apps behave like served ones.
+        await self._run_startup()
+
+        body = b""
+        while True:
+            message = await receive()
+            if message["type"] == "http.request":
+                body += message.get("body", b"")
+                if not message.get("more_body"):
+                    break
+            elif message["type"] == "http.disconnect":  # pragma: no cover
+                return
+
+        request = Request(scope, body)
+        try:
+            response = await self._dispatch(request)
+        except HTTPError as e:
+            response = Response({"detail": e.detail}, status=e.status)
+        except Exception:
+            log.error("handler error on %s %s\n%s", request.method, request.path,
+                      traceback.format_exc())
+            response = Response({"detail": "internal server error"}, status=500)
+
+        await send(
+            {
+                "type": "http.response.start",
+                "status": response.status,
+                "headers": [
+                    (k.encode("latin-1"), v.encode("latin-1"))
+                    for k, v in response.headers.items()
+                ],
+            }
+        )
+        await send({"type": "http.response.body", "body": response.body})

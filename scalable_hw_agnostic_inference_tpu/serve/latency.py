@@ -105,9 +105,12 @@ def run_benchmark(
     fn: Callable[[], object],
     n_runs: int,
     collector: Optional[LatencyCollector] = None,
+    on_sample: Optional[Callable[[float], None]] = None,
 ) -> BenchmarkReport:
     """Call ``fn`` ``n_runs`` times, measuring per-call latency.
 
+    ``on_sample`` receives each individual latency (the serving runtime feeds
+    the metrics publisher with it so counters and histograms stay in lockstep).
     The serving runtime exposes this via ``POST /benchmark`` and
     ``GET /load/{n}/infer/{m}``, matching the reference's built-in
     measurement instrument (reference ``app/run-sd.py:157-175``).
@@ -121,6 +124,8 @@ def run_benchmark(
         local.record(dt)
         if collector is not None:
             collector.record(dt)
+        if on_sample is not None:
+            on_sample(dt)
     total = time.perf_counter() - t0
     return BenchmarkReport(
         n_runs=n_runs,

@@ -93,15 +93,18 @@ class MetricsPublisher:
             self._prom_requests.labels(self.app, self.nodepool, self.pod_name).inc(count)
             self._prom_latency.labels(self.app, self.nodepool).observe(latency_s)
         if self.emit_json:
-            # shape mirrors the reference's three CloudWatch metrics
+            # fixed metadata outside, the reference's three dynamically-named
+            # CloudWatch metrics inside "data" (setdefault so a pathological
+            # NODEPOOL equal to "{app}-counter" can't silently drop a signal)
+            data = {f"{self.app}-counter": count}
+            data.setdefault(self.nodepool, count)
+            data[f"{self.app}-latency"] = round(latency_s, 4)
             line = json.dumps(
                 {
                     "ns": METRIC_NAMESPACE,
                     "ts": round(time.time(), 3),
-                    f"{self.app}-counter": count,
-                    self.nodepool: count,
-                    f"{self.app}-latency": round(latency_s, 4),
                     "pod": self.pod_name,
+                    "data": data,
                 }
             )
             print(line, file=self._stream, flush=True)

@@ -26,6 +26,23 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: test runs under asyncio.run")
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests via asyncio.run (pytest-asyncio isn't baked in)."""
+    import inspect
+    import asyncio
+
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {k: pyfuncitem.funcargs[k] for k in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

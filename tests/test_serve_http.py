@@ -1,0 +1,316 @@
+"""Serving runtime tests: ASGI router, HTTP server, and the app factory.
+
+In-process tests use ``httpx.ASGITransport`` (no sockets); one test boots the
+real asyncio HTTP server on a loopback socket to cover the wire path the pods
+actually use.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import httpx
+import pytest
+
+from scalable_hw_agnostic_inference_tpu.serve.asgi import App, HTTPError, Response
+from scalable_hw_agnostic_inference_tpu.serve.app import ModelService, create_app
+from scalable_hw_agnostic_inference_tpu.serve.httpd import Server
+from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+
+def make_client(app) -> httpx.AsyncClient:
+    return httpx.AsyncClient(transport=httpx.ASGITransport(app=app), base_url="http://test")
+
+
+async def wait_ready(c: httpx.AsyncClient, timeout: float = 10.0) -> httpx.Response:
+    """Poll /readiness until it leaves the 503 'loading' state."""
+    deadline = time.time() + timeout
+    while True:
+        r = await c.get("/readiness")
+        if r.status_code != 503 or time.time() > deadline:
+            return r
+        await asyncio.sleep(0.02)
+
+
+def wait_ready_sync(c: httpx.Client, timeout: float = 10.0) -> httpx.Response:
+    deadline = time.time() + timeout
+    while True:
+        r = c.get("/readiness")
+        if r.status_code != 503 or time.time() > deadline:
+            return r
+        time.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# asgi router
+# ---------------------------------------------------------------------------
+
+def build_router_app():
+    app = App("t")
+
+    @app.get("/hello/{name}")
+    def hello(request, name):
+        return {"hello": name}
+
+    @app.get("/sum/{a:int}/{b:int}")
+    def sum_(request, a, b):
+        return {"sum": a + b}
+
+    @app.post("/echo")
+    def echo(request):
+        return {"got": request.json(), "q": request.query}
+
+    @app.get("/boom")
+    def boom(request):
+        raise HTTPError(418, "teapot")
+
+    @app.get("/crash")
+    def crash(request):
+        raise RuntimeError("internal")
+
+    @app.get("/text")
+    def text(request):
+        return Response("plain text", media_type="text/plain")
+
+    return app
+
+
+@pytest.mark.asyncio
+async def test_router_paths_and_casts():
+    async with make_client(build_router_app()) as c:
+        r = await c.get("/hello/world")
+        assert r.status_code == 200 and r.json() == {"hello": "world"}
+        r = await c.get("/sum/3/4")
+        assert r.json() == {"sum": 7}
+        # non-int segment -> 404 (cast fails)
+        r = await c.get("/sum/x/4")
+        assert r.status_code == 404
+
+
+@pytest.mark.asyncio
+async def test_router_json_query_errors():
+    async with make_client(build_router_app()) as c:
+        r = await c.post("/echo?k=v", json={"a": 1})
+        assert r.json() == {"got": {"a": 1}, "q": {"k": "v"}}
+        r = await c.post("/echo", content=b"{bad json")
+        assert r.status_code == 400
+        r = await c.get("/boom")
+        assert r.status_code == 418 and r.json()["detail"] == "teapot"
+        r = await c.get("/crash")
+        assert r.status_code == 500
+        r = await c.get("/nope")
+        assert r.status_code == 404
+        # wrong method on a known path -> 405
+        r = await c.get("/echo")
+        assert r.status_code == 405
+        r = await c.get("/text")
+        assert r.text == "plain text"
+
+
+# ---------------------------------------------------------------------------
+# app factory with a fake model service
+# ---------------------------------------------------------------------------
+
+class EchoService(ModelService):
+    task = "echo"
+    infer_route = "/predict"
+
+    def __init__(self, cfg, load_delay=0.0, fail=False):
+        super().__init__(cfg)
+        self.load_delay = load_delay
+        self.fail = fail
+        self.loaded = False
+        self.warmups = 0
+
+    def load(self):
+        time.sleep(self.load_delay)
+        if self.fail:
+            raise RuntimeError("artifact missing")
+        self.loaded = True
+
+    def warmup(self):
+        self.warmups += 1
+        self.infer(self.example_payload())
+
+    def example_payload(self):
+        return {"text": "warmup"}
+
+    def infer(self, payload):
+        return {"echo": payload.get("text", "")}
+
+    def extra_routes(self):
+        def sentiment(request):
+            return {"label": "POSITIVE"}
+
+        return [("/sentiment", ("POST",), sentiment)]
+
+
+def make_cfg(**kw) -> ServeConfig:
+    base = dict(app="echo", nodepool="test-pool", pod_name="pod-0", device="cpu",
+                warmup=True)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+@pytest.mark.asyncio
+async def test_app_lifecycle_and_infer():
+    cfg = make_cfg()
+    svc = EchoService(cfg)
+    app = create_app(cfg, svc)
+    async with make_client(app) as c:
+        r = await wait_ready(c)
+        assert r.status_code == 200 and r.json() == {"status": "ready"}
+        assert svc.loaded and svc.warmups == 1
+
+        r = await c.get("/")
+        body = r.json()
+        assert body["app"] == "echo" and body["task"] == "echo"
+        assert "/predict" in body["endpoints"]
+
+        r = await c.get("/health")
+        assert r.json() == {"status": "ok"}
+
+        r = await c.post("/predict", json={"text": "hi"})
+        assert r.json()["echo"] == "hi"
+        assert "latency_s" in r.json()
+
+        r = await c.post("/sentiment", json={})
+        assert r.json() == {"label": "POSITIVE"}
+
+
+@pytest.mark.asyncio
+async def test_app_benchmark_and_load_endpoints():
+    cfg = make_cfg()
+    app = create_app(cfg, EchoService(cfg))
+    async with make_client(app) as c:
+        await wait_ready(c)
+        r = await c.post("/benchmark", json={"n_runs": 5})
+        rep = r.json()["report"]
+        assert rep["n_runs"] == 5 and rep["throughput_rps"] > 0
+        assert "p50" in rep
+
+        r = await c.get("/load/2/infer/3")
+        body = r.json()
+        assert len(body["rounds"]) == 2
+        assert body["served_total"] >= 6
+
+        r = await c.get("/load/0/infer/3")
+        assert r.status_code == 400
+
+        r = await c.get("/stats")
+        assert r.json()["served"] >= 6
+
+
+@pytest.mark.asyncio
+async def test_app_failed_load_reports_not_ready():
+    cfg = make_cfg()
+    app = create_app(cfg, EchoService(cfg, fail=True))
+    async with make_client(app) as c:
+        r = await wait_ready(c)
+        assert r.status_code == 500
+        assert "artifact missing" in r.json()["error"]
+        r = await c.post("/predict", json={})
+        assert r.status_code == 500
+        # liveness stays green: the pod is not crash-looping
+        r = await c.get("/health")
+        assert r.status_code == 200
+
+
+@pytest.mark.asyncio
+async def test_metrics_endpoint_prometheus():
+    pytest.importorskip("prometheus_client")
+    cfg = make_cfg()
+    app = create_app(cfg, EchoService(cfg))
+    async with make_client(app) as c:
+        await wait_ready(c)
+        await c.post("/predict", json={"text": "x"})
+        r = await c.get("/metrics")
+        assert r.status_code == 200
+        assert "shai_requests_total" in r.text
+        assert 'app="echo"' in r.text
+
+
+# ---------------------------------------------------------------------------
+# real socket server
+# ---------------------------------------------------------------------------
+
+def test_probes_answer_during_slow_load():
+    """Socket binds and /health + /readiness answer while load() is running."""
+    cfg = make_cfg()
+    svc = EchoService(cfg, load_delay=1.0)
+    app = create_app(cfg, svc)
+    server = Server(app, host="127.0.0.1", port=0)
+    t0 = time.perf_counter()
+    host, port = server.start_background()
+    bind_dt = time.perf_counter() - t0
+    try:
+        assert bind_dt < 0.9, f"socket bind waited for model load: {bind_dt:.2f}s"
+        with httpx.Client(base_url=f"http://{host}:{port}", timeout=10) as c:
+            r = c.get("/health")
+            assert r.status_code == 200
+            r = c.get("/readiness")
+            assert r.status_code == 503 and r.json() == {"status": "loading"}
+            assert wait_ready_sync(c).status_code == 200
+    finally:
+        server.stop()
+
+
+def test_httpd_over_real_socket():
+    cfg = make_cfg()
+    app = create_app(cfg, EchoService(cfg))
+    server = Server(app, host="127.0.0.1", port=0)
+    host, port = server.start_background()
+    try:
+        base = f"http://{host}:{port}"
+        with httpx.Client(base_url=base, timeout=10) as c:
+            r = wait_ready_sync(c)
+            assert r.status_code == 200
+            # keep-alive: several requests on one client
+            for i in range(3):
+                r = c.post("/predict", json={"text": f"msg{i}"})
+                assert r.json()["echo"] == f"msg{i}"
+            r = c.get("/load/1/infer/2")
+            assert len(r.json()["rounds"]) == 1
+            # concurrent probes while a model call runs
+            r = c.get("/health")
+            assert r.status_code == 200
+    finally:
+        server.stop()
+
+
+def test_httpd_parallel_probes_during_inference():
+    """Health probes answer while the single model lane is busy."""
+    cfg = make_cfg()
+
+    class SlowService(EchoService):
+        def infer(self, payload):
+            time.sleep(0.5)
+            return {"echo": "slow"}
+
+    app = create_app(cfg, SlowService(cfg, load_delay=0))
+    server = Server(app, host="127.0.0.1", port=0)
+    host, port = server.start_background()
+    try:
+        base = f"http://{host}:{port}"
+        with httpx.Client(base_url=base, timeout=10) as warm:
+            assert wait_ready_sync(warm).status_code == 200
+
+        results = {}
+
+        def do_infer():
+            with httpx.Client(base_url=base, timeout=10) as c:
+                results["infer"] = c.post("/predict", json={}).status_code
+
+        t = threading.Thread(target=do_infer)
+        t.start()
+        time.sleep(0.1)  # inference is now holding the model lane
+        t0 = time.perf_counter()
+        with httpx.Client(base_url=base, timeout=10) as c:
+            assert c.get("/health").status_code == 200
+        probe_dt = time.perf_counter() - t0
+        t.join()
+        assert results["infer"] == 200
+        assert probe_dt < 0.4, f"probe blocked behind inference: {probe_dt:.3f}s"
+    finally:
+        server.stop()

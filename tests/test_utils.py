@@ -81,10 +81,11 @@ class TestMetrics:
         pub.publish(0.5, count=3)
         assert pub.served == 4
         lines = [json.loads(l) for l in buf.getvalue().strip().splitlines()]
-        assert lines[0]["sd21-counter"] == 1
-        assert lines[0]["tpu-v5e"] == 1
-        assert lines[1]["sd21-counter"] == 3
+        assert lines[0]["data"]["sd21-counter"] == 1
+        assert lines[0]["data"]["tpu-v5e"] == 1
+        assert lines[1]["data"]["sd21-counter"] == 3
         assert lines[0]["ns"] == "hw-agnostic-infer"
+        assert lines[0]["pod"] == "p0"
 
     def test_prometheus_counter(self):
         pub = MetricsPublisher("sd21", "np", emit_json=False)
