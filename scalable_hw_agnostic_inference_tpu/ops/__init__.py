@@ -1,0 +1,11 @@
+"""Compute ops: attention, rotary embeddings, sampling, Pallas TPU kernels.
+
+Array convention for attention-family ops is ``[batch, seq, heads, head_dim]``
+(the flax layout). The shard_map-level sequence-parallel ops in
+``parallel.ring`` use ``[batch, heads, seq, head_dim]`` — transpose at the
+boundary.
+"""
+
+from .attention import dot_product_attention  # noqa: F401
+from .rope import rope_angles, apply_rope  # noqa: F401
+from .sampling import sample_logits, greedy  # noqa: F401

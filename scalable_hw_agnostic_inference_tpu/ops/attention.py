@@ -1,0 +1,101 @@
+"""Multi-head attention with GQA, masking, and implementation dispatch.
+
+The single attention entry point for the model zoo. On TPU the hot path is
+the Pallas flash-attention kernel (``ops.pallas.flash_attention``); elsewhere
+(CPU tier, tiny shapes, or shapes the kernel doesn't cover) it falls back to
+a fused XLA softmax-attention with fp32 accumulation. The reference gets this
+op from vendored runtimes (neuronx-cc fused softmax via ``NEURON_FUSE_SOFTMAX=1``,
+reference ``app/compile-sd2.py:2``; CUDA SDPA inside diffusers) — here it is
+first-party.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _xla_attention(q, k, v, mask, bias, scale) -> jax.Array:
+    """Reference implementation: [B,T,H,D] x [B,S,Hkv,D] -> [B,T,H,D]."""
+    B, T, H, D = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    if H != Hkv:
+        # grouped-query attention: repeat kv heads over the group
+        group = H // Hkv
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhts,bshd->bthd", p.astype(v.dtype), v)
+    return o.astype(q.dtype)
+
+
+def causal_mask(T: int, S: int, offset: int = 0) -> jax.Array:
+    """[1,1,T,S] boolean mask; query i attends keys j <= i + offset."""
+    qi = jnp.arange(T)[:, None] + offset
+    kj = jnp.arange(S)[None, :]
+    return (qi >= kj)[None, None, :, :]
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Scaled dot-product attention.
+
+    Args:
+      q: ``[B, T, H, D]``.
+      k, v: ``[B, S, Hkv, D]`` with ``H % Hkv == 0`` (GQA/MQA supported).
+      mask: boolean, broadcastable to ``[B, H, T, S]``; True = attend.
+      bias: additive, broadcastable to ``[B, H, T, S]`` (e.g. T5 relative
+        position bias).
+      causal: apply causal masking (assumes key block starts at position 0
+        and queries start at position ``S - T``, the decode-step layout).
+      scale: defaults to ``1/sqrt(D)``.
+      impl: ``auto`` (pallas on TPU when eligible), ``xla``, or ``pallas``.
+    """
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    if H % k.shape[2]:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {k.shape[2]}")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+
+    if impl in ("auto", "pallas"):
+        # the flash kernel applies causal masking itself; arbitrary masks and
+        # biases take the XLA path
+        from .pallas.flash_attention import flash_attention, flash_eligible
+
+        want = impl == "pallas"
+        if flash_eligible(q, k, v, mask=mask, bias=bias) and (
+            want or jax.default_backend() in ("tpu", "axon")
+        ):
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        if want:
+            raise ValueError(
+                f"pallas flash attention not eligible for shapes q={q.shape} "
+                f"k={k.shape} (mask={mask is not None}, bias={bias is not None})"
+            )
+    elif impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r}")
+
+    if causal:
+        cm = causal_mask(T, S, offset=S - T)
+        mask = cm if mask is None else jnp.logical_and(mask, cm)
+    return _xla_attention(q, k, v, mask, bias, scale)

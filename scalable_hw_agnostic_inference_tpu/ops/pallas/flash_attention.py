@@ -1,0 +1,136 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise attention with online softmax: each grid step owns one
+``[BLOCK_Q, D]`` query tile in VMEM and streams K/V tiles, keeping the
+``[T, S]`` score matrix out of HBM entirely. fp32 accumulators, bf16 inputs —
+the MXU-friendly shape for both the SD2.1 UNet's cross/self-attention and LLM
+prefill. This replaces what the reference buys from vendored runtimes
+(``NEURON_FUSE_SOFTMAX=1`` fused softmax, reference ``app/compile-sd2.py:2``).
+
+Grid layout: ``(batch, q_heads, T // BLOCK_Q)``; K/V are resident per
+(batch, head) and sliced in ``BLOCK_K`` chunks inside the kernel. GQA is
+handled by indexing the kv head as ``h // group`` in the BlockSpec index map —
+no materialized ``jnp.repeat`` of K/V.
+
+On CPU the same kernel runs in interpreter mode (tests); on TPU it compiles
+via Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+BLOCK_Q = 128
+BLOCK_K = 128
+# lane width: head_dim and seq tiles must respect TPU tiling
+_MIN_D = 64
+
+
+def flash_eligible(q, k, v, mask=None, bias=None) -> bool:
+    """Shapes/features the kernel covers; everything else → XLA path."""
+    if mask is not None or bias is not None:
+        return False
+    B, T, H, D = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    if D % _MIN_D or D > 256:
+        return False
+    if T % BLOCK_Q or S % BLOCK_K:
+        return False
+    if H % Hkv:
+        return False
+    return True
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+                  block_k: int, seq_k: int):
+    # q_ref: [BLOCK_Q, D]; k_ref/v_ref: [S, D]; o_ref: [BLOCK_Q, D]
+    qi = pl.program_id(2)
+    q = q_ref[:].astype(jnp.float32) * scale
+    bq, d = q.shape
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o0 = jnp.zeros((bq, d), jnp.float32)
+
+    n_blocks = seq_k // block_k
+    if causal:
+        # blocks strictly above the diagonal contribute nothing; bound the
+        # loop at the last block that can contain key <= max local query pos
+        last = (qi + 1) * BLOCK_Q  # exclusive key bound
+        n_live = pl.cdiv(jnp.minimum(last, seq_k), block_k)
+    else:
+        n_live = n_blocks
+
+    def body(j, carry):
+        m, l, o = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        bm = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, bm)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l, o
+
+    m, l, o = jax.lax.fori_loop(0, n_live, body, (m0, l0, o0))
+    o_ref[:] = (o / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention. q ``[B,T,H,D]``, k/v ``[B,S,Hkv,D]`` → ``[B,T,H,D]``.
+
+    ``interpret`` defaults to True off-TPU so the same kernel runs (slowly)
+    in tests on the CPU mesh.
+    """
+    B, T, H, D = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    group = H // Hkv
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    # kernel works in [B, H, T, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (B, H, T // BLOCK_Q)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_k=BLOCK_K, seq_k=S
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, BLOCK_Q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, S, D), lambda b, h, i: (b, h // group, 0, 0)),
+            pl.BlockSpec((None, None, S, D), lambda b, h, i: (b, h // group, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, BLOCK_Q, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,35 @@
+"""Rotary position embeddings (Llama/Mistral-style half-rotation).
+
+Pure functions over ``[B, T, H, D]`` tensors; positions are explicit so the
+same code serves prefill (positions ``0..T``) and paged decode (arbitrary
+per-token positions from the block table) without recompilation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(positions: jax.Array, dim: int, theta: float = 10000.0):
+    """cos/sin tables for ``positions`` → each ``[..., dim/2]`` (fp32)."""
+    if dim % 2:
+        raise ValueError(f"rope dim must be even, got {dim}")
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., dim/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> jax.Array:
+    """Rotate ``x`` ``[B, T, H, D]`` by per-token ``positions`` ``[B, T]``.
+
+    Half-rotation convention (HF Llama): the first D/2 lanes pair with the
+    last D/2 lanes.
+    """
+    B, T, H, D = x.shape
+    cos, sin = rope_angles(positions, D, theta)  # [B, T, D/2]
+    cos = cos[:, :, None, :]  # [B, T, 1, D/2]
+    sin = sin[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

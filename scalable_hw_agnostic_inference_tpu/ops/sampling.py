@@ -1,0 +1,68 @@
+"""On-device token sampling: temperature, top-k, top-p, greedy.
+
+The reference's LLM path samples on-device inside the vLLM/NxD engine
+(``global_topk: 64, "dynamic"``, reference
+``cova/mllama-32-11b-vllm-trn1-config.yaml:18-22``). These are the jit-safe
+equivalents the TPU engine composes into its decode step — no host round-trip
+between logits and the sampled token. All knobs may be scalars or per-request
+arrays (one entry per row of a continuous batch).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def greedy(logits: jax.Array) -> jax.Array:
+    """Argmax over the vocab dim. logits ``[..., V]`` → tokens ``[...]``."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _mask_top_k(logits: jax.Array, k: jax.Array) -> jax.Array:
+    """Keep the top ``k`` logits per row; ``k`` ``[...]`` (0 = off)."""
+    V = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    k_eff = jnp.clip(k, 1, V)
+    thresh = jnp.take_along_axis(sorted_desc, (k_eff - 1)[..., None], axis=-1)
+    masked = jnp.where(logits >= thresh, logits, NEG_INF)
+    return jnp.where((k > 0)[..., None], masked, logits)
+
+
+def _mask_top_p(logits: jax.Array, p: jax.Array) -> jax.Array:
+    """Nucleus sampling mask; ``p`` ``[...]`` in (0, 1] (1 = off)."""
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens while cumulative prob (exclusive of self) < p; this always
+    # keeps the top-1 token
+    keep_sorted = (cum - probs) < p[..., None]
+    kth = jnp.sum(keep_sorted, axis=-1, keepdims=True) - 1
+    thresh = jnp.take_along_axis(sorted_desc, jnp.clip(kth, 0, None), axis=-1)
+    masked = jnp.where(logits >= thresh, logits, NEG_INF)
+    return jnp.where((p >= 1.0)[..., None], logits, masked)
+
+
+def sample_logits(
+    logits: jax.Array,
+    rng: jax.Array,
+    temperature: float | jax.Array = 1.0,
+    top_k: int | jax.Array = 0,
+    top_p: float | jax.Array = 1.0,
+) -> jax.Array:
+    """Sample tokens from ``[..., V]`` logits. Jit-safe; all knobs traceable.
+
+    ``temperature == 0`` selects greedy decoding (per-row when the knob is a
+    per-request array in a continuous batch).
+    """
+    batch_shape = logits.shape[:-1]
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), batch_shape)
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), batch_shape)
+    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), batch_shape)
+
+    scaled = logits.astype(jnp.float32) / jnp.maximum(t, 1e-6)[..., None]
+    masked = _mask_top_p(_mask_top_k(scaled, k), p)
+    sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(t <= 0.0, greedy(logits), sampled)

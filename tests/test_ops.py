@@ -1,0 +1,207 @@
+"""ops layer tests: attention (XLA + pallas-interpret), GQA, rope, sampling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalable_hw_agnostic_inference_tpu.ops import (
+    apply_rope,
+    dot_product_attention,
+    greedy,
+    rope_angles,
+    sample_logits,
+)
+from scalable_hw_agnostic_inference_tpu.ops.attention import causal_mask
+from scalable_hw_agnostic_inference_tpu.ops.pallas.flash_attention import (
+    flash_attention,
+    flash_eligible,
+)
+
+
+def ref_attention(q, k, v, causal=False):
+    """Straight-line numpy-ish reference in fp32."""
+    B, T, H, D = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    if H != Hkv:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s / (D ** 0.5)
+    if causal:
+        qi = jnp.arange(T)[:, None] + (S - T)
+        kj = jnp.arange(S)[None, :]
+        s = jnp.where((qi >= kj)[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
+
+
+class TestXlaAttention:
+    def test_matches_reference(self):
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (2, 16, 4, 32))
+        k = jax.random.normal(kk, (2, 24, 4, 32))
+        v = jax.random.normal(kv, (2, 24, 4, 32))
+        out = dot_product_attention(q, k, v, impl="xla")
+        np.testing.assert_allclose(out, ref_attention(q, k, v), rtol=1e-5, atol=1e-5)
+
+    def test_causal(self):
+        rng = jax.random.PRNGKey(1)
+        q = jax.random.normal(rng, (1, 8, 2, 16))
+        out = dot_product_attention(q, q, q, causal=True, impl="xla")
+        np.testing.assert_allclose(
+            out, ref_attention(q, q, q, causal=True), rtol=1e-5, atol=1e-5
+        )
+
+    def test_gqa_heads(self):
+        rng = jax.random.PRNGKey(2)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (1, 8, 8, 16))
+        k = jax.random.normal(kk, (1, 8, 2, 16))  # 4 q heads per kv head
+        v = jax.random.normal(kv, (1, 8, 2, 16))
+        out = dot_product_attention(q, k, v, impl="xla")
+        np.testing.assert_allclose(out, ref_attention(q, k, v), rtol=1e-5, atol=1e-5)
+
+    def test_decode_step_causal_offset(self):
+        """T=1 decode against S cached keys: the query is the last position."""
+        rng = jax.random.PRNGKey(3)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (1, 1, 2, 16))
+        k = jax.random.normal(kk, (1, 10, 2, 16))
+        v = jax.random.normal(kv, (1, 10, 2, 16))
+        out = dot_product_attention(q, k, v, causal=True, impl="xla")
+        # last-position query attends everything -> same as non-causal
+        np.testing.assert_allclose(out, ref_attention(q, k, v), rtol=1e-5, atol=1e-5)
+
+    def test_bias_and_mask(self):
+        rng = jax.random.PRNGKey(4)
+        q = jax.random.normal(rng, (1, 4, 2, 16))
+        bias = jnp.zeros((1, 2, 4, 4)).at[:, :, :, 0].set(5.0)
+        out_b = dot_product_attention(q, q, q, bias=bias, impl="xla")
+        out = dot_product_attention(q, q, q, impl="xla")
+        assert not np.allclose(out_b, out)
+        # mask that only allows self-attention == identity-ish mixing of v
+        eye = jnp.eye(4, dtype=bool)[None, None]
+        out_m = dot_product_attention(q, q, q, mask=eye, impl="xla")
+        np.testing.assert_allclose(out_m, q.astype(out_m.dtype), rtol=1e-5, atol=1e-5)
+
+
+class TestFlashAttention:
+    """Pallas kernel in interpret mode on CPU; same kernel compiles on TPU."""
+
+    def test_eligibility(self):
+        q = jnp.zeros((1, 128, 4, 64))
+        k = jnp.zeros((1, 256, 4, 64))
+        assert flash_eligible(q, k, k)
+        assert not flash_eligible(q, jnp.zeros((1, 200, 4, 64)), k)  # S % block
+        assert not flash_eligible(jnp.zeros((1, 128, 4, 48)), k, k)  # D % 64
+        assert not flash_eligible(q, k, k, mask=jnp.ones((1, 1, 1, 1), bool))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_xla(self, causal):
+        rng = jax.random.PRNGKey(5)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (2, 256, 4, 64), jnp.float32)
+        k = jax.random.normal(kk, (2, 256, 4, 64), jnp.float32)
+        v = jax.random.normal(kv, (2, 256, 4, 64), jnp.float32)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        ref = ref_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    def test_gqa(self):
+        rng = jax.random.PRNGKey(6)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (1, 128, 8, 64), jnp.float32)
+        k = jax.random.normal(kk, (1, 128, 2, 64), jnp.float32)
+        v = jax.random.normal(kv, (1, 128, 2, 64), jnp.float32)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = ref_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    def test_bf16(self):
+        rng = jax.random.PRNGKey(7)
+        q = jax.random.normal(rng, (1, 128, 2, 64)).astype(jnp.bfloat16)
+        out = flash_attention(q, q, q, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        ref = ref_attention(q, q, q)
+        np.testing.assert_allclose(
+            out.astype(jnp.float32), ref, rtol=5e-2, atol=5e-2
+        )
+
+
+class TestRope:
+    def test_shapes_and_zero_position(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 3, 8))
+        pos = jnp.zeros((2, 4), jnp.int32)
+        out = apply_rope(x, pos)
+        # position 0 => rotation by angle 0 => identity
+        np.testing.assert_allclose(out, x, rtol=1e-6, atol=1e-6)
+
+    def test_rotation_preserves_norm(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 6, 2, 16))
+        pos = jnp.arange(6)[None, :]
+        out = apply_rope(x, pos)
+        np.testing.assert_allclose(
+            jnp.linalg.norm(out, axis=-1), jnp.linalg.norm(x, axis=-1),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_relative_property(self):
+        """<rope(q,m), rope(k,n)> depends only on m-n."""
+        rng = jax.random.PRNGKey(2)
+        q = jax.random.normal(rng, (1, 1, 1, 32))
+        k = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, 32))
+
+        def dot_at(m, n):
+            qm = apply_rope(q, jnp.array([[m]]))
+            kn = apply_rope(k, jnp.array([[n]]))
+            return float(jnp.sum(qm * kn))
+
+        assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-4)
+        assert dot_at(0, 0) == pytest.approx(dot_at(7, 7), rel=1e-4)
+
+    def test_angles_shape(self):
+        cos, sin = rope_angles(jnp.arange(10), 64)
+        assert cos.shape == (10, 32) and sin.shape == (10, 32)
+
+
+class TestSampling:
+    def test_greedy(self):
+        logits = jnp.array([[0.1, 5.0, -1.0], [2.0, 0.0, 3.0]])
+        np.testing.assert_array_equal(greedy(logits), [1, 2])
+
+    def test_temperature_zero_is_greedy(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (4, 100))
+        toks = sample_logits(logits, jax.random.PRNGKey(1), temperature=0.0)
+        np.testing.assert_array_equal(toks, greedy(logits))
+
+    def test_top_k_restricts_support(self):
+        logits = jnp.array([[10.0, 9.0, 1.0, 0.0, -5.0]])
+        seen = set()
+        for i in range(50):
+            t = sample_logits(logits, jax.random.PRNGKey(i), temperature=2.0, top_k=2)
+            seen.add(int(t[0]))
+        assert seen <= {0, 1}
+
+    def test_top_p_keeps_top1_always(self):
+        logits = jnp.array([[3.0, 1.0, 0.0]])
+        for i in range(20):
+            t = sample_logits(logits, jax.random.PRNGKey(i), top_p=0.01)
+            assert int(t[0]) == 0
+
+    def test_per_request_knobs(self):
+        """Row 0 greedy, row 1 heavily top-k-restricted."""
+        logits = jnp.tile(jnp.array([[5.0, 4.0, -10.0, -10.0]]), (2, 1))
+        temps = jnp.array([0.0, 1.0])
+        ks = jnp.array([0, 2])
+        for i in range(20):
+            t = sample_logits(logits, jax.random.PRNGKey(i), temperature=temps, top_k=ks)
+            assert int(t[0]) == 0
+            assert int(t[1]) in (0, 1)
+
+    def test_jit_compatible(self):
+        fn = jax.jit(lambda l, r: sample_logits(l, r, temperature=0.8, top_k=50, top_p=0.9))
+        logits = jax.random.normal(jax.random.PRNGKey(0), (2, 1000))
+        out = fn(logits, jax.random.PRNGKey(1))
+        assert out.shape == (2,) and out.dtype == jnp.int32
