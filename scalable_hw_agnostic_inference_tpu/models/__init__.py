@@ -1,0 +1,10 @@
+"""Flax model zoo: bert, vit, clip, t5, yolos, llama, sd21, flux.
+
+First-party TPU-native implementations (bf16 compute, fp32 params, static
+shapes, our ``ops`` attention) of every model family the reference serves
+(SURVEY.md §2.2). Weights load from HF torch checkpoints via
+``models.convert`` — the artifact format is orbax + AOT-compiled XLA
+executables, not TorchScript.
+"""
+
+from .registry import get_model, list_models, register_model  # noqa: F401

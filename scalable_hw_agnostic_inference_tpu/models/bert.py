@@ -1,0 +1,98 @@
+"""DistilBERT sequence classifier — the reference's sentiment unit.
+
+Parity target: ``run-bert.py`` serving ``distilbert-base-uncased-finetuned-
+sst-2-english`` (reference ``app/run-bert.py:21-29``; xla branch uses
+``NeuronModelForSequenceClassification``). Flax re-implementation: post-LN
+encoder, learned positions, [CLS] pooling, pre-classifier ReLU head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .convert import embedding, encoder_block, layer_norm, linear, state_dict_of
+from .encoder import Encoder, attention_mask_2d
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_position: int = 512
+    dim: int = 768
+    n_layers: int = 6
+    heads: int = 12
+    mlp_dim: int = 3072
+    n_labels: int = 2
+    ln_eps: float = 1e-12
+    act: str = "gelu"
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(vocab_size=128, max_position=64, dim=32, n_layers=2, heads=2,
+                   mlp_dim=64, n_labels=2)
+
+    @classmethod
+    def from_hf(cls, hf_cfg) -> "BertConfig":
+        return cls(
+            vocab_size=hf_cfg.vocab_size,
+            max_position=hf_cfg.max_position_embeddings,
+            dim=hf_cfg.dim,
+            n_layers=hf_cfg.n_layers,
+            heads=hf_cfg.n_heads,
+            mlp_dim=hf_cfg.hidden_dim,
+            n_labels=getattr(hf_cfg, "num_labels", 2),
+            act=hf_cfg.activation,
+        )
+
+
+class DistilBertClassifier(nn.Module):
+    cfg: BertConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array, attention_mask: Optional[jax.Array] = None):
+        c = self.cfg
+        x = nn.Embed(c.vocab_size, c.dim, name="tok_emb")(input_ids)
+        pos = jnp.arange(input_ids.shape[1])[None, :]
+        x = x + nn.Embed(c.max_position, c.dim, name="pos_emb")(pos)
+        x = nn.LayerNorm(epsilon=c.ln_eps, dtype=self.dtype, name="emb_ln")(x)
+        x = x.astype(self.dtype)
+        x = Encoder(
+            n_layers=c.n_layers, dim=c.dim, heads=c.heads, mlp_dim=c.mlp_dim,
+            act=c.act, pre_ln=False, ln_eps=c.ln_eps, dtype=self.dtype,
+            name="encoder",
+        )(x, mask=attention_mask_2d(attention_mask))
+        pooled = x[:, 0]  # [CLS]
+        pooled = nn.Dense(c.dim, dtype=self.dtype, name="pre_classifier")(pooled)
+        pooled = jax.nn.relu(pooled)
+        logits = nn.Dense(c.n_labels, dtype=self.dtype, name="classifier")(pooled)
+        return logits.astype(jnp.float32)
+
+
+def params_from_torch(torch_model_or_sd, cfg: BertConfig) -> Dict:
+    """HF ``DistilBertForSequenceClassification`` state dict → flax params."""
+    sd = state_dict_of(torch_model_or_sd)
+    p: Dict[str, Any] = {
+        "tok_emb": embedding(sd, "distilbert.embeddings.word_embeddings"),
+        "pos_emb": embedding(sd, "distilbert.embeddings.position_embeddings"),
+        "emb_ln": layer_norm(sd, "distilbert.embeddings.LayerNorm"),
+        "pre_classifier": linear(sd, "pre_classifier"),
+        "classifier": linear(sd, "classifier"),
+        "encoder": {},
+    }
+    for i in range(cfg.n_layers):
+        b = f"distilbert.transformer.layer.{i}"
+        p["encoder"][f"layer_{i}"] = encoder_block(
+            sd,
+            q=f"{b}.attention.q_lin", k=f"{b}.attention.k_lin",
+            v=f"{b}.attention.v_lin", o=f"{b}.attention.out_lin",
+            ln1=f"{b}.sa_layer_norm",
+            fc1=f"{b}.ffn.lin1", fc2=f"{b}.ffn.lin2",
+            ln2=f"{b}.output_layer_norm",
+        )
+    return {"params": p}

@@ -1,0 +1,112 @@
+"""CLIP text encoder — SD2.1's conditioning model.
+
+Parity target: the text-encoder component of the reference's SD pipelines
+(``NeuronStableDiffusionPipeline``, reference ``app/compile-sd2.py:13-20``)
+and Flux's CLIP encoder (reference ``app/src/text_encoder_1/model.py:8-33``).
+Causal pre-LN encoder; ``penultimate`` output supports SD2.1's
+``clip_skip``-style conditioning (OpenCLIP ViT-H uses the second-to-last
+hidden state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .convert import embedding, encoder_block, layer_norm, state_dict_of, t2j
+from .encoder import Encoder
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipTextConfig:
+    vocab_size: int = 49408
+    max_position: int = 77
+    dim: int = 1024
+    n_layers: int = 23          # SD2.1 runs 23 of OpenCLIP-H's 24 layers
+    heads: int = 16
+    mlp_dim: int = 4096
+    ln_eps: float = 1e-5
+    act: str = "gelu"           # OpenCLIP-H: gelu; CLIP-L (Flux/SD1.x): quick_gelu
+
+    @classmethod
+    def tiny(cls) -> "ClipTextConfig":
+        return cls(vocab_size=128, max_position=16, dim=32, n_layers=2, heads=2,
+                   mlp_dim=64)
+
+    @classmethod
+    def from_hf(cls, hf_cfg, penultimate: bool = False) -> "ClipTextConfig":
+        n_layers = hf_cfg.num_hidden_layers - (1 if penultimate else 0)
+        return cls(
+            vocab_size=hf_cfg.vocab_size,
+            max_position=hf_cfg.max_position_embeddings,
+            dim=hf_cfg.hidden_size,
+            n_layers=n_layers,
+            heads=hf_cfg.num_attention_heads,
+            mlp_dim=hf_cfg.intermediate_size,
+            ln_eps=hf_cfg.layer_norm_eps,
+            act=hf_cfg.hidden_act,
+        )
+
+
+class ClipTextEncoder(nn.Module):
+    """Returns ``(last_hidden_state, pooled)``; pooled = eot-token features.
+
+    When built with ``n_layers`` < the checkpoint's layer count and
+    ``final_ln=True`` the output matches diffusers' penultimate-layer
+    conditioning (final LayerNorm applied to the truncated stack's output).
+    """
+
+    cfg: ClipTextConfig
+    final_ln: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array):
+        c = self.cfg
+        x = nn.Embed(c.vocab_size, c.dim, name="tok_emb")(input_ids)
+        pos = jnp.arange(input_ids.shape[1])[None, :]
+        x = x + nn.Embed(c.max_position, c.dim, name="pos_emb")(pos)
+        x = x.astype(self.dtype)
+        x = Encoder(
+            n_layers=c.n_layers, dim=c.dim, heads=c.heads, mlp_dim=c.mlp_dim,
+            act=c.act, pre_ln=True, causal=True, ln_eps=c.ln_eps,
+            dtype=self.dtype, name="encoder",
+        )(x)
+        if self.final_ln:
+            x = nn.LayerNorm(epsilon=c.ln_eps, dtype=self.dtype, name="final_ln")(x)
+        x = x.astype(jnp.float32)
+        # pooled output = features at the eot token (highest token id)
+        eot = jnp.argmax(input_ids, axis=-1)
+        pooled = x[jnp.arange(x.shape[0]), eot]
+        return x, pooled
+
+
+def params_from_torch(torch_model_or_sd, cfg: ClipTextConfig,
+                      final_ln: bool = True) -> Dict:
+    """HF ``CLIPTextModel`` state dict → flax params (truncates to cfg.n_layers)."""
+    sd = state_dict_of(torch_model_or_sd)
+    pre = "text_model."
+    if not any(k.startswith(pre) for k in sd):
+        pre = ""
+    p: Dict[str, Any] = {
+        "tok_emb": embedding(sd, f"{pre}embeddings.token_embedding"),
+        "pos_emb": embedding(sd, f"{pre}embeddings.position_embedding"),
+        "encoder": {},
+    }
+    if final_ln:
+        p["final_ln"] = layer_norm(sd, f"{pre}final_layer_norm")
+    for i in range(cfg.n_layers):
+        b = f"{pre}encoder.layers.{i}"
+        p["encoder"][f"layer_{i}"] = encoder_block(
+            sd,
+            q=f"{b}.self_attn.q_proj", k=f"{b}.self_attn.k_proj",
+            v=f"{b}.self_attn.v_proj", o=f"{b}.self_attn.out_proj",
+            ln1=f"{b}.layer_norm1",
+            fc1=f"{b}.mlp.fc1", fc2=f"{b}.mlp.fc2",
+            ln2=f"{b}.layer_norm2",
+        )
+    return {"params": p}

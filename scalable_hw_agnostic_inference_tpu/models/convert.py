@@ -1,0 +1,76 @@
+"""HF torch checkpoint → flax params conversion helpers.
+
+The reference's artifact chain is torch weights → ``torch_neuronx.trace`` →
+TorchScript NEFFs on the HF hub (SURVEY.md §2.6 row 6). Here torch weights
+convert once into flax param pytrees (then orbax checkpoints + XLA AOT cache);
+these helpers are the per-model mapping tables' vocabulary.
+
+Conventions:
+- torch ``nn.Linear.weight`` is ``[out, in]`` → flax Dense kernel ``[in, out]``
+  (transpose).
+- torch ``nn.Conv2d.weight`` is ``[O, I, H, W]`` → flax Conv ``[H, W, I, O]``.
+- embeddings copy as-is.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+def t2j(t) -> np.ndarray:
+    """torch tensor → numpy (fp32, detached)."""
+    return np.asarray(t.detach().cpu().float().numpy())
+
+
+def linear(sd: Dict, prefix: str) -> Dict[str, np.ndarray]:
+    """torch Linear at ``prefix`` → flax Dense {kernel, bias}."""
+    out = {"kernel": t2j(sd[f"{prefix}.weight"]).T}
+    if f"{prefix}.bias" in sd:
+        out["bias"] = t2j(sd[f"{prefix}.bias"])
+    return out
+
+
+def layer_norm(sd: Dict, prefix: str) -> Dict[str, np.ndarray]:
+    return {"scale": t2j(sd[f"{prefix}.weight"]), "bias": t2j(sd[f"{prefix}.bias"])}
+
+
+def embedding(sd: Dict, prefix: str) -> Dict[str, np.ndarray]:
+    return {"embedding": t2j(sd[f"{prefix}.weight"])}
+
+
+def conv2d(sd: Dict, prefix: str) -> Dict[str, np.ndarray]:
+    """torch Conv2d → flax Conv {kernel [H,W,I,O], bias}."""
+    out = {"kernel": t2j(sd[f"{prefix}.weight"]).transpose(2, 3, 1, 0)}
+    if f"{prefix}.bias" in sd:
+        out["bias"] = t2j(sd[f"{prefix}.bias"])
+    return out
+
+
+def group_norm(sd: Dict, prefix: str) -> Dict[str, np.ndarray]:
+    return {"scale": t2j(sd[f"{prefix}.weight"]), "bias": t2j(sd[f"{prefix}.bias"])}
+
+
+def encoder_block(sd: Dict, q: str, k: str, v: str, o: str, ln1: str,
+                  fc1: str, fc2: str, ln2: str) -> Dict[str, Any]:
+    """Map one transformer block's torch prefixes onto our EncoderBlock tree."""
+    return {
+        "attn": {
+            "q": linear(sd, q),
+            "k": linear(sd, k),
+            "v": linear(sd, v),
+            "o": linear(sd, o),
+        },
+        "ln1": layer_norm(sd, ln1),
+        "fc1": linear(sd, fc1),
+        "fc2": linear(sd, fc2),
+        "ln2": layer_norm(sd, ln2),
+    }
+
+
+def state_dict_of(model_or_sd) -> Dict:
+    """Accept a torch module or an already-materialized state dict."""
+    if hasattr(model_or_sd, "state_dict"):
+        return model_or_sd.state_dict()
+    return model_or_sd

@@ -1,0 +1,118 @@
+"""Shared transformer-encoder building blocks (bert / vit / clip / yolos).
+
+One parameterized block covers the pre-LN (ViT, CLIP) and post-LN
+(DistilBERT) families with selectable activation, so each model file is just
+embeddings + head around :class:`Encoder`. Compute dtype is configurable
+(bf16 on TPU); params stay fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+
+ACTIVATIONS: dict[str, Callable] = {
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "quick_gelu": lambda x: x * jax.nn.sigmoid(1.702 * x),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+
+class SelfAttention(nn.Module):
+    """Multi-head self-attention with merged-head Dense projections."""
+
+    dim: int
+    heads: int
+    dtype: Any = jnp.float32
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, mask=None, causal: bool = False):
+        B, T, _ = x.shape
+        head_dim = self.dim // self.heads
+        dense = lambda name: nn.Dense(self.dim, dtype=self.dtype, name=name)
+        q = dense("q")(x).reshape(B, T, self.heads, head_dim)
+        k = dense("k")(x).reshape(B, T, self.heads, head_dim)
+        v = dense("v")(x).reshape(B, T, self.heads, head_dim)
+        o = dot_product_attention(q, k, v, mask=mask, causal=causal, impl=self.attn_impl)
+        return dense("o")(o.reshape(B, T, self.dim))
+
+
+class EncoderBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_dim: int
+    act: str = "gelu"
+    pre_ln: bool = True
+    causal: bool = False
+    ln_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        act = ACTIVATIONS[self.act]
+        ln = lambda name: nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype, name=name)
+        attn = SelfAttention(self.dim, self.heads, dtype=self.dtype,
+                             attn_impl=self.attn_impl, name="attn")
+
+        h = ln("ln1")(x) if self.pre_ln else x
+        h = attn(h, mask=mask, causal=self.causal)
+        x = x + h
+        if not self.pre_ln:
+            x = ln("ln1")(x)
+
+        h = ln("ln2")(x) if self.pre_ln else x
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(h)
+        h = act(h)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="fc2")(h)
+        x = x + h
+        if not self.pre_ln:
+            x = ln("ln2")(x)
+        return x
+
+
+class Encoder(nn.Module):
+    """Stack of :class:`EncoderBlock` named ``layer_{i}`` (stable paths for
+    weight conversion), optionally returning all hidden states."""
+
+    n_layers: int
+    dim: int
+    heads: int
+    mlp_dim: int
+    act: str = "gelu"
+    pre_ln: bool = True
+    causal: bool = False
+    ln_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, mask=None, collect_hidden: bool = False):
+        hidden = []
+        for i in range(self.n_layers):
+            if collect_hidden:
+                hidden.append(x)
+            x = EncoderBlock(
+                self.dim, self.heads, self.mlp_dim, act=self.act,
+                pre_ln=self.pre_ln, causal=self.causal, ln_eps=self.ln_eps,
+                dtype=self.dtype, attn_impl=self.attn_impl, name=f"layer_{i}",
+            )(x, mask=mask)
+        if collect_hidden:
+            hidden.append(x)
+            return x, hidden
+        return x
+
+
+def attention_mask_2d(attention_mask: Optional[jax.Array]) -> Optional[jax.Array]:
+    """[B, S] validity mask → [B, 1, 1, S] broadcastable attention mask."""
+    if attention_mask is None:
+        return None
+    return attention_mask[:, None, None, :].astype(bool)

@@ -1,0 +1,41 @@
+"""Model registry: name → builder.
+
+The reference's dispatch is one serving file per model (``run-bert.py``,
+``run-vit.py``, ...; SURVEY.md §2.2). Here every model registers a builder
+``(ServeConfig) -> ModelService`` under a short name, and the one serving
+entrypoint (``python -m scalable_hw_agnostic_inference_tpu.serve <name>``)
+looks it up — the (model, hardware) deployment-unit matrix is then pure YAML.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    def deco(builder: Callable):
+        if name in _REGISTRY:
+            raise ValueError(f"model {name!r} already registered")
+        _REGISTRY[name] = builder
+        return builder
+
+    return deco
+
+
+def get_model(name: str) -> Callable:
+    _ensure_imported()
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_models() -> List[str]:
+    _ensure_imported()
+    return sorted(_REGISTRY)
+
+
+def _ensure_imported() -> None:
+    """Import service modules for their registration side effects."""
+    from ..serve import services  # noqa: F401

@@ -1,0 +1,108 @@
+"""ViT image classifier — the reference's image-classification unit.
+
+Parity target: ``run-vit.py`` serving ``google/vit-base-patch16-224``
+(reference ``app/run-vit.py:38-49`` — which reloads the model per request, a
+bug explicitly not reproduced here; SURVEY.md §2.2). Pre-LN encoder, conv
+patch embedding, learned positions, [CLS] head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .convert import conv2d, embedding, encoder_block, layer_norm, linear, state_dict_of, t2j
+from .encoder import Encoder
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    dim: int = 768
+    n_layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    n_labels: int = 1000
+    ln_eps: float = 1e-12
+    act: str = "gelu"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def tiny(cls) -> "ViTConfig":
+        return cls(image_size=32, patch_size=8, dim=32, n_layers=2, heads=2,
+                   mlp_dim=64, n_labels=10)
+
+    @classmethod
+    def from_hf(cls, hf_cfg) -> "ViTConfig":
+        return cls(
+            image_size=hf_cfg.image_size,
+            patch_size=hf_cfg.patch_size,
+            dim=hf_cfg.hidden_size,
+            n_layers=hf_cfg.num_hidden_layers,
+            heads=hf_cfg.num_attention_heads,
+            mlp_dim=hf_cfg.intermediate_size,
+            n_labels=len(getattr(hf_cfg, "id2label", {})) or 1000,
+            ln_eps=hf_cfg.layer_norm_eps,
+            act=hf_cfg.hidden_act,
+        )
+
+
+class ViTClassifier(nn.Module):
+    cfg: ViTConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixels: jax.Array):
+        """pixels ``[B, H, W, C]`` (NHWC, normalized) → logits ``[B, labels]``."""
+        c = self.cfg
+        B = pixels.shape[0]
+        x = nn.Conv(
+            c.dim, kernel_size=(c.patch_size, c.patch_size),
+            strides=(c.patch_size, c.patch_size), dtype=self.dtype, name="patch",
+        )(pixels.astype(self.dtype))
+        x = x.reshape(B, -1, c.dim)  # [B, n_patches, dim]
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, c.dim))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, c.dim)).astype(self.dtype), x], axis=1)
+        pos = self.param("pos", nn.initializers.zeros, (1, c.n_patches + 1, c.dim))
+        x = x + pos.astype(self.dtype)
+        x = Encoder(
+            n_layers=c.n_layers, dim=c.dim, heads=c.heads, mlp_dim=c.mlp_dim,
+            act=c.act, pre_ln=True, ln_eps=c.ln_eps, dtype=self.dtype,
+            name="encoder",
+        )(x)
+        x = nn.LayerNorm(epsilon=c.ln_eps, dtype=self.dtype, name="final_ln")(x)
+        logits = nn.Dense(c.n_labels, dtype=self.dtype, name="head")(x[:, 0])
+        return logits.astype(jnp.float32)
+
+
+def params_from_torch(torch_model_or_sd, cfg: ViTConfig) -> Dict:
+    """HF ``ViTForImageClassification`` state dict → flax params."""
+    sd = state_dict_of(torch_model_or_sd)
+    p: Dict[str, Any] = {
+        "cls": t2j(sd["vit.embeddings.cls_token"]),
+        "pos": t2j(sd["vit.embeddings.position_embeddings"]),
+        "patch": conv2d(sd, "vit.embeddings.patch_embeddings.projection"),
+        "final_ln": layer_norm(sd, "vit.layernorm"),
+        "head": linear(sd, "classifier"),
+        "encoder": {},
+    }
+    for i in range(cfg.n_layers):
+        b = f"vit.encoder.layer.{i}"
+        p["encoder"][f"layer_{i}"] = encoder_block(
+            sd,
+            q=f"{b}.attention.attention.query", k=f"{b}.attention.attention.key",
+            v=f"{b}.attention.attention.value", o=f"{b}.attention.output.dense",
+            ln1=f"{b}.layernorm_before",
+            fc1=f"{b}.intermediate.dense", fc2=f"{b}.output.dense",
+            ln2=f"{b}.layernorm_after",
+        )
+    return {"params": p}

@@ -1,0 +1,34 @@
+"""Pod entrypoint: ``python -m scalable_hw_agnostic_inference_tpu.serve <model>``.
+
+The reference's per-model ``run-*.sh`` → ``uvicorn run-X:app`` launch
+(reference ``app/run-sd.sh:14``) collapses to one module: the model name comes
+from argv or the ``MODEL`` env var, everything else from the env contract
+(``utils.env.ServeConfig``).
+"""
+
+import logging
+import os
+import sys
+
+from ..models.registry import get_model, list_models
+from ..utils.env import ServeConfig
+from .app import serve_forever
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    name = sys.argv[1] if len(sys.argv) > 1 else os.environ.get("MODEL", "")
+    if not name:
+        print(f"usage: python -m scalable_hw_agnostic_inference_tpu.serve <model>\n"
+              f"available: {', '.join(list_models())}", file=sys.stderr)
+        raise SystemExit(2)
+    cfg = ServeConfig.from_env()
+    service = get_model(name)(cfg)
+    serve_forever(cfg, service)
+
+
+if __name__ == "__main__":
+    main()

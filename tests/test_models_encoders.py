@@ -1,0 +1,197 @@
+"""Encoder model-zoo tests: bert/vit/clip forward, torch→flax parity, services.
+
+Parity tests instantiate *random-init* HF torch models from tiny configs (no
+network), convert the state dict, and require logits to match fp32-close —
+this validates both the flax architecture and the conversion mapping.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalable_hw_agnostic_inference_tpu.models import bert, clip, vit
+
+
+class TestDistilBert:
+    def test_forward_shapes(self):
+        cfg = bert.BertConfig.tiny()
+        model = bert.DistilBertClassifier(cfg)
+        ids = jnp.ones((2, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        logits = model.apply(params, ids)
+        assert logits.shape == (2, cfg.n_labels)
+
+    def test_mask_changes_output(self):
+        cfg = bert.BertConfig.tiny()
+        model = bert.DistilBertClassifier(cfg)
+        ids = jnp.arange(32).reshape(2, 16).astype(jnp.int32) % cfg.vocab_size
+        params = model.init(jax.random.PRNGKey(0), ids)
+        full = model.apply(params, ids, jnp.ones((2, 16), jnp.int32))
+        half = model.apply(params, ids, jnp.concatenate(
+            [jnp.ones((2, 8), jnp.int32), jnp.zeros((2, 8), jnp.int32)], axis=1))
+        assert not np.allclose(full, half)
+
+    def test_torch_parity(self):
+        torch = pytest.importorskip("torch")
+        from transformers import DistilBertConfig, DistilBertForSequenceClassification
+
+        hf_cfg = DistilBertConfig(
+            vocab_size=96, max_position_embeddings=32, dim=32, n_layers=2,
+            n_heads=2, hidden_dim=64, num_labels=2,
+        )
+        torch.manual_seed(0)
+        tm = DistilBertForSequenceClassification(hf_cfg).eval()
+        cfg = bert.BertConfig.from_hf(hf_cfg)
+        params = bert.params_from_torch(tm, cfg)
+
+        ids = np.random.default_rng(0).integers(0, 96, (2, 16))
+        mask = np.ones((2, 16), dtype=np.int64)
+        with torch.no_grad():
+            want = tm(torch.tensor(ids), attention_mask=torch.tensor(mask)).logits.numpy()
+        got = bert.DistilBertClassifier(cfg).apply(
+            params, jnp.asarray(ids, jnp.int32), jnp.asarray(mask, jnp.int32))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+class TestViT:
+    def test_forward_shapes(self):
+        cfg = vit.ViTConfig.tiny()
+        model = vit.ViTClassifier(cfg)
+        px = jnp.zeros((2, cfg.image_size, cfg.image_size, 3))
+        params = model.init(jax.random.PRNGKey(0), px)
+        logits = model.apply(params, px)
+        assert logits.shape == (2, cfg.n_labels)
+
+    def test_torch_parity(self):
+        torch = pytest.importorskip("torch")
+        from transformers import ViTConfig as HfViTConfig, ViTForImageClassification
+
+        hf_cfg = HfViTConfig(
+            image_size=32, patch_size=8, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            id2label={0: "a", 1: "b", 2: "c"}, label2id={"a": 0, "b": 1, "c": 2},
+        )
+        torch.manual_seed(0)
+        tm = ViTForImageClassification(hf_cfg).eval()
+        cfg = vit.ViTConfig.from_hf(hf_cfg)
+        params = vit.params_from_torch(tm, cfg)
+
+        px = np.random.default_rng(0).standard_normal((2, 32, 32, 3), dtype=np.float32)
+        with torch.no_grad():
+            want = tm(torch.tensor(px.transpose(0, 3, 1, 2))).logits.numpy()
+        got = vit.ViTClassifier(cfg).apply(params, jnp.asarray(px))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+class TestClipText:
+    def test_forward_shapes(self):
+        cfg = clip.ClipTextConfig.tiny()
+        model = clip.ClipTextEncoder(cfg)
+        ids = jnp.ones((2, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        hidden, pooled = model.apply(params, ids)
+        assert hidden.shape == (2, 8, cfg.dim)
+        assert pooled.shape == (2, cfg.dim)
+
+    def test_causal(self):
+        """Changing a later token must not affect earlier hidden states."""
+        cfg = clip.ClipTextConfig.tiny()
+        model = clip.ClipTextEncoder(cfg)
+        ids1 = jnp.array([[1, 2, 3, 4]], jnp.int32)
+        ids2 = jnp.array([[1, 2, 3, 99]], jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids1)
+        h1, _ = model.apply(params, ids1)
+        h2, _ = model.apply(params, ids2)
+        np.testing.assert_allclose(h1[:, :3], h2[:, :3], rtol=1e-5, atol=1e-5)
+        assert not np.allclose(h1[:, 3], h2[:, 3])
+
+    def test_torch_parity(self):
+        torch = pytest.importorskip("torch")
+        from transformers import CLIPTextConfig as HfClipConfig, CLIPTextModel
+
+        hf_cfg = HfClipConfig(
+            vocab_size=96, max_position_embeddings=16, hidden_size=32,
+            num_hidden_layers=2, num_attention_heads=2, intermediate_size=64,
+            hidden_act="quick_gelu",
+        )
+        torch.manual_seed(0)
+        tm = CLIPTextModel(hf_cfg).eval()
+        cfg = clip.ClipTextConfig.from_hf(hf_cfg)
+        params = clip.params_from_torch(tm, cfg)
+
+        ids = np.random.default_rng(1).integers(0, 96, (2, 12))
+        with torch.no_grad():
+            want = tm(torch.tensor(ids)).last_hidden_state.numpy()
+        got, _ = clip.ClipTextEncoder(cfg).apply(params, jnp.asarray(ids, jnp.int32))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_penultimate_truncation(self):
+        """n_layers-1 + final_ln reproduces diffusers' clip-skip conditioning."""
+        torch = pytest.importorskip("torch")
+        from transformers import CLIPTextConfig as HfClipConfig, CLIPTextModel
+
+        hf_cfg = HfClipConfig(
+            vocab_size=96, max_position_embeddings=16, hidden_size=32,
+            num_hidden_layers=3, num_attention_heads=2, intermediate_size=64,
+            hidden_act="gelu",
+        )
+        torch.manual_seed(0)
+        tm = CLIPTextModel(hf_cfg).eval()
+        cfg = clip.ClipTextConfig.from_hf(hf_cfg, penultimate=True)
+        assert cfg.n_layers == 2
+        params = clip.params_from_torch(tm, cfg)
+        ids = np.random.default_rng(2).integers(0, 96, (1, 10))
+        with torch.no_grad():
+            hs = tm(torch.tensor(ids), output_hidden_states=True).hidden_states
+            want = tm.text_model.final_layer_norm(hs[-2]).numpy()
+        got, _ = clip.ClipTextEncoder(cfg).apply(params, jnp.asarray(ids, jnp.int32))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+class TestServices:
+    @pytest.mark.asyncio
+    async def test_bert_service_end_to_end(self):
+        import httpx
+
+        from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+        from scalable_hw_agnostic_inference_tpu.serve.services import BertService
+        from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+        from tests.test_serve_http import wait_ready
+
+        cfg = ServeConfig(app="bert", device="cpu", model_id="tiny", max_seq_len=32)
+        app = create_app(cfg, BertService(cfg))
+        transport = httpx.ASGITransport(app=app)
+        async with httpx.AsyncClient(transport=transport, base_url="http://t") as c:
+            r = await wait_ready(c)
+            assert r.status_code == 200, r.text
+            r = await c.post("/predict", json={"text": "great stuff"})
+            body = r.json()
+            assert body["label"] in ("NEGATIVE", "POSITIVE")
+            assert len(body["logits"]) == 2
+
+    @pytest.mark.asyncio
+    async def test_vit_service_end_to_end(self):
+        import httpx
+
+        from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+        from scalable_hw_agnostic_inference_tpu.serve.services import ViTService
+        from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+        from tests.test_serve_http import wait_ready
+
+        cfg = ServeConfig(app="vit", device="cpu", model_id="tiny")
+        app = create_app(cfg, ViTService(cfg))
+        transport = httpx.ASGITransport(app=app)
+        async with httpx.AsyncClient(transport=transport, base_url="http://t") as c:
+            r = await wait_ready(c)
+            assert r.status_code == 200, r.text
+            r = await c.post("/classify", json={"image_b64": "random"})
+            body = r.json()
+            assert len(body["top5"]) == 5
+
+    def test_registry(self):
+        from scalable_hw_agnostic_inference_tpu.models import get_model, list_models
+
+        assert "bert" in list_models() and "vit" in list_models()
+        with pytest.raises(KeyError):
+            get_model("nope")
