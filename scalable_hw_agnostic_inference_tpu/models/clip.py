@@ -17,7 +17,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from .convert import embedding, encoder_block, layer_norm, state_dict_of, t2j
+from .convert import embedding, encoder_block, layer_norm, state_dict_of
 from .encoder import Encoder
 
 

@@ -1,0 +1,120 @@
+"""Jit-once autoregressive generation: bucketed prefill + ``lax.scan`` decode.
+
+The reference's generation path is ``model.generate(**kwargs)`` inside a
+traced Neuron artifact with frozen ``sequence_length`` (reference
+``app/run-llama.py:42``, ``app/compile-llam3.py:20``). TPU-natively the whole
+generate — prefill, cache writes, per-step sampling, EOS bookkeeping — is ONE
+jitted function per (batch, prompt-bucket, max-new-tokens) triple: no host
+round-trip per token, sampling on-device (``ops.sampling``), shapes static so
+XLA compiles exactly once per bucket (``core.bucketing`` picks the bucket).
+
+Works on any causal LM following the ``LlamaForCausalLM`` calling convention
+``apply(params, ids, positions, cache, mask, write_index) -> (logits, cache)``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.sampling import sample_logits
+from .llama import LlamaConfig, decode_mask, init_cache, prefill_mask
+
+
+class GenerateResult(NamedTuple):
+    tokens: jax.Array      # [B, max_new_tokens] int32, PAD after EOS
+    n_generated: jax.Array  # [B] int32 (includes the EOS token if emitted)
+
+
+def make_generate(
+    model,
+    cfg: LlamaConfig,
+    *,
+    prompt_bucket: int,
+    max_new_tokens: int,
+    eos_id: int = 2,
+    pad_id: int = 0,
+    cache_dtype=jnp.bfloat16,
+    donate_cache: bool = True,
+) -> Callable[..., GenerateResult]:
+    """Build a jitted ``generate(params, ids, prompt_len, rng, temperature,
+    top_k, top_p)`` for one static (prompt_bucket, max_new_tokens) shape.
+
+    ``ids``: ``[B, prompt_bucket]`` right-padded prompts; ``prompt_len``:
+    ``[B]`` true lengths. Sampling knobs are scalars or per-row arrays.
+    """
+    n_slots = prompt_bucket + max_new_tokens
+
+    def generate(params, ids, prompt_len, rng, temperature=1.0, top_k=0, top_p=1.0):
+        B, Tp = ids.shape
+        positions = jnp.broadcast_to(jnp.arange(Tp, dtype=jnp.int32), (B, Tp))
+        token_valid = positions < prompt_len[:, None]
+
+        cache = init_cache(cfg, B, n_slots, dtype=cache_dtype)
+        mask = prefill_mask(token_valid, n_slots)
+        logits, cache = model.apply(
+            params, ids, positions, cache, mask, jnp.int32(0)
+        )
+        # logits for the NEXT token live at the last valid prompt position
+        last = jnp.take_along_axis(
+            logits, (prompt_len - 1)[:, None, None], axis=1
+        )[:, 0]  # [B, V]
+        tok0 = sample_logits(last, jax.random.fold_in(rng, 0),
+                             temperature, top_k, top_p)
+
+        slot_valid = jnp.zeros((B, n_slots), bool).at[:, :Tp].set(token_valid)
+
+        def step(carry, t):
+            cache, tok, slot_valid, done = carry
+            write_idx = Tp + t
+            slot_valid = slot_valid.at[:, write_idx].set(True)
+            pos = (prompt_len + t)[:, None]  # [B, 1]
+            logits, cache = model.apply(
+                params, tok[:, None], pos.astype(jnp.int32), cache,
+                decode_mask(slot_valid), write_idx,
+            )
+            nxt = sample_logits(logits[:, -1], jax.random.fold_in(rng, t + 1),
+                                temperature, top_k, top_p)
+            emitted = jnp.where(done, pad_id, tok)
+            done = jnp.logical_or(done, tok == eos_id)
+            nxt = jnp.where(done, eos_id, nxt)
+            return (cache, nxt, slot_valid, done), emitted
+
+        done0 = jnp.zeros((B,), bool)
+        (_, _, _, done), toks = jax.lax.scan(
+            step, (cache, tok0, slot_valid, done0),
+            jnp.arange(max_new_tokens, dtype=jnp.int32),
+        )
+        tokens = jnp.swapaxes(toks, 0, 1)  # [B, N]
+        n_gen = jnp.sum(tokens != pad_id, axis=1).astype(jnp.int32)
+        return GenerateResult(tokens, n_gen)
+
+    return jax.jit(generate)
+
+
+class ByteTokenizer:
+    """Self-contained byte-level tokenizer for the offline/CI tier.
+
+    ids: 0 = PAD, 1 = BOS, 2 = EOS, byte b → 3 + b. Round-trips any UTF-8
+    text without a vocab file, so generation is exercisable hermetically.
+    """
+
+    pad_id, bos_id, eos_id = 0, 1, 2
+    vocab_size = 259
+
+    def encode(self, text: str, max_len: int) -> tuple:
+        import numpy as np
+
+        raw = [self.bos_id] + [3 + b for b in text.encode("utf-8")][: max_len - 1]
+        n = len(raw)
+        ids = np.zeros((max_len,), np.int32)
+        ids[:n] = raw
+        return ids, n
+
+    def decode(self, ids) -> str:
+        # ids beyond the byte range (a model vocab may be larger) are dropped
+        data = bytes(int(i) - 3 for i in ids if 3 <= int(i) < 3 + 256)
+        return data.decode("utf-8", errors="replace")

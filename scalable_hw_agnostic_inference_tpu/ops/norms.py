@@ -1,0 +1,35 @@
+"""Normalization ops shared across model families (llama, t5, flux).
+
+RMSNorm computes in fp32 regardless of compute dtype — matching the HF/Llama
+convention so converted checkpoints are numerically comparable. The reference
+gets these from vendored torch modules; here they are first-party and fuse
+into neighbouring matmuls under XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square LayerNorm (no mean subtraction, no bias).
+
+    ``scale_offset=1.0`` gives the Gemma convention (param stored as
+    ``scale - 1``); 0.0 (default) is the Llama/T5 convention.
+    """
+
+    eps: float = 1e-6
+    dtype: Any = jnp.float32
+    scale_offset: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(var + self.eps)
+        return (y * (scale + self.scale_offset)).astype(self.dtype)

@@ -29,6 +29,7 @@ import numpy as np
 from ..models.registry import register_model
 from ..utils.env import ServeConfig
 from .app import ModelService
+from .asgi import HTTPError
 
 log = logging.getLogger(__name__)
 
@@ -191,6 +192,144 @@ class ViTService(ModelService):
         }
 
 
+class LlamaService(ModelService):
+    """Text generation — parity with reference ``run-llama.py`` (Llama-3/
+    Mistral) and ``deepseek_model_api.py`` (generic causal LM + /benchmark).
+
+    One jitted generate per (prompt-bucket, max-new-tokens) shape; the
+    smallest bucket is compile-warmed before readiness, larger buckets warm
+    lazily on first use. TP via MESH_SPEC (e.g. ``tp=4``): weights are placed
+    with the declarative Megatron rules table and XLA inserts the collectives.
+    """
+
+    task = "text-generation"
+    infer_route = "/generate"
+
+    def load(self) -> None:
+        from ..core.bucketing import BucketRegistry, pow2_buckets
+        from ..core.mesh import build_mesh
+        from ..models import llama
+        from ..models.generate import ByteTokenizer, make_generate
+
+        cfg = self.cfg
+        if cfg.model_id in ("", "tiny"):
+            mcfg = llama.LlamaConfig.tiny()
+            self.model = llama.LlamaForCausalLM(mcfg, dtype=jnp.float32)
+            params = self.model.init(
+                jax.random.PRNGKey(cfg.seed), jnp.zeros((1, 8), jnp.int32)
+            )
+            self.tokenizer = ByteTokenizer()
+            self.eos_id, self.pad_id = ByteTokenizer.eos_id, ByteTokenizer.pad_id
+            self._byte_tok = True
+        else:
+            import torch  # noqa: F401
+            from transformers import AutoModelForCausalLM
+
+            tm = AutoModelForCausalLM.from_pretrained(
+                cfg.model_id, token=cfg.hf_token or None
+            )
+            mcfg = llama.LlamaConfig.from_hf(tm.config)
+            self.model = llama.LlamaForCausalLM(mcfg, dtype=jnp.bfloat16)
+            params = llama.params_from_torch(tm, mcfg)
+            del tm
+            self.tokenizer = _hf_tokenizer(cfg.model_id, cfg.hf_token)
+            self.eos_id = self.tokenizer.eos_token_id or 2
+            self.pad_id = self.tokenizer.pad_token_id or self.eos_id
+            self._byte_tok = False
+        self.mcfg = mcfg
+
+        if cfg.mesh_spec:
+            from ..parallel.sharding import shard_pytree
+
+            mesh = build_mesh(cfg.mesh_spec)
+            params = shard_pytree(params, mesh, llama.tp_rules())
+        else:
+            params = jax.device_put(params)
+        self.params = params
+
+        max_prompt = min(cfg.max_seq_len, mcfg.max_seq_len - cfg.max_new_tokens)
+        self.buckets = BucketRegistry(pow2_buckets(32, max(32, max_prompt)))
+        self._gen = {}
+        self._make_generate = lambda bucket: make_generate(
+            self.model, self.mcfg,
+            prompt_bucket=bucket, max_new_tokens=cfg.max_new_tokens,
+            eos_id=self.eos_id, pad_id=self.pad_id,
+            cache_dtype=jnp.bfloat16 if cfg.device == "tpu" else jnp.float32,
+        )
+
+    def _gen_for(self, bucket: int):
+        if bucket not in self._gen:
+            self._gen[bucket] = self._make_generate(bucket)
+        return self._gen[bucket]
+
+    def _encode(self, text: str):
+        if self._byte_tok:
+            ids, n = self.tokenizer.encode(text, self.buckets.max)
+            ids = ids[:n]
+        else:
+            ids = np.asarray(
+                self.tokenizer(text, truncation=True, max_length=self.buckets.max)[
+                    "input_ids"
+                ],
+                np.int32,
+            )
+        if len(ids) == 0:
+            raise HTTPError(400, "empty prompt")
+        bucket = self.buckets.bucket_for(len(ids))
+        padded = np.full((1, bucket), self.pad_id, np.int32)
+        padded[0, : len(ids)] = ids
+        return padded, np.array([len(ids)], np.int32), bucket
+
+    def _decode(self, ids) -> str:
+        ids = [int(i) for i in ids if int(i) not in (self.pad_id,) and int(i) != self.eos_id]
+        if self._byte_tok:
+            return self.tokenizer.decode(ids)
+        return self.tokenizer.decode(ids, skip_special_tokens=True)
+
+    def example_payload(self) -> Dict[str, Any]:
+        return {"prompt": "the quick brown fox", "temperature": 0.0}
+
+    def generate_text(self, prompt: str, temperature=1.0, top_k=0, top_p=1.0,
+                      max_new_tokens: Optional[int] = None, seed: int = 0):
+        ids, n, bucket = self._encode(prompt)
+        fn = self._gen_for(bucket)
+        res = fn(self.params, jnp.asarray(ids), jnp.asarray(n),
+                 jax.random.PRNGKey(seed), float(temperature), int(top_k),
+                 float(top_p))
+        toks = np.asarray(res.tokens)[0]
+        if max_new_tokens is not None:
+            toks = toks[: max(int(max_new_tokens), 0)]
+        n_gen = int(np.sum(toks != self.pad_id))
+        return self._decode(toks), n_gen
+
+    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = str(payload.get("prompt", payload.get("text", "")))
+        text, n_gen = self.generate_text(
+            prompt,
+            temperature=float(payload.get("temperature", 1.0)),
+            top_k=int(payload.get("top_k", 0)),
+            top_p=float(payload.get("top_p", 1.0)),
+            max_new_tokens=payload.get("max_new_tokens"),
+            seed=int(payload.get("seed", 0)),
+        )
+        return {"generated_text": text, "n_tokens": n_gen}
+
+    def extra_routes(self):
+        def sentiment(request):
+            # reference run-llama.py's bonus /sentiment prompt-template
+            # endpoint (reference ``app/run-llama.py:48-51,82-85``)
+            body = request.json()
+            text = str(body.get("text", ""))
+            prompt = (
+                "Classify the sentiment of the following review as "
+                f"Positive or Negative.\nReview: {text}\nSentiment:"
+            )
+            out, _ = self.generate_text(prompt, temperature=0.0)
+            return {"sentiment": out.strip().split("\n")[0]}
+
+        return [("/sentiment", ("POST",), sentiment)]
+
+
 @register_model("bert")
 def _build_bert(cfg: ServeConfig) -> ModelService:
     return BertService(cfg)
@@ -199,3 +338,21 @@ def _build_bert(cfg: ServeConfig) -> ModelService:
 @register_model("vit")
 def _build_vit(cfg: ServeConfig) -> ModelService:
     return ViTService(cfg)
+
+
+@register_model("llama")
+def _build_llama(cfg: ServeConfig) -> ModelService:
+    return LlamaService(cfg)
+
+
+# Same causal-LM service covers the reference's Mistral and DeepSeek-distill
+# units (reference ``app/run-llama.py`` serves both families by MODEL_ID;
+# ``app/deepseek_model_api.py`` is its /benchmark-bearing twin).
+@register_model("mistral")
+def _build_mistral(cfg: ServeConfig) -> ModelService:
+    return LlamaService(cfg)
+
+
+@register_model("deepseek")
+def _build_deepseek(cfg: ServeConfig) -> ModelService:
+    return LlamaService(cfg)
