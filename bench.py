@@ -1,0 +1,89 @@
+"""Round-end benchmark: prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.json north star): causal-LM decode throughput on a
+single chip — Llama-3.2-1B geometry with random bf16 weights, bucketed
+prefill + ``lax.scan`` decode (the same jit-once generate path serving uses).
+``vs_baseline`` is the ratio to BASELINE.json's published figure when one
+exists; 1.0 marks "no prior round published" (round 1 sets the bar).
+
+Usage: ``python bench.py`` (runs on whatever platform JAX sees; the driver
+gives it the one real TPU chip).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+
+if "--cpu" in sys.argv:  # local smoke; env-var JAX_PLATFORMS is captured too early
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+from scalable_hw_agnostic_inference_tpu.models.generate import make_generate
+from scalable_hw_agnostic_inference_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+
+# Llama-3.2-1B geometry (HF config.json: hidden 2048, 16 layers, 32 heads,
+# 8 kv heads, mlp 8192, vocab 128256) — the model the reference serves via
+# vllm_model_api.py on neuron.
+CFG_1B = LlamaConfig(
+    vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+    mlp_dim=8192, max_seq_len=4096, rope_theta=500000.0, tie_embeddings=True,
+)
+
+BATCH = 8
+PROMPT_BUCKET = 128
+MAX_NEW = 128
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    if platform == "cpu":  # keep a CPU smoke run fast
+        cfg, batch, prompt, new = LlamaConfig.tiny(), 2, 32, 16
+    else:
+        cfg, batch, prompt, new = CFG_1B, BATCH, PROMPT_BUCKET, MAX_NEW
+
+    model = LlamaForCausalLM(cfg, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    params = jax.jit(model.init)(rng, jnp.zeros((1, 8), jnp.int32))
+    params = jax.tree.map(lambda a: a.astype(jnp.bfloat16)
+                          if a.dtype == jnp.float32 else a, params)
+
+    gen = make_generate(model, cfg, prompt_bucket=prompt, max_new_tokens=new,
+                        eos_id=-1)  # never hit EOS: measure full decode
+    ids = jax.random.randint(rng, (batch, prompt), 3, cfg.vocab_size, jnp.int32)
+    plen = jnp.full((batch,), prompt, jnp.int32)
+
+    # compile + warmup
+    out = gen(params, ids, plen, rng, 1.0, 0, 1.0)
+    out.tokens.block_until_ready()
+
+    runs = 3
+    t0 = time.perf_counter()
+    for i in range(runs):
+        out = gen(params, ids, plen, jax.random.fold_in(rng, i), 1.0, 0, 1.0)
+    out.tokens.block_until_ready()
+    dt = (time.perf_counter() - t0) / runs
+    toks_per_s = batch * new / dt
+
+    try:
+        published = json.load(open("BASELINE.json"))["published"]
+        base = published.get("llama1b_decode_tok_s")
+    except Exception:
+        base = None
+    print(json.dumps({
+        "metric": f"llama3.2-1b-geometry decode tok/s (bs={batch}, {platform})",
+        "value": round(toks_per_s, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(toks_per_s / base, 3) if base else 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,28 @@ def resolve_device(device: str) -> str:
     raise ValueError(f"unknown device tier {device!r}")
 
 
+def apply_platform(device: str) -> None:
+    """Pin the process's JAX platform to the requested tier.
+
+    The reference's ``DEVICE`` branch selects a whole accelerator stack at
+    import time (``app/run-sd.py:41-44``); here ``DEVICE=cpu`` must keep the
+    process off the TPU entirely (a cpu-tier pod on a TPU host must not claim
+    the chip). Env vars are captured before our code runs, so use the live
+    config; call before the first backend use.
+    """
+    if device != "cpu":
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        log.warning(
+            "JAX backend already initialized; DEVICE=cpu will fall back to "
+            "default-platform placement"
+        )
+
+
 def local_devices(device: Optional[str] = None) -> List:
     """Devices for the requested tier, in stable id order."""
     import jax

@@ -14,8 +14,7 @@ Works on any causal LM following the ``LlamaForCausalLM`` calling convention
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Dict, NamedTuple, Optional
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp

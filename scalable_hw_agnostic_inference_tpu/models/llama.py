@@ -265,9 +265,15 @@ def tp_rules(axis: str = "tp") -> ShardingRules:
     ])
 
 
-def cache_specs(cfg: LlamaConfig, axis: str = "tp") -> Dict[str, P]:
+def cache_specs(
+    cfg: LlamaConfig, axis: str = "tp", axis_size: int = 1
+) -> Dict[str, P]:
     """KV cache sharded over kv heads (dim 2) when divisible, else replicated."""
-    return {"k": P(None, None, axis, None), "v": P(None, None, axis, None)}
+    if axis_size > 1 and cfg.n_kv_heads % axis_size == 0:
+        spec = P(None, None, axis, None)
+    else:
+        spec = P()
+    return {"k": spec, "v": spec}
 
 
 # ---------------------------------------------------------------------------

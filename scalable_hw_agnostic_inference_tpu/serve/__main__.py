@@ -26,6 +26,9 @@ def main() -> None:
               f"available: {', '.join(list_models())}", file=sys.stderr)
         raise SystemExit(2)
     cfg = ServeConfig.from_env()
+    from ..core.device import apply_platform
+
+    apply_platform(cfg.device)
     service = get_model(name)(cfg)
     serve_forever(cfg, service)
 

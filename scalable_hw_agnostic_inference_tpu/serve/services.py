@@ -233,9 +233,20 @@ class LlamaService(ModelService):
             params = llama.params_from_torch(tm, mcfg)
             del tm
             self.tokenizer = _hf_tokenizer(cfg.model_id, cfg.hf_token)
-            self.eos_id = self.tokenizer.eos_token_id or 2
-            self.pad_id = self.tokenizer.pad_token_id or self.eos_id
+            # `is not None` (not truthiness): token id 0 is a legitimate id
+            eos = self.tokenizer.eos_token_id
+            if eos is None:
+                raise ValueError(f"tokenizer for {cfg.model_id} has no eos_token_id")
+            self.eos_id = int(eos)
+            pad = self.tokenizer.pad_token_id
+            self.pad_id = int(pad) if pad is not None else self.eos_id
             self._byte_tok = False
+            # bf16 on device: the module computes in bf16 regardless, and fp32
+            # placement would double HBM (8B fp32 > one v5e chip)
+            params = jax.tree.map(
+                lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+                params,
+            )
         self.mcfg = mcfg
 
         if cfg.mesh_spec:
@@ -248,7 +259,12 @@ class LlamaService(ModelService):
         self.params = params
 
         max_prompt = min(cfg.max_seq_len, mcfg.max_seq_len - cfg.max_new_tokens)
-        self.buckets = BucketRegistry(pow2_buckets(32, max(32, max_prompt)))
+        if max_prompt < 1:
+            raise ValueError(
+                f"MAX_NEW_TOKENS={cfg.max_new_tokens} leaves no prompt room "
+                f"within the model's max_seq_len={mcfg.max_seq_len}"
+            )
+        self.buckets = BucketRegistry(pow2_buckets(min(32, max_prompt), max_prompt))
         self._gen = {}
         self._make_generate = lambda bucket: make_generate(
             self.model, self.mcfg,
@@ -291,6 +307,12 @@ class LlamaService(ModelService):
 
     def generate_text(self, prompt: str, temperature=1.0, top_k=0, top_p=1.0,
                       max_new_tokens: Optional[int] = None, seed: int = 0):
+        if max_new_tokens is not None and int(max_new_tokens) > self.cfg.max_new_tokens:
+            raise HTTPError(
+                400,
+                f"max_new_tokens={max_new_tokens} exceeds this deployment's "
+                f"compiled cap MAX_NEW_TOKENS={self.cfg.max_new_tokens}",
+            )
         ids, n, bucket = self._encode(prompt)
         fn = self._gen_for(bucket)
         res = fn(self.params, jnp.asarray(ids), jnp.asarray(n),
