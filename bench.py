@@ -1,13 +1,15 @@
 """Round-end benchmark: prints ONE JSON line for the driver.
 
-Headline metric (BASELINE.json north star): causal-LM decode throughput on a
-single chip — Llama-3.2-1B geometry with random bf16 weights, bucketed
-prefill + ``lax.scan`` decode (the same jit-once generate path serving uses).
-``vs_baseline`` is the ratio to BASELINE.json's published figure when one
-exists; 1.0 marks "no prior round published" (round 1 sets the bar).
+Headline (default): SD2.1 512x512 txt2img on a single chip — real UNet/VAE
+geometry (random weights; throughput is weight-value-independent), bf16, the
+whole 25-step CFG denoise loop as one jitted scan. ``vs_baseline`` compares
+single-stream images/sec against the reference's inf2.xlarge unit at its
+published breaking point: latency 0.67 s/img => 1.49 img/s (BASELINE.md,
+reference ``README.md:261``).
 
-Usage: ``python bench.py`` (runs on whatever platform JAX sees; the driver
-gives it the one real TPU chip).
+``python bench.py llama`` benches the causal-LM decode path instead
+(Llama-3.2-1B geometry tokens/sec). ``--cpu`` forces tiny shapes on the CPU
+platform (local smoke only).
 """
 
 from __future__ import annotations
@@ -22,67 +24,110 @@ if "--cpu" in sys.argv:  # local smoke; env-var JAX_PLATFORMS is captured too ea
     jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
+import numpy as np
 
-from scalable_hw_agnostic_inference_tpu.models.generate import make_generate
-from scalable_hw_agnostic_inference_tpu.models.llama import (
-    LlamaConfig,
-    LlamaForCausalLM,
-)
-
-# Llama-3.2-1B geometry (HF config.json: hidden 2048, 16 layers, 32 heads,
-# 8 kv heads, mlp 8192, vocab 128256) — the model the reference serves via
-# vllm_model_api.py on neuron.
-CFG_1B = LlamaConfig(
-    vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
-    mlp_dim=8192, max_seq_len=4096, rope_theta=500000.0, tie_embeddings=True,
-)
-
-BATCH = 8
-PROMPT_BUCKET = 128
-MAX_NEW = 128
+# inf2.xlarge SD2.1 breaking point: 0.67 s/img p50 (reference README.md:261)
+SD_BASELINE_IMG_S = 1.0 / 0.67
 
 
-def main() -> None:
-    platform = jax.devices()[0].platform
-    if platform == "cpu":  # keep a CPU smoke run fast
+def bench_sd(tiny: bool) -> dict:
+    from scalable_hw_agnostic_inference_tpu.models import sd as sd_mod
+
+    if tiny:
+        variant, size, steps, seq = sd_mod.SDVariant.tiny(), 16, 2, 8
+    else:
+        variant, size, steps, seq = sd_mod.SDVariant.sd21_base(), 512, 25, 77
+
+    rng = jax.random.PRNGKey(0)
+    unet = sd_mod.UNet2DCondition(variant.unet)
+    f = 2 ** (len(variant.vae.block_out) - 1)
+    lat = size // f
+    unet_params = jax.jit(unet.init)(
+        rng, jnp.zeros((1, lat, lat, variant.unet.in_channels)),
+        jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1, seq, variant.unet.cross_attention_dim)),
+    )
+    unet_params = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+        unet_params)
+    vae = sd_mod.AutoencoderKL(variant.vae)
+    vae_params = jax.jit(vae.init)(
+        jax.random.PRNGKey(1), jnp.zeros((1, lat, lat, variant.vae.latent_channels)))
+
+    D = variant.unet.cross_attention_dim
+
+    def text_encode(ids):  # conditioning cost is negligible; bench unet+vae
+        return jax.nn.one_hot(ids % D, D, dtype=jnp.bfloat16)
+
+    pipe = sd_mod.StableDiffusion(variant, unet_params, vae_params, text_encode)
+    ids = jnp.zeros((1, seq), jnp.int32)
+
+    pipe.txt2img(ids, ids, rng=rng, height=size, width=size, steps=steps)  # warm
+    runs = 3
+    t0 = time.perf_counter()
+    for i in range(runs):
+        img = pipe.txt2img(ids, ids, rng=jax.random.PRNGKey(i), height=size,
+                           width=size, steps=steps)
+    dt = (time.perf_counter() - t0) / runs
+    assert img.shape[1] == size
+    return {
+        "metric": f"sd21-{size}px {steps}-step txt2img img/s "
+                  f"({jax.devices()[0].platform})",
+        "value": round(1.0 / dt, 4),
+        "unit": "images/sec",
+        "vs_baseline": round((1.0 / dt) / SD_BASELINE_IMG_S, 3),
+    }
+
+
+def bench_llama(tiny: bool) -> dict:
+    from scalable_hw_agnostic_inference_tpu.models.generate import make_generate
+    from scalable_hw_agnostic_inference_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    if tiny:
         cfg, batch, prompt, new = LlamaConfig.tiny(), 2, 32, 16
     else:
-        cfg, batch, prompt, new = CFG_1B, BATCH, PROMPT_BUCKET, MAX_NEW
+        # Llama-3.2-1B geometry (hidden 2048, 16 layers, 32 q / 8 kv heads)
+        cfg = LlamaConfig(
+            vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+            mlp_dim=8192, max_seq_len=4096, rope_theta=500000.0,
+            tie_embeddings=True)
+        batch, prompt, new = 8, 128, 128
 
     model = LlamaForCausalLM(cfg, dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
     params = jax.jit(model.init)(rng, jnp.zeros((1, 8), jnp.int32))
     params = jax.tree.map(lambda a: a.astype(jnp.bfloat16)
                           if a.dtype == jnp.float32 else a, params)
-
     gen = make_generate(model, cfg, prompt_bucket=prompt, max_new_tokens=new,
-                        eos_id=-1)  # never hit EOS: measure full decode
+                        eos_id=-1)
     ids = jax.random.randint(rng, (batch, prompt), 3, cfg.vocab_size, jnp.int32)
     plen = jnp.full((batch,), prompt, jnp.int32)
-
-    # compile + warmup
     out = gen(params, ids, plen, rng, 1.0, 0, 1.0)
     out.tokens.block_until_ready()
-
     runs = 3
     t0 = time.perf_counter()
     for i in range(runs):
         out = gen(params, ids, plen, jax.random.fold_in(rng, i), 1.0, 0, 1.0)
     out.tokens.block_until_ready()
     dt = (time.perf_counter() - t0) / runs
-    toks_per_s = batch * new / dt
-
-    try:
-        published = json.load(open("BASELINE.json"))["published"]
-        base = published.get("llama1b_decode_tok_s")
-    except Exception:
-        base = None
-    print(json.dumps({
-        "metric": f"llama3.2-1b-geometry decode tok/s (bs={batch}, {platform})",
-        "value": round(toks_per_s, 2),
+    toks = batch * new / dt
+    return {
+        "metric": f"llama3.2-1b-geometry decode tok/s (bs={batch}, "
+                  f"{jax.devices()[0].platform})",
+        "value": round(toks, 2),
         "unit": "tokens/sec",
-        "vs_baseline": round(toks_per_s / base, 3) if base else 1.0,
-    }))
+        "vs_baseline": 1.0,
+    }
+
+
+def main() -> None:
+    tiny = jax.devices()[0].platform == "cpu"
+    which = "llama" if "llama" in sys.argv else "sd"
+    out = bench_llama(tiny) if which == "llama" else bench_sd(tiny)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,190 @@
+"""Diffusion noise schedulers (DDIM, Euler) as pure JAX table math.
+
+The reference swaps a ``DDIMScheduler`` into its SD pipeline at load time
+(reference ``app/run-sd.py:108``) and leaves the step loop to diffusers,
+re-crossing the host boundary every denoise step. Here a scheduler is just
+precomputed coefficient tables (numpy, host-side, once) plus a pure
+``step(...)`` that lives INSIDE the jitted ``lax.scan`` denoise loop — no
+host round-trips, no object state mutated per step.
+
+Supports both SD2.1 prediction parameterizations: ``epsilon``
+(2-1-base, 512px) and ``v_prediction`` (2-1, 768px).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleConfig:
+    num_train_timesteps: int = 1000
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+    beta_schedule: str = "scaled_linear"   # or "linear"
+    prediction_type: str = "epsilon"       # or "v_prediction"
+    steps_offset: int = 1
+    timestep_spacing: str = "leading"
+
+
+def betas_for(cfg: ScheduleConfig) -> np.ndarray:
+    if cfg.beta_schedule == "scaled_linear":
+        return np.linspace(
+            cfg.beta_start ** 0.5, cfg.beta_end ** 0.5, cfg.num_train_timesteps
+        ) ** 2
+    if cfg.beta_schedule == "linear":
+        return np.linspace(cfg.beta_start, cfg.beta_end, cfg.num_train_timesteps)
+    raise ValueError(f"unknown beta schedule {cfg.beta_schedule!r}")
+
+
+def alphas_cumprod_for(cfg: ScheduleConfig) -> np.ndarray:
+    return np.cumprod(1.0 - betas_for(cfg))
+
+
+def inference_timesteps(cfg: ScheduleConfig, num_steps: int) -> np.ndarray:
+    """Descending training-timestep indices for an inference run."""
+    if num_steps < 1 or num_steps > cfg.num_train_timesteps:
+        raise ValueError(f"num_steps={num_steps} out of range")
+    if cfg.timestep_spacing == "leading":
+        ratio = cfg.num_train_timesteps // num_steps
+        ts = (np.arange(num_steps) * ratio).round()[::-1].astype(np.int64)
+        ts = ts + cfg.steps_offset
+    elif cfg.timestep_spacing == "trailing":
+        ratio = cfg.num_train_timesteps / num_steps
+        ts = np.arange(cfg.num_train_timesteps, 0, -ratio).round().astype(np.int64) - 1
+    else:
+        raise ValueError(f"unknown timestep spacing {cfg.timestep_spacing!r}")
+    return np.clip(ts, 0, cfg.num_train_timesteps - 1)
+
+
+def pred_x0_and_eps(
+    sample: jax.Array, model_out: jax.Array, acp_t: jax.Array, prediction_type: str
+) -> Tuple[jax.Array, jax.Array]:
+    """Recover (x0, eps) from the model output under either parameterization.
+
+    ``acp_t`` broadcasts against sample (scalar or [B,1,1,1]).
+    """
+    sqrt_acp = jnp.sqrt(acp_t)
+    sqrt_1m = jnp.sqrt(1.0 - acp_t)
+    if prediction_type == "epsilon":
+        eps = model_out
+        x0 = (sample - sqrt_1m * eps) / sqrt_acp
+    elif prediction_type == "v_prediction":
+        x0 = sqrt_acp * sample - sqrt_1m * model_out
+        eps = sqrt_acp * model_out + sqrt_1m * sample
+    else:
+        raise ValueError(f"unknown prediction type {prediction_type!r}")
+    return x0, eps
+
+
+class DDIM:
+    """Deterministic DDIM (eta=0). Tables as device arrays; ``step`` is pure.
+
+    Usage inside a jitted scan: precompute ``(timesteps, acp_t, acp_prev)``
+    with :meth:`tables`, feed them as scan ``xs``.
+    """
+
+    def __init__(self, cfg: ScheduleConfig = ScheduleConfig()):
+        self.cfg = cfg
+        self.alphas_cumprod = alphas_cumprod_for(cfg)
+
+    def tables(self, num_steps: int):
+        """(timesteps [N], acp_t [N], acp_prev [N]) fp32 host arrays."""
+        ts = inference_timesteps(self.cfg, num_steps)
+        acp = self.alphas_cumprod
+        ratio = self.cfg.num_train_timesteps // num_steps
+        prev = ts - ratio
+        acp_t = acp[ts].astype(np.float32)
+        acp_prev = np.where(prev >= 0, acp[np.clip(prev, 0, None)], 1.0).astype(
+            np.float32
+        )
+        return (
+            jnp.asarray(ts, jnp.int32),
+            jnp.asarray(acp_t),
+            jnp.asarray(acp_prev),
+        )
+
+    def step(
+        self, sample: jax.Array, model_out: jax.Array,
+        acp_t: jax.Array, acp_prev: jax.Array,
+    ) -> jax.Array:
+        """One deterministic reverse step x_t -> x_{t-1}. fp32 math."""
+        sample = sample.astype(jnp.float32)
+        model_out = model_out.astype(jnp.float32)
+        x0, eps = pred_x0_and_eps(sample, model_out, acp_t, self.cfg.prediction_type)
+        return jnp.sqrt(acp_prev) * x0 + jnp.sqrt(1.0 - acp_prev) * eps
+
+    def add_noise(self, x0, noise, t: jax.Array) -> jax.Array:
+        """Forward diffusion q(x_t | x_0) (img2img / tests)."""
+        acp = jnp.asarray(self.alphas_cumprod, jnp.float32)[t]
+        while acp.ndim < x0.ndim:
+            acp = acp[..., None]
+        return jnp.sqrt(acp) * x0 + jnp.sqrt(1.0 - acp) * noise
+
+    @property
+    def init_noise_sigma(self) -> float:
+        return 1.0
+
+
+class EulerDiscrete:
+    """Euler (discrete) sampler over the karras-style sigma ladder.
+
+    diffusers' default SD scheduler; one first-order step per sigma.
+    """
+
+    def __init__(self, cfg: ScheduleConfig = ScheduleConfig()):
+        self.cfg = cfg
+        acp = alphas_cumprod_for(cfg)
+        self.sigmas_all = np.sqrt((1 - acp) / acp)
+
+    def tables(self, num_steps: int):
+        """(timesteps [N], sigma_t [N], sigma_next [N]); sigma_next[-1]=0."""
+        ts = inference_timesteps(self.cfg, num_steps)
+        sig = self.sigmas_all[ts].astype(np.float32)
+        sig_next = np.concatenate([sig[1:], [0.0]]).astype(np.float32)
+        return jnp.asarray(ts, jnp.int32), jnp.asarray(sig), jnp.asarray(sig_next)
+
+    @property
+    def init_noise_sigma(self) -> float:
+        """Training-grid upper bound; prefer :meth:`init_sigma_for` per run."""
+        return float(np.sqrt(self.sigmas_all.max() ** 2 + 1))
+
+    def init_sigma_for(self, num_steps: int) -> float:
+        """Initial latent scale for a run: from the FIRST inference sigma
+        (the ladder the steps actually descend), not the training-grid max."""
+        ts = inference_timesteps(self.cfg, num_steps)
+        s0 = float(self.sigmas_all[ts[0]])
+        return float(np.sqrt(s0 ** 2 + 1))
+
+    def scale_model_input(self, sample: jax.Array, sigma: jax.Array) -> jax.Array:
+        return sample / jnp.sqrt(sigma ** 2 + 1)
+
+    def step(self, sample, model_out, sigma, sigma_next) -> jax.Array:
+        """x_{i+1} = x_i + (sigma_next - sigma) * d, d = (x - x0)/sigma."""
+        sample = sample.astype(jnp.float32)
+        model_out = model_out.astype(jnp.float32)
+        acp_t = 1.0 / (sigma ** 2 + 1.0)
+        # model sees the scaled input; recover x0 in sigma space
+        if self.cfg.prediction_type == "epsilon":
+            x0 = sample - sigma * model_out
+        elif self.cfg.prediction_type == "v_prediction":
+            x0 = sample * acp_t - model_out * (sigma * jnp.sqrt(acp_t))
+        else:
+            raise ValueError(self.cfg.prediction_type)
+        d = (sample - x0) / sigma
+        return sample + (sigma_next - sigma) * d
+
+
+SCHEDULERS = {"ddim": DDIM, "euler": EulerDiscrete}
+
+
+def get_scheduler(name: str, cfg: ScheduleConfig = ScheduleConfig()):
+    try:
+        return SCHEDULERS[name](cfg)
+    except KeyError:
+        raise ValueError(f"unknown scheduler {name!r}; have {sorted(SCHEDULERS)}")

@@ -1,0 +1,333 @@
+"""UNet2DConditionModel (the SD denoiser) in flax, NHWC, one jitted forward.
+
+The reference's SD path treats the UNet as a diffusers black box compiled by
+optimum-neuron or ``torch.compile`` (reference ``app/run-sd.py:104-135``,
+``app/compile-sd2.py:13-20``). Here it is first-party: NHWC convs for TPU,
+``ops.attention`` for self/cross attention (pallas flash on TPU where
+eligible), bf16 compute with fp32 time-embedding and norm math where it
+matters, and a declarative converter from the published checkpoint layout.
+
+Geometry covers SD1.x (cross_attention_dim 768, conv proj) and SD2.x
+(1024, linear proj) via :class:`UNetConfig`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from . import convert
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out: Tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    cross_attention_dim: int = 1024
+    attn_heads: Tuple[int, ...] = (5, 10, 20, 20)   # per resolution level
+    cross_attn: Tuple[bool, ...] = (True, True, True, False)  # per level (down order)
+    norm_groups: int = 32
+    transformer_layers: int = 1
+
+    @property
+    def time_embed_dim(self) -> int:
+        return self.block_out[0] * 4
+
+    @classmethod
+    def sd21(cls) -> "UNetConfig":
+        return cls()
+
+    @classmethod
+    def sd15(cls) -> "UNetConfig":
+        return cls(cross_attention_dim=768, attn_heads=(8, 8, 8, 8))
+
+    @classmethod
+    def tiny(cls) -> "UNetConfig":
+        # cross_attention_dim matches ClipTextConfig.tiny().dim so the tiny
+        # serving tier wires the real text-encoder path end-to-end
+        return cls(block_out=(8, 16), layers_per_block=1, cross_attention_dim=32,
+                   attn_heads=(2, 2), cross_attn=(True, False), norm_groups=4)
+
+    @classmethod
+    def from_hf(cls, hf: Dict) -> "UNetConfig":
+        block_out = tuple(hf.get("block_out_channels", (320, 640, 1280, 1280)))
+        # diffusers' documented naming quirk: "attention_head_dim" holds the
+        # NUMBER OF HEADS per level (SD2.x [5,10,20,20] -> 5 heads of dim 64
+        # at 320ch; SD1.x scalar 8 -> 8 heads of dim 40)
+        ahd = hf.get("attention_head_dim", 8)
+        if isinstance(ahd, (list, tuple)):
+            heads = tuple(int(h) for h in ahd)
+        else:
+            heads = tuple(int(ahd) for _ in block_out)
+        down = hf.get("down_block_types",
+                      ("CrossAttnDownBlock2D",) * (len(block_out) - 1) + ("DownBlock2D",))
+        return cls(
+            in_channels=hf.get("in_channels", 4),
+            out_channels=hf.get("out_channels", 4),
+            block_out=block_out,
+            layers_per_block=hf.get("layers_per_block", 2),
+            cross_attention_dim=hf.get("cross_attention_dim", 1024),
+            attn_heads=heads,
+            cross_attn=tuple(t.startswith("CrossAttn") for t in down),
+            norm_groups=hf.get("norm_num_groups", 32),
+            transformer_layers=hf.get("transformer_layers_per_block", 1),
+        )
+
+
+def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0,
+                       flip_sin_to_cos: bool = True,
+                       downscale_freq_shift: float = 0.0) -> jax.Array:
+    """[B] int/float timesteps -> [B, dim] sinusoidal features (fp32)."""
+    half = dim // 2
+    exponent = -math.log(max_period) * jnp.arange(half, dtype=jnp.float32)
+    exponent = exponent / (half - downscale_freq_shift)
+    freqs = jnp.exp(exponent)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    emb = jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+    if flip_sin_to_cos:
+        emb = jnp.concatenate([emb[:, half:], emb[:, :half]], axis=-1)
+    return emb
+
+
+def _conv(ch: int, kernel: int, name: str, stride: int = 1, dtype=jnp.bfloat16):
+    # dtype on the conv keeps compute in bf16 (fp32 params are cast in);
+    # without it, fp32 params promote the whole graph off the MXU fast path
+    return nn.Conv(ch, (kernel, kernel), strides=(stride, stride),
+                   padding=[(kernel // 2, kernel // 2)] * 2, dtype=dtype, name=name)
+
+
+class ResBlock(nn.Module):
+    """GN-SiLU-conv x2 with time-embedding injection between the convs."""
+
+    out_ch: int
+    groups: int = 32
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, temb: jax.Array) -> jax.Array:
+        h = nn.GroupNorm(self.groups, dtype=jnp.float32, name="norm1")(x)
+        h = nn.silu(h).astype(self.dtype)
+        h = _conv(self.out_ch, 3, "conv1", dtype=self.dtype)(h)
+        t = nn.Dense(self.out_ch, dtype=self.dtype, name="time_emb")(
+            nn.silu(temb).astype(self.dtype))
+        h = h + t[:, None, None, :]
+        h = nn.GroupNorm(self.groups, dtype=jnp.float32, name="norm2")(h)
+        h = nn.silu(h).astype(self.dtype)
+        h = _conv(self.out_ch, 3, "conv2", dtype=self.dtype)(h)
+        if x.shape[-1] != self.out_ch:
+            x = _conv(self.out_ch, 1, "shortcut", dtype=self.dtype)(x)
+        return (x + h).astype(self.dtype)
+
+
+class CrossAttention(nn.Module):
+    heads: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
+        B, T, C = x.shape
+        ctx = x if context is None else context
+        Dh = C // self.heads
+        dense = lambda n, name, bias=False: nn.Dense(
+            n, use_bias=bias, dtype=self.dtype, name=name)
+        q = dense(C, "q")(x).reshape(B, T, self.heads, Dh)
+        k = dense(C, "k")(ctx).reshape(B, ctx.shape[1], self.heads, Dh)
+        v = dense(C, "v")(ctx).reshape(B, ctx.shape[1], self.heads, Dh)
+        o = dot_product_attention(q, k, v).reshape(B, T, C)
+        return dense(C, "o", bias=True)(o)
+
+
+class TransformerBlock(nn.Module):
+    """ln->self-attn, ln->cross-attn, ln->geglu ff (diffusers Basic block)."""
+
+    heads: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
+        C = x.shape[-1]
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        x = x + CrossAttention(self.heads, self.dtype, name="attn1")(
+            ln("norm1")(x).astype(self.dtype), None)
+        x = x + CrossAttention(self.heads, self.dtype, name="attn2")(
+            ln("norm2")(x).astype(self.dtype), context)
+        h = ln("norm3")(x).astype(self.dtype)
+        h = nn.Dense(C * 8, dtype=self.dtype, name="ff_in")(h)
+        val, gate = jnp.split(h, 2, axis=-1)
+        h = val * nn.gelu(gate)
+        return x + nn.Dense(C, dtype=self.dtype, name="ff_out")(h)
+
+
+class Transformer2D(nn.Module):
+    """Spatial transformer: GN -> proj_in -> blocks -> proj_out, residual."""
+
+    heads: int
+    n_layers: int = 1
+    groups: int = 32
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
+        B, H, W, C = x.shape
+        h = nn.GroupNorm(self.groups, dtype=jnp.float32, name="norm")(x)
+        h = h.reshape(B, H * W, C).astype(self.dtype)
+        h = nn.Dense(C, dtype=self.dtype, name="proj_in")(h)
+        for i in range(self.n_layers):
+            h = TransformerBlock(self.heads, self.dtype, name=f"block_{i}")(h, context)
+        h = nn.Dense(C, dtype=self.dtype, name="proj_out")(h)
+        return x + h.reshape(B, H, W, C)
+
+
+class UNet2DCondition(nn.Module):
+    """sample [B,H,W,Cin], timesteps [B], context [B,L,ctx] -> [B,H,W,Cout]."""
+
+    cfg: UNetConfig
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, sample: jax.Array, timesteps: jax.Array,
+                 context: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        n_levels = len(cfg.block_out)
+        context = context.astype(self.dtype)
+
+        temb = timestep_embedding(timesteps, cfg.block_out[0])
+        temb = nn.Dense(cfg.time_embed_dim, name="time_embed_1")(temb)
+        temb = nn.Dense(cfg.time_embed_dim, name="time_embed_2")(nn.silu(temb))
+        temb = temb.astype(self.dtype)
+
+        res = lambda ch, name: ResBlock(ch, cfg.norm_groups, self.dtype, name=name)
+        xf = lambda heads, name: Transformer2D(
+            heads, cfg.transformer_layers, cfg.norm_groups, self.dtype, name=name)
+
+        h = _conv(cfg.block_out[0], 3, "conv_in", dtype=self.dtype)(
+            sample.astype(self.dtype))
+        skips = [h]
+        for i, ch in enumerate(cfg.block_out):
+            for j in range(cfg.layers_per_block):
+                h = res(ch, f"down_{i}_res_{j}")(h, temb)
+                if cfg.cross_attn[i]:
+                    h = xf(cfg.attn_heads[i], f"down_{i}_attn_{j}")(h, context)
+                skips.append(h)
+            if i < n_levels - 1:
+                h = _conv(ch, 3, f"down_{i}_conv", stride=2, dtype=self.dtype)(h)
+                skips.append(h)
+
+        mid_ch = cfg.block_out[-1]
+        h = res(mid_ch, "mid_res_0")(h, temb)
+        h = xf(cfg.attn_heads[-1], "mid_attn")(h, context)
+        h = res(mid_ch, "mid_res_1")(h, temb)
+
+        for i, ch in enumerate(reversed(cfg.block_out)):
+            level = n_levels - 1 - i
+            for j in range(cfg.layers_per_block + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = res(ch, f"up_{i}_res_{j}")(h, temb)
+                if cfg.cross_attn[level]:
+                    h = xf(cfg.attn_heads[level], f"up_{i}_attn_{j}")(h, context)
+            if i < n_levels - 1:
+                B, H, W, C = h.shape
+                h = jax.image.resize(h, (B, H * 2, W * 2, C), "nearest")
+                h = _conv(ch, 3, f"up_{i}_conv", dtype=self.dtype)(h)
+
+        h = nn.GroupNorm(cfg.norm_groups, dtype=jnp.float32, name="norm_out")(h)
+        h = nn.silu(h)
+        out = _conv(cfg.out_channels, 3, "conv_out", dtype=jnp.float32)(h)
+        return out.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint conversion (diffusers UNet2DConditionModel state-dict layout)
+# ---------------------------------------------------------------------------
+
+def _maybe_conv_to_dense(sd, p: str) -> Dict[str, Any]:
+    """proj_in/proj_out: linear (SD2.x) or 1x1 conv (SD1.x) -> Dense."""
+    w = convert.t2j(sd[f"{p}.weight"])
+    if w.ndim == 4:
+        w = w[:, :, 0, 0]
+    return {"kernel": w.T, "bias": convert.t2j(sd[f"{p}.bias"])}
+
+
+def _resnet(sd, p: str) -> Dict[str, Any]:
+    out = {
+        "norm1": convert.group_norm(sd, f"{p}.norm1"),
+        "conv1": convert.conv2d(sd, f"{p}.conv1"),
+        "time_emb": convert.linear(sd, f"{p}.time_emb_proj"),
+        "norm2": convert.group_norm(sd, f"{p}.norm2"),
+        "conv2": convert.conv2d(sd, f"{p}.conv2"),
+    }
+    if f"{p}.conv_shortcut.weight" in sd:
+        out["shortcut"] = convert.conv2d(sd, f"{p}.conv_shortcut")
+    return out
+
+
+def _attn(sd, p: str) -> Dict[str, Any]:
+    return {
+        "q": convert.linear(sd, f"{p}.to_q"),
+        "k": convert.linear(sd, f"{p}.to_k"),
+        "v": convert.linear(sd, f"{p}.to_v"),
+        "o": convert.linear(sd, f"{p}.to_out.0"),
+    }
+
+
+def _transformer(sd, p: str, n_layers: int) -> Dict[str, Any]:
+    out = {
+        "norm": convert.group_norm(sd, f"{p}.norm"),
+        "proj_in": _maybe_conv_to_dense(sd, f"{p}.proj_in"),
+        "proj_out": _maybe_conv_to_dense(sd, f"{p}.proj_out"),
+    }
+    for i in range(n_layers):
+        b = f"{p}.transformer_blocks.{i}"
+        out[f"block_{i}"] = {
+            "norm1": convert.layer_norm(sd, f"{b}.norm1"),
+            "attn1": _attn(sd, f"{b}.attn1"),
+            "norm2": convert.layer_norm(sd, f"{b}.norm2"),
+            "attn2": _attn(sd, f"{b}.attn2"),
+            "norm3": convert.layer_norm(sd, f"{b}.norm3"),
+            "ff_in": convert.linear(sd, f"{b}.ff.net.0.proj"),
+            "ff_out": convert.linear(sd, f"{b}.ff.net.2"),
+        }
+    return out
+
+
+def params_from_torch(model_or_sd, cfg: UNetConfig) -> Dict[str, Any]:
+    sd = convert.state_dict_of(model_or_sd)
+    n_levels = len(cfg.block_out)
+    tree: Dict[str, Any] = {
+        "time_embed_1": convert.linear(sd, "time_embedding.linear_1"),
+        "time_embed_2": convert.linear(sd, "time_embedding.linear_2"),
+        "conv_in": convert.conv2d(sd, "conv_in"),
+        "mid_res_0": _resnet(sd, "mid_block.resnets.0"),
+        "mid_attn": _transformer(sd, "mid_block.attentions.0", cfg.transformer_layers),
+        "mid_res_1": _resnet(sd, "mid_block.resnets.1"),
+        "norm_out": convert.group_norm(sd, "conv_norm_out"),
+        "conv_out": convert.conv2d(sd, "conv_out"),
+    }
+    for i in range(n_levels):
+        for j in range(cfg.layers_per_block):
+            tree[f"down_{i}_res_{j}"] = _resnet(sd, f"down_blocks.{i}.resnets.{j}")
+            if cfg.cross_attn[i]:
+                tree[f"down_{i}_attn_{j}"] = _transformer(
+                    sd, f"down_blocks.{i}.attentions.{j}", cfg.transformer_layers)
+        if i < n_levels - 1:
+            tree[f"down_{i}_conv"] = convert.conv2d(
+                sd, f"down_blocks.{i}.downsamplers.0.conv")
+    for i in range(n_levels):
+        level = n_levels - 1 - i
+        for j in range(cfg.layers_per_block + 1):
+            tree[f"up_{i}_res_{j}"] = _resnet(sd, f"up_blocks.{i}.resnets.{j}")
+            if cfg.cross_attn[level]:
+                tree[f"up_{i}_attn_{j}"] = _transformer(
+                    sd, f"up_blocks.{i}.attentions.{j}", cfg.transformer_layers)
+        if i < n_levels - 1:
+            tree[f"up_{i}_conv"] = convert.conv2d(
+                sd, f"up_blocks.{i}.upsamplers.0.conv")
+    return {"params": tree}

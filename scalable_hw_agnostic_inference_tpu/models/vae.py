@@ -1,0 +1,256 @@
+"""AutoencoderKL (the SD VAE) in flax, NHWC end-to-end.
+
+The reference consumes the VAE as an opaque traced artifact
+(``torch_neuronx.trace`` of the decoder at frozen latent shape, reference
+``app/src/decoder/compile.py:31-37``) or inside the diffusers pipeline
+(``app/run-sd.py:104-135``). Here it is a first-party flax module: NHWC
+layout (TPU conv-friendly), GroupNorm+SiLU resnet stacks, single-head
+spatial attention in the mid block, and a converter from the published
+checkpoint layout. Decode is one jitted function at bucketed H/W.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from . import convert
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    block_out: Tuple[int, ...] = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_groups: int = 32
+    scaling_factor: float = 0.18215
+
+    @classmethod
+    def tiny(cls) -> "VAEConfig":
+        return cls(block_out=(8, 16), layers_per_block=1, norm_groups=4,
+                   scaling_factor=0.18215)
+
+    @classmethod
+    def from_hf(cls, hf) -> "VAEConfig":
+        return cls(
+            in_channels=hf.get("in_channels", 3),
+            latent_channels=hf.get("latent_channels", 4),
+            block_out=tuple(hf.get("block_out_channels", (128, 256, 512, 512))),
+            layers_per_block=hf.get("layers_per_block", 2),
+            norm_groups=hf.get("norm_num_groups", 32),
+            scaling_factor=hf.get("scaling_factor", 0.18215),
+        )
+
+
+def _conv(ch: int, kernel: int, name: str, stride: int = 1):
+    return nn.Conv(ch, (kernel, kernel), strides=(stride, stride),
+                   padding=[(kernel // 2, kernel // 2)] * 2, name=name)
+
+
+class ResnetBlock(nn.Module):
+    out_ch: int
+    groups: int = 32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = nn.GroupNorm(self.groups, name="norm1")(x)
+        h = nn.silu(h)
+        h = _conv(self.out_ch, 3, "conv1")(h)
+        h = nn.GroupNorm(self.groups, name="norm2")(h)
+        h = nn.silu(h)
+        h = _conv(self.out_ch, 3, "conv2")(h)
+        if x.shape[-1] != self.out_ch:
+            x = _conv(self.out_ch, 1, "shortcut")(x)
+        return x + h
+
+
+class SpatialAttention(nn.Module):
+    """Single-head attention over H*W tokens (the VAE mid-block attention)."""
+
+    groups: int = 32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        B, H, W, C = x.shape
+        h = nn.GroupNorm(self.groups, name="norm")(x).reshape(B, H * W, C)
+        q = nn.Dense(C, name="q")(h)
+        k = nn.Dense(C, name="k")(h)
+        v = nn.Dense(C, name="v")(h)
+        s = jnp.einsum("btc,bsc->bts", q, k,
+                       preferred_element_type=jnp.float32) / (C ** 0.5)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bts,bsc->btc", p, v)
+        o = nn.Dense(C, name="o")(o).reshape(B, H, W, C)
+        return x + o
+
+
+class MidBlock(nn.Module):
+    ch: int
+    groups: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        x = ResnetBlock(self.ch, self.groups, name="res1")(x)
+        x = SpatialAttention(self.groups, name="attn")(x)
+        x = ResnetBlock(self.ch, self.groups, name="res2")(x)
+        return x
+
+
+class Decoder(nn.Module):
+    cfg: VAEConfig
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        rev = tuple(reversed(cfg.block_out))
+        h = _conv(rev[0], 3, "conv_in")(z)
+        h = MidBlock(rev[0], cfg.norm_groups, name="mid")(h)
+        n_up = len(rev)
+        for i, ch in enumerate(rev):
+            for j in range(cfg.layers_per_block + 1):
+                h = ResnetBlock(ch, cfg.norm_groups, name=f"up_{i}_res_{j}")(h)
+            if i < n_up - 1:
+                B, H, W, C = h.shape
+                h = jax.image.resize(h, (B, H * 2, W * 2, C), "nearest")
+                h = _conv(ch, 3, f"up_{i}_conv")(h)
+        h = nn.GroupNorm(cfg.norm_groups, name="norm_out")(h)
+        h = nn.silu(h)
+        return _conv(cfg.in_channels, 3, "conv_out")(h)
+
+
+class Encoder(nn.Module):
+    cfg: VAEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        h = _conv(cfg.block_out[0], 3, "conv_in")(x)
+        n = len(cfg.block_out)
+        for i, ch in enumerate(cfg.block_out):
+            for j in range(cfg.layers_per_block):
+                h = ResnetBlock(ch, cfg.norm_groups, name=f"down_{i}_res_{j}")(h)
+            if i < n - 1:
+                # diffusers pads (0,1,0,1) then convs stride 2 with VALID
+                h = jnp.pad(h, ((0, 0), (0, 1), (0, 1), (0, 0)))
+                h = nn.Conv(ch, (3, 3), strides=(2, 2), padding="VALID",
+                            name=f"down_{i}_conv")(h)
+        h = MidBlock(cfg.block_out[-1], cfg.norm_groups, name="mid")(h)
+        h = nn.GroupNorm(cfg.norm_groups, name="norm_out")(h)
+        h = nn.silu(h)
+        return _conv(2 * cfg.latent_channels, 3, "conv_out")(h)
+
+
+class AutoencoderKL(nn.Module):
+    """decode(z) -> image in [-1, 1]; encode(x) -> (mean, logvar)."""
+
+    cfg: VAEConfig
+
+    def setup(self):
+        self.decoder = Decoder(self.cfg)
+        self.encoder = Encoder(self.cfg)
+        self.post_quant = nn.Dense(self.cfg.latent_channels, name="post_quant")
+        self.quant = nn.Dense(2 * self.cfg.latent_channels, name="quant")
+
+    def decode(self, z: jax.Array) -> jax.Array:
+        """z: [B, h, w, latent] *scaled* latents (divides by scaling_factor)."""
+        z = z / self.cfg.scaling_factor
+        return self.decoder(self.post_quant(z))
+
+    def encode(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        m = self.quant(self.encoder(x))
+        mean, logvar = jnp.split(m, 2, axis=-1)
+        return mean, jnp.clip(logvar, -30.0, 20.0)
+
+    def __call__(self, z):
+        return self.decode(z)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint conversion (diffusers AutoencoderKL state-dict layout)
+# ---------------------------------------------------------------------------
+
+def _resnet(sd, p: str) -> Dict[str, Any]:
+    out = {
+        "norm1": convert.group_norm(sd, f"{p}.norm1"),
+        "conv1": convert.conv2d(sd, f"{p}.conv1"),
+        "norm2": convert.group_norm(sd, f"{p}.norm2"),
+        "conv2": convert.conv2d(sd, f"{p}.conv2"),
+    }
+    if f"{p}.conv_shortcut.weight" in sd:
+        out["shortcut"] = convert.conv2d(sd, f"{p}.conv_shortcut")
+    return out
+
+
+def _mid(sd, p: str) -> Dict[str, Any]:
+    a = f"{p}.attentions.0"
+    # modern diffusers uses to_q/to_k/to_v/to_out.0; older query/key/value/proj_attn
+    if f"{a}.to_q.weight" in sd:
+        q, k, v, o, g = "to_q", "to_k", "to_v", "to_out.0", "group_norm"
+    else:
+        q, k, v, o, g = "query", "key", "value", "proj_attn", "group_norm"
+
+    def lin(name):
+        w = convert.t2j(sd[f"{a}.{name}.weight"])
+        if w.ndim == 4:  # very old checkpoints store 1x1 convs
+            w = w[:, :, 0, 0]
+        return {"kernel": w.T, "bias": convert.t2j(sd[f"{a}.{name}.bias"])}
+
+    return {
+        "res1": _resnet(sd, f"{p}.resnets.0"),
+        "res2": _resnet(sd, f"{p}.resnets.1"),
+        "attn": {
+            "norm": convert.group_norm(sd, f"{a}.{g}"),
+            "q": lin(q), "k": lin(k), "v": lin(v), "o": lin(o),
+        },
+    }
+
+
+def _conv1x1_as_dense(sd, p: str) -> Dict[str, Any]:
+    w = convert.t2j(sd[f"{p}.weight"])[:, :, 0, 0]  # [O, I, 1, 1] -> [O, I]
+    return {"kernel": w.T, "bias": convert.t2j(sd[f"{p}.bias"])}
+
+
+def params_from_torch(model_or_sd, cfg: VAEConfig) -> Dict[str, Any]:
+    sd = convert.state_dict_of(model_or_sd)
+    rev = tuple(reversed(cfg.block_out))
+    dec: Dict[str, Any] = {
+        "conv_in": convert.conv2d(sd, "decoder.conv_in"),
+        "mid": _mid(sd, "decoder.mid_block"),
+        "norm_out": convert.group_norm(sd, "decoder.conv_norm_out"),
+        "conv_out": convert.conv2d(sd, "decoder.conv_out"),
+    }
+    for i, ch in enumerate(rev):
+        for j in range(cfg.layers_per_block + 1):
+            dec[f"up_{i}_res_{j}"] = _resnet(
+                sd, f"decoder.up_blocks.{i}.resnets.{j}"
+            )
+        if i < len(rev) - 1:
+            dec[f"up_{i}_conv"] = convert.conv2d(
+                sd, f"decoder.up_blocks.{i}.upsamplers.0.conv"
+            )
+    enc: Dict[str, Any] = {
+        "conv_in": convert.conv2d(sd, "encoder.conv_in"),
+        "mid": _mid(sd, "encoder.mid_block"),
+        "norm_out": convert.group_norm(sd, "encoder.conv_norm_out"),
+        "conv_out": convert.conv2d(sd, "encoder.conv_out"),
+    }
+    for i, ch in enumerate(cfg.block_out):
+        for j in range(cfg.layers_per_block):
+            enc[f"down_{i}_res_{j}"] = _resnet(
+                sd, f"encoder.down_blocks.{i}.resnets.{j}"
+            )
+        if i < len(cfg.block_out) - 1:
+            enc[f"down_{i}_conv"] = convert.conv2d(
+                sd, f"encoder.down_blocks.{i}.downsamplers.0.conv"
+            )
+    return {"params": {
+        "decoder": dec,
+        "encoder": enc,
+        "post_quant": _conv1x1_as_dense(sd, "post_quant_conv"),
+        "quant": _conv1x1_as_dense(sd, "quant_conv"),
+    }}

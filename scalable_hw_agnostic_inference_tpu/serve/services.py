@@ -352,6 +352,139 @@ class LlamaService(ModelService):
         return [("/sentiment", ("POST",), sentiment)]
 
 
+class SDService(ModelService):
+    """Text-to-image — parity with reference ``run-sd.py``/``run-sd2.py``
+    (SD2.1 512x512, DDIM swap at ``app/run-sd.py:108``, base64 PNG response
+    ``:177-181``). The whole denoise loop is one jitted scan
+    (``models.sd.StableDiffusion``); warmup compiles the serving shape so
+    readiness implies the executable is built.
+    """
+
+    task = "text-to-image"
+    infer_route = "/genimage"
+
+    def load(self) -> None:
+        from ..models import clip, sd
+
+        cfg = self.cfg
+        if cfg.model_id in ("", "tiny"):
+            variant = sd.SDVariant.tiny()
+            ccfg = clip.ClipTextConfig.tiny()
+            text_model = clip.ClipTextEncoder(ccfg)
+            text_params = text_model.init(
+                jax.random.PRNGKey(cfg.seed), jnp.zeros((1, 8), jnp.int32)
+            )
+            unet = sd.UNet2DCondition(variant.unet)
+            unet_params = unet.init(
+                jax.random.PRNGKey(cfg.seed + 1),
+                jnp.zeros((1, 8, 8, variant.unet.in_channels)),
+                jnp.zeros((1,), jnp.int32),
+                jnp.zeros((1, 8, variant.unet.cross_attention_dim)),
+            )
+            vae = sd.AutoencoderKL(variant.vae)
+            vae_params = vae.init(
+                jax.random.PRNGKey(cfg.seed + 2),
+                jnp.zeros((1, 8, 8, variant.vae.latent_channels)),
+            )
+            self.tokenizer = HashTokenizer(ccfg.vocab_size, ccfg.max_position)
+            self.seq_len = ccfg.max_position
+        else:
+            from transformers import CLIPTextModel
+
+            from ..models import unet as unet_mod
+            from ..models import vae as vae_mod
+
+            root = sd.resolve_checkpoint_dir(cfg.model_id, cfg.hf_token)
+            variant = sd.variant_from_checkpoint(root)
+            tm = CLIPTextModel.from_pretrained(root, subfolder="text_encoder")
+            ccfg = clip.ClipTextConfig.from_hf(tm.config)
+            text_model = clip.ClipTextEncoder(ccfg)
+            text_params = clip.params_from_torch(tm, ccfg)
+            del tm
+            unet_params = unet_mod.params_from_torch(
+                sd.load_torch_state(f"{root}/unet"), variant.unet
+            )
+            vae_params = vae_mod.params_from_torch(
+                sd.load_torch_state(f"{root}/vae"), variant.vae
+            )
+            self.tokenizer = _hf_tokenizer(root + "/tokenizer", cfg.hf_token)
+            self.seq_len = ccfg.max_position
+            # bf16 placement for the hot path (UNet); VAE stays fp32
+            unet_params = jax.tree.map(
+                lambda a: a.astype(jnp.bfloat16)
+                if getattr(a, "dtype", None) == np.float32 else a,
+                unet_params,
+            )
+
+        text_params = jax.device_put(text_params)
+        text_fn = jax.jit(lambda ids: text_model.apply(text_params, ids)[0])
+        self.pipe = sd.StableDiffusion(
+            variant,
+            jax.device_put(unet_params),
+            jax.device_put(vae_params),
+            text_fn,
+            scheduler=cfg.scheduler,
+        )
+        self.variant = variant
+        if cfg.model_id in ("", "tiny"):
+            self.height = self.width = variant.default_size
+        else:
+            self.height, self.width = cfg.height, cfg.width
+        # XLA compiles one executable per steps value — a client must not be
+        # able to force arbitrary compiles, so steps is a closed set (env
+        # STEPS_BUCKETS opts extra values in; all are compile-warmed below)
+        self.steps_allowed = {cfg.num_inference_steps}
+        if cfg.steps_buckets:
+            self.steps_allowed |= {int(s) for s in cfg.steps_buckets.split(",")}
+
+    def warmup(self) -> None:
+        # warm at batch 1 — the shape infer() actually runs
+        for steps in sorted(self.steps_allowed):
+            self.pipe.warm(1, self.height, self.width, steps, self.seq_len)
+
+    def _tokenize(self, text: str) -> np.ndarray:
+        if isinstance(self.tokenizer, HashTokenizer):
+            ids, _ = self.tokenizer(text)
+            return ids[None].astype(np.int32)
+        enc = self.tokenizer(
+            text, padding="max_length", truncation=True, max_length=self.seq_len
+        )
+        return np.asarray(enc["input_ids"], np.int32)[None]
+
+    def example_payload(self) -> Dict[str, Any]:
+        return {"prompt": "a photo of an astronaut riding a horse", "steps": None}
+
+    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        from ..models.sd import to_png_base64
+
+        cfg = self.cfg
+        prompt = str(payload.get("prompt", payload.get("text", "")))
+        steps_raw = payload.get("steps")
+        steps = cfg.num_inference_steps if steps_raw is None else int(steps_raw)
+        if steps not in self.steps_allowed:
+            raise HTTPError(
+                400,
+                f"steps={steps} not in this deployment's compiled set "
+                f"{sorted(self.steps_allowed)} (extend via STEPS_BUCKETS)",
+            )
+        guidance = float(payload.get("guidance_scale", cfg.guidance_scale))
+        seed = int(payload.get("seed", 0))
+        ids = self._tokenize(prompt)
+        uncond = self._tokenize(str(payload.get("negative_prompt", "")))
+        imgs = self.pipe.txt2img(
+            jnp.asarray(ids), jnp.asarray(uncond),
+            rng=jax.random.PRNGKey(seed),
+            height=self.height, width=self.width,
+            steps=steps, guidance_scale=guidance,
+        )
+        return {
+            "image_b64": to_png_base64(imgs[0]),
+            "steps": steps,
+            "height": self.height,
+            "width": self.width,
+        }
+
+
 @register_model("bert")
 def _build_bert(cfg: ServeConfig) -> ModelService:
     return BertService(cfg)
@@ -378,3 +511,16 @@ def _build_mistral(cfg: ServeConfig) -> ModelService:
 @register_model("deepseek")
 def _build_deepseek(cfg: ServeConfig) -> ModelService:
     return LlamaService(cfg)
+
+
+# One SD service covers the reference's run-sd.py / run-sd2.py twins (they
+# differ only in the Gradio title, reference ``run-sd.py:151`` vs
+# ``run-sd2.py:151``) and the SD1.5 geometry.
+@register_model("sd")
+def _build_sd(cfg: ServeConfig) -> ModelService:
+    return SDService(cfg)
+
+
+@register_model("sd2")
+def _build_sd2(cfg: ServeConfig) -> ModelService:
+    return SDService(cfg)

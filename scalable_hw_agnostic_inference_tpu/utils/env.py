@@ -75,6 +75,8 @@ class ServeConfig:
     width: int = 512
     guidance_scale: float = 7.5
     batch_size: int = 1
+    scheduler: str = "ddim"              # diffusion sampler: ddim | euler
+    steps_buckets: str = ""              # extra allowed steps values, csv
     # mesh / parallelism
     mesh_spec: str = ""                  # e.g. "tp=4" or "dp=2,tp=4"; "" = single device
     submesh: str = ""                    # e.g. "0:4" — device-slice placement
@@ -104,6 +106,8 @@ class ServeConfig:
             width=env_int("WIDTH", 512),
             guidance_scale=env_float("GUIDANCE_SCALE", 7.5),
             batch_size=env_int("BATCH_SIZE", 1),
+            scheduler=env_str("SCHEDULER", "ddim"),
+            steps_buckets=env_str("STEPS_BUCKETS", ""),
             mesh_spec=env_str("MESH_SPEC", ""),
             submesh=env_str("SUBMESH", ""),
             port=env_int("PORT", 8000),

@@ -1,0 +1,45 @@
+"""SD service over the real HTTP surface (tiny tier, CPU)."""
+
+import base64
+import io
+
+import httpx
+import pytest
+
+from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+from test_serve_http import make_client, wait_ready
+
+
+@pytest.mark.asyncio
+async def test_sd_service_genimage_roundtrip():
+    cfg = ServeConfig(app="sd21", model_id="tiny", device="cpu",
+                      num_inference_steps=2, batch_size=1)
+    service = get_model("sd")(cfg)
+    app = create_app(cfg, service)
+    async with make_client(app) as c:
+        r = await wait_ready(c, timeout=180.0)
+        assert r.status_code == 200, r.text
+
+        r = await c.post("/genimage", json={"prompt": "a red square",
+                                            "steps": 2, "seed": 7})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(base64.b64decode(body["image_b64"])))
+        assert img.size == (64, 64)  # tiny variant default_size
+        assert body["steps"] == 2
+
+        # same seed → identical image; different seed → different image
+        r2 = await c.post("/genimage", json={"prompt": "a red square",
+                                             "steps": 2, "seed": 7})
+        assert r2.json()["image_b64"] == body["image_b64"]
+        r3 = await c.post("/genimage", json={"prompt": "a red square",
+                                             "steps": 2, "seed": 8})
+        assert r3.json()["image_b64"] != body["image_b64"]
+
+        r = await c.post("/genimage", json={"prompt": "x", "steps": 0})
+        assert r.status_code == 400
