@@ -47,9 +47,9 @@ def bench_sd(tiny: bool) -> dict:
         jnp.zeros((1,), jnp.int32),
         jnp.zeros((1, seq, variant.unet.cross_attention_dim)),
     )
-    unet_params = jax.tree.map(
-        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
-        unet_params)
+    from scalable_hw_agnostic_inference_tpu.models.convert import cast_f32_to_bf16
+
+    unet_params = cast_f32_to_bf16(unet_params)
     vae = sd_mod.AutoencoderKL(variant.vae)
     vae_params = jax.jit(vae.init)(
         jax.random.PRNGKey(1), jnp.zeros((1, lat, lat, variant.vae.latent_channels)))
@@ -96,11 +96,12 @@ def bench_llama(tiny: bool) -> dict:
             tie_embeddings=True)
         batch, prompt, new = 8, 128, 128
 
+    from scalable_hw_agnostic_inference_tpu.models.convert import cast_f32_to_bf16
+
     model = LlamaForCausalLM(cfg, dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
     params = jax.jit(model.init)(rng, jnp.zeros((1, 8), jnp.int32))
-    params = jax.tree.map(lambda a: a.astype(jnp.bfloat16)
-                          if a.dtype == jnp.float32 else a, params)
+    params = cast_f32_to_bf16(params)
     gen = make_generate(model, cfg, prompt_bucket=prompt, max_new_tokens=new,
                         eos_id=-1)
     ids = jax.random.randint(rng, (batch, prompt), 3, cfg.vocab_size, jnp.int32)
@@ -114,12 +115,17 @@ def bench_llama(tiny: bool) -> dict:
     out.tokens.block_until_ready()
     dt = (time.perf_counter() - t0) / runs
     toks = batch * new / dt
+    try:
+        published = json.load(open("BASELINE.json"))["published"]
+        base = published.get("llama1b_decode_tok_s")
+    except Exception:
+        base = None
     return {
         "metric": f"llama3.2-1b-geometry decode tok/s (bs={batch}, "
                   f"{jax.devices()[0].platform})",
         "value": round(toks, 2),
         "unit": "tokens/sec",
-        "vs_baseline": 1.0,
+        "vs_baseline": round(toks / base, 3) if base else 1.0,
     }
 
 

@@ -1,0 +1,13 @@
+"""TPU-native continuous-batching LLM engine.
+
+The reference consumes this capability through the vLLM neuron fork
+(``LLM(**vllm_config.yaml)``, reference ``app/vllm_model_api.py:33-34``;
+bucketing/continuous-batching knobs
+``cova/mllama-32-11b-vllm-trn1-config.yaml:10-22``). Here the engine is
+first-party: paged KV cache with host-side block allocation, bucketed
+prefill, one jitted decode step for the whole running batch, on-device
+sampling, and a continuous-batching scheduler — all static-shaped for XLA.
+"""
+
+from .cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .config import EngineConfig  # noqa: F401

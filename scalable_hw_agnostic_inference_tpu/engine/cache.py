@@ -1,0 +1,127 @@
+"""Paged KV cache: device block pool + host-side block allocator.
+
+The reference gets paged KV from vLLM's neuron fork (``block_size: 4096``,
+reference ``cova/mllama-32-11b-vllm-trn1-config.yaml:16``). TPU-natively the
+pool is one device array per layer ``[num_blocks, block_size, n_kv, head_dim]``
+— block tables are *data* (int32 arrays), so one compiled executable serves
+any allocation pattern; only bucket shapes trigger compiles.
+
+Allocation is host-side and O(1) per block (free list). The device never
+sees fragmentation: gathers go through block tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BlockAllocator:
+    """Free-list allocator over ``total_blocks`` physical blocks.
+
+    Block 0 is reserved as the null block (block tables are padded with 0;
+    its contents are garbage but always masked out by sequence lengths).
+    """
+
+    def __init__(self, total_blocks: int):
+        if total_blocks < 2:
+            raise ValueError("need at least 2 blocks (0 is reserved)")
+        self.total = total_blocks
+        self._free: List[int] = list(range(total_blocks - 1, 0, -1))
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(f"wanted {n} blocks, {len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b == 0:
+                raise ValueError("block 0 is reserved")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+
+@dataclasses.dataclass
+class SeqAllocation:
+    """Host bookkeeping for one running sequence."""
+
+    seq_id: int
+    blocks: List[int]
+    n_tokens: int = 0
+
+    def table(self, blocks_per_seq: int) -> np.ndarray:
+        t = np.zeros((blocks_per_seq,), np.int32)
+        t[: len(self.blocks)] = self.blocks
+        return t
+
+
+class PagedKVCache:
+    """Device block pool + per-sequence block accounting.
+
+    ``kv`` is a pytree: per layer ``{"k": [N, Bs, Hkv, Dh], "v": ...}``.
+    The jitted model paths update it functionally (donated) via
+    :func:`write_prefill` / :func:`write_decode` in ``engine.runner``.
+    """
+
+    def __init__(self, n_layers: int, n_kv_heads: int, head_dim: int,
+                 total_blocks: int, block_size: int, blocks_per_seq: int,
+                 dtype=jnp.bfloat16):
+        self.n_layers = n_layers
+        self.block_size = block_size
+        self.blocks_per_seq = blocks_per_seq
+        self.allocator = BlockAllocator(total_blocks)
+        shape = (total_blocks, block_size, n_kv_heads, head_dim)
+        self.kv = [
+            {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(n_layers)
+        ]
+        self._seqs: Dict[int, SeqAllocation] = {}
+
+    # -- host-side sequence lifecycle --------------------------------------
+
+    def can_admit(self, n_tokens: int) -> bool:
+        return self._blocks_needed(n_tokens) <= self.allocator.n_free
+
+    def admit(self, seq_id: int, n_tokens: int) -> SeqAllocation:
+        """Allocate blocks to cover ``n_tokens`` prompt tokens."""
+        if seq_id in self._seqs:
+            raise ValueError(f"seq {seq_id} already admitted")
+        alloc = SeqAllocation(seq_id, self.allocator.alloc(
+            self._blocks_needed(n_tokens)), n_tokens)
+        self._seqs[seq_id] = alloc
+        return alloc
+
+    def extend(self, seq_id: int, n_new: int = 1) -> SeqAllocation:
+        """Grow a sequence by ``n_new`` tokens, allocating blocks as needed."""
+        alloc = self._seqs[seq_id]
+        need = self._blocks_needed(alloc.n_tokens + n_new) - len(alloc.blocks)
+        if need > 0:
+            if len(alloc.blocks) + need > self.blocks_per_seq:
+                raise MemoryError(f"seq {seq_id} exceeds max_model_len")
+            alloc.blocks.extend(self.allocator.alloc(need))
+        alloc.n_tokens += n_new
+        return alloc
+
+    def release(self, seq_id: int) -> None:
+        alloc = self._seqs.pop(seq_id)
+        self.allocator.free(alloc.blocks)
+
+    def seq(self, seq_id: int) -> SeqAllocation:
+        return self._seqs[seq_id]
+
+    @property
+    def active(self) -> List[int]:
+        return sorted(self._seqs)
+
+    def _blocks_needed(self, n_tokens: int) -> int:
+        return max(1, -(-n_tokens // self.block_size))

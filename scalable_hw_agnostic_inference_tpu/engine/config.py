@@ -1,0 +1,92 @@
+"""Engine configuration — the ``vllm_config.yaml`` ConfigMap contract.
+
+The reference mounts a YAML ConfigMap and splats it into ``vllm.LLM(**cfg)``
+(reference ``app/vllm_model_api.py:33-34``, knobs at
+``cova/mllama-32-11b-vllm-trn1-config.yaml:8-23``). :class:`EngineConfig`
+accepts the same key names (vLLM-style) plus TPU-native extras, so existing
+deployment YAML carries over unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    model: str = ""                       # HF id or "tiny"
+    max_model_len: int = 2048             # max prompt+generation per sequence
+    max_num_seqs: int = 8                 # running-batch slots
+    block_size: int = 16                  # KV block granularity (tokens)
+    num_blocks: int = 0                   # 0 = auto from max_model_len*max_num_seqs
+    context_encoding_buckets: Sequence[int] = (128, 512)   # prefill shapes
+    token_generation_buckets: Sequence[int] = ()           # reserved (decode is B x 1)
+    is_continuous_batching: bool = True
+    tensor_parallel_size: int = 1
+    dtype: str = "bfloat16"
+    # on-device sampling (reference: global_topk 64, dynamic)
+    global_topk: int = 64
+    max_new_tokens: int = 128
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        if self.max_model_len % self.block_size:
+            raise ValueError("max_model_len must be a multiple of block_size")
+        if not self.context_encoding_buckets:
+            raise ValueError("need at least one prefill bucket")
+        bad = [b for b in self.context_encoding_buckets if b > self.max_model_len]
+        if bad:
+            raise ValueError(f"prefill buckets {bad} exceed max_model_len")
+        misaligned = [b for b in self.context_encoding_buckets
+                      if b % self.block_size]
+        if misaligned:
+            raise ValueError(
+                f"prefill buckets {misaligned} not multiples of "
+                f"block_size={self.block_size}")
+
+    @property
+    def blocks_per_seq(self) -> int:
+        return self.max_model_len // self.block_size
+
+    @property
+    def total_blocks(self) -> int:
+        if self.num_blocks:
+            return self.num_blocks
+        return self.blocks_per_seq * self.max_num_seqs
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EngineConfig":
+        """Accept vLLM key names; unknown keys are ignored with a record."""
+        known = {f.name for f in dataclasses.fields(cls)}
+        aliases = {
+            "device": None,                 # vLLM "neuron"/"cuda" — meaningless here
+            "max_num_batched_tokens": None,  # derived from buckets
+            "override_neuron_config": None,
+        }
+        kwargs, ignored = {}, []
+        for k, v in d.items():
+            if k in known:
+                kwargs[k] = tuple(v) if isinstance(v, list) else v
+            elif k in aliases:
+                ignored.append(k)
+            elif k == "sequence_parallel_enabled":
+                ignored.append(k)           # reference sets False explicitly
+            else:
+                ignored.append(k)
+        cfg = cls(**kwargs)
+        object.__setattr__(cfg, "_ignored_keys", tuple(ignored))
+        return cfg
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "EngineConfig":
+        import yaml
+
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f) or {})
+
+    @property
+    def ignored_keys(self) -> tuple:
+        return getattr(self, "_ignored_keys", ())

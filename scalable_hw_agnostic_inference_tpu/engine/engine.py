@@ -1,0 +1,313 @@
+"""Continuous-batching engine: slots, scheduler, and the step loop.
+
+Reference behavior being reproduced (via the vLLM neuron fork there):
+``is_continuous_batching: True`` with bucketed context encoding and on-device
+sampling (``cova/mllama-32-11b-vllm-trn1-config.yaml:10-22``). The TPU shape
+of it: a fixed slot batch (``max_num_seqs``) decoded by ONE compiled step,
+at most one bucketed prefill admitted per step, paged KV with optimistic
+admission and recompute-preemption when the block pool runs dry (vLLM's
+recompute policy; the preempted sequence's generated tokens simply become
+prompt suffix on re-admission).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.bucketing import BucketRegistry
+from ..models.llama import LlamaConfig
+from ..ops.sampling import sample_logits
+from .cache import PagedKVCache
+from .config import EngineConfig
+from .runner import make_decode, make_prefill
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    max_new_tokens: int = 128
+    eos_id: int = -1            # -1: never stop on a token
+
+    def clamp(self, ecfg: EngineConfig) -> "SamplingParams":
+        return dataclasses.replace(
+            self,
+            max_new_tokens=min(self.max_new_tokens, ecfg.max_new_tokens),
+            top_k=min(self.top_k, ecfg.global_topk) if self.top_k
+            else (ecfg.global_topk if ecfg.global_topk else 0),
+        )
+
+
+@dataclasses.dataclass
+class Request:
+    req_id: int
+    prompt_ids: List[int]
+    params: SamplingParams
+    # tokens generated before a recompute-preemption (they re-enter the
+    # cache as prompt suffix but remain part of the client-visible output)
+    already_generated: List[int] = dataclasses.field(default_factory=list)
+    orig_n_prompt: int = -1
+
+    def __post_init__(self):
+        if self.orig_n_prompt < 0:
+            self.orig_n_prompt = len(self.prompt_ids)
+
+
+@dataclasses.dataclass
+class Finished:
+    req_id: int
+    token_ids: List[int]        # generated tokens, EOS excluded
+    n_prompt: int
+    stop_reason: str            # "eos" | "length" | "rejected"
+
+
+@dataclasses.dataclass
+class _Running:
+    req: Request
+    slot: int
+    generated: List[int]
+    pending_token: int          # sampled but not yet written to the cache
+
+
+class LLMEngine:
+    """Drive with :meth:`add_request` + :meth:`step`, or offline
+    :meth:`generate`. Single-threaded by design — one engine per pod, the
+    serving layer serializes onto the model lane (``serve.app``)."""
+
+    def __init__(self, model_cfg: LlamaConfig, params: Any, ecfg: EngineConfig):
+        self.cfg = model_cfg
+        self.ecfg = ecfg
+        self.params = params
+        self.cache = PagedKVCache(
+            model_cfg.n_layers, model_cfg.n_kv_heads, model_cfg.head_dim,
+            ecfg.total_blocks, ecfg.block_size, ecfg.blocks_per_seq,
+            dtype=jnp.bfloat16 if ecfg.dtype == "bfloat16" else jnp.float32,
+        )
+        self.buckets = BucketRegistry(sorted(ecfg.context_encoding_buckets))
+        self._prefill = {}
+        self._decode = make_decode(
+            model_cfg, ecfg.block_size, ecfg.blocks_per_seq, ecfg.max_num_seqs)
+        self._sample1 = jax.jit(sample_logits)
+        self.waiting: deque[Request] = deque()
+        self.slots: List[Optional[_Running]] = [None] * ecfg.max_num_seqs
+        self._ids = itertools.count()
+        self._step_count = 0
+        self._rng = jax.random.PRNGKey(ecfg.seed)
+        self.finished: List[Finished] = []
+        self._done_this_step: List[Finished] = []
+
+    # -- public API --------------------------------------------------------
+
+    def add_request(self, prompt_ids: Sequence[int],
+                    params: Optional[SamplingParams] = None) -> int:
+        params = (params or SamplingParams()).clamp(self.ecfg)
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        max_prompt = self.buckets.max
+        if len(prompt_ids) > max_prompt:
+            prompt_ids = list(prompt_ids)[-max_prompt:]  # keep the tail
+        rid = next(self._ids)
+        self.waiting.append(Request(rid, list(prompt_ids), params))
+        return rid
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    def step(self) -> List[Finished]:
+        """Admit (at most one prefill), then decode the running batch.
+
+        Returns every request that finished during this step, whatever the
+        path (decode EOS/length, admission rejection, preemption close-out).
+        """
+        self._step_count += 1
+        self._done_this_step = []
+        self._admit_one()
+        if any(s is not None for s in self.slots):
+            self._decode_step()
+        return self._done_this_step
+
+    def _finish(self, fin: Finished) -> None:
+        self.finished.append(fin)
+        self._done_this_step.append(fin)
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 params: Optional[SamplingParams] = None) -> List[Finished]:
+        """Offline batch: submit all, run to completion, return in order."""
+        ids = [self.add_request(p, params) for p in prompts]
+        want = set(ids)
+        done: Dict[int, Finished] = {}
+        while want - set(done):
+            for f in self.step():
+                done[f.req_id] = f
+        return [done[i] for i in ids]
+
+    # -- internals ---------------------------------------------------------
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit_one(self) -> None:
+        if not self.waiting:
+            return
+        slot = self._free_slot()
+        if slot is None:
+            return
+        req = self.waiting[0]
+        if len(req.prompt_ids) > self.buckets.max:
+            # preemption re-queues prompt+generated directly and may overflow
+            # the largest prefill bucket — keep the tail (matches add_request)
+            req.prompt_ids = req.prompt_ids[-self.buckets.max:]
+        n = len(req.prompt_ids)
+        # optimistic admission: prompt blocks plus one decode block of
+        # headroom, capped at what one sequence can ever use
+        need = min(self.cache._blocks_needed(n + self.ecfg.block_size),
+                   self.ecfg.blocks_per_seq)
+        if need > self.cache.allocator.n_free:
+            if not any(s is not None for s in self.slots):
+                # nothing running => the pool is as free as it will ever get;
+                # this request can never be admitted — fail it, don't starve
+                # the queue (and don't let generate() spin forever)
+                self.waiting.popleft()
+                log.error("rejecting req %d: needs %d blocks, pool max %d",
+                          req.req_id, need, self.cache.allocator.n_free)
+                self._finish(Finished(
+                    req.req_id, list(req.already_generated),
+                    req.orig_n_prompt, "rejected"))
+            return
+        self.waiting.popleft()
+        bucket = self.buckets.bucket_for(n)
+        alloc = self.cache.admit(req.req_id, n)
+        table = jnp.asarray(alloc.table(self.ecfg.blocks_per_seq))
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = req.prompt_ids
+        fn = self._prefill_for(bucket)
+        self.cache.kv, logits = fn(
+            self.params, self.cache.kv, jnp.asarray(ids),
+            jnp.asarray([n], jnp.int32), table)
+        rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
+        tok = int(self._sample1(
+            logits, rng, req.params.temperature, req.params.top_k,
+            req.params.top_p)[0])
+        self.slots[slot] = _Running(req, slot, [], pending_token=tok)
+
+    def _prefill_for(self, bucket: int):
+        if bucket not in self._prefill:
+            self._prefill[bucket] = make_prefill(
+                self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq, bucket)
+        return self._prefill[bucket]
+
+    def _preempt_lowest(self) -> None:
+        """Recompute-preempt the most recently admitted sequence."""
+        victims = [s for s in self.slots if s is not None]
+        victim = max(victims, key=lambda s: s.req.req_id)
+        log.warning("preempting seq %d (block pool exhausted)", victim.req.req_id)
+        self.cache.release(victim.req.req_id)
+        self.slots[victim.slot] = None
+        # generated + pending tokens become cache prompt suffix, but stay in
+        # the client-visible output via already_generated; budget shrinks by
+        # what is already committed (pending included — it was sampled)
+        committed = victim.generated + [victim.pending_token]
+        emitted = victim.req.already_generated + committed
+        p = victim.req.params
+        if victim.pending_token == p.eos_id or len(committed) >= p.max_new_tokens:
+            # nothing left to resume — finish right here
+            if emitted and emitted[-1] == p.eos_id:
+                emitted = emitted[:-1]
+                reason = "eos"
+            else:
+                reason = "length"
+            self._finish(Finished(
+                victim.req.req_id, emitted, victim.req.orig_n_prompt, reason))
+            return
+        params = dataclasses.replace(
+            p, max_new_tokens=p.max_new_tokens - len(committed))
+        self.waiting.appendleft(Request(
+            victim.req.req_id,
+            victim.req.prompt_ids + committed,
+            params,
+            already_generated=emitted,
+            orig_n_prompt=victim.req.orig_n_prompt))
+
+    def _decode_step(self) -> None:
+        B = self.ecfg.max_num_seqs
+        M = self.ecfg.blocks_per_seq
+        # grow each running seq by one slot for the pending token; preempt on
+        # pool exhaustion (never preempt down to zero running sequences)
+        for s in list(self.slots):
+            if s is None:
+                continue
+            while True:
+                try:
+                    self.cache.extend(s.req.req_id, 1)
+                    break
+                except MemoryError:
+                    if sum(x is not None for x in self.slots) <= 1:
+                        raise  # one seq must always fit: config error
+                    self._preempt_lowest()
+                    if self.slots[s.slot] is not s:
+                        break  # s itself was preempted
+            if self.slots[s.slot] is not s:
+                continue
+
+        tokens = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        tables = np.zeros((B, M), np.int32)
+        active = np.zeros((B,), bool)
+        temp = np.ones((B,), np.float32)
+        topk = np.zeros((B,), np.int32)
+        topp = np.ones((B,), np.float32)
+        for s in self.slots:
+            if s is None:
+                continue
+            alloc = self.cache.seq(s.req.req_id)
+            tokens[s.slot] = s.pending_token
+            pos[s.slot] = alloc.n_tokens - 1
+            tables[s.slot] = alloc.table(M)
+            active[s.slot] = True
+            temp[s.slot] = s.req.params.temperature
+            topk[s.slot] = s.req.params.top_k
+            topp[s.slot] = s.req.params.top_p
+        if not active.any():
+            return
+
+        rng = jax.random.fold_in(self._rng, self._step_count * 2)
+        self.cache.kv, nxt = self._decode(
+            self.params, self.cache.kv, jnp.asarray(tokens), jnp.asarray(pos),
+            jnp.asarray(tables), jnp.asarray(active), rng,
+            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp))
+        nxt = np.asarray(nxt)
+
+        for s in list(self.slots):
+            if s is None:
+                continue
+            s.generated.append(s.pending_token)
+            p = s.req.params
+            hit_eos = s.pending_token == p.eos_id
+            if hit_eos:
+                s.generated.pop()  # exclude EOS from the emitted text
+            full = len(s.generated) >= p.max_new_tokens
+            total = self.cache.seq(s.req.req_id).n_tokens
+            out_of_len = total >= self.ecfg.max_model_len
+            if hit_eos or full or out_of_len:
+                self._finish(Finished(
+                    s.req.req_id, s.req.already_generated + s.generated,
+                    s.req.orig_n_prompt, "eos" if hit_eos else "length"))
+                self.cache.release(s.req.req_id)
+                self.slots[s.slot] = None
+            else:
+                s.pending_token = int(nxt[s.slot])

@@ -1,0 +1,154 @@
+"""Jitted engine paths: bucketed prefill + one decode step for the batch.
+
+The runner consumes the SAME parameter pytree as
+``models.llama.LlamaForCausalLM`` (one weight story: HF convert → orbax →
+either the plain server or this engine) but re-plumbs the forward around the
+paged KV pool — prefill scatters whole blocks, decode writes one token per
+slot and gathers per-slot context through block tables. The reference gets
+all of this from the vLLM fork's neuron backend (SURVEY.md §2.6 row 5);
+TPU-natively it is two compiled executables per bucket, shapes static.
+
+Decode is ONE executable for the whole running batch: [B] tokens in,
+[B] sampled tokens out, sampling on device (reference parity:
+``on_device_sampling_config`` ``global_topk: 64``,
+``cova/mllama-32-11b-vllm-trn1-config.yaml:19-22``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.llama import LlamaConfig
+from ..ops.attention import dot_product_attention
+from ..ops.rope import apply_rope
+from ..ops.sampling import sample_logits
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    n = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+    return (n * scale).astype(x.dtype)
+
+
+def _proj(x: jax.Array, p: Dict[str, jax.Array]) -> jax.Array:
+    return x @ p["kernel"].astype(x.dtype)
+
+
+def _qkv(lp: Dict, x: jax.Array, positions: jax.Array, cfg: LlamaConfig):
+    B, T, _ = x.shape
+    Dh = cfg.head_dim
+    q = _proj(x, lp["attn"]["q"]).reshape(B, T, cfg.n_heads, Dh)
+    k = _proj(x, lp["attn"]["k"]).reshape(B, T, cfg.n_kv_heads, Dh)
+    v = _proj(x, lp["attn"]["v"]).reshape(B, T, cfg.n_kv_heads, Dh)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp(lp: Dict, x: jax.Array) -> jax.Array:
+    gate = _proj(x, lp["mlp"]["gate"])
+    up = _proj(x, lp["mlp"]["up"])
+    return _proj(jax.nn.silu(gate) * up, lp["mlp"]["down"])
+
+
+def _logits(p: Dict, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    x = _rmsnorm(x, p["final_norm"]["scale"], cfg.rms_eps)
+    if cfg.tie_embeddings:
+        return (x.astype(jnp.float32) @ p["embed"]["embedding"].T)
+    return _proj(x, p["lm_head"]).astype(jnp.float32)
+
+
+def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
+                 bucket: int):
+    """Compile ``prefill(params, kv, ids, n, block_table) -> (kv, logits)``.
+
+    One sequence per call (the scheduler prefills at most one per step —
+    vLLM-style), ``ids`` ``[1, bucket]`` right-padded, true length ``n``.
+    k/v for the whole bucket are scattered into the pool; pad positions land
+    in allocated blocks but stay masked forever by the sequence length.
+    Returns next-token logits from position ``n - 1``.
+    """
+    assert bucket % block_size == 0
+    m_used = bucket // block_size
+
+    def prefill(params, kv, ids, n, block_table):
+        p = params["params"]
+        B, T = ids.shape  # B == 1
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+        x = p["embed"]["embedding"][ids].astype(jnp.bfloat16)
+        valid = positions < n  # [1, T]
+        for li in range(cfg.n_layers):
+            lp = p[f"layer_{li}"]
+            h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
+            q, k, v = _qkv(lp, h, positions, cfg)
+            # causal within the prompt; pad keys masked out
+            mask = valid[:, None, None, :]
+            o = dot_product_attention(q, k, v, mask=mask, causal=True)
+            x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
+            x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
+            # scatter this layer's k/v blocks into the pool
+            kdst = kv[li]["k"].at[block_table[:m_used]].set(
+                k[0].reshape(m_used, block_size, cfg.n_kv_heads, cfg.head_dim)
+                .astype(kv[li]["k"].dtype))
+            vdst = kv[li]["v"].at[block_table[:m_used]].set(
+                v[0].reshape(m_used, block_size, cfg.n_kv_heads, cfg.head_dim)
+                .astype(kv[li]["v"].dtype))
+            kv[li] = {"k": kdst, "v": vdst}
+        last = jnp.take_along_axis(x, (n - 1).reshape(1, 1, 1), axis=1)
+        return kv, _logits(p, last, cfg)[:, 0]  # [1, V]
+
+    return jax.jit(prefill, donate_argnums=(1,))
+
+
+def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
+                max_num_seqs: int):
+    """Compile one decode step for the whole slot batch.
+
+    ``decode(params, kv, tokens [B], pos [B], tables [B, M], active [B],
+    rng, temperature [B], top_k [B], top_p [B]) -> (kv, next_tokens [B])``.
+
+    ``pos[b]`` is the index the new token is written at (== tokens so far).
+    Inactive slots carry ``tables`` of zeros and write harmlessly into the
+    reserved null block 0.
+    """
+    L = block_size * blocks_per_seq  # max context per seq
+
+    def decode(params, kv, tokens, pos, tables, active, rng,
+               temperature, top_k, top_p):
+        p = params["params"]
+        B = max_num_seqs
+        x = p["embed"]["embedding"][tokens][:, None, :].astype(jnp.bfloat16)
+        positions = pos[:, None]  # [B, 1]
+        # flat write offsets for the new token's kv: [B]
+        widx = tables[jnp.arange(B), pos // block_size] * block_size + pos % block_size
+        # flat gather offsets for the whole context window: [B, L]
+        goff = (tables[:, :, None] * block_size
+                + jnp.arange(block_size)[None, None, :]).reshape(B, L)
+        # slot b attends exactly its pos[b]+1 tokens (the one just written
+        # included); inactive slots see one dummy token
+        mask = (jnp.arange(L)[None, :] <= pos[:, None])[:, None, None, :]
+        for li in range(cfg.n_layers):
+            lp = p[f"layer_{li}"]
+            h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
+            q, k, v = _qkv(lp, h, positions, cfg)
+            kflat = kv[li]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            vflat = kv[li]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            kflat = kflat.at[widx].set(k[:, 0].astype(kflat.dtype))
+            vflat = vflat.at[widx].set(v[:, 0].astype(vflat.dtype))
+            kctx = kflat[goff]  # [B, L, Hkv, Dh]
+            vctx = vflat[goff]
+            o = dot_product_attention(q, kctx, vctx, mask=mask)
+            x = x + _proj(o.reshape(B, 1, -1), lp["attn"]["o"])
+            x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
+            pool_shape = kv[li]["k"].shape
+            kv[li] = {"k": kflat.reshape(pool_shape),
+                      "v": vflat.reshape(pool_shape)}
+        logits = _logits(p, x, cfg)[:, 0]  # [B, V]
+        nxt = sample_logits(logits, rng, temperature, top_k, top_p)
+        return kv, nxt
+
+    return jax.jit(decode, donate_argnums=(1,))

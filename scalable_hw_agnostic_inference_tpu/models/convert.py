@@ -69,6 +69,25 @@ def encoder_block(sd: Dict, q: str, k: str, v: str, o: str, ln1: str,
     }
 
 
+def cast_f32_to_bf16(tree):
+    """fp32 leaves → bf16 (weight placement for the bf16 compute path).
+
+    One shared policy point: if bf16 placement ever needs exceptions (e.g.
+    keeping norm scales fp32) every caller — serving, bench, engine — picks
+    the change up together.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def cast(a):
+        dt = getattr(a, "dtype", None)
+        if dt is not None and np.dtype(dt) == np.float32:
+            return jnp.asarray(a, jnp.bfloat16)
+        return a
+
+    return jax.tree.map(cast, tree)
+
+
 def state_dict_of(model_or_sd) -> Dict:
     """Accept a torch module or an already-materialized state dict."""
     if hasattr(model_or_sd, "state_dict"):

@@ -243,10 +243,9 @@ class LlamaService(ModelService):
             self._byte_tok = False
             # bf16 on device: the module computes in bf16 regardless, and fp32
             # placement would double HBM (8B fp32 > one v5e chip)
-            params = jax.tree.map(
-                lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
-                params,
-            )
+            from ..models.convert import cast_f32_to_bf16
+
+            params = cast_f32_to_bf16(params)
         self.mcfg = mcfg
 
         if cfg.mesh_spec:
@@ -410,11 +409,9 @@ class SDService(ModelService):
             self.tokenizer = _hf_tokenizer(root + "/tokenizer", cfg.hf_token)
             self.seq_len = ccfg.max_position
             # bf16 placement for the hot path (UNet); VAE stays fp32
-            unet_params = jax.tree.map(
-                lambda a: a.astype(jnp.bfloat16)
-                if getattr(a, "dtype", None) == np.float32 else a,
-                unet_params,
-            )
+            from ..models.convert import cast_f32_to_bf16
+
+            unet_params = cast_f32_to_bf16(unet_params)
 
         text_params = jax.device_put(text_params)
         text_fn = jax.jit(lambda ids: text_model.apply(text_params, ids)[0])
@@ -435,7 +432,9 @@ class SDService(ModelService):
         # STEPS_BUCKETS opts extra values in; all are compile-warmed below)
         self.steps_allowed = {cfg.num_inference_steps}
         if cfg.steps_buckets:
-            self.steps_allowed |= {int(s) for s in cfg.steps_buckets.split(",")}
+            self.steps_allowed |= {
+                int(s) for s in cfg.steps_buckets.split(",") if s.strip()
+            }
 
     def warmup(self) -> None:
         # warm at batch 1 — the shape infer() actually runs
