@@ -1,0 +1,97 @@
+"""Engine loop thread: the bridge between concurrent HTTP and one engine.
+
+vLLM's AsyncLLMEngine equivalent, sized down: one daemon thread owns the
+engine (and through it the device); callers submit token-id prompts and wait
+on a future. Concurrent requests naturally coalesce into the running batch —
+this is where continuous batching actually pays off in serving (the
+reference gets it inside ``vllm.LLM``; our serving lane is widened to
+``max_num_seqs`` so requests reach the loop concurrently, see
+``serve.app.ModelService.concurrency``).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Sequence, Tuple
+
+from .engine import Finished, LLMEngine, SamplingParams
+
+log = logging.getLogger(__name__)
+
+
+class EngineLoop:
+    def __init__(self, engine: LLMEngine, poll_s: float = 0.005):
+        self.engine = engine
+        self._submit_q: "queue.Queue[Tuple[List[int], SamplingParams, Future]]" = (
+            queue.Queue()
+        )
+        self._futures: dict[int, Future] = {}
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="engine-loop",
+                                        daemon=True)
+
+    def start(self) -> "EngineLoop":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout)
+
+    def submit(self, prompt_ids: Sequence[int],
+               params: Optional[SamplingParams] = None) -> Future:
+        """Enqueue a request; the future resolves to a :class:`Finished`."""
+        if self._stop.is_set():
+            raise RuntimeError("engine loop is stopped")
+        fut: Future = Future()
+        self._submit_q.put((list(prompt_ids), params or SamplingParams(), fut))
+        return fut
+
+    def generate(self, prompt_ids: Sequence[int],
+                 params: Optional[SamplingParams] = None,
+                 timeout: Optional[float] = None) -> Finished:
+        """Submit and block — the serving ``infer`` path."""
+        return self.submit(prompt_ids, params).result(timeout)
+
+    # -- loop --------------------------------------------------------------
+
+    def _drain_submissions(self, block: bool) -> None:
+        try:
+            item = self._submit_q.get(timeout=self._poll_s if block else None) \
+                if block else self._submit_q.get_nowait()
+        except queue.Empty:
+            return
+        while True:
+            ids, params, fut = item
+            try:
+                rid = self.engine.add_request(ids, params)
+                self._futures[rid] = fut
+            except Exception as e:  # bad request (e.g. empty prompt)
+                fut.set_exception(e)
+            try:
+                item = self._submit_q.get_nowait()
+            except queue.Empty:
+                return
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            # block for work only when idle; never between engine steps
+            self._drain_submissions(block=not self.engine.has_work)
+            if not self.engine.has_work:
+                continue
+            try:
+                for fin in self.engine.step():
+                    fut = self._futures.pop(fin.req_id, None)
+                    if fut is not None:
+                        fut.set_result(fin)
+            except Exception:
+                log.exception("engine step failed; failing in-flight requests")
+                for fut in self._futures.values():
+                    if not fut.done():
+                        fut.set_exception(RuntimeError("engine step failed"))
+                self._futures.clear()
+                raise

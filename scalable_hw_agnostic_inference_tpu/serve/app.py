@@ -50,6 +50,11 @@ class ModelService:
     task: str = "generic"
     #: route the default POST handler mounts at
     infer_route: str = "/infer"
+    #: how many requests may be in ``infer`` simultaneously. 1 = the model
+    #: call itself owns the device (default). Engine-backed services raise
+    #: this to their slot count — infer() then only enqueues into the engine
+    #: loop (which owns the device), so concurrent requests batch together.
+    concurrency: int = 1
 
     def __init__(self, cfg: ServeConfig):
         self.cfg = cfg
@@ -82,8 +87,10 @@ def create_app(
     collector = LatencyCollector()
     pub = publisher or MetricsPublisher(cfg.app, cfg.nodepool, cfg.pod_name)
     state = {"loaded": False, "warm": False, "load_error": None}
-    # single lane to the accelerator: model calls are serialized, probes are not
-    lane = concurrent.futures.ThreadPoolExecutor(max_workers=1, thread_name_prefix="model")
+    # the model lane: probes never queue behind it. Width 1 serializes device
+    # access; engine-backed services widen it (their infer only enqueues).
+    lane = concurrent.futures.ThreadPoolExecutor(
+        max_workers=max(1, service.concurrency), thread_name_prefix="model")
 
     app.state.update(cfg=cfg, service=service, collector=collector, publisher=pub,
                      status=state)

@@ -192,6 +192,45 @@ class ViTService(ModelService):
         }
 
 
+def _load_causal_lm(cfg: ServeConfig, model_id: str):
+    """Shared causal-LM bootstrap for LlamaService and VllmService.
+
+    Returns ``(mcfg, model, params, tokenizer, eos_id, pad_id, byte_tok)``;
+    params are host-side (callers place/shard them).
+    """
+    from ..models import llama
+    from ..models.generate import ByteTokenizer
+
+    if model_id in ("", "tiny"):
+        mcfg = llama.LlamaConfig.tiny()
+        model = llama.LlamaForCausalLM(mcfg, dtype=jnp.float32)
+        params = model.init(
+            jax.random.PRNGKey(cfg.seed), jnp.zeros((1, 8), jnp.int32))
+        return (mcfg, model, params, ByteTokenizer(),
+                ByteTokenizer.eos_id, ByteTokenizer.pad_id, True)
+
+    import torch  # noqa: F401
+    from transformers import AutoModelForCausalLM
+
+    from ..models.convert import cast_f32_to_bf16
+
+    tm = AutoModelForCausalLM.from_pretrained(model_id, token=cfg.hf_token or None)
+    mcfg = llama.LlamaConfig.from_hf(tm.config)
+    model = llama.LlamaForCausalLM(mcfg, dtype=jnp.bfloat16)
+    # bf16 on device: the module computes in bf16 regardless, and fp32
+    # placement would double HBM (8B fp32 > one v5e chip)
+    params = cast_f32_to_bf16(llama.params_from_torch(tm, mcfg))
+    del tm
+    tokenizer = _hf_tokenizer(model_id, cfg.hf_token)
+    # `is not None` (not truthiness): token id 0 is a legitimate id
+    eos = tokenizer.eos_token_id
+    if eos is None:
+        raise ValueError(f"tokenizer for {model_id} has no eos_token_id")
+    pad = tokenizer.pad_token_id
+    return (mcfg, model, params, tokenizer, int(eos),
+            int(pad) if pad is not None else int(eos), False)
+
+
 class LlamaService(ModelService):
     """Text generation — parity with reference ``run-llama.py`` (Llama-3/
     Mistral) and ``deepseek_model_api.py`` (generic causal LM + /benchmark).
@@ -209,43 +248,12 @@ class LlamaService(ModelService):
         from ..core.bucketing import BucketRegistry, pow2_buckets
         from ..core.mesh import build_mesh
         from ..models import llama
-        from ..models.generate import ByteTokenizer, make_generate
+        from ..models.generate import make_generate
 
         cfg = self.cfg
-        if cfg.model_id in ("", "tiny"):
-            mcfg = llama.LlamaConfig.tiny()
-            self.model = llama.LlamaForCausalLM(mcfg, dtype=jnp.float32)
-            params = self.model.init(
-                jax.random.PRNGKey(cfg.seed), jnp.zeros((1, 8), jnp.int32)
-            )
-            self.tokenizer = ByteTokenizer()
-            self.eos_id, self.pad_id = ByteTokenizer.eos_id, ByteTokenizer.pad_id
-            self._byte_tok = True
-        else:
-            import torch  # noqa: F401
-            from transformers import AutoModelForCausalLM
-
-            tm = AutoModelForCausalLM.from_pretrained(
-                cfg.model_id, token=cfg.hf_token or None
-            )
-            mcfg = llama.LlamaConfig.from_hf(tm.config)
-            self.model = llama.LlamaForCausalLM(mcfg, dtype=jnp.bfloat16)
-            params = llama.params_from_torch(tm, mcfg)
-            del tm
-            self.tokenizer = _hf_tokenizer(cfg.model_id, cfg.hf_token)
-            # `is not None` (not truthiness): token id 0 is a legitimate id
-            eos = self.tokenizer.eos_token_id
-            if eos is None:
-                raise ValueError(f"tokenizer for {cfg.model_id} has no eos_token_id")
-            self.eos_id = int(eos)
-            pad = self.tokenizer.pad_token_id
-            self.pad_id = int(pad) if pad is not None else self.eos_id
-            self._byte_tok = False
-            # bf16 on device: the module computes in bf16 regardless, and fp32
-            # placement would double HBM (8B fp32 > one v5e chip)
-            from ..models.convert import cast_f32_to_bf16
-
-            params = cast_f32_to_bf16(params)
+        (mcfg, self.model, params, self.tokenizer,
+         self.eos_id, self.pad_id, self._byte_tok) = _load_causal_lm(
+            cfg, cfg.model_id)
         self.mcfg = mcfg
 
         if cfg.mesh_spec:
@@ -510,6 +518,139 @@ def _build_mistral(cfg: ServeConfig) -> ModelService:
 @register_model("deepseek")
 def _build_deepseek(cfg: ServeConfig) -> ModelService:
     return LlamaService(cfg)
+
+
+class VllmService(ModelService):
+    """Engine-backed text generation — parity with reference
+    ``vllm_model_api.py`` (``LLM(**yaml.safe_load('/vllm_config.yaml'))``,
+    reference ``:33-34``; ConfigMap mount
+    ``cova/mllama-32-11b-vllm-trn1-deploy.yaml:41-43``). The engine is
+    first-party (``engine/``): continuous batching across concurrent HTTP
+    requests via the engine loop, paged KV, bucketed prefill, on-device
+    sampling. ``concurrency`` widens the serving lane so requests actually
+    coalesce into the running batch.
+    """
+
+    task = "text-generation"
+    infer_route = "/generate"
+
+    def __init__(self, cfg: ServeConfig):
+        super().__init__(cfg)
+        # config resolves at construction (no weights): the app factory needs
+        # `concurrency` before load() runs to size the serving lane. A bad
+        # ConfigMap must NOT crash the process here — defer the error to
+        # load(), where it surfaces as a readiness failure (no crash loop).
+        self._ecfg_error: Optional[Exception] = None
+        try:
+            self.ecfg = self._resolve_ecfg(cfg)
+            self.concurrency = self.ecfg.max_num_seqs
+        except Exception as e:
+            self.ecfg = None
+            self._ecfg_error = e
+            self.concurrency = 1
+
+    @staticmethod
+    def _resolve_ecfg(cfg: ServeConfig):
+        import os
+
+        from ..engine.config import EngineConfig
+
+        if os.path.exists(cfg.vllm_config):
+            ecfg = EngineConfig.from_yaml(cfg.vllm_config)
+            if ecfg.ignored_keys:
+                log.info("vllm_config: ignoring keys %s", ecfg.ignored_keys)
+            return ecfg
+        # the largest bucket must reach MAX_SEQ_LEN (block-aligned up) or
+        # long prompts silently truncate below the advertised limit
+        top = -(-cfg.max_seq_len // 16) * 16
+        buckets = sorted({b for b in (128, 512, 2048) if b < top} | {top})
+        return EngineConfig(
+            model=cfg.model_id,
+            # rounded up to a block multiple
+            max_model_len=-(-(cfg.max_seq_len + cfg.max_new_tokens) // 16) * 16,
+            max_num_seqs=max(cfg.batch_size, 4),
+            block_size=16,
+            context_encoding_buckets=tuple(buckets),
+            max_new_tokens=cfg.max_new_tokens,
+        )
+
+    def load(self) -> None:
+        from ..engine.config import EngineConfig
+        from ..engine.engine import LLMEngine, SamplingParams
+        from ..engine.loop import EngineLoop
+
+        if self._ecfg_error is not None:
+            raise self._ecfg_error
+        cfg = self.cfg
+        ecfg = self.ecfg
+        model_id = ecfg.model or cfg.model_id
+        (mcfg, _model, params, self.tokenizer,
+         self.eos_id, self.pad_id, self._byte_tok) = _load_causal_lm(
+            cfg, model_id)
+        if self._byte_tok:
+            # tiny engine shapes: small blocks/buckets so CI exercises paging
+            ecfg = EngineConfig(
+                model="tiny", max_model_len=256, max_num_seqs=ecfg.max_num_seqs,
+                block_size=16, context_encoding_buckets=(32, 64, 128),
+                max_new_tokens=min(ecfg.max_new_tokens, 64))
+
+        self.ecfg = ecfg
+        engine = LLMEngine(mcfg, jax.device_put(params), ecfg)
+        self.loop = EngineLoop(engine).start()
+        self._SamplingParams = SamplingParams
+
+    def _encode(self, text: str):
+        # max() not [-1]: YAML bucket lists arrive in arbitrary order
+        max_bucket = max(self.ecfg.context_encoding_buckets)
+        if self._byte_tok:
+            ids, n = self.tokenizer.encode(text, max_bucket)
+            return [int(i) for i in ids[:n]]
+        return [int(i) for i in self.tokenizer(
+            text, truncation=True, max_length=max_bucket)["input_ids"]]
+
+    def _decode(self, ids) -> str:
+        if self._byte_tok:
+            return self.tokenizer.decode(ids)
+        return self.tokenizer.decode(ids, skip_special_tokens=True)
+
+    def example_payload(self) -> Dict[str, Any]:
+        return {"prompt": "the quick brown fox", "temperature": 0.0,
+                "max_new_tokens": 8}
+
+    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        if "prompt" not in payload and "text" not in payload:
+            raise HTTPError(400, "missing 'prompt'")
+        prompt = str(payload.get("prompt", payload.get("text", "")))
+        ids = self._encode(prompt)
+        if not ids:
+            raise HTTPError(400, "empty prompt")
+        mnt = payload.get("max_new_tokens")
+        try:
+            mnt = self.ecfg.max_new_tokens if mnt is None else int(mnt)
+            params = self._SamplingParams(
+                temperature=float(payload.get("temperature", 1.0)),
+                top_k=int(payload.get("top_k", 0)),
+                top_p=float(payload.get("top_p", 1.0)),
+                max_new_tokens=mnt,
+                eos_id=self.eos_id,
+            )
+        except (TypeError, ValueError) as e:
+            raise HTTPError(400, f"bad sampling parameter: {e}")
+        if mnt < 1:
+            raise HTTPError(400, "max_new_tokens must be >= 1")
+        fin = self.loop.generate(ids, params, timeout=600.0)
+        if fin.stop_reason == "rejected":
+            raise HTTPError(503, "request rejected: prompt cannot fit the KV pool")
+        return {
+            "generated_text": self._decode(fin.token_ids),
+            "n_tokens": len(fin.token_ids),
+            "stop_reason": fin.stop_reason,
+        }
+
+
+@register_model("vllm")
+def _build_vllm(cfg: ServeConfig) -> ModelService:
+    return VllmService(cfg)
 
 
 # One SD service covers the reference's run-sd.py / run-sd2.py twins (they

@@ -77,6 +77,7 @@ class ServeConfig:
     batch_size: int = 1
     scheduler: str = "ddim"              # diffusion sampler: ddim | euler
     steps_buckets: str = ""              # extra allowed steps values, csv
+    vllm_config: str = "/vllm_config.yaml"  # engine ConfigMap mount path
     # mesh / parallelism
     mesh_spec: str = ""                  # e.g. "tp=4" or "dp=2,tp=4"; "" = single device
     submesh: str = ""                    # e.g. "0:4" — device-slice placement
@@ -108,6 +109,7 @@ class ServeConfig:
             batch_size=env_int("BATCH_SIZE", 1),
             scheduler=env_str("SCHEDULER", "ddim"),
             steps_buckets=env_str("STEPS_BUCKETS", ""),
+            vllm_config=env_str("VLLM_CONFIG", "/vllm_config.yaml"),
             mesh_spec=env_str("MESH_SPEC", ""),
             submesh=env_str("SUBMESH", ""),
             port=env_int("PORT", 8000),
