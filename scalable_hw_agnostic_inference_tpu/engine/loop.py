@@ -41,6 +41,7 @@ class EngineLoop:
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         self._thread.join(timeout)
+        self._fail_all(RuntimeError("engine loop is stopped"))
 
     def submit(self, prompt_ids: Sequence[int],
                params: Optional[SamplingParams] = None) -> Future:
@@ -77,6 +78,20 @@ class EngineLoop:
             except queue.Empty:
                 return
 
+    def _fail_all(self, err: Exception) -> None:
+        """Fail every queued and in-flight future (loop death / stop)."""
+        while True:
+            try:
+                _, _, fut = self._submit_q.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(err)
+        for fut in self._futures.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._futures.clear()
+
     def _run(self) -> None:
         while not self._stop.is_set():
             # block for work only when idle; never between engine steps
@@ -90,8 +105,7 @@ class EngineLoop:
                         fut.set_result(fin)
             except Exception:
                 log.exception("engine step failed; failing in-flight requests")
-                for fut in self._futures.values():
-                    if not fut.done():
-                        fut.set_exception(RuntimeError("engine step failed"))
-                self._futures.clear()
+                # dead loop must refuse new submissions, not strand them
+                self._stop.set()
+                self._fail_all(RuntimeError("engine step failed"))
                 raise

@@ -1,0 +1,191 @@
+"""YOLOS object detection — the reference's detection unit.
+
+Parity target: ``run-yolo.py`` serving ``hustvl/yolos-tiny`` via
+optimum-neuron (reference ``app/compile-yolo.py:13-27``,
+``app/run-yolo.py``; its ``/detectobj`` handler calls an undefined function —
+a bug not reproduced, SURVEY.md §2.2). YOLOS is a ViT with 100 learned
+detection tokens appended after the patch sequence; detection heads are
+3-layer MLPs over the detection-token outputs (class logits incl. the
+no-object class, and sigmoid cxcywh boxes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .convert import (
+    conv2d,
+    encoder_block,
+    layer_norm,
+    linear,
+    state_dict_of,
+    t2j,
+)
+from .encoder import Encoder
+
+
+@dataclasses.dataclass(frozen=True)
+class YolosConfig:
+    image_size: Tuple[int, int] = (512, 864)   # (H, W), yolos-tiny default
+    patch_size: int = 16
+    dim: int = 192
+    n_layers: int = 12
+    heads: int = 3
+    mlp_dim: int = 768
+    n_det_tokens: int = 100
+    n_labels: int = 92           # COCO 91 + no-object
+    ln_eps: float = 1e-12
+    act: str = "gelu"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size[0] // self.patch_size) * \
+            (self.image_size[1] // self.patch_size)
+
+    @classmethod
+    def tiny(cls) -> "YolosConfig":
+        return cls(image_size=(32, 32), patch_size=8, dim=32, n_layers=2,
+                   heads=2, mlp_dim=64, n_det_tokens=5, n_labels=4)
+
+    @classmethod
+    def from_hf(cls, hf) -> "YolosConfig":
+        size = hf.image_size
+        if isinstance(size, int):
+            size = (size, size)
+        return cls(
+            image_size=tuple(size),
+            patch_size=hf.patch_size,
+            dim=hf.hidden_size,
+            n_layers=hf.num_hidden_layers,
+            heads=hf.num_attention_heads,
+            mlp_dim=hf.intermediate_size,
+            n_det_tokens=hf.num_detection_tokens,
+            n_labels=(len(hf.id2label) + 1) if getattr(hf, "id2label", None)
+            else 92,
+            ln_eps=hf.layer_norm_eps,
+            act=hf.hidden_act,
+        )
+
+
+class DetectionMLP(nn.Module):
+    """3-layer relu MLP head (DETR-style)."""
+
+    out_dim: int
+    hidden: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype, name="fc0")(x))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x))
+        return nn.Dense(self.out_dim, dtype=self.dtype, name="fc2")(x)
+
+
+class YolosForObjectDetection(nn.Module):
+    """pixels [B, H, W, 3] -> (class logits [B, D, labels], boxes [B, D, 4]).
+
+    Boxes are normalized cxcywh in [0, 1].
+    """
+
+    cfg: YolosConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixels: jax.Array):
+        c = self.cfg
+        B = pixels.shape[0]
+        x = nn.Conv(c.dim, kernel_size=(c.patch_size, c.patch_size),
+                    strides=(c.patch_size, c.patch_size), dtype=self.dtype,
+                    name="patch")(pixels.astype(self.dtype))
+        x = x.reshape(B, -1, c.dim)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, c.dim))
+        det = self.param("det", nn.initializers.zeros,
+                         (1, c.n_det_tokens, c.dim))
+        x = jnp.concatenate([
+            jnp.broadcast_to(cls, (B, 1, c.dim)).astype(self.dtype),
+            x,
+            jnp.broadcast_to(det, (B, c.n_det_tokens, c.dim)).astype(self.dtype),
+        ], axis=1)
+        pos = self.param("pos", nn.initializers.zeros,
+                         (1, 1 + c.n_patches + c.n_det_tokens, c.dim))
+        x = x + pos.astype(self.dtype)
+        x = Encoder(n_layers=c.n_layers, dim=c.dim, heads=c.heads,
+                    mlp_dim=c.mlp_dim, act=c.act, pre_ln=True,
+                    ln_eps=c.ln_eps, dtype=self.dtype, name="encoder")(x)
+        x = nn.LayerNorm(epsilon=c.ln_eps, dtype=self.dtype, name="final_ln")(x)
+        dtok = x[:, -c.n_det_tokens:]
+        logits = DetectionMLP(c.n_labels, c.dim, self.dtype, name="class_head")(dtok)
+        boxes = nn.sigmoid(
+            DetectionMLP(4, c.dim, self.dtype, name="box_head")(dtok))
+        return logits.astype(jnp.float32), boxes.astype(jnp.float32)
+
+
+def postprocess(logits: np.ndarray, boxes: np.ndarray, threshold: float,
+                width: int, height: int, id2label=None) -> List[Dict[str, Any]]:
+    """Softmax-score detections above threshold, boxes to absolute xyxy —
+    the ``pipeline("object-detection")`` output shape the reference self-test
+    consumes (reference ``app/compile-yolo.py:22-27``)."""
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    scores = probs[..., :-1]      # drop the no-object class
+    out = []
+    for d in range(scores.shape[0]):
+        label = int(scores[d].argmax())
+        score = float(scores[d, label])
+        if score < threshold:
+            continue
+        cx, cy, w, h = boxes[d]
+        out.append({
+            "label": id2label.get(label, str(label)) if id2label else str(label),
+            "label_id": label,
+            "score": round(score, 4),
+            "box": {
+                "xmin": round(float(cx - w / 2) * width, 1),
+                "ymin": round(float(cy - h / 2) * height, 1),
+                "xmax": round(float(cx + w / 2) * width, 1),
+                "ymax": round(float(cy + h / 2) * height, 1),
+            },
+        })
+    return sorted(out, key=lambda r: -r["score"])
+
+
+def params_from_torch(model_or_sd, cfg: YolosConfig) -> Dict[str, Any]:
+    """HF ``YolosForObjectDetection`` state dict → our tree."""
+    sd = state_dict_of(model_or_sd)
+
+    def mlp(prefix):
+        return {
+            "fc0": linear(sd, f"{prefix}.layers.0"),
+            "fc1": linear(sd, f"{prefix}.layers.1"),
+            "fc2": linear(sd, f"{prefix}.layers.2"),
+        }
+
+    p: Dict[str, Any] = {
+        "cls": t2j(sd["vit.embeddings.cls_token"]),
+        "det": t2j(sd["vit.embeddings.detection_tokens"]),
+        "pos": t2j(sd["vit.embeddings.position_embeddings"]),
+        "patch": conv2d(sd, "vit.embeddings.patch_embeddings.projection"),
+        "final_ln": layer_norm(sd, "vit.layernorm"),
+        "class_head": mlp("class_labels_classifier"),
+        "box_head": mlp("bbox_predictor"),
+        "encoder": {},
+    }
+    for i in range(cfg.n_layers):
+        b = f"vit.encoder.layer.{i}"
+        p["encoder"][f"layer_{i}"] = encoder_block(
+            sd,
+            q=f"{b}.attention.attention.query",
+            k=f"{b}.attention.attention.key",
+            v=f"{b}.attention.attention.value",
+            o=f"{b}.attention.output.dense",
+            ln1=f"{b}.layernorm_before",
+            fc1=f"{b}.intermediate.dense", fc2=f"{b}.output.dense",
+            ln2=f"{b}.layernorm_after",
+        )
+    return {"params": p}

@@ -60,16 +60,22 @@ def _hf_tokenizer(model_id: str, token: str = ""):
     return AutoTokenizer.from_pretrained(model_id, token=token or None)
 
 
-def decode_image(payload: Dict[str, Any], size: int) -> np.ndarray:
-    """base64 PNG/JPEG (or 'random') → normalized NHWC float array."""
+def decode_image(payload: Dict[str, Any], size, width: Optional[int] = None
+                 ) -> np.ndarray:
+    """base64 PNG/JPEG (or 'random') → normalized NHWC float array.
+
+    ``size`` is the height (and width when ``width`` is omitted).
+    """
+    h = size
+    w = width if width is not None else size
     b64 = payload.get("image_b64", "")
     if not b64 or b64 == "random":
         rng = np.random.default_rng(0)
-        return rng.standard_normal((1, size, size, 3), dtype=np.float32)
+        return rng.standard_normal((1, h, w, 3)).astype(np.float32)
     from PIL import Image
 
     img = Image.open(io.BytesIO(base64.b64decode(b64))).convert("RGB")
-    img = img.resize((size, size))
+    img = img.resize((w, h))
     arr = np.asarray(img, dtype=np.float32) / 255.0
     arr = (arr - 0.5) / 0.5  # HF ViT/CLIP normalization
     return arr[None]
@@ -598,6 +604,11 @@ class VllmService(ModelService):
         engine = LLMEngine(mcfg, jax.device_put(params), ecfg)
         self.loop = EngineLoop(engine).start()
         self._SamplingParams = SamplingParams
+        # the lane is max_num_seqs wide; HF fast tokenizers mutate Rust-side
+        # truncation state per call and are not thread-safe
+        import threading
+
+        self._tok_lock = threading.Lock()
 
     def _encode(self, text: str):
         # max() not [-1]: YAML bucket lists arrive in arbitrary order
@@ -605,13 +616,15 @@ class VllmService(ModelService):
         if self._byte_tok:
             ids, n = self.tokenizer.encode(text, max_bucket)
             return [int(i) for i in ids[:n]]
-        return [int(i) for i in self.tokenizer(
-            text, truncation=True, max_length=max_bucket)["input_ids"]]
+        with self._tok_lock:
+            return [int(i) for i in self.tokenizer(
+                text, truncation=True, max_length=max_bucket)["input_ids"]]
 
     def _decode(self, ids) -> str:
         if self._byte_tok:
             return self.tokenizer.decode(ids)
-        return self.tokenizer.decode(ids, skip_special_tokens=True)
+        with self._tok_lock:
+            return self.tokenizer.decode(ids, skip_special_tokens=True)
 
     def example_payload(self) -> Dict[str, Any]:
         return {"prompt": "the quick brown fox", "temperature": 0.0,
@@ -646,6 +659,140 @@ class VllmService(ModelService):
             "n_tokens": len(fin.token_ids),
             "stop_reason": fin.stop_reason,
         }
+
+
+class T5EmbedService(ModelService):
+    """Mean-pooled sentence embeddings — parity with reference
+    ``t5_model_api.py`` (TP-sharded T5-v1.1 encoder, shard-selective load
+    ``:27``, mean-pool readout ``:44``). TP via MESH_SPEC uses the
+    declarative rules table in ``models.t5`` instead of the reference's
+    hand-sharded ``parallel_model_load``.
+    """
+
+    task = "embeddings"
+    infer_route = "/embed"
+
+    def load(self) -> None:
+        from ..models import t5
+
+        cfg = self.cfg
+        if cfg.model_id in ("", "tiny"):
+            mcfg = t5.T5Config.tiny()
+            model = t5.T5Encoder(mcfg)
+            seq = min(cfg.max_seq_len, 64)
+            params = model.init(
+                jax.random.PRNGKey(cfg.seed),
+                jnp.zeros((1, seq), jnp.int32), jnp.ones((1, seq), jnp.int32))
+            self.tokenizer = HashTokenizer(mcfg.vocab_size, seq)
+        else:
+            import torch  # noqa: F401
+            from transformers import T5EncoderModel
+
+            from ..models.convert import cast_f32_to_bf16
+
+            tm = T5EncoderModel.from_pretrained(
+                cfg.model_id, token=cfg.hf_token or None)
+            mcfg = t5.T5Config.from_hf(tm.config)
+            model = t5.T5Encoder(mcfg, dtype=jnp.bfloat16)
+            params = cast_f32_to_bf16(t5.params_from_torch(tm, mcfg))
+            del tm
+            self.tokenizer = _hf_tokenizer(cfg.model_id, cfg.hf_token)
+            seq = min(cfg.max_seq_len, 512)
+        self.seq = seq
+        if cfg.mesh_spec:
+            from ..core.mesh import build_mesh
+            from ..parallel.sharding import shard_pytree
+
+            mesh = build_mesh(cfg.mesh_spec)
+            params = shard_pytree(params, mesh, t5.tp_rules())
+        else:
+            params = jax.device_put(params)
+        self.params = params
+
+        def embed(p, ids, mask):
+            hidden = model.apply(p, ids, mask)
+            return t5.mean_pool(hidden, mask)
+
+        self.fn = jax.jit(embed)
+
+    def _encode(self, text: str):
+        if isinstance(self.tokenizer, HashTokenizer):
+            ids, mask = self.tokenizer(text)
+        else:
+            enc = self.tokenizer(text, padding="max_length", truncation=True,
+                                 max_length=self.seq)
+            ids = np.array(enc["input_ids"])
+            mask = np.array(enc["attention_mask"])
+        return ids[None].astype(np.int32), mask[None].astype(np.int32)
+
+    def example_payload(self) -> Dict[str, Any]:
+        return {"text": "embed me"}
+
+    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        text = payload.get("text", payload.get("prompt"))
+        if text is None:
+            raise HTTPError(400, "missing 'text'")
+        ids, mask = self._encode(str(text))
+        emb = np.asarray(self.fn(self.params, jnp.asarray(ids), jnp.asarray(mask)))
+        return {"embedding": emb[0].tolist(), "dim": int(emb.shape[-1])}
+
+
+class YolosService(ModelService):
+    """Object detection — parity with reference ``run-yolo.py`` (whose
+    ``/detectobj`` handler calls an undefined function, reference
+    ``app/run-yolo.py:68``; implemented for real here).
+    """
+
+    task = "object-detection"
+    infer_route = "/detectobj"
+
+    def load(self) -> None:
+        from ..models import yolos
+
+        cfg = self.cfg
+        if cfg.model_id in ("", "tiny"):
+            mcfg = yolos.YolosConfig.tiny()
+            model = yolos.YolosForObjectDetection(mcfg)
+            params = model.init(
+                jax.random.PRNGKey(cfg.seed),
+                jnp.zeros((1, *mcfg.image_size, 3)))
+            self.id2label = {i: f"class_{i}" for i in range(mcfg.n_labels - 1)}
+        else:
+            import torch  # noqa: F401
+            from transformers import YolosForObjectDetection as HFYolos
+
+            tm = HFYolos.from_pretrained(cfg.model_id, token=cfg.hf_token or None)
+            mcfg = yolos.YolosConfig.from_hf(tm.config)
+            model = yolos.YolosForObjectDetection(mcfg, dtype=jnp.bfloat16)
+            params = yolos.params_from_torch(tm, mcfg)
+            self.id2label = dict(getattr(tm.config, "id2label", {}) or {})
+            del tm
+        self.mcfg = mcfg
+        self.params = jax.device_put(params)
+        self.fn = jax.jit(model.apply)
+        self._post = yolos.postprocess
+
+    def example_payload(self) -> Dict[str, Any]:
+        return {"image_b64": "random", "threshold": 0.5}
+
+    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        H, W = self.mcfg.image_size
+        arr = decode_image(payload, H, W)
+        thr = float(payload.get("threshold", 0.9))
+        logits, boxes = self.fn(self.params, jnp.asarray(arr))
+        dets = self._post(np.asarray(logits)[0], np.asarray(boxes)[0], thr,
+                          W, H, self.id2label)
+        return {"detections": dets, "count": len(dets)}
+
+
+@register_model("yolo")
+def _build_yolo(cfg: ServeConfig) -> ModelService:
+    return YolosService(cfg)
+
+
+@register_model("t5")
+def _build_t5(cfg: ServeConfig) -> ModelService:
+    return T5EmbedService(cfg)
 
 
 @register_model("vllm")

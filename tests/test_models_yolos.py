@@ -1,0 +1,85 @@
+"""YOLOS: HF torch numeric parity, postprocess, detection service."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_hw_agnostic_inference_tpu.models import yolos
+
+
+def hf_tiny():
+    import torch
+    from transformers import YolosConfig as HFConfig
+    from transformers import YolosForObjectDetection as HFModel
+
+    hf_cfg = HFConfig(
+        image_size=[32, 32], patch_size=8, hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=2, intermediate_size=64,
+        num_detection_tokens=5, num_labels=3, layer_norm_eps=1e-12,
+        hidden_act="gelu", use_mid_position_embeddings=False,
+    )
+    torch.manual_seed(0)
+    return HFModel(hf_cfg).eval(), hf_cfg
+
+
+def test_yolos_torch_parity():
+    import torch
+
+    tm, hf_cfg = hf_tiny()
+    cfg = yolos.YolosConfig.from_hf(hf_cfg)
+    assert cfg.n_det_tokens == 5
+    assert cfg.n_labels == 4  # 3 labels + no-object
+    model = yolos.YolosForObjectDetection(cfg)
+    params = yolos.params_from_torch(tm, cfg)
+
+    rng = np.random.default_rng(0)
+    img = rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(pixel_values=torch.tensor(img.transpose(0, 3, 1, 2)))
+    logits, boxes = model.apply(params, jnp.asarray(img))
+    np.testing.assert_allclose(np.asarray(logits), ref.logits.numpy(), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(boxes), ref.pred_boxes.numpy(), atol=2e-4)
+
+
+def test_postprocess_threshold_and_boxes():
+    logits = np.full((2, 4), -10.0, np.float32)
+    logits[0, 1] = 10.0   # confident class 1
+    logits[1, 3] = 10.0   # confident no-object -> dropped
+    boxes = np.array([[0.5, 0.5, 0.2, 0.4], [0.1, 0.1, 0.1, 0.1]], np.float32)
+    dets = yolos.postprocess(logits, boxes, 0.5, width=100, height=200)
+    assert len(dets) == 1
+    d = dets[0]
+    assert d["label_id"] == 1 and d["score"] > 0.99
+    assert d["box"] == {"xmin": 40.0, "ymin": 60.0, "xmax": 60.0, "ymax": 140.0}
+
+
+@pytest.mark.asyncio
+async def test_yolo_service_end_to_end():
+    import base64
+    import io
+
+    from PIL import Image
+
+    from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    from test_serve_http import make_client, wait_ready
+
+    cfg = ServeConfig(app="yolo", model_id="tiny", device="cpu")
+    app = create_app(cfg, get_model("yolo")(cfg))
+    async with make_client(app) as c:
+        r = await wait_ready(c, timeout=120.0)
+        assert r.status_code == 200, r.text
+
+        buf = io.BytesIO()
+        Image.new("RGB", (64, 48), (200, 30, 30)).save(buf, format="PNG")
+        b64 = base64.b64encode(buf.getvalue()).decode()
+        r = await c.post("/detectobj", json={"image_b64": b64, "threshold": 0.0})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["count"] == len(body["detections"]) > 0
+        det = body["detections"][0]
+        assert {"label", "score", "box"} <= set(det)
