@@ -1,0 +1,19 @@
+"""compilectl: build-time AOT compilation of serving units.
+
+Parity with the reference's compile scripts (``app/compile-sd2.py``,
+``compile-llam3.py``, ``compile-yolo.py``, ``compile-vllm.py`` — SURVEY.md
+§2.1): each AOT-compiles one model at frozen serving shapes and publishes
+the artifact. TPU-natively the artifact is two-tier (``core.aot``):
+
+1. the XLA persistent compilation cache, warmed by running the service's
+   real ``load() + warmup()`` under the artifact root — a restarted pod
+   with the same root skips the multi-minute compile entirely;
+2. optional exported StableHLO functions for models whose serving forward
+   is a single jitted callable.
+
+``python -m scalable_hw_agnostic_inference_tpu.compilectl <model>`` uses the
+same env contract as serving, so a compile Job differs from a serving pod
+only in command (reference ``compile-vllm-job.yaml`` pattern).
+"""
+
+from .run import compile_model  # noqa: F401

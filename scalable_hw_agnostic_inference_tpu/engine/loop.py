@@ -29,6 +29,7 @@ class EngineLoop:
             queue.Queue()
         )
         self._futures: dict[int, Future] = {}
+        self._futures_lock = threading.Lock()
         self._poll_s = poll_s
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="engine-loop",
@@ -39,9 +40,9 @@ class EngineLoop:
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
+        """Signal the loop to exit; its exit path fails outstanding futures."""
         self._stop.set()
         self._thread.join(timeout)
-        self._fail_all(RuntimeError("engine loop is stopped"))
 
     def submit(self, prompt_ids: Sequence[int],
                params: Optional[SamplingParams] = None) -> Future:
@@ -50,6 +51,10 @@ class EngineLoop:
             raise RuntimeError("engine loop is stopped")
         fut: Future = Future()
         self._submit_q.put((list(prompt_ids), params or SamplingParams(), fut))
+        # close the put-after-drain window: if the loop died between our
+        # _stop check and the put, nobody will ever drain this item
+        if self._stop.is_set():
+            self._fail_all(RuntimeError("engine loop is stopped"))
         return fut
 
     def generate(self, prompt_ids: Sequence[int],
@@ -70,7 +75,8 @@ class EngineLoop:
             ids, params, fut = item
             try:
                 rid = self.engine.add_request(ids, params)
-                self._futures[rid] = fut
+                with self._futures_lock:
+                    self._futures[rid] = fut
             except Exception as e:  # bad request (e.g. empty prompt)
                 fut.set_exception(e)
             try:
@@ -80,32 +86,37 @@ class EngineLoop:
 
     def _fail_all(self, err: Exception) -> None:
         """Fail every queued and in-flight future (loop death / stop)."""
-        while True:
-            try:
-                _, _, fut = self._submit_q.get_nowait()
-            except queue.Empty:
-                break
-            if not fut.done():
-                fut.set_exception(err)
-        for fut in self._futures.values():
-            if not fut.done():
-                fut.set_exception(err)
-        self._futures.clear()
+        with self._futures_lock:
+            while True:
+                try:
+                    _, _, fut = self._submit_q.get_nowait()
+                except queue.Empty:
+                    break
+                if not fut.done():
+                    fut.set_exception(err)
+            for fut in self._futures.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._futures.clear()
 
     def _run(self) -> None:
-        while not self._stop.is_set():
-            # block for work only when idle; never between engine steps
-            self._drain_submissions(block=not self.engine.has_work)
-            if not self.engine.has_work:
-                continue
-            try:
-                for fin in self.engine.step():
-                    fut = self._futures.pop(fin.req_id, None)
-                    if fut is not None:
-                        fut.set_result(fin)
-            except Exception:
-                log.exception("engine step failed; failing in-flight requests")
-                # dead loop must refuse new submissions, not strand them
-                self._stop.set()
-                self._fail_all(RuntimeError("engine step failed"))
-                raise
+        try:
+            while not self._stop.is_set():
+                # block for work only when idle; never between engine steps
+                self._drain_submissions(block=not self.engine.has_work)
+                if not self.engine.has_work:
+                    continue
+                try:
+                    for fin in self.engine.step():
+                        with self._futures_lock:
+                            fut = self._futures.pop(fin.req_id, None)
+                        if fut is not None:
+                            fut.set_result(fin)
+                except Exception:
+                    log.exception("engine step failed")
+                    self._stop.set()  # dead loop must refuse new submissions
+                    raise
+        finally:
+            # sole cleanup point: runs on clean stop AND on crash, from the
+            # loop thread itself, so callers never race live future updates
+            self._fail_all(RuntimeError("engine loop is stopped"))

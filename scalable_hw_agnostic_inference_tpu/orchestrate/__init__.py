@@ -1,0 +1,7 @@
+"""Orchestration layer: traffic-policy controller and fan-out clients.
+
+TPU-native equivalents of the reference's L5/L6 pieces: the
+capacity-checker failover controller (``capacity-checker-deploy.yaml``,
+SURVEY.md §3.5), the cova chain client (``app/cova_gradio_m.py``), and the
+load simulators (``app/appsimulator.sh``, ``load-cosine-simu.yaml``).
+"""

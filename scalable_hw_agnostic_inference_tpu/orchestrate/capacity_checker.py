@@ -1,0 +1,162 @@
+"""Capacity-checker: the two-state failover/fallback routing controller.
+
+Reference semantics (``capacity-checker-deploy.yaml:26-49``,
+``capacity-checker-config.yaml:24-44``; formalized ``README.md:276-316``):
+
+- every poll interval, look for **insufficient-capacity provisioning
+  events** for the accelerator nodepools; on a hit, switch the stack from
+  cost-optimized (weighted routing + weighted scaledobjects) to
+  capacity-optimized (equal routing + equal scaledobjects)  — FAILOVER;
+- once in failover, when the synthetic-load deployment's readyReplicas
+  indicates a fresh demand cycle (in [lo, hi]), switch back — FALLBACK.
+
+The reference reads CloudWatch Logs Insights over Karpenter logs; the
+TPU/GKE-native signal is Kubernetes events (``FailedScaleUp``,
+``NotTriggerScaleUp``, Karpenter's ``insufficient capacity`` NodeClaim
+events). The decision core is pure (:func:`decide`) and unit-tested with
+fake events (SURVEY.md §4's fake-cluster implication); the k8s glue shells
+out to kubectl exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import subprocess
+import time
+from typing import List, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+INSUFFICIENT_MARKERS = (
+    "insufficient capacity",        # Karpenter NodeClaim failure text
+    "FailedScaleUp",                # cluster-autoscaler event reason
+    "NotTriggerScaleUp",
+    "GCE_STOCKOUT",                 # GKE TPU stockout
+    "does not have enough resources",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    reason: str
+    message: str
+    involved: str = ""              # node/nodepool/nodeclaim name
+
+
+@dataclasses.dataclass
+class ControllerState:
+    mode: str = "weighted"          # "weighted" (cost) | "equal" (capacity)
+    last_trigger: str = ""
+
+
+def is_capacity_failure(ev: Event, nodepool_substrings: Sequence[str]) -> bool:
+    text = f"{ev.reason} {ev.message}"
+    if not any(m.lower() in text.lower() for m in INSUFFICIENT_MARKERS):
+        return False
+    if not nodepool_substrings:
+        return True
+    hay = f"{ev.involved} {ev.message}".lower()
+    return any(s.lower() in hay for s in nodepool_substrings)
+
+
+def decide(state: ControllerState, events: List[Event],
+           load_ready_replicas: Optional[int],
+           nodepool_substrings: Sequence[str] = (),
+           fresh_cycle: range = range(1, 6)) -> str:
+    """Pure decision → action: "failover" | "fallback" | "hold".
+
+    Mirrors the reference's two rules exactly (``capacity-checker-deploy.
+    yaml:30-47``): capacity failure in cost mode → failover; fresh demand
+    cycle while failed-over → fallback. Does NOT mutate ``state`` — callers
+    :func:`commit` only after the cluster apply succeeds, so a failed apply
+    retries next poll instead of desyncing controller from cluster.
+    """
+    failures = [e for e in events if is_capacity_failure(e, nodepool_substrings)]
+    if state.mode == "weighted" and failures:
+        state.last_trigger = failures[0].message[:200]
+        return "failover"
+    if state.mode == "equal" and load_ready_replicas is not None \
+            and load_ready_replicas in fresh_cycle:
+        state.last_trigger = f"load readyReplicas={load_ready_replicas}"
+        return "fallback"
+    return "hold"
+
+
+def commit(state: ControllerState, action: str) -> None:
+    """Record a successfully applied transition."""
+    if action == "failover":
+        state.mode = "equal"
+    elif action == "fallback":
+        state.mode = "weighted"
+
+
+# -- k8s glue (shell-out, matching the reference's kubectl-apply loop) ------
+
+def kubectl(*args: str) -> str:
+    out = subprocess.run(["kubectl", *args], capture_output=True, text=True)
+    if out.returncode != 0:
+        raise RuntimeError(f"kubectl {' '.join(args)}: {out.stderr.strip()}")
+    return out.stdout
+
+
+def fetch_events(namespace: str = "default") -> List[Event]:
+    raw = kubectl("get", "events", "-n", namespace, "-o", "json",
+                  "--field-selector", "type=Warning")
+    items = json.loads(raw).get("items", [])
+    return [Event(reason=i.get("reason", ""),
+                  message=i.get("message", ""),
+                  involved=i.get("involvedObject", {}).get("name", ""))
+            for i in items]
+
+
+def fetch_load_ready(deployment: str, namespace: str = "load") -> Optional[int]:
+    try:
+        raw = kubectl("get", "deploy", deployment, "-n", namespace, "-o",
+                      "jsonpath={.status.readyReplicas}")
+        return int(raw) if raw.strip() else 0
+    except Exception:
+        return None
+
+
+def apply_mode(mode: str, manifest_dir: str, app: str) -> None:
+    """Apply the ingress + scaledobjects for the target mode (the
+    reference's kubectl-apply pair, ``capacity-checker-deploy.yaml:30-36``)."""
+    kubectl("apply", "-f", f"{manifest_dir}/ingress/{app}-{mode}-routing-ing.yaml")
+    kubectl("apply", "-f",
+            f"{manifest_dir}/scaledobjects/{app}-scaledobject-{mode}-routing.yaml")
+
+
+def main_loop(app: str = "sd21", manifest_dir: str = "/deploy",
+              nodepools: Sequence[str] = ("tpu", "v5e"),
+              load_deploy: str = "load", interval_s: int = 300) -> None:
+    state = ControllerState()
+    while True:
+        try:
+            action = decide(state, fetch_events(), fetch_load_ready(load_deploy),
+                            nodepool_substrings=nodepools)
+            if action in ("failover", "fallback"):
+                mode = "equal" if action == "failover" else "weighted"
+                log.warning("%s -> applying %s routing (%s)", action, mode,
+                            state.last_trigger)
+                apply_mode(mode, manifest_dir, app)
+                commit(state, action)  # only after the apply succeeded
+            else:
+                log.info("hold (mode=%s)", state.mode)
+        except Exception:
+            log.exception("capacity-checker iteration failed")
+        time.sleep(interval_s)
+
+
+if __name__ == "__main__":
+    import os
+
+    logging.basicConfig(level="INFO")
+    main_loop(
+        app=os.environ.get("APP", "sd21"),
+        manifest_dir=os.environ.get("MANIFEST_DIR", "/deploy"),
+        nodepools=tuple(os.environ.get("NODEPOOLS", "tpu,v5e").split(",")),
+        load_deploy=os.environ.get("LOAD_DEPLOY", "load"),
+        interval_s=int(os.environ.get("INTERVAL_S", "300")),
+    )

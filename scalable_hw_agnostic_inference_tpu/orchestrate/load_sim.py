@@ -1,0 +1,117 @@
+"""Synthetic-demand simulator: scale a client Deployment along a wave.
+
+Parity targets: ``load-cosine-simu.yaml:26-69`` (cosine wave, 20-min steps)
+and ``app/appsimulator.sh`` (sine wave; persists phase to SQS so a restarted
+simulator resumes mid-cycle ``:2-20``; deletes Evicted/CrashLoop pods each
+tick ``:56``). Here the wave math is pure and tested; phase persists to a
+state file (PV) instead of SQS; kubectl does the scaling.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def wave_replicas(step: int, period_steps: int, magnitude: float,
+                  minimum: float, kind: str = "cosine") -> int:
+    """Replica count for one wave step; peak = min+magnitude, trough = min."""
+    phase = 2.0 * math.pi * (step % period_steps) / period_steps
+    if kind == "cosine":
+        v = (1.0 - math.cos(phase)) / 2.0     # starts at trough
+    elif kind == "sine":
+        v = (1.0 + math.sin(phase)) / 2.0
+    else:
+        raise ValueError(f"unknown wave kind {kind!r}")
+    return max(0, round(minimum + magnitude * v))
+
+
+class PhaseStore:
+    """Resumable wave phase (the reference's SQS trick, file-backed)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> int:
+        try:
+            with open(self.path) as f:
+                return int(json.load(f)["step"])
+        except Exception:
+            return 0
+
+    def save(self, step: int) -> None:
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": step, "ts": time.time()}, f)
+        os.replace(tmp, self.path)
+
+
+def scale_deployment(name: str, replicas: int, namespace: str = "load") -> None:
+    from .capacity_checker import kubectl
+
+    kubectl("scale", "deploy", name, "-n", namespace,
+            f"--replicas={replicas}")
+
+
+def gc_bad_pods(namespace: str = "load") -> int:
+    """Delete Evicted/CrashLoopBackOff pods (``appsimulator.sh:56``)."""
+    from .capacity_checker import kubectl
+
+    raw = kubectl("get", "pods", "-n", namespace, "-o", "json")
+    victims = []
+    for p in json.loads(raw).get("items", []):
+        phase = p.get("status", {}).get("phase", "")
+        reason = p.get("status", {}).get("reason", "")
+        waiting = [
+            (c.get("state", {}).get("waiting") or {}).get("reason", "")
+            for c in p.get("status", {}).get("containerStatuses", [])
+        ]
+        if phase == "Failed" or reason == "Evicted" \
+                or "CrashLoopBackOff" in waiting:
+            victims.append(p["metadata"]["name"])
+    for v in victims:
+        kubectl("delete", "pod", v, "-n", namespace, "--ignore-not-found")
+    return len(victims)
+
+
+def main_loop(deployment: str = "load", namespace: str = "load",
+              period_steps: int = 24, magnitude: float = 20.0,
+              minimum: float = 1.0, step_s: int = 1200,
+              kind: str = "cosine",
+              state_path: str = "/tmp/load-sim-state.json",
+              publish: Optional[object] = None) -> None:
+    store = PhaseStore(state_path)
+    step = store.load()
+    while True:
+        n = wave_replicas(step, period_steps, magnitude, minimum, kind)
+        try:
+            scale_deployment(deployment, n, namespace)
+            gc_bad_pods(namespace)
+            if publish is not None:
+                publish(n)  # the reference's app_workers metric (:50)
+            log.info("step %d -> %d replicas", step, n)
+        except Exception:
+            log.exception("load-sim iteration failed")
+        step += 1
+        store.save(step)
+        time.sleep(step_s)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level="INFO")
+    main_loop(
+        deployment=os.environ.get("LOAD_DEPLOY", "load"),
+        namespace=os.environ.get("NAMESPACE", "load"),
+        period_steps=int(os.environ.get("PERIOD_STEPS", "24")),
+        magnitude=float(os.environ.get("MAGNITUDE", "20")),
+        minimum=float(os.environ.get("MINIMUM", "1")),
+        step_s=int(os.environ.get("STEP_S", "1200")),
+        kind=os.environ.get("WAVE", "cosine"),
+        state_path=os.environ.get("STATE_PATH", "/tmp/load-sim-state.json"),
+    )

@@ -26,9 +26,14 @@ def main() -> None:
               f"available: {', '.join(list_models())}", file=sys.stderr)
         raise SystemExit(2)
     cfg = ServeConfig.from_env()
+    from ..core.aot import enable_persistent_cache
     from ..core.device import apply_platform
 
     apply_platform(cfg.device)
+    # consume compile-Job artifacts: a pod booting with the same artifact
+    # root skips the cold XLA compile (reference's COMPILED_MODEL_ID pull,
+    # ``sd21-inf2-deploy.yaml:60-61``, minus the hub round-trip)
+    enable_persistent_cache(f"{cfg.artifact_root}/xla-cache")
     service = get_model(name)(cfg)
     serve_forever(cfg, service)
 

@@ -60,11 +60,17 @@ def _hf_tokenizer(model_id: str, token: str = ""):
     return AutoTokenizer.from_pretrained(model_id, token=token or None)
 
 
-def decode_image(payload: Dict[str, Any], size, width: Optional[int] = None
-                 ) -> np.ndarray:
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def decode_image(payload: Dict[str, Any], size, width: Optional[int] = None,
+                 mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)) -> np.ndarray:
     """base64 PNG/JPEG (or 'random') → normalized NHWC float array.
 
-    ``size`` is the height (and width when ``width`` is omitted).
+    ``size`` is the height (and width when ``width`` is omitted). Default
+    normalization is HF ViT/CLIP's 0.5/0.5; detection models pass ImageNet
+    statistics.
     """
     h = size
     w = width if width is not None else size
@@ -77,7 +83,7 @@ def decode_image(payload: Dict[str, Any], size, width: Optional[int] = None
     img = Image.open(io.BytesIO(base64.b64decode(b64))).convert("RGB")
     img = img.resize((w, h))
     arr = np.asarray(img, dtype=np.float32) / 255.0
-    arr = (arr - 0.5) / 0.5  # HF ViT/CLIP normalization
+    arr = (arr - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
     return arr[None]
 
 
@@ -777,7 +783,8 @@ class YolosService(ModelService):
 
     def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         H, W = self.mcfg.image_size
-        arr = decode_image(payload, H, W)
+        # HF YolosImageProcessor normalizes with ImageNet stats, not 0.5/0.5
+        arr = decode_image(payload, H, W, mean=IMAGENET_MEAN, std=IMAGENET_STD)
         thr = float(payload.get("threshold", 0.9))
         logits, boxes = self.fn(self.params, jnp.asarray(arr))
         dets = self._post(np.asarray(logits)[0], np.asarray(boxes)[0], thr,

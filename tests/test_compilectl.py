@@ -1,0 +1,44 @@
+"""compilectl: cache warming, manifest, AOT export/load round-trip."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalable_hw_agnostic_inference_tpu.compilectl import compile_model
+from scalable_hw_agnostic_inference_tpu.core.aot import AotCache, aot_key
+from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+
+def test_compile_model_warms_cache_and_manifest(tmp_path):
+    cfg = ServeConfig(app="bert", model_id="tiny", device="cpu",
+                      artifact_root=str(tmp_path))
+    report = compile_model("bert", cfg, self_test=True)
+    assert report["cache_entries"] >= 1
+    assert "label" in report["self_test_keys"]
+    manifest = json.loads((tmp_path / "compile-manifest.json").read_text())
+    assert "bert" in manifest and manifest["bert"]["model"] == "bert"
+    # warm second run reuses the cache (no new entries for same shapes)
+    report2 = compile_model("bert", cfg, self_test=False)
+    assert report2["cache_entries"] == report["cache_entries"]
+
+
+def test_aot_cache_export_load_roundtrip(tmp_path):
+    cache = AotCache(str(tmp_path))
+
+    def fn(x):
+        return jnp.sin(x) * 2.0
+
+    x = jnp.arange(8, dtype=jnp.float32)
+    key = cache.export("sin2", fn, (x,))
+    assert key in cache.keys()
+    assert (tmp_path / f"{key}.shlo").exists()
+
+    loaded = AotCache(str(tmp_path)).load(key)
+    np.testing.assert_allclose(np.asarray(loaded(x)),
+                               np.asarray(fn(x)), atol=1e-6)
+    # same shapes -> same key; different shapes -> different key
+    assert aot_key("sin2", (x,)) == aot_key("sin2", (jnp.ones(8),))
+    assert aot_key("sin2", (x,)) != aot_key("sin2", (jnp.ones(4),))

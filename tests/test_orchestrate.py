@@ -1,0 +1,90 @@
+"""Capacity-checker decision core + load-wave math (fake-cluster tests).
+
+The reference's controller is only testable against a live EKS cluster
+(SURVEY.md §4); here the decision function is pure, so the failover state
+machine is covered hermetically with fake events and replica counts.
+"""
+
+import json
+
+import pytest
+
+from scalable_hw_agnostic_inference_tpu.orchestrate.capacity_checker import (
+    ControllerState,
+    Event,
+    commit,
+    decide,
+    is_capacity_failure,
+)
+from scalable_hw_agnostic_inference_tpu.orchestrate.load_sim import (
+    PhaseStore,
+    wave_replicas,
+)
+
+
+def ev(msg, reason="FailedScaleUp", involved="tpu-v5e-pool-x7k"):
+    return Event(reason=reason, message=msg, involved=involved)
+
+
+def test_capacity_failure_matching():
+    assert is_capacity_failure(
+        ev("insufficient capacity for ct5lp-hightpu-1t"), ("tpu",))
+    assert is_capacity_failure(ev("GCE_STOCKOUT in us-central2"), ("tpu",))
+    # unrelated warning
+    assert not is_capacity_failure(
+        Event("BackOff", "restarting failed container", "pod-1"), ("tpu",))
+    # capacity failure on a non-watched pool
+    assert not is_capacity_failure(
+        Event("FailedScaleUp", "insufficient capacity", "gpu-pool-abc"), ("tpu",))
+
+
+def test_failover_then_fallback_cycle():
+    st = ControllerState()
+    # healthy: hold
+    assert decide(st, [], 10, ("tpu",)) == "hold"
+    assert st.mode == "weighted"
+    # capacity failure -> failover; state commits only after a good apply
+    events = [ev("insufficient capacity: ct5lp")]
+    assert decide(st, events, 10, ("tpu",)) == "failover"
+    assert st.mode == "weighted"          # not yet applied
+    # failed apply -> same decision re-fires next poll (no desync)
+    assert decide(st, events, 10, ("tpu",)) == "failover"
+    commit(st, "failover")
+    assert st.mode == "equal"
+    # still failing, already failed over -> hold
+    assert decide(st, events, 10, ("tpu",)) == "hold"
+    # demand cycle resets (readyReplicas in [1,5]) -> fallback
+    assert decide(st, [], 3, ("tpu",)) == "fallback"
+    commit(st, "fallback")
+    assert st.mode == "weighted"
+    # replicas in fresh range but already weighted -> hold
+    assert decide(st, [], 3, ("tpu",)) == "hold"
+
+
+def test_fallback_needs_fresh_cycle():
+    st = ControllerState(mode="equal")
+    assert decide(st, [], 20, ("tpu",)) == "hold"   # mid-cycle
+    assert decide(st, [], 0, ("tpu",)) == "hold"    # idle
+    assert decide(st, [], None, ("tpu",)) == "hold"  # unknown
+    assert decide(st, [], 5, ("tpu",)) == "fallback"
+
+
+def test_wave_replicas_shape():
+    period, mag, mn = 24, 20.0, 1.0
+    vals = [wave_replicas(s, period, mag, mn, "cosine") for s in range(period)]
+    assert vals[0] == 1                  # cosine starts at trough
+    assert max(vals) == 21               # peak = min + magnitude
+    assert vals[period // 2] == 21
+    svals = [wave_replicas(s, period, mag, mn, "sine") for s in range(period)]
+    assert svals[period // 4] == 21      # sine peaks at quarter period
+    with pytest.raises(ValueError):
+        wave_replicas(0, 24, 1, 1, "square")
+
+
+def test_phase_store_roundtrip(tmp_path):
+    store = PhaseStore(str(tmp_path / "phase.json"))
+    assert store.load() == 0             # missing -> fresh cycle
+    store.save(17)
+    assert store.load() == 17
+    (tmp_path / "phase.json").write_text("garbage")
+    assert store.load() == 0             # corrupt -> fresh cycle
