@@ -1,0 +1,72 @@
+"""Flow-matching Euler scheduler (the Flux sampler) as pure table math.
+
+The reference's Flux path gets its sigma schedule from diffusers'
+FlowMatchEulerDiscreteScheduler inside the reassembled pipeline (reference
+``app/src/inference.py:168-204``). Same design as ``models.schedulers``:
+host-side tables once, a pure ``step`` inside the jitted scan.
+
+Flow matching: x_sigma = (1-sigma)*x0 + sigma*noise; the model predicts the
+velocity v = noise - x0, and Euler integration walks sigma down to 0:
+``x_{i+1} = x_i + (sigma_{i+1} - sigma_i) * v``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class FlowMatchConfig:
+    num_train_timesteps: int = 1000
+    shift: float = 1.15          # static shift (flux-dev uses dynamic too)
+    use_dynamic_shifting: bool = True
+    base_seq_len: int = 256      # mu interpolation anchors (flux defaults)
+    max_seq_len: int = 4096
+    base_shift: float = 0.5
+    max_shift: float = 1.15
+
+
+def time_shift(mu: float, sigma: np.ndarray) -> np.ndarray:
+    """Flux's exponential time shift: more steps near sigma=1 for big images."""
+    return np.exp(mu) / (np.exp(mu) + (1.0 / sigma - 1.0))
+
+
+def mu_for_seq_len(cfg: FlowMatchConfig, seq_len: int) -> float:
+    """Linear interpolation of the shift exponent by image token count."""
+    m = (cfg.max_shift - cfg.base_shift) / (cfg.max_seq_len - cfg.base_seq_len)
+    b = cfg.base_shift - m * cfg.base_seq_len
+    return seq_len * m + b
+
+
+class FlowMatchEuler:
+    def __init__(self, cfg: FlowMatchConfig = FlowMatchConfig()):
+        self.cfg = cfg
+
+    def tables(self, num_steps: int, image_seq_len: int = 0):
+        """(timesteps [N] in [0,1000), sigma [N], sigma_next [N])."""
+        sigmas = np.linspace(1.0, 1.0 / num_steps, num_steps)
+        if self.cfg.use_dynamic_shifting and image_seq_len:
+            sigmas = time_shift(mu_for_seq_len(self.cfg, image_seq_len), sigmas)
+        else:
+            s = self.cfg.shift
+            sigmas = s * sigmas / (1 + (s - 1) * sigmas)
+        ts = sigmas * self.cfg.num_train_timesteps
+        sig_next = np.concatenate([sigmas[1:], [0.0]])
+        return (jnp.asarray(ts, jnp.float32),
+                jnp.asarray(sigmas, jnp.float32),
+                jnp.asarray(sig_next, jnp.float32))
+
+    @staticmethod
+    def step(sample: jax.Array, velocity: jax.Array, sigma: jax.Array,
+             sigma_next: jax.Array) -> jax.Array:
+        return (sample.astype(jnp.float32)
+                + (sigma_next - sigma) * velocity.astype(jnp.float32))
+
+    @staticmethod
+    def init_noise(rng: jax.Array, shape) -> jax.Array:
+        return jax.random.normal(rng, shape, jnp.float32)

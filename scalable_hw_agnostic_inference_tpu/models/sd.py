@@ -184,6 +184,8 @@ def resolve_checkpoint_dir(model_id: str, token: str = "") -> str:
     return snapshot_download(
         model_id, token=token or None,
         allow_patterns=["unet/*", "vae/*", "text_encoder/*", "tokenizer/*",
+                        "text_encoder_2/*", "tokenizer_2/*",  # flux T5/CLIP pair
+                        "flux1-*.safetensors",                # BFL transformer
                         "scheduler/*", "*.json"],
     )
 

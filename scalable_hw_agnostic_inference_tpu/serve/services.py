@@ -64,6 +64,16 @@ IMAGENET_MEAN = (0.485, 0.456, 0.406)
 IMAGENET_STD = (0.229, 0.224, 0.225)
 
 
+def tokenize_to_length(tok, text: str, length: int) -> np.ndarray:
+    """Fixed-length [1, length] int32 ids from a HashTokenizer or HF fast
+    tokenizer — one helper for every fixed-shape conditioning path."""
+    if isinstance(tok, HashTokenizer):
+        ids, _ = tok(text)
+        return np.asarray(ids)[None, :length].astype(np.int32)
+    enc = tok(text, padding="max_length", truncation=True, max_length=length)
+    return np.asarray(enc["input_ids"], np.int32)[None]
+
+
 def decode_image(payload: Dict[str, Any], size, width: Optional[int] = None,
                  mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)) -> np.ndarray:
     """base64 PNG/JPEG (or 'random') → normalized NHWC float array.
@@ -462,13 +472,7 @@ class SDService(ModelService):
             self.pipe.warm(1, self.height, self.width, steps, self.seq_len)
 
     def _tokenize(self, text: str) -> np.ndarray:
-        if isinstance(self.tokenizer, HashTokenizer):
-            ids, _ = self.tokenizer(text)
-            return ids[None].astype(np.int32)
-        enc = self.tokenizer(
-            text, padding="max_length", truncation=True, max_length=self.seq_len
-        )
-        return np.asarray(enc["input_ids"], np.int32)[None]
+        return tokenize_to_length(self.tokenizer, text, self.seq_len)
 
     def example_payload(self) -> Dict[str, Any]:
         return {"prompt": "a photo of an astronaut riding a horse", "steps": None}
@@ -790,6 +794,166 @@ class YolosService(ModelService):
         dets = self._post(np.asarray(logits)[0], np.asarray(boxes)[0], thr,
                           W, H, self.id2label)
         return {"detections": dets, "count": len(dets)}
+
+
+class FluxService(ModelService):
+    """Flux txt2img — parity with reference ``flux_model_api.py``.
+
+    The reference pins CLIP+VAE / T5-TP8 / transformer-TP8 to overlapping
+    NeuronCore ranges of one 16-core host (``app/flux_model_api.py:128-140,
+    298-320``); here SUBMESH="a:b" gives the transformer its TP slice and the
+    encoders+VAE live on the remaining devices (``core.mesh.submesh``). One
+    jitted scan runs the whole denoise; flux-dev guidance is an embedding,
+    not CFG, so no batch doubling.
+    """
+
+    task = "text-to-image"
+    infer_route = "/genimage"
+
+    def load(self) -> None:
+        from ..core.device import local_devices
+        from ..core.mesh import build_mesh, parse_submesh, submesh
+        from ..models import clip, flux, t5
+        from ..models.flux_pipeline import FluxPipeline
+        from ..models.vae import AutoencoderKL, VAEConfig
+
+        cfg = self.cfg
+        devices = local_devices()
+        sub = parse_submesh(cfg.submesh) if cfg.submesh else None
+        if sub is not None:
+            tf_devices = submesh(sub[0], sub[1], devices)
+            rest = [d for d in devices if d not in tf_devices] or devices[:1]
+        else:
+            tf_devices, rest = devices, devices[:1]
+        enc_dev = rest[0]
+
+        if cfg.model_id in ("", "tiny"):
+            fcfg = flux.FluxConfig.tiny()
+            tcfg = t5.T5Config.tiny()
+            ccfg = clip.ClipTextConfig.tiny()
+            vcfg = VAEConfig.tiny()
+            t5m = t5.T5Encoder(tcfg)
+            t5p = t5m.init(jax.random.PRNGKey(cfg.seed),
+                           jnp.zeros((1, 8), jnp.int32))
+            clipm = clip.ClipTextEncoder(ccfg)
+            clipp = clipm.init(jax.random.PRNGKey(cfg.seed + 1),
+                               jnp.zeros((1, 8), jnp.int32))
+            model = flux.FluxTransformer(fcfg, dtype=jnp.float32)
+            h = w = 8
+            fparams = model.init(
+                jax.random.PRNGKey(cfg.seed + 2),
+                jnp.zeros((1, (h // 2) * (w // 2), fcfg.in_channels)),
+                jnp.zeros((1, 8, fcfg.t5_dim)),
+                jnp.zeros((1, fcfg.clip_dim)),
+                jnp.zeros((1,)), jnp.zeros((1,)),
+                flux.make_ids(1, 8, h, w))
+            vae = AutoencoderKL(vcfg)
+            vparams = vae.init(jax.random.PRNGKey(cfg.seed + 3),
+                               jnp.zeros((1, 4, 4, vcfg.latent_channels)))
+            self.t5_tok = HashTokenizer(tcfg.vocab_size, 16)
+            self.clip_tok = HashTokenizer(ccfg.vocab_size, ccfg.max_position)
+            self.t5_len, self.clip_len = 16, ccfg.max_position
+            self.height = self.width = 32  # vae_scale 2 * patch 2 * 8 lat
+        else:
+            import os
+
+            from safetensors.torch import load_file
+            from transformers import CLIPTextModel, T5EncoderModel
+
+            from ..models import sd as sd_mod
+            from ..models import vae as vae_mod
+            from ..models.convert import cast_f32_to_bf16
+
+            root = sd_mod.resolve_checkpoint_dir(cfg.model_id, cfg.hf_token)
+            fcfg = flux.FluxConfig.flux_dev()
+            tmt = T5EncoderModel.from_pretrained(root, subfolder="text_encoder_2")
+            tcfg = t5.T5Config.from_hf(tmt.config)
+            t5m = t5.T5Encoder(tcfg, dtype=jnp.bfloat16)
+            t5p = cast_f32_to_bf16(t5.params_from_torch(tmt, tcfg))
+            del tmt
+            tmc = CLIPTextModel.from_pretrained(root, subfolder="text_encoder")
+            ccfg = clip.ClipTextConfig.from_hf(tmc.config)
+            clipm = clip.ClipTextEncoder(ccfg)
+            clipp = clip.params_from_torch(tmc, ccfg)
+            del tmc
+            # BFL single-file transformer weights; HF repo stores them under
+            # transformer/ in diffusers layout and flux1-dev.safetensors at
+            # the root — we consume the BFL layout (models.flux converter)
+            import json
+
+            bfl = os.path.join(root, "flux1-dev.safetensors")
+            fparams = cast_f32_to_bf16(
+                flux.params_from_torch(load_file(bfl), fcfg))
+            with open(os.path.join(root, "vae", "config.json")) as f:
+                vcfg = vae_mod.VAEConfig.from_hf(json.load(f))
+            vparams = vae_mod.params_from_torch(
+                sd_mod.load_torch_state(os.path.join(root, "vae")), vcfg)
+            self.t5_tok = _hf_tokenizer(f"{root}/tokenizer_2", cfg.hf_token)
+            self.clip_tok = _hf_tokenizer(f"{root}/tokenizer", cfg.hf_token)
+            self.t5_len, self.clip_len = 512, ccfg.max_position
+            self.height, self.width = cfg.height, cfg.width
+
+        t5p = jax.device_put(t5p, enc_dev)
+        clipp = jax.device_put(clipp, enc_dev)
+        vparams = jax.device_put(vparams, enc_dev)
+        mesh = None
+        if len(tf_devices) > 1:
+            mesh = build_mesh(f"tp={len(tf_devices)}", devices=tf_devices)
+            from ..parallel.sharding import shard_pytree
+
+            fparams = shard_pytree(fparams, mesh, flux.tp_rules())
+        else:
+            fparams = jax.device_put(fparams, tf_devices[0])
+
+        self.steps_allowed = {cfg.num_inference_steps}
+        if cfg.steps_buckets:
+            self.steps_allowed |= {
+                int(s) for s in cfg.steps_buckets.split(",") if s.strip()
+            }
+        t5_fn = jax.jit(lambda ids: t5m.apply(t5p, ids))
+        clip_fn = jax.jit(lambda ids: clipm.apply(clipp, ids)[1])
+        self.pipe = FluxPipeline(
+            fcfg, fparams, vcfg, vparams, t5_fn, clip_fn,
+            dtype=jnp.float32 if cfg.model_id in ("", "tiny") else jnp.bfloat16,
+            mesh=mesh, encoder_device=enc_dev)
+
+    def warmup(self) -> None:
+        # same closed compiled-steps policy as SDService: every allowed steps
+        # value is warmed; clients cannot force request-time compiles
+        for steps in sorted(self.steps_allowed):
+            self.pipe.warm(1, self.height, self.width, steps,
+                           self.t5_len, self.clip_len)
+
+    def example_payload(self) -> Dict[str, Any]:
+        return {"prompt": "a watercolor fox", "steps": None}
+
+    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        from ..models.sd import to_png_base64
+
+        prompt = str(payload.get("prompt", ""))
+        steps_raw = payload.get("steps")
+        steps = (self.cfg.num_inference_steps if steps_raw is None
+                 else int(steps_raw))
+        if steps not in self.steps_allowed:
+            raise HTTPError(
+                400,
+                f"steps={steps} not in this deployment's compiled set "
+                f"{sorted(self.steps_allowed)} (extend via STEPS_BUCKETS)")
+        guidance = float(payload.get("guidance", 3.5))
+        seed = int(payload.get("seed", 0))
+        imgs = self.pipe.txt2img(
+            jnp.asarray(tokenize_to_length(self.t5_tok, prompt, self.t5_len)),
+            jnp.asarray(tokenize_to_length(self.clip_tok, prompt,
+                                           self.clip_len)),
+            rng=jax.random.PRNGKey(seed), height=self.height,
+            width=self.width, steps=steps, guidance=guidance)
+        return {"image_b64": to_png_base64(imgs[0]), "steps": steps,
+                "height": self.height, "width": self.width}
+
+
+@register_model("flux")
+def _build_flux(cfg: ServeConfig) -> ModelService:
+    return FluxService(cfg)
 
 
 @register_model("yolo")
