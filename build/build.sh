@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Build + push the serving image — parity with app/build.sh:1-14.
+# IMAGE_REPO / IMAGE_TAG / BASE_IMAGE are the envsubst knobs.
+set -euo pipefail
+
+IMAGE_REPO="${IMAGE_REPO:-ghcr.io/example/shai-tpu}"
+IMAGE_TAG="${IMAGE_TAG:-latest}"
+BASE_IMAGE="${BASE_IMAGE:-python:3.12-slim}"
+
+cd "$(dirname "$0")/.."
+docker build \
+  -f build/Dockerfile \
+  --build-arg BASE_IMAGE="${BASE_IMAGE}" \
+  -t "${IMAGE_REPO}:${IMAGE_TAG}" .
+docker push "${IMAGE_REPO}:${IMAGE_TAG}"

@@ -1,0 +1,163 @@
+// loadgen — native closed-loop HTTP load generator (L5 instrumentation).
+//
+// Parity target: the reference's synthetic client loop (`app/call-model.sh:6-10`,
+// one curl per replica) and the breaking-point finder's demand source
+// (`find-compute-breaking-point.yaml:20-59`). A shell curl loop cannot hold
+// precise concurrency or measure tail latency; this native client drives N
+// concurrent closed-loop connections and emits the same percentile report
+// shape as serve/latency.py, as one JSON line.
+//
+// Build: make -C native     Usage:
+//   loadgen --url http://host:port/path [--method POST --body '{"x":1}']
+//           [--concurrency 8] [--duration 30] [--warmup 2]
+//
+// Single file, C++17, POSIX sockets only (no third-party deps).
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <netdb.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+using Clock = std::chrono::steady_clock;
+
+struct Url {
+    std::string host, port, path;
+};
+
+static bool parse_url(const std::string &u, Url &out) {
+    const std::string pre = "http://";
+    if (u.rfind(pre, 0) != 0) return false;
+    auto rest = u.substr(pre.size());
+    auto slash = rest.find('/');
+    out.path = slash == std::string::npos ? "/" : rest.substr(slash);
+    auto hostport = rest.substr(0, slash);
+    auto colon = hostport.find(':');
+    out.host = hostport.substr(0, colon);
+    out.port = colon == std::string::npos ? "80" : hostport.substr(colon + 1);
+    return !out.host.empty();
+}
+
+static int dial(const Url &u) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(u.host.c_str(), u.port.c_str(), &hints, &res) != 0)
+        return -1;
+    int fd = -1;
+    for (auto *p = res; p; p = p->ai_next) {
+        fd = socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+        if (fd < 0) continue;
+        timeval tv{300, 0};  // generous: covers cold-compile responses
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+        if (connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
+        close(fd);
+        fd = -1;
+    }
+    freeaddrinfo(res);
+    return fd;
+}
+
+// one full request/response on a fresh connection; returns HTTP status or -1
+static int once(const Url &u, const std::string &req) {
+    int fd = dial(u);
+    if (fd < 0) return -1;
+    size_t off = 0;
+    while (off < req.size()) {
+        ssize_t n = send(fd, req.data() + off, req.size() - off, 0);
+        if (n <= 0) { close(fd); return -1; }
+        off += size_t(n);
+    }
+    // read status line + drain until close (we send Connection: close)
+    char buf[8192];
+    std::string head;
+    int status = -1;
+    while (true) {
+        ssize_t n = recv(fd, buf, sizeof buf, 0);
+        if (n <= 0) break;
+        if (status < 0) {
+            head.append(buf, size_t(n));
+            auto sp = head.find(' ');
+            if (sp != std::string::npos && head.size() >= sp + 4)
+                status = std::atoi(head.c_str() + sp + 1);
+        }
+    }
+    close(fd);
+    return status;
+}
+
+int main(int argc, char **argv) {
+    std::string url, method = "GET", body;
+    int concurrency = 8, duration = 30, warmup = 2;
+    for (int i = 1; i < argc - 1; i++) {
+        std::string a = argv[i];
+        if (a == "--url") url = argv[++i];
+        else if (a == "--method") method = argv[++i];
+        else if (a == "--body") body = argv[++i];
+        else if (a == "--concurrency") concurrency = std::atoi(argv[++i]);
+        else if (a == "--duration") duration = std::atoi(argv[++i]);
+        else if (a == "--warmup") warmup = std::atoi(argv[++i]);
+    }
+    Url u;
+    if (url.empty() || !parse_url(url, u)) {
+        std::fprintf(stderr,
+                     "usage: loadgen --url http://h:p/path [--method M] "
+                     "[--body B] [--concurrency N] [--duration S] [--warmup S]\n");
+        return 2;
+    }
+    std::string req = method + " " + u.path + " HTTP/1.1\r\n" +
+                      "Host: " + u.host + "\r\n" +
+                      "Connection: close\r\n";
+    if (!body.empty())
+        req += "Content-Type: application/json\r\nContent-Length: " +
+               std::to_string(body.size()) + "\r\n";
+    req += "\r\n" + body;
+
+    std::mutex mu;
+    std::vector<double> lat;
+    std::atomic<long> ok{0}, errs{0}, non200{0};
+    auto t_end = Clock::now() + std::chrono::seconds(duration + warmup);
+    auto t_measure = Clock::now() + std::chrono::seconds(warmup);
+
+    std::vector<std::thread> ts;
+    for (int i = 0; i < concurrency; i++)
+        ts.emplace_back([&] {
+            while (Clock::now() < t_end) {
+                auto t0 = Clock::now();
+                int status = once(u, req);
+                double dt = std::chrono::duration<double>(Clock::now() - t0).count();
+                if (Clock::now() < t_measure) continue;  // warmup discard
+                if (status < 0) { errs++; continue; }
+                if (status != 200) { non200++; continue; }
+                ok++;
+                std::lock_guard<std::mutex> g(mu);
+                lat.push_back(dt);
+            }
+        });
+    for (auto &t : ts) t.join();
+
+    std::sort(lat.begin(), lat.end());
+    auto pct = [&](double p) -> double {
+        if (lat.empty()) return 0.0;
+        size_t i = size_t(p * double(lat.size() - 1) + 0.5);
+        return lat[std::min(i, lat.size() - 1)];
+    };
+    double rps = double(ok.load()) / double(duration);
+    // same report shape as serve/latency.py's percentile report
+    std::printf(
+        "{\"n_runs\": %ld, \"throughput_rps\": %.3f, \"errors\": %ld, "
+        "\"non_200\": %ld, \"p0\": %.4f, \"p50\": %.4f, \"p90\": %.4f, "
+        "\"p95\": %.4f, \"p99\": %.4f, \"p100\": %.4f}\n",
+        ok.load(), rps, errs.load(), non200.load(), pct(0.0), pct(0.5),
+        pct(0.9), pct(0.95), pct(0.99), pct(1.0));
+    return 0;
+}

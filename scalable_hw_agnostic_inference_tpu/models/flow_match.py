@@ -66,7 +66,3 @@ class FlowMatchEuler:
              sigma_next: jax.Array) -> jax.Array:
         return (sample.astype(jnp.float32)
                 + (sigma_next - sigma) * velocity.astype(jnp.float32))
-
-    @staticmethod
-    def init_noise(rng: jax.Array, shape) -> jax.Array:
-        return jax.random.normal(rng, shape, jnp.float32)

@@ -29,6 +29,8 @@ class VAEConfig:
     layers_per_block: int = 2
     norm_groups: int = 32
     scaling_factor: float = 0.18215
+    shift_factor: float = 0.0        # flux VAE: 0.1159
+    use_quant_conv: bool = True      # flux VAE: False
 
     @classmethod
     def tiny(cls) -> "VAEConfig":
@@ -44,6 +46,8 @@ class VAEConfig:
             layers_per_block=hf.get("layers_per_block", 2),
             norm_groups=hf.get("norm_num_groups", 32),
             scaling_factor=hf.get("scaling_factor", 0.18215),
+            shift_factor=hf.get("shift_factor") or 0.0,
+            use_quant_conv=hf.get("use_quant_conv", True),
         )
 
 
@@ -157,12 +161,16 @@ class AutoencoderKL(nn.Module):
         self.quant = nn.Dense(2 * self.cfg.latent_channels, name="quant")
 
     def decode(self, z: jax.Array) -> jax.Array:
-        """z: [B, h, w, latent] *scaled* latents (divides by scaling_factor)."""
-        z = z / self.cfg.scaling_factor
-        return self.decoder(self.post_quant(z))
+        """z: [B, h, w, latent] *scaled* latents: un-scale, un-shift, decode."""
+        z = z / self.cfg.scaling_factor + self.cfg.shift_factor
+        if self.cfg.use_quant_conv:
+            z = self.post_quant(z)
+        return self.decoder(z)
 
     def encode(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        m = self.quant(self.encoder(x))
+        m = self.encoder(x)
+        if self.cfg.use_quant_conv:
+            m = self.quant(m)
         mean, logvar = jnp.split(m, 2, axis=-1)
         return mean, jnp.clip(logvar, -30.0, 20.0)
 
@@ -248,9 +256,8 @@ def params_from_torch(model_or_sd, cfg: VAEConfig) -> Dict[str, Any]:
             enc[f"down_{i}_conv"] = convert.conv2d(
                 sd, f"encoder.down_blocks.{i}.downsamplers.0.conv"
             )
-    return {"params": {
-        "decoder": dec,
-        "encoder": enc,
-        "post_quant": _conv1x1_as_dense(sd, "post_quant_conv"),
-        "quant": _conv1x1_as_dense(sd, "quant_conv"),
-    }}
+    tree = {"decoder": dec, "encoder": enc}
+    if cfg.use_quant_conv:  # flux's VAE ships without the 1x1 quant convs
+        tree["post_quant"] = _conv1x1_as_dense(sd, "post_quant_conv")
+        tree["quant"] = _conv1x1_as_dense(sd, "quant_conv")
+    return {"params": tree}

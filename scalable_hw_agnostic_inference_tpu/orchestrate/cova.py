@@ -1,0 +1,202 @@
+"""Cova orchestrator: config-driven fan-out over model services (L6).
+
+Parity targets (SURVEY.md §2.3):
+
+- ``app/cova_gradio_m.py`` — the chain: image → multimodal caption → T5
+  embeddings of caption and of prompt; models discovered from a
+  ``models.json`` ConfigMap and K8s ``*_SERVICE_HOST/PORT`` env vars;
+- ``app/llm_gradio.py`` — N-model side-by-side text generation + benchmark
+  comparison with async fan-out.
+
+The reference builds these on Gradio; here the same surface is the in-repo
+ASGI framework (no third-party UI dep): JSON endpoints plus a minimal HTML
+page. Cross-service transport stays HTTP/JSON with base64 payloads, exactly
+like the reference (``app/cova_gradio_m.py:29-34``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..serve.asgi import App, HTTPError, Request, Response
+
+log = logging.getLogger(__name__)
+
+
+def resolve_service_url(name: str, spec: Dict[str, Any]) -> str:
+    """models.json entry → base URL, honoring K8s service env vars.
+
+    The reference reads ``{NAME}_SERVICE_HOST/PORT`` injected by K8s
+    (``app/cova_gradio_m.py:9-27``); an explicit ``url`` wins, matching its
+    config override.
+    """
+    if spec.get("url"):
+        return spec["url"].rstrip("/")
+    envbase = name.upper().replace("-", "_")
+    host = os.environ.get(f"{envbase}_SERVICE_HOST")
+    port = os.environ.get(f"{envbase}_SERVICE_PORT", "80")
+    if host:
+        return f"http://{host}:{port}"
+    return f"http://{name}"
+
+
+def load_models_config(path: str) -> Dict[str, Dict[str, Any]]:
+    """models.json ConfigMap (``cova/cova-gradio-config.yaml:6-21``)."""
+    with open(path) as f:
+        cfg = json.load(f)
+    if not isinstance(cfg, dict):
+        raise ValueError("models.json must map model name -> spec")
+    models = cfg.get("models", cfg)
+    if not isinstance(models, dict) or not all(
+            isinstance(v, dict) for v in models.values()):
+        raise ValueError("models.json must map model name -> spec")
+    return models
+
+
+class CovaClient:
+    """Async fan-out client over the model services."""
+
+    def __init__(self, models: Dict[str, Dict[str, Any]], timeout: float = 300.0):
+        self.models = models
+        self.timeout = timeout
+
+    def url_of(self, name: str) -> str:
+        if name not in self.models:
+            raise KeyError(f"unknown model {name!r}; have {sorted(self.models)}")
+        return resolve_service_url(name, self.models[name])
+
+    async def post(self, name: str, route: str, payload: Dict) -> Dict:
+        import httpx
+
+        url = f"{self.url_of(name)}{route}"
+        async with httpx.AsyncClient(timeout=self.timeout) as c:
+            r = await c.post(url, json=payload)
+            if r.status_code != 200:
+                raise HTTPError(502, f"{name}{route} -> {r.status_code}: "
+                                     f"{r.text[:200]}")
+            return r.json()
+
+    async def chain(self, prompt: str, image_b64: str = "") -> Dict[str, Any]:
+        """The cova chain: caption the image, embed caption and prompt
+        (``app/cova_gradio_m.py:54-71``)."""
+        t0 = time.perf_counter()
+        out: Dict[str, Any] = {"prompt": prompt}
+        caption = prompt
+        if "caption" in self.models and image_b64:
+            cap = await self.post("caption", "/generate",
+                                  {"prompt": prompt, "image_b64": image_b64})
+            caption = cap.get("generated_text", "")
+            out["caption"] = caption
+            out["caption_latency_s"] = cap.get("latency_s")
+        emb_c, emb_p = await asyncio.gather(
+            self.post("embed", "/embed", {"text": caption}),
+            self.post("embed", "/embed", {"text": prompt}),
+        )
+        out["caption_embedding_dim"] = emb_c.get("dim")
+        out["prompt_embedding_dim"] = emb_p.get("dim")
+        # cosine similarity caption <-> prompt (the demo's comparison signal)
+        va, vb = emb_c.get("embedding"), emb_p.get("embedding")
+        if va and vb:
+            dot = sum(a * b for a, b in zip(va, vb))
+            na = sum(a * a for a in va) ** 0.5
+            nb = sum(b * b for b in vb) ** 0.5
+            out["similarity"] = round(dot / (na * nb + 1e-9), 4)
+        out["total_latency_s"] = round(time.perf_counter() - t0, 3)
+        return out
+
+    async def compare(self, prompt: str, params: Dict[str, Any],
+                      names: Optional[List[str]] = None) -> Dict[str, Any]:
+        """llm_gradio parity: same prompt to N generation services
+        (``app/llm_gradio.py:76-94``)."""
+        gen = [n for n in (names or self.models)
+               if self.models.get(n, {}).get("task", "text-generation")
+               == "text-generation"]
+        if not gen:
+            raise HTTPError(400, "no text-generation models configured")
+
+        async def one(n):
+            t0 = time.perf_counter()
+            try:
+                r = await self.post(n, "/generate", {"prompt": prompt, **params})
+                return n, {"generated_text": r.get("generated_text"),
+                           "n_tokens": r.get("n_tokens"),
+                           "latency_s": round(time.perf_counter() - t0, 3)}
+            except Exception as e:
+                return n, {"error": str(e)[:300]}
+
+        results = dict(await asyncio.gather(*[one(n) for n in gen]))
+        return {"prompt": prompt, "results": results}
+
+
+INDEX_HTML = """<!doctype html><meta charset="utf-8">
+<title>cova orchestrator</title>
+<style>body{font-family:sans-serif;max-width:52rem;margin:2rem auto}
+textarea{width:100%%}pre{background:#f4f4f4;padding:1rem;overflow:auto}</style>
+<h1>cova orchestrator</h1>
+<p>Configured models: <code>%s</code></p>
+<h2>chain</h2>
+<textarea id=p rows=2>a bicycle leaning on a wall</textarea>
+<button onclick="run('/chain',{prompt:p.value})">run chain</button>
+<h2>compare</h2>
+<button onclick="run('/compare',{prompt:p.value,temperature:0.7,max_new_tokens:64})">
+compare models</button>
+<pre id=out></pre>
+<script>
+async function run(route, body){
+  out.textContent = '...';
+  const r = await fetch(route, {method:'POST', body: JSON.stringify(body)});
+  out.textContent = JSON.stringify(await r.json(), null, 1);
+}
+</script>"""
+
+
+def create_cova_app(models_path: str) -> App:
+    models = load_models_config(models_path)
+    client = CovaClient(models)
+    app = App(title="cova")
+
+    @app.get("/")
+    def index(request: Request):
+        return Response(INDEX_HTML % ", ".join(sorted(models)),
+                        media_type="text/html")
+
+    @app.get("/health")
+    def health(request: Request):
+        return {"status": "ok", "models": sorted(models)}
+
+    @app.post("/chain")
+    async def chain(request: Request):
+        body = request.json()
+        return await client.chain(str(body.get("prompt", "")),
+                                  str(body.get("image_b64", "")))
+
+    @app.post("/compare")
+    async def compare(request: Request):
+        body = request.json()
+        prompt = str(body.get("prompt", ""))
+        if not prompt:
+            raise HTTPError(400, "missing prompt")
+        params = {k: body[k] for k in
+                  ("temperature", "top_k", "top_p", "max_new_tokens")
+                  if k in body}
+        return await client.compare(prompt, params, body.get("models"))
+
+    return app
+
+
+def main() -> None:
+    logging.basicConfig(level="INFO")
+    from ..serve.httpd import Server
+
+    path = os.environ.get("MODELS_CONFIG", "/config/models.json")
+    port = int(os.environ.get("PORT", "8080"))
+    Server(create_cova_app(path), port=port).run()
+
+
+if __name__ == "__main__":
+    main()

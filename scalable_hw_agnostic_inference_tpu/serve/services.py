@@ -18,6 +18,7 @@ run warmup through it, so readiness implies the XLA executable is built
 from __future__ import annotations
 
 import base64
+import dataclasses
 import io
 import logging
 from typing import Any, Dict, Optional
@@ -879,11 +880,20 @@ class FluxService(ModelService):
             # BFL single-file transformer weights; HF repo stores them under
             # transformer/ in diffusers layout and flux1-dev.safetensors at
             # the root — we consume the BFL layout (models.flux converter)
+            import glob
             import json
 
-            bfl = os.path.join(root, "flux1-dev.safetensors")
-            fparams = cast_f32_to_bf16(
-                flux.params_from_torch(load_file(bfl), fcfg))
+            # variant-agnostic: flux1-dev / flux1-schnell single-file weights;
+            # schnell has no guidance embedding (detected by key presence)
+            matches = sorted(glob.glob(os.path.join(root, "flux1-*.safetensors")))
+            if not matches:
+                raise FileNotFoundError(
+                    f"no flux1-*.safetensors under {root}")
+            bfl_sd = load_file(matches[0])
+            fcfg = dataclasses.replace(
+                fcfg, guidance_embed="guidance_in.in_layer.weight" in bfl_sd)
+            fparams = cast_f32_to_bf16(flux.params_from_torch(bfl_sd, fcfg))
+            del bfl_sd
             with open(os.path.join(root, "vae", "config.json")) as f:
                 vcfg = vae_mod.VAEConfig.from_hf(json.load(f))
             vparams = vae_mod.params_from_torch(
@@ -939,7 +949,9 @@ class FluxService(ModelService):
                 400,
                 f"steps={steps} not in this deployment's compiled set "
                 f"{sorted(self.steps_allowed)} (extend via STEPS_BUCKETS)")
-        guidance = float(payload.get("guidance", 3.5))
+        guidance = float(payload.get("guidance_scale",
+                                     payload.get("guidance",
+                                                 self.cfg.guidance_scale)))
         seed = int(payload.get("seed", 0))
         imgs = self.pipe.txt2img(
             jnp.asarray(tokenize_to_length(self.t5_tok, prompt, self.t5_len)),

@@ -1,0 +1,97 @@
+"""Cova orchestrator: service URL resolution, chain + compare fan-out over
+real in-process HTTP services (t5 embed + vllm generate on loopback)."""
+
+import json
+
+import pytest
+
+from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+    create_cova_app,
+    load_models_config,
+    resolve_service_url,
+)
+
+from test_serve_http import make_client, wait_ready_sync
+
+
+def test_resolve_service_url(monkeypatch):
+    assert resolve_service_url("t5", {"url": "http://x:9/"}) == "http://x:9"
+    monkeypatch.setenv("EMBED_SVC_SERVICE_HOST", "10.0.0.7")
+    monkeypatch.setenv("EMBED_SVC_SERVICE_PORT", "8000")
+    assert resolve_service_url("embed-svc", {}) == "http://10.0.0.7:8000"
+    assert resolve_service_url("plain", {}) == "http://plain"
+
+
+def test_models_config_shapes(tmp_path):
+    p = tmp_path / "models.json"
+    p.write_text(json.dumps({"models": {"embed": {"task": "embeddings"}}}))
+    assert load_models_config(str(p)) == {"embed": {"task": "embeddings"}}
+    p.write_text(json.dumps({"embed": {}}))
+    assert "embed" in load_models_config(str(p))
+    p.write_text(json.dumps([1, 2]))
+    with pytest.raises(ValueError):
+        load_models_config(str(p))
+
+
+@pytest.fixture(scope="module")
+def upstream_services():
+    """Real t5 + vllm services on loopback sockets."""
+    from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+    from scalable_hw_agnostic_inference_tpu.serve.httpd import Server
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    import httpx
+
+    servers = []
+    urls = {}
+    for name, model in (("embed", "t5"), ("llm", "vllm")):
+        cfg = ServeConfig(app=name, model_id="tiny", device="cpu",
+                          max_new_tokens=8, vllm_config="/nonexistent.yaml")
+        srv = Server(create_app(cfg, get_model(model)(cfg)), port=0)
+        srv.start_background()
+        servers.append(srv)
+        urls[name] = f"http://127.0.0.1:{srv.port}"
+    for u in urls.values():
+        with httpx.Client(base_url=u) as c:
+            r = wait_ready_sync(c, timeout=240.0)
+            assert r.status_code == 200, r.text
+    yield urls
+    for s in servers:
+        s.stop()
+
+
+@pytest.mark.asyncio
+async def test_chain_and_compare_end_to_end(upstream_services, tmp_path):
+    urls = upstream_services
+    models = {
+        "embed": {"url": urls["embed"], "task": "embeddings"},
+        "llm": {"url": urls["llm"], "task": "text-generation"},
+    }
+    p = tmp_path / "models.json"
+    p.write_text(json.dumps({"models": models}))
+    app = create_cova_app(str(p))
+    async with make_client(app) as c:
+        r = await c.get("/health")
+        assert r.json()["models"] == ["embed", "llm"]
+
+        r = await c.post("/chain", json={"prompt": "a red bicycle"})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["prompt_embedding_dim"] == 32
+        assert body["similarity"] == 1.0  # no caption model: caption==prompt
+        assert body["total_latency_s"] >= 0
+
+        r = await c.post("/compare", json={"prompt": "hello world",
+                                           "temperature": 0.0,
+                                           "max_new_tokens": 4})
+        assert r.status_code == 200, r.text
+        res = r.json()["results"]
+        assert set(res) == {"llm"}
+        assert res["llm"]["n_tokens"] == 4
+
+        r = await c.post("/compare", json={})
+        assert r.status_code == 400
+
+        r = await c.get("/")
+        assert r.status_code == 200 and "cova" in r.text

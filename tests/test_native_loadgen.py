@@ -1,0 +1,68 @@
+"""Native loadgen: build + drive it against a real in-repo HTTP server."""
+
+import json
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "native", "loadgen")
+
+
+@pytest.fixture(scope="module")
+def loadgen_bin():
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")], check=True,
+                   capture_output=True)
+    assert os.path.exists(BIN)
+    return BIN
+
+
+@pytest.fixture(scope="module")
+def bert_server():
+    from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+    from scalable_hw_agnostic_inference_tpu.serve.httpd import Server
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    import httpx
+
+    from test_serve_http import wait_ready_sync
+
+    cfg = ServeConfig(app="bert", model_id="tiny", device="cpu")
+    srv = Server(create_app(cfg, get_model("bert")(cfg)), port=0)
+    srv.start_background()
+    with httpx.Client(base_url=f"http://127.0.0.1:{srv.port}") as c:
+        r = wait_ready_sync(c, timeout=120.0)
+        assert r.status_code == 200
+    yield srv
+    srv.stop()
+
+
+def test_loadgen_get_and_post(loadgen_bin, bert_server):
+    base = f"http://127.0.0.1:{bert_server.port}"
+    out = subprocess.run(
+        [loadgen_bin, "--url", f"{base}/health", "--concurrency", "4",
+         "--duration", "2", "--warmup", "0"],
+        capture_output=True, text=True, timeout=60)
+    rep = json.loads(out.stdout)
+    assert rep["errors"] == 0 and rep["non_200"] == 0
+    assert rep["n_runs"] > 10
+    assert rep["throughput_rps"] > 5
+    assert rep["p0"] <= rep["p50"] <= rep["p99"] <= rep["p100"]
+
+    out = subprocess.run(
+        [loadgen_bin, "--url", f"{base}/predict", "--method", "POST",
+         "--body", '{"text": "load test"}', "--concurrency", "2",
+         "--duration", "2", "--warmup", "0"],
+        capture_output=True, text=True, timeout=60)
+    rep = json.loads(out.stdout)
+    assert rep["non_200"] == 0 and rep["n_runs"] > 0
+
+
+def test_loadgen_usage_error(loadgen_bin):
+    out = subprocess.run([loadgen_bin], capture_output=True, text=True)
+    assert out.returncode == 2
