@@ -54,6 +54,9 @@ class Request:
     req_id: int
     prompt_ids: List[int]
     params: SamplingParams
+    # soft-prefix embeddings [P, dim] (vision tokens — multimodal requests,
+    # reference ``vllm_model_api_m.py:42-66``); occupy the first P positions
+    prefix: Optional[np.ndarray] = None
     # tokens generated before a recompute-preemption (they re-enter the
     # cache as prompt suffix but remain part of the client-visible output)
     already_generated: List[int] = dataclasses.field(default_factory=list)
@@ -62,6 +65,10 @@ class Request:
     def __post_init__(self):
         if self.orig_n_prompt < 0:
             self.orig_n_prompt = len(self.prompt_ids)
+
+    @property
+    def prefix_len(self) -> int:
+        return 0 if self.prefix is None else int(self.prefix.shape[0])
 
 
 @dataclasses.dataclass
@@ -110,15 +117,22 @@ class LLMEngine:
     # -- public API --------------------------------------------------------
 
     def add_request(self, prompt_ids: Sequence[int],
-                    params: Optional[SamplingParams] = None) -> int:
+                    params: Optional[SamplingParams] = None,
+                    prefix: Optional[np.ndarray] = None) -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
-        max_prompt = self.buckets.max
+        n_prefix = 0 if prefix is None else int(prefix.shape[0])
+        if n_prefix >= self.buckets.max:
+            raise ValueError(
+                f"prefix of {n_prefix} tokens exceeds the largest prefill "
+                f"bucket {self.buckets.max}")
+        max_prompt = self.buckets.max - n_prefix
         if len(prompt_ids) > max_prompt:
             prompt_ids = list(prompt_ids)[-max_prompt:]  # keep the tail
         rid = next(self._ids)
-        self.waiting.append(Request(rid, list(prompt_ids), params))
+        self.waiting.append(Request(rid, list(prompt_ids), params,
+                                    prefix=prefix))
         return rid
 
     @property
@@ -168,11 +182,12 @@ class LLMEngine:
         if slot is None:
             return
         req = self.waiting[0]
-        if len(req.prompt_ids) > self.buckets.max:
+        max_text = self.buckets.max - req.prefix_len
+        if len(req.prompt_ids) > max_text:
             # preemption re-queues prompt+generated directly and may overflow
             # the largest prefill bucket — keep the tail (matches add_request)
-            req.prompt_ids = req.prompt_ids[-self.buckets.max:]
-        n = len(req.prompt_ids)
+            req.prompt_ids = req.prompt_ids[-max_text:]
+        n = req.prefix_len + len(req.prompt_ids)  # total cache tokens
         # optimistic admission: prompt blocks plus one decode block of
         # headroom, capped at what one sequence can ever use
         need = min(self.cache._blocks_needed(n + self.ecfg.block_size),
@@ -190,26 +205,32 @@ class LLMEngine:
                     req.orig_n_prompt, "rejected"))
             return
         self.waiting.popleft()
+        P = req.prefix_len
+        n_text = len(req.prompt_ids)
         bucket = self.buckets.bucket_for(n)
         alloc = self.cache.admit(req.req_id, n)
         table = jnp.asarray(alloc.table(self.ecfg.blocks_per_seq))
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = req.prompt_ids
-        fn = self._prefill_for(bucket)
-        self.cache.kv, logits = fn(
-            self.params, self.cache.kv, jnp.asarray(ids),
-            jnp.asarray([n], jnp.int32), table)
+        ids = np.zeros((1, bucket - P), np.int32)
+        ids[0, :n_text] = req.prompt_ids
+        fn = self._prefill_for(bucket, P)
+        args = [self.params, self.cache.kv, jnp.asarray(ids),
+                jnp.asarray([n_text], jnp.int32), table]
+        if P:
+            args.append(jnp.asarray(req.prefix)[None])
+        self.cache.kv, logits = fn(*args)
         rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
         tok = int(self._sample1(
             logits, rng, req.params.temperature, req.params.top_k,
             req.params.top_p)[0])
         self.slots[slot] = _Running(req, slot, [], pending_token=tok)
 
-    def _prefill_for(self, bucket: int):
-        if bucket not in self._prefill:
-            self._prefill[bucket] = make_prefill(
-                self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq, bucket)
-        return self._prefill[bucket]
+    def _prefill_for(self, bucket: int, prefix_len: int = 0):
+        key = (bucket, prefix_len)
+        if key not in self._prefill:
+            self._prefill[key] = make_prefill(
+                self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
+                bucket, prefix_len=prefix_len)
+        return self._prefill[key]
 
     def _preempt_lowest(self) -> None:
         """Recompute-preempt the most recently admitted sequence."""
@@ -240,6 +261,7 @@ class LLMEngine:
             victim.req.req_id,
             victim.req.prompt_ids + committed,
             params,
+            prefix=victim.req.prefix,
             already_generated=emitted,
             orig_n_prompt=victim.req.orig_n_prompt))
 

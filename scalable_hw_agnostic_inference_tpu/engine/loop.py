@@ -25,7 +25,8 @@ log = logging.getLogger(__name__)
 class EngineLoop:
     def __init__(self, engine: LLMEngine, poll_s: float = 0.005):
         self.engine = engine
-        self._submit_q: "queue.Queue[Tuple[List[int], SamplingParams, Future]]" = (
+        # items: (prompt_ids, params, prefix [P, dim] or None, future)
+        self._submit_q: "queue.Queue[Tuple[List[int], SamplingParams, Optional[object], Future]]" = (
             queue.Queue()
         )
         self._futures: dict[int, Future] = {}
@@ -45,12 +46,17 @@ class EngineLoop:
         self._thread.join(timeout)
 
     def submit(self, prompt_ids: Sequence[int],
-               params: Optional[SamplingParams] = None) -> Future:
-        """Enqueue a request; the future resolves to a :class:`Finished`."""
+               params: Optional[SamplingParams] = None,
+               prefix=None) -> Future:
+        """Enqueue a request; the future resolves to a :class:`Finished`.
+
+        ``prefix``: optional soft-prefix embeddings [P, dim] (vision tokens).
+        """
         if self._stop.is_set():
             raise RuntimeError("engine loop is stopped")
         fut: Future = Future()
-        self._submit_q.put((list(prompt_ids), params or SamplingParams(), fut))
+        self._submit_q.put(
+            (list(prompt_ids), params or SamplingParams(), prefix, fut))
         # close the put-after-drain window: if the loop died between our
         # _stop check and the put, nobody will ever drain this item
         if self._stop.is_set():
@@ -59,9 +65,9 @@ class EngineLoop:
 
     def generate(self, prompt_ids: Sequence[int],
                  params: Optional[SamplingParams] = None,
-                 timeout: Optional[float] = None) -> Finished:
+                 timeout: Optional[float] = None, prefix=None) -> Finished:
         """Submit and block — the serving ``infer`` path."""
-        return self.submit(prompt_ids, params).result(timeout)
+        return self.submit(prompt_ids, params, prefix=prefix).result(timeout)
 
     # -- loop --------------------------------------------------------------
 
@@ -72,9 +78,9 @@ class EngineLoop:
         except queue.Empty:
             return
         while True:
-            ids, params, fut = item
+            ids, params, prefix, fut = item
             try:
-                rid = self.engine.add_request(ids, params)
+                rid = self.engine.add_request(ids, params, prefix=prefix)
                 with self._futures_lock:
                     self._futures[rid] = fut
             except Exception as e:  # bad request (e.g. empty prompt)
@@ -89,7 +95,7 @@ class EngineLoop:
         with self._futures_lock:
             while True:
                 try:
-                    _, _, fut = self._submit_q.get_nowait()
+                    *_, fut = self._submit_q.get_nowait()
                 except queue.Empty:
                     break
                 if not fut.done():

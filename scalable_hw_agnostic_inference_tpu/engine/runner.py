@@ -63,23 +63,31 @@ def _logits(p: Dict, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
 
 
 def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
-                 bucket: int):
-    """Compile ``prefill(params, kv, ids, n, block_table) -> (kv, logits)``.
+                 bucket: int, prefix_len: int = 0):
+    """Compile ``prefill(params, kv, ids, n, block_table[, prefix])``.
 
     One sequence per call (the scheduler prefills at most one per step —
-    vLLM-style), ``ids`` ``[1, bucket]`` right-padded, true length ``n``.
-    k/v for the whole bucket are scattered into the pool; pad positions land
-    in allocated blocks but stay masked forever by the sequence length.
-    Returns next-token logits from position ``n - 1``.
+    vLLM-style), ``ids`` ``[1, bucket - prefix_len]`` right-padded text with
+    true length ``n_text``; with ``prefix_len > 0`` a ``prefix``
+    ``[1, prefix_len, dim]`` of soft embeddings (vision tokens — the
+    multimodal path, reference ``vllm_model_api_m.py:42-66``) occupies the
+    first positions. k/v for the whole bucket are scattered into the pool;
+    pad positions land in allocated blocks but stay masked forever by the
+    sequence length. Returns next-token logits from the last valid position.
     """
     assert bucket % block_size == 0
+    assert 0 <= prefix_len < bucket
     m_used = bucket // block_size
 
-    def prefill(params, kv, ids, n, block_table):
+    def prefill(params, kv, ids, n_text, block_table, prefix=None):
         p = params["params"]
-        B, T = ids.shape  # B == 1
-        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+        B = ids.shape[0]  # == 1
         x = p["embed"]["embedding"][ids].astype(jnp.bfloat16)
+        if prefix_len:
+            x = jnp.concatenate([prefix.astype(jnp.bfloat16), x], axis=1)
+        T = x.shape[1]  # == bucket
+        n = n_text + prefix_len
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
         valid = positions < n  # [1, T]
         for li in range(cfg.n_layers):
             lp = p[f"layer_{li}"]

@@ -1,0 +1,72 @@
+"""Vision-language conditioning: image → soft prompt tokens for the engine.
+
+Parity target: the reference's multimodal serving unit
+(``vllm_model_api_m.py:42-66`` — mllama-11B-Vision via the vLLM neuron fork,
+base64 image + ``multi_modal_data``). The reference consumes mllama's
+cross-attention fusion as a black box; the TPU-native path here is the
+projector architecture (LLaVA-style): a ViT vision tower's patch features
+projected into the LM's embedding space and prepended as a soft prefix —
+which the paged engine supports natively (``engine.runner.make_prefill``'s
+``prefix_len``). Cross-attention fusion (mllama's exact scheme) is a
+converter away once weights are in scope; the serving/engine contract is
+identical either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .encoder import Encoder
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionTowerConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    dim: int = 1024
+    n_layers: int = 24
+    heads: int = 16
+    mlp_dim: int = 4096
+    lm_dim: int = 4096           # target LM embedding width
+    ln_eps: float = 1e-5
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def tiny(cls, lm_dim: int = 64) -> "VisionTowerConfig":
+        return cls(image_size=32, patch_size=8, dim=32, n_layers=2, heads=2,
+                   mlp_dim=64, lm_dim=lm_dim)
+
+
+class VisionProjector(nn.Module):
+    """pixels [B, H, W, 3] -> soft prompt tokens [B, n_patches, lm_dim]."""
+
+    cfg: VisionTowerConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixels: jax.Array) -> jax.Array:
+        c = self.cfg
+        B = pixels.shape[0]
+        x = nn.Conv(c.dim, kernel_size=(c.patch_size, c.patch_size),
+                    strides=(c.patch_size, c.patch_size), dtype=self.dtype,
+                    name="patch")(pixels.astype(self.dtype))
+        x = x.reshape(B, -1, c.dim)
+        pos = self.param("pos", nn.initializers.normal(0.02),
+                         (1, c.n_patches, c.dim))
+        x = x + pos.astype(self.dtype)
+        x = Encoder(n_layers=c.n_layers, dim=c.dim, heads=c.heads,
+                    mlp_dim=c.mlp_dim, act="gelu", pre_ln=True,
+                    ln_eps=c.ln_eps, dtype=self.dtype, name="tower")(x)
+        x = nn.LayerNorm(epsilon=c.ln_eps, dtype=self.dtype, name="post_ln")(x)
+        # 2-layer gelu projector (llava-1.5 style)
+        x = nn.Dense(c.lm_dim, dtype=self.dtype, name="proj1")(x)
+        x = nn.Dense(c.lm_dim, dtype=self.dtype, name="proj2")(nn.gelu(x))
+        return x.astype(jnp.float32)

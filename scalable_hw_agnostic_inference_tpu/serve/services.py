@@ -620,6 +620,19 @@ class VllmService(ModelService):
         import threading
 
         self._tok_lock = threading.Lock()
+        # multimodal tier (reference vllm_model_api_m.py): a vision tower
+        # projecting image patches into the LM embedding space as a soft
+        # prefix. The tiny tier always carries one so the path is CI-tested;
+        # real VLM checkpoints attach through the same seam.
+        self._vision = None
+        if self._byte_tok:
+            from ..models.vlm import VisionProjector, VisionTowerConfig
+
+            vcfg = VisionTowerConfig.tiny(lm_dim=mcfg.dim)
+            vm = VisionProjector(vcfg)
+            vp = vm.init(jax.random.PRNGKey(cfg.seed + 9),
+                         jnp.zeros((1, vcfg.image_size, vcfg.image_size, 3)))
+            self._vision = (vcfg, jax.jit(lambda px: vm.apply(vp, px)))
 
     def _encode(self, text: str):
         # max() not [-1]: YAML bucket lists arrive in arbitrary order
@@ -662,7 +675,19 @@ class VllmService(ModelService):
             raise HTTPError(400, f"bad sampling parameter: {e}")
         if mnt < 1:
             raise HTTPError(400, "max_new_tokens must be >= 1")
-        fin = self.loop.generate(ids, params, timeout=600.0)
+        prefix = None
+        if payload.get("image_b64"):
+            if self._vision is None:
+                raise HTTPError(
+                    400, "this deployment's model has no vision tower; "
+                         "multimodal requests need a VLM unit")
+            vcfg, vision_fn = self._vision
+            try:
+                px = decode_image(payload, vcfg.image_size)
+            except Exception as e:  # bad base64 / not an image: client error
+                raise HTTPError(400, f"bad image_b64: {type(e).__name__}")
+            prefix = np.asarray(vision_fn(jnp.asarray(px)))[0]
+        fin = self.loop.generate(ids, params, timeout=600.0, prefix=prefix)
         if fin.stop_reason == "rejected":
             raise HTTPError(503, "request rejected: prompt cannot fit the KV pool")
         return {

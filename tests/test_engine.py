@@ -172,6 +172,39 @@ def test_engine_rejects_never_admissible_request(tiny_model):
     assert fin.token_ids == []
 
 
+def test_engine_soft_prefix_conditions_output(tiny_model):
+    """Multimodal path: a soft prefix must change generation, identical
+    prefixes must reproduce it, and text-only requests must be unaffected."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(0)
+    prefix_a = rng.standard_normal((8, cfg.dim)).astype(np.float32)
+    prefix_b = rng.standard_normal((8, cfg.dim)).astype(np.float32)
+    prompt = [1, 17, 42]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+
+    def run(prefix):
+        eng = make_engine(tiny_model)
+        rid = eng.add_request(prompt, sp, prefix=prefix)
+        done = {}
+        while eng.has_work:
+            for f in eng.step():
+                done[f.req_id] = f
+        return done[rid].token_ids
+
+    plain = run(None)
+    with_a = run(prefix_a)
+    with_a2 = run(prefix_a)
+    with_b = run(prefix_b)
+    assert with_a == with_a2
+    assert with_a != plain
+    assert with_a != with_b
+    # oversized prefix is rejected up front
+    eng = make_engine(tiny_model)
+    with pytest.raises(ValueError):
+        eng.add_request(prompt, sp,
+                        prefix=np.zeros((64, cfg.dim), np.float32))
+
+
 def test_engine_per_request_sampling_params(tiny_model):
     eng = make_engine(tiny_model)
     a = eng.add_request([1, 5, 9], SamplingParams(temperature=0.0, max_new_tokens=4))

@@ -51,6 +51,36 @@ async def test_vllm_service_generate_and_batching():
         assert r.status_code == 400  # missing prompt field
 
 
+@pytest.mark.asyncio
+async def test_vllm_service_multimodal_generate():
+    """vllm_model_api_m parity: optional base64 image conditions generation."""
+    import base64
+    import io
+
+    from PIL import Image
+
+    cfg, service = make_service()
+    app = create_app(cfg, service)
+    async with make_client(app) as c:
+        r = await wait_ready(c, timeout=300.0)
+        assert r.status_code == 200, r.text
+
+        buf = io.BytesIO()
+        Image.new("RGB", (32, 32), (10, 200, 30)).save(buf, format="PNG")
+        img = base64.b64encode(buf.getvalue()).decode()
+        base = {"prompt": "describe the image", "temperature": 0.0,
+                "max_new_tokens": 6}
+        r_plain = await c.post("/generate", json=base)
+        r_img = await c.post("/generate", json={**base, "image_b64": img})
+        assert r_img.status_code == 200, r_img.text
+        assert r_img.json()["n_tokens"] == 6
+        # the image actually conditions the output
+        assert r_img.json()["generated_text"] != r_plain.json()["generated_text"]
+        # same image -> same output
+        r_img2 = await c.post("/generate", json={**base, "image_b64": img})
+        assert r_img2.json()["generated_text"] == r_img.json()["generated_text"]
+
+
 def test_vllm_service_reads_configmap(tmp_path):
     cfg_yaml = tmp_path / "vllm_config.yaml"
     cfg_yaml.write_text(
