@@ -1,0 +1,96 @@
+"""Weight artifact store: converted params as orbax checkpoints.
+
+The reference's weight story: compiled NEFF artifacts + weights pushed to
+the HF hub, pulled at boot by ``COMPILED_MODEL_ID``
+(``sd21-inf2-deploy.yaml:60-61``; SURVEY.md §5 checkpoint/resume). The
+TPU-native pair is (a) the XLA compile cache (``core.aot``) and (b) this
+store: the one-time torch→flax conversion is persisted under the artifact
+root, so serving pods never import torch once an artifact exists — boot is
+orbax restore + warm-cache compile.
+
+Layout: ``<root>/weights/<key>/`` (orbax) + ``meta.json`` (config dataclass
+fields). Keys are caller-chosen (e.g. ``sd21-unet``, ``llama3-8b``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Any, Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+def _dir_for(root: str, key: str) -> str:
+    safe = key.replace("/", "--")
+    return os.path.join(root, "weights", safe)
+
+
+def save_params(root: str, key: str, params: Any,
+                meta: Optional[Dict[str, Any]] = None) -> str:
+    """Persist a param pytree (+ JSON-able metadata). Returns the dir."""
+    import orbax.checkpoint as ocp
+
+    d = _dir_for(root, key)
+    ckpt = os.path.join(d, "ckpt")
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(ckpt), params, force=True)
+    ckptr.wait_until_finished()
+    if meta is not None:
+        tmp = os.path.join(d, f"meta.json.{os.getpid()}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1, sort_keys=True)
+        os.replace(tmp, os.path.join(d, "meta.json"))
+    log.info("saved weights %s -> %s", key, d)
+    return d
+
+
+def has_params(root: str, key: str) -> bool:
+    return os.path.isdir(os.path.join(_dir_for(root, key), "ckpt"))
+
+
+def load_params(root: str, key: str, like: Any = None) -> Any:
+    """Restore a param pytree; ``like`` (an abstract/concrete pytree) pins
+    structure and dtypes — pass the model's ``init`` output (or a
+    ``jax.eval_shape`` of it) to restore with correct sharding-free layout."""
+    import orbax.checkpoint as ocp
+
+    ckpt = os.path.join(_dir_for(root, key), "ckpt")
+    if not os.path.isdir(ckpt):
+        raise FileNotFoundError(f"no weight artifact {key!r} under {root}")
+    ckptr = ocp.StandardCheckpointer()
+    if like is None:
+        return ckptr.restore(os.path.abspath(ckpt))
+    return ckptr.restore(os.path.abspath(ckpt), like)
+
+
+def load_meta(root: str, key: str) -> Dict[str, Any]:
+    p = os.path.join(_dir_for(root, key), "meta.json")
+    if not os.path.exists(p):
+        return {}
+    with open(p) as f:
+        return json.load(f)
+
+
+def get_or_convert(root: str, key: str, convert_fn, meta_fn=None,
+                   like: Any = None) -> Tuple[Any, Dict[str, Any]]:
+    """Load the artifact if present, else run ``convert_fn()`` (the torch
+    path) and persist its result. Returns ``(params, meta)``."""
+    if has_params(root, key):
+        log.info("weights %s: loading artifact (skipping torch convert)", key)
+        return load_params(root, key, like=like), load_meta(root, key)
+    params = convert_fn()
+    meta = meta_fn() if meta_fn else {}
+    try:
+        save_params(root, key, params, meta)
+    except Exception:
+        log.exception("weights %s: artifact save failed (serving anyway)", key)
+    return params, meta
+
+
+def config_meta(cfg) -> Dict[str, Any]:
+    """Dataclass config -> JSON-able metadata dict."""
+    d = dataclasses.asdict(cfg)
+    return {k: list(v) if isinstance(v, tuple) else v for k, v in d.items()}

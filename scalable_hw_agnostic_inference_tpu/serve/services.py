@@ -232,18 +232,34 @@ def _load_causal_lm(cfg: ServeConfig, model_id: str):
         return (mcfg, model, params, ByteTokenizer(),
                 ByteTokenizer.eos_id, ByteTokenizer.pad_id, True)
 
-    import torch  # noqa: F401
-    from transformers import AutoModelForCausalLM
+    from ..core import weights as wstore
 
-    from ..models.convert import cast_f32_to_bf16
+    key = f"causal-lm--{model_id}"
+    if wstore.has_params(cfg.artifact_root, key):
+        # artifact path: no torch import, no HF model download — the
+        # reference's COMPILED_MODEL_ID pull, orbax-shaped (SURVEY.md §5)
+        meta = wstore.load_meta(cfg.artifact_root, key)
+        mcfg = llama.LlamaConfig(**meta["config"])
+        params = wstore.load_params(cfg.artifact_root, key)
+    else:
+        import torch  # noqa: F401
+        from transformers import AutoModelForCausalLM
 
-    tm = AutoModelForCausalLM.from_pretrained(model_id, token=cfg.hf_token or None)
-    mcfg = llama.LlamaConfig.from_hf(tm.config)
+        from ..models.convert import cast_f32_to_bf16
+
+        tm = AutoModelForCausalLM.from_pretrained(
+            model_id, token=cfg.hf_token or None)
+        mcfg = llama.LlamaConfig.from_hf(tm.config)
+        # bf16 on device: the module computes in bf16 regardless, and fp32
+        # placement would double HBM (8B fp32 > one v5e chip)
+        params = cast_f32_to_bf16(llama.params_from_torch(tm, mcfg))
+        del tm
+        try:
+            wstore.save_params(cfg.artifact_root, key, params,
+                               {"config": wstore.config_meta(mcfg)})
+        except Exception:
+            log.exception("weight-artifact save failed (serving anyway)")
     model = llama.LlamaForCausalLM(mcfg, dtype=jnp.bfloat16)
-    # bf16 on device: the module computes in bf16 regardless, and fp32
-    # placement would double HBM (8B fp32 > one v5e chip)
-    params = cast_f32_to_bf16(llama.params_from_torch(tm, mcfg))
-    del tm
     tokenizer = _hf_tokenizer(model_id, cfg.hf_token)
     # `is not None` (not truthiness): token id 0 is a legitimate id
     eos = tokenizer.eos_token_id
