@@ -1,30 +1,42 @@
-"""Round-end benchmark: prints ONE JSON line for the driver.
+"""Round-end benchmark: prints ONE JSON line for the driver — always.
 
 Headline (default): SD2.1 512x512 txt2img on a single chip — real UNet/VAE
 geometry (random weights; throughput is weight-value-independent), bf16, the
 whole 25-step CFG denoise loop as one jitted scan. ``vs_baseline`` compares
 single-stream images/sec against the reference's inf2.xlarge unit at its
 published breaking point: latency 0.67 s/img => 1.49 img/s (BASELINE.md,
-reference ``README.md:261``).
+reference ``README.md:261``) — i.e. single-stream latency here vs the
+reference's p50 *at* its breaking point, the comparison BASELINE.md records.
 
 ``python bench.py llama`` benches the causal-LM decode path instead
 (Llama-3.2-1B geometry tokens/sec). ``--cpu`` forces tiny shapes on the CPU
 platform (local smoke only).
+
+Robustness contract (round-1 postmortem: BENCH_r01.json was a crash dump):
+the parent process never touches the accelerator. It runs the measurement in
+a child (``--inner``), retries backend init with backoff + stale-lock
+cleanup, falls back to a CPU-tiny run if the TPU stays down, and in the
+worst case still prints a well-formed JSON line with an ``error`` field and
+exits 0.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
+INNER = "--inner" in sys.argv
 
-if "--cpu" in sys.argv:  # local smoke; env-var JAX_PLATFORMS is captured too early
-    jax.config.update("jax_platforms", "cpu")
+if INNER:
+    import jax
 
-import jax.numpy as jnp
-import numpy as np
+    if "--cpu" in sys.argv:  # env-var JAX_PLATFORMS is captured too early
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
 
 # inf2.xlarge SD2.1 breaking point: 0.67 s/img p50 (reference README.md:261)
 SD_BASELINE_IMG_S = 1.0 / 0.67
@@ -129,12 +141,96 @@ def bench_llama(tiny: bool) -> dict:
     }
 
 
-def main() -> None:
+def inner_main() -> None:
     tiny = jax.devices()[0].platform == "cpu"
     which = "llama" if "llama" in sys.argv else "sd"
     out = bench_llama(tiny) if which == "llama" else bench_sd(tiny)
     print(json.dumps(out))
 
 
+# ---------------------------------------------------------------------------
+# Parent: retry / fallback harness (no accelerator access in this process).
+# ---------------------------------------------------------------------------
+
+_STALE_LOCKS = ("/tmp/libtpu_lockfile",)
+
+
+def _clear_stale_locks() -> None:
+    for p in _STALE_LOCKS:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+def _run_child(which: str, cpu: bool, timeout: float) -> tuple[dict | None, str]:
+    """Run one measurement attempt in a child; return (result, error_tail)."""
+    args = [sys.executable, os.path.abspath(__file__), "--inner", which]
+    if cpu:
+        args.append("--cpu")
+    try:
+        r = subprocess.run(args, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"attempt timed out after {timeout:.0f}s"
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj, ""
+    tail = (r.stderr or r.stdout or "").strip().splitlines()
+    return None, " | ".join(tail[-4:])[-500:] or f"rc={r.returncode}, no output"
+
+
+def main() -> None:
+    which = "llama" if "llama" in sys.argv else "sd"
+    unit = "tokens/sec" if which == "llama" else "images/sec"
+    force_cpu = "--cpu" in sys.argv
+
+    last_err = ""
+    attempts = 1 if force_cpu else 3
+    for i in range(attempts):
+        _clear_stale_locks()
+        out, last_err = _run_child(which, force_cpu, timeout=2400)
+        if out is not None:
+            print(json.dumps(out))
+            return
+        if i + 1 < attempts:
+            time.sleep(20 * (i + 1))
+
+    # TPU never came up: still emit a valid line from a CPU-tiny run so the
+    # driver records a measurement (clearly marked) instead of a crash dump.
+    if not force_cpu:
+        out, cpu_err = _run_child(which, cpu=True, timeout=900)
+        if out is not None:
+            out["error"] = f"tpu backend unavailable, cpu-tiny fallback: {last_err}"
+            out["vs_baseline"] = 0.0
+            print(json.dumps(out))
+            return
+        last_err = f"{last_err}; cpu fallback also failed: {cpu_err}"
+
+    print(json.dumps({
+        "metric": f"{which} bench failed (backend unavailable)",
+        "value": 0.0,
+        "unit": unit,
+        "vs_baseline": 0.0,
+        "error": last_err[-700:],
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if INNER:
+        inner_main()
+    else:
+        try:
+            main()
+        except BaseException as e:  # the driver must ALWAYS get one JSON line
+            print(json.dumps({
+                "metric": "bench harness crashed",
+                "value": 0.0,
+                "unit": "images/sec",
+                "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {e}"[:700],
+            }))
+        sys.exit(0)

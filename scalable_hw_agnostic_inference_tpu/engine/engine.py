@@ -41,11 +41,15 @@ class SamplingParams:
     eos_id: int = -1            # -1: never stop on a token
 
     def clamp(self, ecfg: EngineConfig) -> "SamplingParams":
+        # global_topk == 0 means "cap disabled": leave a user-set top_k alone
+        if self.top_k and ecfg.global_topk:
+            top_k = min(self.top_k, ecfg.global_topk)
+        else:
+            top_k = self.top_k or ecfg.global_topk
         return dataclasses.replace(
             self,
             max_new_tokens=min(self.max_new_tokens, ecfg.max_new_tokens),
-            top_k=min(self.top_k, ecfg.global_topk) if self.top_k
-            else (ecfg.global_topk if ecfg.global_topk else 0),
+            top_k=top_k,
         )
 
 

@@ -272,19 +272,25 @@ class FluxTransformer(nn.Module):
 # ---------------------------------------------------------------------------
 
 def patchify(lat: jax.Array) -> jax.Array:
-    """[B, h, w, C] latents -> [B, (h/2)(w/2), 4C] tokens (2x2 patches)."""
+    """[B, h, w, C] latents -> [B, (h/2)(w/2), 4C] tokens (2x2 patches).
+
+    Token features are CHANNEL-MAJOR, i.e. flattened in (c, ph, pw) order —
+    the BFL/diffusers packed-latent layout (``FluxPipeline._pack_latents``:
+    'b c (h ph) (w pw) -> b (h w) (c ph pw)'). Pretrained ``img_in`` /
+    ``final_layer.linear`` weights index features in this order.
+    """
     B, h, w, C = lat.shape
     x = lat.reshape(B, h // 2, 2, w // 2, 2, C)
-    x = x.transpose(0, 1, 3, 2, 4, 5)
+    x = x.transpose(0, 1, 3, 5, 2, 4)               # [B, h2, w2, C, ph, pw]
     return x.reshape(B, (h // 2) * (w // 2), 4 * C)
 
 
 def unpatchify(tok: jax.Array, h: int, w: int) -> jax.Array:
-    """[B, (h/2)(w/2), 4C] -> [B, h, w, C]."""
+    """[B, (h/2)(w/2), 4C] channel-major tokens -> [B, h, w, C]."""
     B, L, C4 = tok.shape
     C = C4 // 4
-    x = tok.reshape(B, h // 2, w // 2, 2, 2, C)
-    x = x.transpose(0, 1, 3, 2, 4, 5)
+    x = tok.reshape(B, h // 2, w // 2, C, 2, 2)     # [B, h2, w2, C, ph, pw]
+    x = x.transpose(0, 1, 4, 2, 5, 3)               # [B, h2, ph, w2, pw, C]
     return x.reshape(B, h, w, C)
 
 

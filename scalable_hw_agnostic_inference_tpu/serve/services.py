@@ -691,6 +691,13 @@ class VllmService(ModelService):
             raise HTTPError(400, f"bad sampling parameter: {e}")
         if mnt < 1:
             raise HTTPError(400, "max_new_tokens must be >= 1")
+        # same contract as LlamaService.generate_text: over-cap is a client
+        # error, not a silent clamp (ADVICE r1)
+        if mnt > self.ecfg.max_new_tokens:
+            raise HTTPError(
+                400,
+                f"max_new_tokens={mnt} exceeds this deployment's engine cap "
+                f"MAX_NEW_TOKENS={self.ecfg.max_new_tokens}")
         prefix = None
         if payload.get("image_b64"):
             if self._vision is None:
@@ -896,6 +903,9 @@ class FluxService(ModelService):
             self.clip_tok = HashTokenizer(ccfg.vocab_size, ccfg.max_position)
             self.t5_len, self.clip_len = 16, ccfg.max_position
             self.height = self.width = 32  # vae_scale 2 * patch 2 * 8 lat
+            from ..models.flow_match import FlowMatchConfig
+
+            schedule = FlowMatchConfig()
         else:
             import os
 
@@ -935,13 +945,38 @@ class FluxService(ModelService):
                 fcfg, guidance_embed="guidance_in.in_layer.weight" in bfl_sd)
             fparams = cast_f32_to_bf16(flux.params_from_torch(bfl_sd, fcfg))
             del bfl_sd
+            # sigma schedule from the checkpoint's diffusers scheduler config
+            # when present; otherwise schnell (no guidance embed) wants static
+            # shift=1.0 while dev keeps the dynamic-shift defaults
+            from ..models.flow_match import FlowMatchConfig
+
+            sched_path = os.path.join(root, "scheduler",
+                                      "scheduler_config.json")
+            if os.path.exists(sched_path):
+                with open(sched_path) as f:
+                    sc = json.load(f)
+                schedule = FlowMatchConfig(
+                    num_train_timesteps=sc.get("num_train_timesteps", 1000),
+                    shift=sc.get("shift", 1.0),
+                    use_dynamic_shifting=sc.get("use_dynamic_shifting", False),
+                    base_seq_len=sc.get("base_image_seq_len", 256),
+                    max_seq_len=sc.get("max_image_seq_len", 4096),
+                    base_shift=sc.get("base_shift", 0.5),
+                    max_shift=sc.get("max_shift", 1.15))
+            elif fcfg.guidance_embed:
+                schedule = FlowMatchConfig()
+            else:
+                schedule = FlowMatchConfig(use_dynamic_shifting=False,
+                                           shift=1.0)
             with open(os.path.join(root, "vae", "config.json")) as f:
                 vcfg = vae_mod.VAEConfig.from_hf(json.load(f))
             vparams = vae_mod.params_from_torch(
                 sd_mod.load_torch_state(os.path.join(root, "vae")), vcfg)
             self.t5_tok = _hf_tokenizer(f"{root}/tokenizer_2", cfg.hf_token)
             self.clip_tok = _hf_tokenizer(f"{root}/tokenizer", cfg.hf_token)
-            self.t5_len, self.clip_len = 512, ccfg.max_position
+            # schnell's max_sequence_length is 256 (dev: 512)
+            self.t5_len = 512 if fcfg.guidance_embed else 256
+            self.clip_len = ccfg.max_position
             self.height, self.width = cfg.height, cfg.width
 
         t5p = jax.device_put(t5p, enc_dev)
@@ -964,7 +999,7 @@ class FluxService(ModelService):
         t5_fn = jax.jit(lambda ids: t5m.apply(t5p, ids))
         clip_fn = jax.jit(lambda ids: clipm.apply(clipp, ids)[1])
         self.pipe = FluxPipeline(
-            fcfg, fparams, vcfg, vparams, t5_fn, clip_fn,
+            fcfg, fparams, vcfg, vparams, t5_fn, clip_fn, schedule=schedule,
             dtype=jnp.float32 if cfg.model_id in ("", "tiny") else jnp.bfloat16,
             mesh=mesh, encoder_device=enc_dev)
 

@@ -216,3 +216,15 @@ def test_engine_per_request_sampling_params(tiny_model):
             done[f.req_id] = f
     assert len(done[a].token_ids) == 4
     assert len(done[b].token_ids) == 6
+
+
+def test_sampling_params_clamp_topk_cap_disabled():
+    """global_topk=0 means 'cap disabled' — a user top_k must survive."""
+    from scalable_hw_agnostic_inference_tpu.engine.config import EngineConfig
+
+    uncapped = EngineConfig(global_topk=0)
+    capped = EngineConfig(global_topk=64)
+    assert SamplingParams(top_k=40).clamp(uncapped).top_k == 40
+    assert SamplingParams(top_k=100).clamp(capped).top_k == 64
+    assert SamplingParams(top_k=0).clamp(capped).top_k == 64
+    assert SamplingParams(top_k=0).clamp(uncapped).top_k == 0

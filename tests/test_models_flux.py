@@ -22,6 +22,29 @@ def test_patchify_roundtrip():
                                   np.asarray(x))
 
 
+def test_patchify_matches_diffusers_pack_latents():
+    """Pin the token feature order to the BFL/diffusers packed-latent layout.
+
+    diffusers ``FluxPipeline._pack_latents`` (NCHW input):
+    ``view(B, C, h//2, 2, w//2, 2).permute(0, 2, 4, 1, 3, 5)
+    .reshape(B, (h//2)*(w//2), C*4)`` — i.e. features flattened channel-major
+    (c, ph, pw). Pretrained img_in/final_layer weights index this order; a
+    self-consistent but permuted layout would scramble real checkpoints
+    (ADVICE r1, high).
+    """
+    rng = np.random.default_rng(1)
+    B, C, h, w = 2, 16, 8, 12
+    nchw = rng.standard_normal((B, C, h, w)).astype(np.float32)
+    ref = (nchw.reshape(B, C, h // 2, 2, w // 2, 2)
+           .transpose(0, 2, 4, 1, 3, 5)
+           .reshape(B, (h // 2) * (w // 2), C * 4))
+    ours = np.asarray(flux.patchify(jnp.asarray(nchw.transpose(0, 2, 3, 1))))
+    np.testing.assert_array_equal(ours, ref)
+    # and the inverse unpacks back to the same NHWC latents
+    back = np.asarray(flux.unpatchify(jnp.asarray(ref), h, w))
+    np.testing.assert_array_equal(back, nchw.transpose(0, 2, 3, 1))
+
+
 def test_flow_match_tables_and_step():
     sch = FlowMatchEuler(FlowMatchConfig())
     ts, sig, sig_next = sch.tables(8, image_seq_len=1024)
