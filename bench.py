@@ -229,7 +229,8 @@ if __name__ == "__main__":
             print(json.dumps({
                 "metric": "bench harness crashed",
                 "value": 0.0,
-                "unit": "images/sec",
+                "unit": ("tokens/sec" if "llama" in sys.argv
+                         else "images/sec"),
                 "vs_baseline": 0.0,
                 "error": f"{type(e).__name__}: {e}"[:700],
             }))

@@ -75,16 +75,22 @@ class PagedKVCache:
 
     def __init__(self, n_layers: int, n_kv_heads: int, head_dim: int,
                  total_blocks: int, block_size: int, blocks_per_seq: int,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, sharding=None):
         self.n_layers = n_layers
         self.block_size = block_size
         self.blocks_per_seq = blocks_per_seq
         self.allocator = BlockAllocator(total_blocks)
         shape = (total_blocks, block_size, n_kv_heads, head_dim)
-        self.kv = [
-            {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-            for _ in range(n_layers)
-        ]
+
+        def zeros(name: str) -> jax.Array:
+            z = jnp.zeros(shape, dtype)
+            if sharding is not None:
+                # tensor-parallel pool: split on the kv-head axis so each tp
+                # rank owns its heads' blocks (sharding: {"k": NS, "v": NS})
+                z = jax.device_put(z, sharding[name])
+            return z
+
+        self.kv = [{"k": zeros("k"), "v": zeros("v")} for _ in range(n_layers)]
         self._seqs: Dict[int, SeqAllocation] = {}
 
     # -- host-side sequence lifecycle --------------------------------------

@@ -21,7 +21,9 @@ class EngineConfig:
     block_size: int = 16                  # KV block granularity (tokens)
     num_blocks: int = 0                   # 0 = auto from max_model_len*max_num_seqs
     context_encoding_buckets: Sequence[int] = (128, 512)   # prefill shapes
-    token_generation_buckets: Sequence[int] = ()           # reserved (decode is B x 1)
+    # decode attention-window buckets: one decode executable per bucket,
+    # dispatched on the longest running sequence (empty = max_model_len only)
+    token_generation_buckets: Sequence[int] = ()
     is_continuous_batching: bool = True
     tensor_parallel_size: int = 1
     dtype: str = "bfloat16"

@@ -96,19 +96,35 @@ class LLMEngine:
     :meth:`generate`. Single-threaded by design — one engine per pod, the
     serving layer serializes onto the model lane (``serve.app``)."""
 
-    def __init__(self, model_cfg: LlamaConfig, params: Any, ecfg: EngineConfig):
+    def __init__(self, model_cfg: LlamaConfig, params: Any, ecfg: EngineConfig,
+                 mesh=None):
         self.cfg = model_cfg
         self.ecfg = ecfg
         self.params = params
+        # tensor parallelism: params arrive sharded (serve layer runs
+        # shard_pytree); the pool and both executables follow the same plan
+        self.shardings = None
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            from .runner import EngineShardings
+
+            self.shardings = EngineShardings(mesh, params, model_cfg)
         self.cache = PagedKVCache(
             model_cfg.n_layers, model_cfg.n_kv_heads, model_cfg.head_dim,
             ecfg.total_blocks, ecfg.block_size, ecfg.blocks_per_seq,
             dtype=jnp.bfloat16 if ecfg.dtype == "bfloat16" else jnp.float32,
+            sharding=None if self.shardings is None
+            else self.shardings.kv_layer,
         )
         self.buckets = BucketRegistry(sorted(ecfg.context_encoding_buckets))
         self._prefill = {}
-        self._decode = make_decode(
-            model_cfg, ecfg.block_size, ecfg.blocks_per_seq, ecfg.max_num_seqs)
+        # decode executables per context bucket (token_generation_buckets):
+        # the attention window is the smallest bucket covering the longest
+        # running sequence, so decode cost tracks bucketed context in use
+        bs = ecfg.block_size
+        tg = [min(-(-t // bs), ecfg.blocks_per_seq)
+              for t in ecfg.token_generation_buckets]
+        self._ctx_buckets = sorted(set(tg) | {ecfg.blocks_per_seq})
+        self._decode_fns: Dict[int, Any] = {}
         self._sample1 = jax.jit(sample_logits)
         self.waiting: deque[Request] = deque()
         self.slots: List[Optional[_Running]] = [None] * ecfg.max_num_seqs
@@ -233,8 +249,70 @@ class LLMEngine:
         if key not in self._prefill:
             self._prefill[key] = make_prefill(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
-                bucket, prefix_len=prefix_len)
+                bucket, prefix_len=prefix_len, shardings=self.shardings)
         return self._prefill[key]
+
+    def _decode_for(self, m_blocks: int):
+        """Smallest context-bucket decode executable covering ``m_blocks``."""
+        m = next(b for b in self._ctx_buckets if b >= m_blocks)
+        if m not in self._decode_fns:
+            self._decode_fns[m] = make_decode(
+                self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
+                self.ecfg.max_num_seqs, ctx_blocks=m,
+                shardings=self.shardings)
+        return self._decode_fns[m]
+
+    def warm_executables(self, prefix_lens: Sequence[int] = (0,)) -> int:
+        """Compile the engine's CLOSED executable set up front.
+
+        Every (prefill bucket, prefix_len) pair plus every context-bucket
+        decode step is built here, so no post-ready request can trigger an
+        XLA compile — the reference's warmup-gates-readiness idiom
+        (``app/run-sd.py:144-146``) applied to the engine. Returns the number
+        of executables compiled.
+        """
+        n = 0
+        for b in self.buckets.buckets:
+            for p in sorted(set(prefix_lens)):
+                if 0 <= p < b:
+                    self._prefill_for(b, p)
+                    n += 1
+        for m in self._ctx_buckets:
+            self._decode_for(m)
+            n += 1
+        # force compilation (jit is lazy until first call) with null args
+        self._run_warm_calls()
+        return n
+
+    def _run_warm_calls(self) -> None:
+        ecfg = self.ecfg
+        B, M = ecfg.max_num_seqs, ecfg.blocks_per_seq
+        table = jnp.zeros((M,), jnp.int32)
+        for (bucket, P_), fn in list(self._prefill.items()):
+            ids = jnp.zeros((1, bucket - P_), jnp.int32)
+            args = [self.params, self.cache.kv, ids,
+                    jnp.asarray([1], jnp.int32), table]
+            if P_:
+                args.append(jnp.zeros((1, P_, self.cfg.dim), jnp.float32))
+            self.cache.kv, logits = fn(*args)
+            logits.block_until_ready()
+        for m, fn in list(self._decode_fns.items()):
+            self.cache.kv, nxt = fn(
+                self.params, self.cache.kv, jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32), jnp.zeros((B, M), jnp.int32),
+                jnp.zeros((B,), bool), jax.random.PRNGKey(0),
+                jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
+                jnp.ones((B,), jnp.float32))
+            nxt.block_until_ready()
+        # the host-side sampler used at admission time is part of the closed
+        # set too — same arg types as _admit_one's call
+        self._sample1(
+            jnp.zeros((1, self.cfg.vocab_size), jnp.float32),
+            jax.random.PRNGKey(0), 1.0, 0, 1.0).block_until_ready()
+
+    @property
+    def n_executables(self) -> int:
+        return len(self._prefill) + len(self._decode_fns)
 
     def _preempt_lowest(self) -> None:
         """Recompute-preempt the most recently admitted sequence."""
@@ -297,6 +375,7 @@ class LLMEngine:
         temp = np.ones((B,), np.float32)
         topk = np.zeros((B,), np.int32)
         topp = np.ones((B,), np.float32)
+        m_blocks = 1
         for s in self.slots:
             if s is None:
                 continue
@@ -308,11 +387,14 @@ class LLMEngine:
             temp[s.slot] = s.req.params.temperature
             topk[s.slot] = s.req.params.top_k
             topp[s.slot] = s.req.params.top_p
+            m_blocks = max(m_blocks,
+                           self.cache._blocks_needed(alloc.n_tokens))
         if not active.any():
             return
 
         rng = jax.random.fold_in(self._rng, self._step_count * 2)
-        self.cache.kv, nxt = self._decode(
+        decode = self._decode_for(m_blocks)
+        self.cache.kv, nxt = decode(
             self.params, self.cache.kv, jnp.asarray(tokens), jnp.asarray(pos),
             jnp.asarray(tables), jnp.asarray(active), rng,
             jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp))

@@ -17,15 +17,42 @@ Decode is ONE executable for the whole running batch: [B] tokens in,
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.llama import LlamaConfig
 from ..ops.attention import dot_product_attention
 from ..ops.rope import apply_rope
 from ..ops.sampling import sample_logits
+
+
+class EngineShardings:
+    """Tensor-parallel placement plan for the engine's two executables.
+
+    The reference's TP=32 serving tier comes from the vLLM/NxD fork
+    (``compile-vllm-job.yaml:54-55``); here it is in_shardings on the jitted
+    prefill/decode — params per ``models.llama.tp_rules``, the paged KV pool
+    split on its kv-head axis (``cache_specs``) — and XLA inserts the
+    collectives over the ``tp`` mesh axis.
+    """
+
+    def __init__(self, mesh, params, cfg: LlamaConfig):
+        from ..models.llama import cache_specs, tp_rules
+
+        self.mesh = mesh
+        self.rep = NamedSharding(mesh, P())
+        specs = tp_rules().tree_specs(params)
+        self.params = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        kvspec = cache_specs(cfg, axis_size=mesh.shape.get("tp", 1))
+        self.kv_layer = {n: NamedSharding(mesh, s) for n, s in kvspec.items()}
+
+    def kv_pool(self, n_layers: int):
+        return [dict(self.kv_layer) for _ in range(n_layers)]
 
 
 def _rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
@@ -63,7 +90,8 @@ def _logits(p: Dict, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
 
 
 def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
-                 bucket: int, prefix_len: int = 0):
+                 bucket: int, prefix_len: int = 0,
+                 shardings: Optional[EngineShardings] = None):
     """Compile ``prefill(params, kv, ids, n, block_table[, prefix])``.
 
     One sequence per call (the scheduler prefills at most one per step —
@@ -88,14 +116,14 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
         T = x.shape[1]  # == bucket
         n = n_text + prefix_len
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
-        valid = positions < n  # [1, T]
         for li in range(cfg.n_layers):
             lp = p[f"layer_{li}"]
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
             q, k, v = _qkv(lp, h, positions, cfg)
-            # causal within the prompt; pad keys masked out
-            mask = valid[:, None, None, :]
-            o = dot_product_attention(q, k, v, mask=mask, causal=True)
+            # causal within the prompt; pad keys masked by the true length —
+            # kv_lengths (not a mask) keeps the pallas flash kernel eligible
+            # for bucketed prefill shapes (VERDICT r1 #3)
+            o = dot_product_attention(q, k, v, kv_lengths=n, causal=True)
             x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
             x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
             # scatter this layer's k/v blocks into the pool
@@ -109,11 +137,18 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
         last = jnp.take_along_axis(x, (n - 1).reshape(1, 1, 1), axis=1)
         return kv, _logits(p, last, cfg)[:, 0]  # [1, V]
 
-    return jax.jit(prefill, donate_argnums=(1,))
+    if shardings is None:
+        return jax.jit(prefill, donate_argnums=(1,))
+    sh, rep = shardings, shardings.rep
+    kvsh = sh.kv_pool(cfg.n_layers)
+    in_sh = [sh.params, kvsh, rep, rep, rep] + ([rep] if prefix_len else [])
+    return jax.jit(prefill, donate_argnums=(1,),
+                   in_shardings=tuple(in_sh), out_shardings=(kvsh, rep))
 
 
 def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
-                max_num_seqs: int):
+                max_num_seqs: int, ctx_blocks: Optional[int] = None,
+                shardings: Optional[EngineShardings] = None):
     """Compile one decode step for the whole slot batch.
 
     ``decode(params, kv, tokens [B], pos [B], tables [B, M], active [B],
@@ -122,13 +157,23 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     ``pos[b]`` is the index the new token is written at (== tokens so far).
     Inactive slots carry ``tables`` of zeros and write harmlessly into the
     reserved null block 0.
+
+    ``ctx_blocks`` bounds the attention window to the first ``ctx_blocks``
+    table entries — the engine compiles one executable per context bucket
+    (``token_generation_buckets``) and dispatches on the longest running
+    sequence, so decode cost scales with the bucketed context actually in
+    use, not ``max_model_len`` (the reference's token-bucketing,
+    ``cova/mllama-32-11b-vllm-trn1-config.yaml:10-16``).
     """
-    L = block_size * blocks_per_seq  # max context per seq
+    m_ctx = blocks_per_seq if ctx_blocks is None else ctx_blocks
+    assert 1 <= m_ctx <= blocks_per_seq
+    L = block_size * m_ctx  # bucketed max context per seq
 
     def decode(params, kv, tokens, pos, tables, active, rng,
                temperature, top_k, top_p):
         p = params["params"]
         B = max_num_seqs
+        tables = tables[:, :m_ctx]
         x = p["embed"]["embedding"][tokens][:, None, :].astype(jnp.bfloat16)
         positions = pos[:, None]  # [B, 1]
         # flat write offsets for the new token's kv: [B]
@@ -159,4 +204,10 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
         nxt = sample_logits(logits, rng, temperature, top_k, top_p)
         return kv, nxt
 
-    return jax.jit(decode, donate_argnums=(1,))
+    if shardings is None:
+        return jax.jit(decode, donate_argnums=(1,))
+    sh, rep = shardings, shardings.rep
+    kvsh = sh.kv_pool(cfg.n_layers)
+    in_sh = (sh.params, kvsh) + (rep,) * 8
+    return jax.jit(decode, donate_argnums=(1,),
+                   in_shardings=in_sh, out_shardings=(kvsh, rep))

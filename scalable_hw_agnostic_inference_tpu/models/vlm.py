@@ -1,15 +1,19 @@
 """Vision-language conditioning: image → soft prompt tokens for the engine.
 
 Parity target: the reference's multimodal serving unit
-(``vllm_model_api_m.py:42-66`` — mllama-11B-Vision via the vLLM neuron fork,
-base64 image + ``multi_modal_data``). The reference consumes mllama's
-cross-attention fusion as a black box; the TPU-native path here is the
-projector architecture (LLaVA-style): a ViT vision tower's patch features
-projected into the LM's embedding space and prepended as a soft prefix —
-which the paged engine supports natively (``engine.runner.make_prefill``'s
-``prefix_len``). Cross-attention fusion (mllama's exact scheme) is a
-converter away once weights are in scope; the serving/engine contract is
-identical either way.
+(``vllm_model_api_m.py:42-66`` — Llama-3.2-11B-Vision via the vLLM neuron
+fork, base64 image + ``multi_modal_data``). The reference consumes the VLM's
+vision fusion as a black box; the TPU-native path is the LLaVA architecture:
+a CLIP vision tower's penultimate-layer patch features pushed through a
+2-layer projector into the LM's embedding space and prepended as a soft
+prefix — which the paged engine supports natively
+(``engine.runner.make_prefill``'s ``prefix_len``).
+
+:func:`params_from_torch` consumes the HF ``LlavaForConditionalGeneration``
+checkpoint layout (``vision_tower.vision_model.*`` CLIP encoder +
+``multi_modal_projector.linear_{1,2}``), so real LLaVA checkpoints load the
+same way bert/vit ones do; parity is pinned against HF torch in
+``tests/test_serve_vllm.py``.
 """
 
 from __future__ import annotations
@@ -21,19 +25,32 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from .convert import (
+    conv2d,
+    encoder_block,
+    layer_norm,
+    linear,
+    state_dict_of,
+    t2j,
+)
 from .encoder import Encoder
 
 
 @dataclasses.dataclass(frozen=True)
 class VisionTowerConfig:
-    image_size: int = 224
+    image_size: int = 336          # llava-1.5 (CLIP-L/14-336)
     patch_size: int = 14
     dim: int = 1024
     n_layers: int = 24
     heads: int = 16
     mlp_dim: int = 4096
-    lm_dim: int = 4096           # target LM embedding width
+    lm_dim: int = 4096             # target LM embedding width
     ln_eps: float = 1e-5
+    act: str = "quick_gelu"        # CLIP activation
+    # LLaVA default feature selection: hidden state index -2 (output of the
+    # second-to-last block; HF ``vision_feature_layer=-2``), CLS dropped
+    # (``vision_feature_select_strategy="default"``)
+    feature_layer: int = -2
 
     @property
     def n_patches(self) -> int:
@@ -44,9 +61,37 @@ class VisionTowerConfig:
         return cls(image_size=32, patch_size=8, dim=32, n_layers=2, heads=2,
                    mlp_dim=64, lm_dim=lm_dim)
 
+    @classmethod
+    def from_hf(cls, hf_cfg, lm_dim: int) -> "VisionTowerConfig":
+        """From an HF ``LlavaConfig`` (or its ``vision_config``)."""
+        strategy = getattr(hf_cfg, "vision_feature_select_strategy", "default")
+        if strategy != "default":
+            raise ValueError(
+                f"vision_feature_select_strategy={strategy!r} not supported "
+                "(only 'default', which drops CLS)")
+        v = getattr(hf_cfg, "vision_config", hf_cfg)
+        return cls(
+            image_size=v.image_size,
+            patch_size=v.patch_size,
+            dim=v.hidden_size,
+            n_layers=v.num_hidden_layers,
+            heads=v.num_attention_heads,
+            mlp_dim=v.intermediate_size,
+            lm_dim=lm_dim,
+            ln_eps=getattr(v, "layer_norm_eps", 1e-5),
+            act=getattr(v, "hidden_act", "quick_gelu"),
+            feature_layer=getattr(hf_cfg, "vision_feature_layer", -2),
+        )
+
 
 class VisionProjector(nn.Module):
-    """pixels [B, H, W, 3] -> soft prompt tokens [B, n_patches, lm_dim]."""
+    """pixels [B, H, W, 3] -> soft prompt tokens [B, n_patches, lm_dim].
+
+    CLIP vision tower (class token, learned positions, pre-LN blocks,
+    quick-gelu) → hidden state at ``feature_layer`` → drop CLS → LLaVA
+    2-layer gelu projector. Matches HF LLaVA's
+    ``get_image_features(..., vision_feature_select_strategy="default")``.
+    """
 
     cfg: VisionTowerConfig
     dtype: Any = jnp.float32
@@ -56,17 +101,61 @@ class VisionProjector(nn.Module):
         c = self.cfg
         B = pixels.shape[0]
         x = nn.Conv(c.dim, kernel_size=(c.patch_size, c.patch_size),
-                    strides=(c.patch_size, c.patch_size), dtype=self.dtype,
-                    name="patch")(pixels.astype(self.dtype))
+                    strides=(c.patch_size, c.patch_size), use_bias=False,
+                    dtype=self.dtype, name="patch")(pixels.astype(self.dtype))
         x = x.reshape(B, -1, c.dim)
+        cls = self.param("cls", nn.initializers.normal(0.02), (1, 1, c.dim))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, c.dim)).astype(self.dtype), x],
+            axis=1)
         pos = self.param("pos", nn.initializers.normal(0.02),
-                         (1, c.n_patches, c.dim))
+                         (1, c.n_patches + 1, c.dim))
         x = x + pos.astype(self.dtype)
-        x = Encoder(n_layers=c.n_layers, dim=c.dim, heads=c.heads,
-                    mlp_dim=c.mlp_dim, act="gelu", pre_ln=True,
-                    ln_eps=c.ln_eps, dtype=self.dtype, name="tower")(x)
-        x = nn.LayerNorm(epsilon=c.ln_eps, dtype=self.dtype, name="post_ln")(x)
-        # 2-layer gelu projector (llava-1.5 style)
+        x = nn.LayerNorm(epsilon=c.ln_eps, dtype=self.dtype, name="pre_ln")(x)
+        _, hidden = Encoder(n_layers=c.n_layers, dim=c.dim, heads=c.heads,
+                            mlp_dim=c.mlp_dim, act=c.act, pre_ln=True,
+                            ln_eps=c.ln_eps, dtype=self.dtype,
+                            name="tower")(x, collect_hidden=True)
+        x = hidden[c.feature_layer]
+        x = x[:, 1:]  # drop CLS ("default" select strategy)
+        # 2-layer gelu projector (llava-1.5 style; HF uses exact gelu)
         x = nn.Dense(c.lm_dim, dtype=self.dtype, name="proj1")(x)
-        x = nn.Dense(c.lm_dim, dtype=self.dtype, name="proj2")(nn.gelu(x))
+        x = nn.Dense(c.lm_dim, dtype=self.dtype, name="proj2")(
+            jax.nn.gelu(x, approximate=False))
         return x.astype(jnp.float32)
+
+
+def params_from_torch(model_or_sd, cfg: VisionTowerConfig) -> Dict[str, Any]:
+    """HF ``LlavaForConditionalGeneration`` (or just its vision tower +
+    projector) state dict → flax params for :class:`VisionProjector`."""
+    sd = state_dict_of(model_or_sd)
+    vt = "vision_tower.vision_model"
+    if not any(k.startswith(vt) for k in sd):
+        # transformers >= 4.46 uses model.vision_tower...
+        vt = "model.vision_tower.vision_model"
+    mp = ("multi_modal_projector"
+          if any(k.startswith("multi_modal_projector") for k in sd)
+          else "model.multi_modal_projector")
+    p: Dict[str, Any] = {
+        "cls": t2j(sd[f"{vt}.embeddings.class_embedding"]).reshape(1, 1, -1),
+        "patch": conv2d(sd, f"{vt}.embeddings.patch_embedding"),
+        "pos": t2j(sd[f"{vt}.embeddings.position_embedding.weight"])[None],
+        # HF CLIP's historical typo "pre_layrnorm" is the real key
+        "pre_ln": layer_norm(
+            sd, f"{vt}.pre_layrnorm"
+            if f"{vt}.pre_layrnorm.weight" in sd else f"{vt}.pre_layernorm"),
+        "proj1": linear(sd, f"{mp}.linear_1"),
+        "proj2": linear(sd, f"{mp}.linear_2"),
+        "tower": {},
+    }
+    for i in range(cfg.n_layers):
+        b = f"{vt}.encoder.layers.{i}"
+        p["tower"][f"layer_{i}"] = encoder_block(
+            sd,
+            q=f"{b}.self_attn.q_proj", k=f"{b}.self_attn.k_proj",
+            v=f"{b}.self_attn.v_proj", o=f"{b}.self_attn.out_proj",
+            ln1=f"{b}.layer_norm1",
+            fc1=f"{b}.mlp.fc1", fc2=f"{b}.mlp.fc2",
+            ln2=f"{b}.layer_norm2",
+        )
+    return {"params": p}

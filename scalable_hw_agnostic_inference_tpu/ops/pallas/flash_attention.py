@@ -34,7 +34,12 @@ _MIN_D = 64
 
 
 def flash_eligible(q, k, v, mask=None, bias=None) -> bool:
-    """Shapes/features the kernel covers; everything else → XLA path."""
+    """Shapes/features the kernel covers; everything else → XLA path.
+
+    Per-sequence valid lengths are NOT a mask — the kernel handles them
+    natively (``lengths=``), which is what lets bucketed LLM prefill (padded
+    to a static bucket, true length dynamic) run on the flash path.
+    """
     if mask is not None or bias is not None:
         return False
     B, T, H, D = q.shape
@@ -48,9 +53,14 @@ def flash_eligible(q, k, v, mask=None, bias=None) -> bool:
     return True
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-                  block_k: int, seq_k: int):
-    # q_ref: [BLOCK_Q, D]; k_ref/v_ref: [S, D]; o_ref: [BLOCK_Q, D]
+def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float,
+                  causal: bool, has_lengths: bool, block_k: int, seq_k: int,
+                  q_offset: int):
+    # lens_ref: [B] in SMEM (scalar-prefetch); q_ref: [BLOCK_Q, D];
+    # k_ref/v_ref: [S, D]; o_ref: [BLOCK_Q, D]. ``q_offset`` = S - T: causal
+    # queries start at key position S - T (the decode-step layout contract of
+    # ``ops.attention.dot_product_attention``).
+    b = pl.program_id(0)
     qi = pl.program_id(2)
     q = q_ref[:].astype(jnp.float32) * scale
     bq, d = q.shape
@@ -59,24 +69,38 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
     l0 = jnp.zeros((bq, 1), jnp.float32)
     o0 = jnp.zeros((bq, d), jnp.float32)
 
-    n_blocks = seq_k // block_k
-    if causal:
-        # blocks strictly above the diagonal contribute nothing; bound the
-        # loop at the last block that can contain key <= max local query pos
-        last = (qi + 1) * BLOCK_Q  # exclusive key bound
-        n_live = pl.cdiv(jnp.minimum(last, seq_k), block_k)
+    # key blocks past the valid length contribute nothing; with causal also
+    # skip blocks strictly above the diagonal. has_lengths is static: the
+    # non-LLM (SD/flux) callers keep the unmasked fast path.
+    if has_lengths:
+        length = lens_ref[b]  # valid key count for this batch row
+        bound = jnp.minimum(length, seq_k)
     else:
-        n_live = n_blocks
+        length = None
+        bound = seq_k
+    if causal:
+        bound = jnp.minimum(bound, q_offset + (qi + 1) * BLOCK_Q)
+    n_live = pl.cdiv(bound, block_k) if (has_lengths or causal) else (
+        seq_k // block_k)
 
     def body(j, carry):
         m, l, o = carry
         k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        live = None
+        if has_lengths or causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+        if has_lengths:
+            live = k_pos < length
         if causal:
-            q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            q_pos = q_offset + qi * BLOCK_Q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            c = q_pos >= k_pos
+            live = c if live is None else jnp.logical_and(live, c)
+        if live is not None:
+            s = jnp.where(live, s, NEG_INF)
         bm = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, bm)
         p = jnp.exp(s - m_new)
@@ -97,13 +121,19 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    lengths: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention. q ``[B,T,H,D]``, k/v ``[B,S,Hkv,D]`` → ``[B,T,H,D]``.
 
-    ``interpret`` defaults to True off-TPU so the same kernel runs (slowly)
-    in tests on the CPU mesh.
+    ``lengths`` ``[B]`` int32 marks the valid key count per row (keys beyond
+    it are masked AND their blocks skipped entirely) — the bucketed-prefill
+    contract: pad to the static bucket, pay for the true length. ``interpret``
+    defaults to True off-TPU so the same kernel runs (slowly) in tests on the
+    CPU mesh.
     """
+    from jax.experimental.pallas import tpu as pltpu
+
     B, T, H, D = q.shape
     S, Hkv = k.shape[1], k.shape[2]
     group = H // Hkv
@@ -111,6 +141,11 @@ def flash_attention(
         scale = 1.0 / (D ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
+    has_lengths = lengths is not None
+    if lengths is None:
+        lengths = jnp.full((B,), S, jnp.int32)  # placeholder, never read
+    else:
+        lengths = jnp.broadcast_to(lengths.astype(jnp.int32), (B,))
 
     # kernel works in [B, H, T, D]
     qt = q.transpose(0, 2, 1, 3)
@@ -119,18 +154,26 @@ def flash_attention(
 
     grid = (B, H, T // BLOCK_Q)
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, block_k=BLOCK_K, seq_k=S
+        _flash_kernel, scale=scale, causal=causal, has_lengths=has_lengths,
+        block_k=BLOCK_K, seq_k=S, q_offset=S - T,
     )
     out = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, None, BLOCK_Q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, S, D), lambda b, h, i: (b, h // group, 0, 0)),
-            pl.BlockSpec((None, None, S, D), lambda b, h, i: (b, h // group, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, None, BLOCK_Q, D), lambda b, h, i: (b, h, i, 0)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((None, None, BLOCK_Q, D),
+                             lambda b, h, i, lens: (b, h, i, 0)),
+                pl.BlockSpec((None, None, S, D),
+                             lambda b, h, i, lens: (b, h // group, 0, 0)),
+                pl.BlockSpec((None, None, S, D),
+                             lambda b, h, i, lens: (b, h // group, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, None, BLOCK_Q, D),
+                                   lambda b, h, i, lens: (b, h, i, 0)),
+        ),
         out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt)
+    )(lengths, qt, kt, vt)
     return out.transpose(0, 2, 1, 3)

@@ -215,6 +215,57 @@ class ViTService(ModelService):
         }
 
 
+def _load_vlm(cfg: ServeConfig, model_id: str):
+    """LLaVA-family checkpoint → (mcfg, params, vcfg, vparams, tokenizer).
+
+    Parity with the reference's multimodal unit
+    (``vllm_model_api_m.py:42-66``): one checkpoint carries the vision tower
+    + projector and the language model; both convert to flax here (layouts in
+    ``models.vlm.params_from_torch`` / ``models.llama.params_from_torch``).
+    """
+    import torch  # noqa: F401
+    from transformers import AutoConfig, AutoModelForImageTextToText
+
+    from ..models import llama, vlm
+    from ..models.convert import cast_f32_to_bf16
+
+    hf_cfg = AutoConfig.from_pretrained(model_id, token=cfg.hf_token or None)
+    tm = AutoModelForImageTextToText.from_pretrained(
+        model_id, token=cfg.hf_token or None)
+    sd = tm.state_dict()
+    del tm
+    mcfg = llama.LlamaConfig.from_hf(hf_cfg.text_config)
+    vcfg = vlm.VisionTowerConfig.from_hf(hf_cfg, lm_dim=mcfg.dim)
+    # strip the llava wrapper prefix so the llama converter sees its usual
+    # "model.*"/"lm_head.*" keys (old layout "language_model.model.*", new
+    # "model.language_model.*")
+    if any(k.startswith("language_model.") for k in sd):
+        lm_sd = {k[len("language_model."):]: v for k, v in sd.items()
+                 if k.startswith("language_model.")}
+    else:
+        lm_sd = {k[len("model.language_model."):]: v for k, v in sd.items()
+                 if k.startswith("model.language_model.")}
+        lm_sd.update({k: v for k, v in sd.items() if k.startswith("lm_head.")})
+    params = cast_f32_to_bf16(llama.params_from_torch(lm_sd, mcfg))
+    vparams = cast_f32_to_bf16(vlm.params_from_torch(sd, vcfg))
+    tokenizer = _hf_tokenizer(model_id, cfg.hf_token)
+    return mcfg, params, vcfg, vparams, tokenizer
+
+
+def _is_vlm_checkpoint(cfg: ServeConfig, model_id: str) -> bool:
+    if model_id in ("", "tiny"):
+        return False
+    try:
+        from transformers import AutoConfig
+
+        hf_cfg = AutoConfig.from_pretrained(model_id,
+                                            token=cfg.hf_token or None)
+    except Exception:
+        return False
+    return (hasattr(hf_cfg, "vision_config")
+            and hasattr(hf_cfg, "text_config"))
+
+
 def _load_causal_lm(cfg: ServeConfig, model_id: str):
     """Shared causal-LM bootstrap for LlamaService and VllmService.
 
@@ -617,19 +668,55 @@ class VllmService(ModelService):
         cfg = self.cfg
         ecfg = self.ecfg
         model_id = ecfg.model or cfg.model_id
-        (mcfg, _model, params, self.tokenizer,
-         self.eos_id, self.pad_id, self._byte_tok) = _load_causal_lm(
-            cfg, model_id)
+        vlm_parts = None
+        if _is_vlm_checkpoint(cfg, model_id):
+            (mcfg, params, real_vcfg, real_vparams,
+             self.tokenizer) = _load_vlm(cfg, model_id)
+            vlm_parts = (real_vcfg, real_vparams)
+            eos = self.tokenizer.eos_token_id
+            if eos is None:
+                raise ValueError(f"tokenizer for {model_id} has no eos_token_id")
+            pad = self.tokenizer.pad_token_id
+            self.eos_id = int(eos)
+            self.pad_id = int(pad) if pad is not None else int(eos)
+            self._byte_tok = False
+        else:
+            (mcfg, _model, params, self.tokenizer,
+             self.eos_id, self.pad_id, self._byte_tok) = _load_causal_lm(
+                cfg, model_id)
         if self._byte_tok:
             # tiny engine shapes: small blocks/buckets so CI exercises paging
             ecfg = EngineConfig(
                 model="tiny", max_model_len=256, max_num_seqs=ecfg.max_num_seqs,
                 block_size=16, context_encoding_buckets=(32, 64, 128),
+                token_generation_buckets=ecfg.token_generation_buckets,
+                tensor_parallel_size=ecfg.tensor_parallel_size,
                 max_new_tokens=min(ecfg.max_new_tokens, 64))
 
         self.ecfg = ecfg
-        engine = LLMEngine(mcfg, jax.device_put(params), ecfg)
-        self.loop = EngineLoop(engine).start()
+        # tensor_parallel_size is honored, never silently dropped: the
+        # reference's TP=32 serving tier (compile-vllm-job.yaml:54-55) maps to
+        # a tp mesh over local chips; an over-sized config is a deploy error
+        mesh = None
+        tp = ecfg.tensor_parallel_size
+        if tp > 1:
+            from ..core.device import local_devices
+            from ..core.mesh import build_mesh
+            from ..models import llama as llama_mod
+            from ..parallel.sharding import shard_pytree
+
+            devs = local_devices()
+            if tp > len(devs):
+                raise ValueError(
+                    f"tensor_parallel_size={tp} exceeds the {len(devs)} local "
+                    f"devices of this unit — match it to the nodepool's chip "
+                    f"count (reference compile-vllm-job.yaml:54-55)")
+            mesh = build_mesh(f"tp={tp}", devices=devs[:tp])
+            params = shard_pytree(params, mesh, llama_mod.tp_rules())
+        else:
+            params = jax.device_put(params)
+        engine = LLMEngine(mcfg, params, ecfg, mesh=mesh)
+        self._engine = engine
         self._SamplingParams = SamplingParams
         # the lane is max_num_seqs wide; HF fast tokenizers mutate Rust-side
         # truncation state per call and are not thread-safe
@@ -641,7 +728,14 @@ class VllmService(ModelService):
         # prefix. The tiny tier always carries one so the path is CI-tested;
         # real VLM checkpoints attach through the same seam.
         self._vision = None
-        if self._byte_tok:
+        if vlm_parts is not None:
+            from ..models.vlm import VisionProjector
+
+            vcfg, vparams = vlm_parts
+            vm = VisionProjector(vcfg, dtype=jnp.bfloat16)
+            vparams = jax.device_put(vparams)
+            self._vision = (vcfg, jax.jit(lambda px: vm.apply(vparams, px)))
+        elif self._byte_tok:
             from ..models.vlm import VisionProjector, VisionTowerConfig
 
             vcfg = VisionTowerConfig.tiny(lm_dim=mcfg.dim)
@@ -649,6 +743,21 @@ class VllmService(ModelService):
             vp = vm.init(jax.random.PRNGKey(cfg.seed + 9),
                          jnp.zeros((1, vcfg.image_size, vcfg.image_size, 3)))
             self._vision = (vcfg, jax.jit(lambda px: vm.apply(vp, px)))
+        if self._vision is not None:  # the vision jit is in the closed set too
+            vcfg = self._vision[0]
+            self._vision[1](jnp.zeros(
+                (1, vcfg.image_size, vcfg.image_size, 3))).block_until_ready()
+        # compile the CLOSED executable set — every (bucket, prefix) prefill
+        # plus every context-bucket decode — BEFORE the engine loop starts
+        # serving, so no post-ready request ever eats an XLA compile (the
+        # cold-graph-behind-the-ALB failure; reference run-sd.py:144-146)
+        prefix_lens = [0]
+        if self._vision is not None:
+            prefix_lens.append(self._vision[0].n_patches)
+        n = engine.warm_executables(prefix_lens)
+        log.info("engine: warmed %d executables (buckets=%s, prefixes=%s)",
+                 n, list(engine.buckets.buckets), prefix_lens)
+        self.loop = EngineLoop(engine).start()
 
     def _encode(self, text: str):
         # max() not [-1]: YAML bucket lists arrive in arbitrary order
