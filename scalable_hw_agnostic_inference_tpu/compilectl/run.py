@@ -42,12 +42,17 @@ def compile_model(name: str, cfg=None, artifact_root: Optional[str] = None,
         out = service.infer(service.example_payload())
         test_out = sorted(out) if isinstance(out, dict) else str(type(out))
 
+    # portable StableHLO exports (AotCache) alongside the XLA cache — the
+    # hub-distributable artifact tier; serve loads them at boot
+    n_exported = service.export_artifacts(root)
+
     entries = sorted(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else []
     report = {
         "model": name,
         "artifact_root": root,
         "cache_dir": cache_dir,
         "cache_entries": len(entries),
+        "aot_exported": n_exported,
         "load_s": round(t_load, 2),
         "warmup_s": round(t_warm, 2),
         "self_test_keys": test_out,

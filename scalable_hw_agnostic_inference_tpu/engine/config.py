@@ -25,6 +25,9 @@ class EngineConfig:
     # dispatched on the longest running sequence (empty = max_model_len only)
     token_generation_buckets: Sequence[int] = ()
     is_continuous_batching: bool = True
+    # max same-bucket prompts admitted as ONE batched prefill call (rounded
+    # to a power of two per compiled executable); 1 = serial prefill
+    max_prefill_batch: int = 4
     tensor_parallel_size: int = 1
     dtype: str = "bfloat16"
     # on-device sampling (reference: global_topk 64, dynamic)

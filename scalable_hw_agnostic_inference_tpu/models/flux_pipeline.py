@@ -63,8 +63,14 @@ class FluxPipeline:
         self.mesh = mesh
         self.encoder_device = encoder_device
         self._denoise_cache: Dict[Any, Callable] = {}
-        self._decode = jax.jit(
-            lambda p, z: self.vae.apply(p, z, method=AutoencoderKL.decode))
+
+        def _decode_u8(p, z):
+            # decode + uint8 quantize on device: one small transfer back
+            img = self.vae.apply(p, z, method=AutoencoderKL.decode)
+            return jnp.round(jnp.clip(img * 127.5 + 127.5, 0.0, 255.0)
+                             ).astype(jnp.uint8)
+
+        self._decode = jax.jit(_decode_u8)
 
     def _denoise_for(self, B: int, h: int, w: int, txt_len: int, steps: int):
         key = (B, h, w, txt_len, steps)
@@ -117,9 +123,7 @@ class FluxPipeline:
             self.params, txt, pooled, rng, jnp.float32(guidance))
         if self.encoder_device is not None:
             lat = jax.device_put(lat, self.encoder_device)
-        img = self._decode(self.vae_params, lat)
-        img = np.asarray(jnp.clip(img / 2 + 0.5, 0.0, 1.0))
-        return (img * 255).round().astype(np.uint8)
+        return np.asarray(self._decode(self.vae_params, lat))
 
     def warm(self, B: int, height: int, width: int, steps: int,
              t5_len: int, clip_len: int) -> None:

@@ -50,6 +50,9 @@ class LlamaConfig:
     mlp_dim: int = 14336
     max_seq_len: int = 8192
     rope_theta: float = 500000.0
+    # HF rope_type="llama3" tuple (factor, low_freq_factor, high_freq_factor,
+    # original_max_position_embeddings); None = plain rope
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
 
@@ -81,9 +84,24 @@ class LlamaConfig:
             mlp_dim=hf.intermediate_size,
             max_seq_len=getattr(hf, "max_position_embeddings", 8192),
             rope_theta=getattr(hf, "rope_theta", 10000.0),
+            rope_scaling=rope_scaling_from_hf(getattr(hf, "rope_scaling", None)),
             rms_eps=getattr(hf, "rms_norm_eps", 1e-5),
             tie_embeddings=getattr(hf, "tie_word_embeddings", False),
         )
+
+
+def rope_scaling_from_hf(rs) -> Optional[Tuple[float, float, float, int]]:
+    """HF ``config.rope_scaling`` dict → the llama3 scaling tuple."""
+    if not rs:
+        return None
+    rope_type = rs.get("rope_type", rs.get("type", "default"))
+    if rope_type == "default":
+        return None
+    if rope_type != "llama3":
+        raise ValueError(f"unsupported rope_scaling type {rope_type!r}")
+    return (float(rs["factor"]), float(rs["low_freq_factor"]),
+            float(rs["high_freq_factor"]),
+            int(rs["original_max_position_embeddings"]))
 
 
 class LlamaAttention(nn.Module):
@@ -109,8 +127,8 @@ class LlamaAttention(nn.Module):
         q = dense(cfg.n_heads * Dh, "q")(x).reshape(B, T, cfg.n_heads, Dh)
         k = dense(cfg.n_kv_heads * Dh, "k")(x).reshape(B, T, cfg.n_kv_heads, Dh)
         v = dense(cfg.n_kv_heads * Dh, "v")(x).reshape(B, T, cfg.n_kv_heads, Dh)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
         if layer_cache is None:
             # full-sequence scoring: attend within the (masked) sequence

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
 from . import convert
+from .vae import _upsample2x
 
 
 @dataclasses.dataclass(frozen=True)
@@ -234,8 +235,7 @@ class UNet2DCondition(nn.Module):
                 if cfg.cross_attn[level]:
                     h = xf(cfg.attn_heads[level], f"up_{i}_attn_{j}")(h, context)
             if i < n_levels - 1:
-                B, H, W, C = h.shape
-                h = jax.image.resize(h, (B, H * 2, W * 2, C), "nearest")
+                h = _upsample2x(h)
                 h = _conv(ch, 3, f"up_{i}_conv", dtype=self.dtype)(h)
 
         h = nn.GroupNorm(cfg.norm_groups, dtype=jnp.float32, name="norm_out")(h)

@@ -18,6 +18,17 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# Plain (non-causal, no-lengths) attention dispatch: measured on v5e
+# (scripts/perf_attn.py), XLA's fused softmax-attention beats the flash
+# kernel on every SD2.1 UNet shape — L0 self (T=S=4096, T*S=16.7M) runs
+# ~2x faster through XLA (1.8ms vs 3.8ms above the sync floor). The kernel
+# only wins plain attention when the [B,H,T,S] fp32 score materialization
+# stops fitting comfortably in HBM (1024px-class shapes), hence a budget on
+# T*S rather than a flat preference. Causal/ragged shapes always take the
+# kernel: it skips key blocks past the diagonal/valid length, which XLA's
+# masked softmax cannot.
+_XLA_SCORE_BUDGET = 64 * 1024 * 1024
+
 
 def _xla_attention(q, k, v, mask, bias, scale) -> jax.Array:
     """Reference implementation: [B,T,H,D] x [B,S,Hkv,D] -> [B,T,H,D]."""
@@ -81,6 +92,14 @@ def dot_product_attention(
         raise ValueError(f"q heads {H} not a multiple of kv heads {k.shape[2]}")
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    if impl == "auto":
+        # measured-dispatch escape hatch (scripts/perf_attn.py)
+        import os
+
+        impl = os.environ.get("SHAI_ATTN_IMPL", "auto")
+        if (impl == "auto" and not causal and kv_lengths is None
+                and T * S <= _XLA_SCORE_BUDGET):
+            impl = "xla"
 
     if impl in ("auto", "pallas"):
         # the flash kernel applies causal + length masking itself; arbitrary

@@ -82,11 +82,22 @@ class CovaClient:
             return r.json()
 
     async def chain(self, prompt: str, image_b64: str = "") -> Dict[str, Any]:
-        """The cova chain: caption the image, embed caption and prompt
-        (``app/cova_gradio_m.py:54-71``)."""
+        """The full cova chain: prompt → image → caption → embeddings.
+
+        With an ``image`` model configured and no client-supplied image, the
+        chain STARTS from the prompt by generating the image (the reference's
+        flagship demo: prompt → Flux image → mllama caption → T5 embeddings,
+        ``app/cova_gradio.py:55-57``, ``cova/README.md:98``). A caller-
+        provided ``image_b64`` skips generation (``cova_gradio_m`` mode).
+        """
         t0 = time.perf_counter()
         out: Dict[str, Any] = {"prompt": prompt}
         caption = prompt
+        if "image" in self.models and not image_b64:
+            img = await self.post("image", "/genimage", {"prompt": prompt})
+            image_b64 = img.get("image_b64") or img.get("image", "")
+            out["image_b64"] = image_b64
+            out["image_latency_s"] = img.get("latency_s")
         if "caption" in self.models and image_b64:
             cap = await self.post("caption", "/generate",
                                   {"prompt": prompt, "image_b64": image_b64})

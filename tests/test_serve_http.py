@@ -314,3 +314,38 @@ def test_httpd_parallel_probes_during_inference():
         assert probe_dt < 0.4, f"probe blocked behind inference: {probe_dt:.3f}s"
     finally:
         server.stop()
+
+
+@pytest.mark.asyncio
+async def test_serve_ui_and_profile_endpoint(tmp_path):
+    """/serve renders the interactive console (reference run-sd.py:203) and
+    /profile/{s} captures a jax.profiler trace under the artifact root."""
+    import os
+
+    cfg = make_cfg(artifact_root=str(tmp_path))
+    service = EchoService(cfg)
+    app = create_app(cfg, service)
+    async with make_client(app) as c:
+        r = await wait_ready(c, timeout=300.0)
+        assert r.status_code == 200, r.text
+
+        r = await c.get("/serve")
+        assert r.status_code == 200
+        assert "text/html" in r.headers["content-type"]
+        assert cfg.app in r.text and service.infer_route in r.text
+
+        r = await c.post("/profile/0")
+        assert r.status_code == 400
+        r = await c.post("/profile/1")
+        assert r.status_code == 200, r.text
+        trace_dir = r.json()["trace_dir"]
+        assert trace_dir.startswith(str(tmp_path))
+        # a second trace while one runs is refused
+        r2 = await c.post("/profile/5")
+        assert r2.status_code == 409
+        # trace session closes and leaves artifacts on disk
+        for _ in range(80):
+            await asyncio.sleep(0.25)
+            if os.path.isdir(trace_dir) and any(os.scandir(trace_dir)):
+                break
+        assert any(os.scandir(trace_dir)), "no trace artifacts written"

@@ -180,3 +180,24 @@ def test_vlm_language_model_conversion_roundtrip():
     model = llama.LlamaForCausalLM(mcfg, dtype=jnp.float32)
     got, _ = model.apply(params, jnp.asarray(ids, jnp.int32))
     np.testing.assert_allclose(np.asarray(got), want, rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.asyncio
+async def test_dead_engine_loop_fails_readiness():
+    """A crashed engine loop must drain the pod: /readiness 503, /generate
+    503 — not an endless stream of 500s behind a green probe (VERDICT r2 #6)."""
+    cfg, service = make_service()
+    app = create_app(cfg, service)
+    async with make_client(app) as c:
+        r = await wait_ready(c, timeout=300.0)
+        assert r.status_code == 200, r.text
+
+        # simulate an engine-step crash: the loop stops and refuses work
+        service.loop.stop()
+
+        r = await c.get("/readiness")
+        assert r.status_code == 503, r.text
+        assert "engine loop" in r.json()["error"]
+        r = await c.post("/generate", json={"prompt": "hi",
+                                            "max_new_tokens": 4})
+        assert r.status_code == 503
