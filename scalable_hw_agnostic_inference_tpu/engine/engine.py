@@ -61,6 +61,9 @@ class Request:
     # soft-prefix embeddings [P, dim] (vision tokens — multimodal requests,
     # reference ``vllm_model_api_m.py:42-66``); occupy the first P positions
     prefix: Optional[np.ndarray] = None
+    # mllama cross-attention states [Lv, dim] (projected vision features);
+    # attended by the gated cross layers, never part of the token sequence
+    cross_states: Optional[np.ndarray] = None
     # tokens generated before a recompute-preemption (they re-enter the
     # cache as prompt suffix but remain part of the client-visible output)
     already_generated: List[int] = dataclasses.field(default_factory=list)
@@ -97,10 +100,16 @@ class LLMEngine:
     serving layer serializes onto the model lane (``serve.app``)."""
 
     def __init__(self, model_cfg: LlamaConfig, params: Any, ecfg: EngineConfig,
-                 mesh=None):
+                 mesh=None, cross_seq_len: int = 0):
         self.cfg = model_cfg
         self.ecfg = ecfg
         self.params = params
+        # mllama: slot-indexed cross-kv buffers (the encoder cache). Lv is
+        # static per checkpoint (tiles x (patches+1)); rows gate off via
+        # has_image when a slot holds a text-only request.
+        self.cross_seq_len = cross_seq_len
+        if model_cfg.cross_attention_layers and not cross_seq_len:
+            raise ValueError("mllama config needs cross_seq_len (Lv)")
         # tensor parallelism: params arrive sharded (serve layer runs
         # shard_pytree); the pool and both executables follow the same plan
         self.shardings = None
@@ -108,8 +117,12 @@ class LLMEngine:
             from .runner import EngineShardings
 
             self.shardings = EngineShardings(mesh, params, model_cfg)
+        # cross layers own no pool entries — sizing the pool by self-attn
+        # layer count returns ~20% of KV HBM on 11B-Vision to real blocks
+        n_pool_layers = (model_cfg.n_layers
+                         - len(model_cfg.cross_attention_layers))
         self.cache = PagedKVCache(
-            model_cfg.n_layers, model_cfg.n_kv_heads, model_cfg.head_dim,
+            n_pool_layers, model_cfg.n_kv_heads, model_cfg.head_dim,
             ecfg.total_blocks, ecfg.block_size, ecfg.blocks_per_seq,
             dtype=jnp.bfloat16 if ecfg.dtype == "bfloat16" else jnp.float32,
             sharding=None if self.shardings is None
@@ -126,6 +139,30 @@ class LLMEngine:
         self._ctx_buckets = sorted(set(tg) | {ecfg.blocks_per_seq})
         self._decode_fns: Dict[int, Any] = {}
         self._sample1 = jax.jit(sample_logits)
+        self._cross_kv = None      # mllama slot-indexed encoder cache
+        self._cross_embed = None   # jitted states -> per-layer k/v
+        self._has_image = np.zeros((ecfg.max_num_seqs,), np.float32)
+        if model_cfg.cross_attention_layers:
+            from .runner import make_cross_kv, make_cross_slot_write
+
+            dt = jnp.bfloat16 if ecfg.dtype == "bfloat16" else jnp.float32
+            shape = (ecfg.max_num_seqs, cross_seq_len,
+                     model_cfg.n_kv_heads, model_cfg.head_dim)
+            csh = (None if self.shardings is None
+                   else self.shardings.cross_pool(
+                       len(model_cfg.cross_attention_layers)))
+
+            def zeros(i, name):
+                z = jnp.zeros(shape, dt)
+                if csh is not None:
+                    z = jax.device_put(z, csh[i][name])
+                return z
+
+            self._cross_kv = [
+                {"k": zeros(i, "k"), "v": zeros(i, "v")}
+                for i in range(len(model_cfg.cross_attention_layers))]
+            self._cross_embed = make_cross_kv(model_cfg)
+            self._cross_write = make_cross_slot_write(model_cfg)
         self.waiting: deque[Request] = deque()
         self.slots: List[Optional[_Running]] = [None] * ecfg.max_num_seqs
         self._ids = itertools.count()
@@ -138,10 +175,23 @@ class LLMEngine:
 
     def add_request(self, prompt_ids: Sequence[int],
                     params: Optional[SamplingParams] = None,
-                    prefix: Optional[np.ndarray] = None) -> int:
+                    prefix: Optional[np.ndarray] = None,
+                    cross_states: Optional[np.ndarray] = None) -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
+        if cross_states is not None:
+            if self._cross_kv is None:
+                raise ValueError("model has no cross-attention layers")
+            if cross_states.shape != (self.cross_seq_len, self.cfg.dim):
+                raise ValueError(
+                    f"cross_states must be [{self.cross_seq_len}, "
+                    f"{self.cfg.dim}], got {cross_states.shape}")
+        if prefix is not None and self._cross_kv is not None:
+            # a prefix on a cross model would assert deep inside make_prefill
+            # and kill the engine loop — reject it as a per-request error
+            raise ValueError(
+                "mllama models condition on cross_states, not a soft prefix")
         n_prefix = 0 if prefix is None else int(prefix.shape[0])
         if n_prefix >= self.buckets.max:
             raise ValueError(
@@ -152,7 +202,7 @@ class LLMEngine:
             prompt_ids = list(prompt_ids)[-max_prompt:]  # keep the tail
         rid = next(self._ids)
         self.waiting.append(Request(rid, list(prompt_ids), params,
-                                    prefix=prefix))
+                                    prefix=prefix, cross_states=cross_states))
         return rid
 
     @property
@@ -167,8 +217,9 @@ class LLMEngine:
         """
         self._step_count += 1
         self._done_this_step = []
-        if self.waiting and self.waiting[0].prefix is not None:
-            self._admit_one()       # multimodal: single-seq prefix executable
+        if self.waiting and (self.waiting[0].prefix is not None
+                             or self.waiting[0].cross_states is not None):
+            self._admit_one()       # multimodal: single-seq executables
         else:
             self._admit_batch()
         if any(s is not None for s in self.slots):
@@ -240,12 +291,45 @@ class LLMEngine:
                 jnp.asarray([n_text], jnp.int32), table]
         if P:
             args.append(jnp.asarray(req.prefix)[None])
+        if self._cross_kv is not None:
+            args += list(self._set_slot_cross(slot, req))
         self.cache.kv, logits = fn(*args)
         rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
         tok = int(self._sample1(
             logits, rng, req.params.temperature, req.params.top_k,
             req.params.top_p)[0])
         self.slots[slot] = _Running(req, slot, [], pending_token=tok)
+
+    def _set_slot_cross(self, slot: int, req: Request):
+        """Project the request's vision states into the slot's cross-kv
+        buffer rows (or gate the slot off for text-only). Returns the
+        ``(cross_kv [1, Lv, ...], has_image [1])`` prefill args."""
+        if req.cross_states is None:
+            self._has_image[slot] = 0.0
+            return (self._cross_zeros(1), jnp.zeros((1,), jnp.float32))
+        per_layer = self._cross_embed(self.params,
+                                      jnp.asarray(req.cross_states))
+        self._cross_kv = self._cross_write(
+            self._cross_kv, per_layer, jnp.int32(slot))
+        self._has_image[slot] = 1.0
+        # prefill arg dtype must match the warmed signature (buffer dtype)
+        dt = self._cross_kv[0]["k"].dtype
+        one = [{"k": c["k"][None].astype(dt), "v": c["v"][None].astype(dt)}
+               for c in per_layer]
+        return (one, jnp.ones((1,), jnp.float32))
+
+    def _cross_zeros(self, K: int):
+        """Zero cross-kv prefill args for text-only rows, cached per K."""
+        cache = getattr(self, "_cross_zero_cache", None)
+        if cache is None:
+            cache = self._cross_zero_cache = {}
+        if K not in cache:
+            tmpl = self._cross_kv[0]["k"]
+            shape = (K,) + tmpl.shape[1:]
+            cache[K] = [{"k": jnp.zeros(shape, tmpl.dtype),
+                         "v": jnp.zeros(shape, tmpl.dtype)}
+                        for _ in self._cross_kv]
+        return cache[K]
 
     def _admit_batch(self) -> None:
         """Admit up to ``max_prefill_batch`` same-bucket text prompts as ONE
@@ -265,7 +349,7 @@ class LLMEngine:
         bucket = -1
         while self.waiting and len(group) < kmax:
             req = self.waiting[0]
-            if req.prefix is not None:
+            if req.prefix is not None or req.cross_states is not None:
                 break  # multimodal: handled by the single-seq path next step
             max_text = self.buckets.max
             if len(req.prompt_ids) > max_text:
@@ -313,15 +397,18 @@ class LLMEngine:
             topk[i] = req.params.top_k
             topp[i] = req.params.top_p
         fn = self._prefill_for(bucket, 0, Kp)
-        self.cache.kv, logits = fn(
-            self.params, self.cache.kv, jnp.asarray(ids),
-            jnp.asarray(n_text), jnp.asarray(tables))
+        args = [self.params, self.cache.kv, jnp.asarray(ids),
+                jnp.asarray(n_text), jnp.asarray(tables)]
+        if self._cross_kv is not None:  # text-only rows through a cross model
+            args += [self._cross_zeros(Kp), jnp.zeros((Kp,), jnp.float32)]
+        self.cache.kv, logits = fn(*args)
         rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
         toks = np.asarray(self._sample1(
             logits, rng, jnp.asarray(temp), jnp.asarray(topk),
             jnp.asarray(topp)))
         for i, req in enumerate(group):
             slot = self._free_slot()
+            self._has_image[slot] = 0.0
             self.slots[slot] = _Running(req, slot, [],
                                         pending_token=int(toks[i]))
 
@@ -367,7 +454,7 @@ class LLMEngine:
                     for kb in batch_sizes:
                         self._prefill_for(b, 0, kb)
                         n += 1
-                elif 0 < p < b:
+                elif 0 < p < b and self._cross_kv is None:
                     self._prefill_for(b, p)  # prefix path stays single-seq
                     n += 1
         for m in self._ctx_buckets:
@@ -386,16 +473,28 @@ class LLMEngine:
                     jnp.ones((K,), jnp.int32), jnp.zeros((K, M), jnp.int32)]
             if P_:
                 args.append(jnp.zeros((K, P_, self.cfg.dim), jnp.float32))
+            if self._cross_kv is not None:
+                args += [self._cross_zeros(K), jnp.zeros((K,), jnp.float32)]
             self.cache.kv, logits = fn(*args)
             logits.block_until_ready()
         for m, fn in list(self._decode_fns.items()):
-            self.cache.kv, nxt = fn(
-                self.params, self.cache.kv, jnp.zeros((B,), jnp.int32),
-                jnp.zeros((B,), jnp.int32), jnp.zeros((B, M), jnp.int32),
-                jnp.zeros((B,), bool), jax.random.PRNGKey(0),
-                jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
-                jnp.ones((B,), jnp.float32))
+            args = [self.params, self.cache.kv, jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B,), jnp.int32), jnp.zeros((B, M), jnp.int32),
+                    jnp.zeros((B,), bool), jax.random.PRNGKey(0),
+                    jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
+                    jnp.ones((B,), jnp.float32)]
+            if self._cross_kv is not None:
+                args += [self._cross_kv, jnp.zeros((B,), jnp.float32)]
+            self.cache.kv, nxt = fn(*args)
             nxt.block_until_ready()
+        if self._cross_embed is not None:  # the admission-time projector
+            per_layer = self._cross_embed(
+                self.params,
+                jnp.zeros((self.cross_seq_len, self.cfg.dim), jnp.float32))
+            jax.block_until_ready(per_layer)
+            self._cross_kv = self._cross_write(
+                self._cross_kv, per_layer, jnp.int32(0))
+            jax.block_until_ready(self._cross_kv)
         # the host-side sampler used at admission time is part of the closed
         # set too — both signatures: scalar knobs (_admit_one, prefix path)
         # and per-row arrays at every warmed batch size (_admit_batch)
@@ -421,6 +520,7 @@ class LLMEngine:
         log.warning("preempting seq %d (block pool exhausted)", victim.req.req_id)
         self.cache.release(victim.req.req_id)
         self.slots[victim.slot] = None
+        self._has_image[victim.slot] = 0.0
         # generated + pending tokens become cache prompt suffix, but stay in
         # the client-visible output via already_generated; budget shrinks by
         # what is already committed (pending included — it was sampled)
@@ -444,6 +544,7 @@ class LLMEngine:
             victim.req.prompt_ids + committed,
             params,
             prefix=victim.req.prefix,
+            cross_states=victim.req.cross_states,
             already_generated=emitted,
             orig_n_prompt=victim.req.orig_n_prompt))
 
@@ -494,10 +595,12 @@ class LLMEngine:
 
         rng = jax.random.fold_in(self._rng, self._step_count * 2)
         decode = self._decode_for(m_blocks)
-        self.cache.kv, nxt = decode(
-            self.params, self.cache.kv, jnp.asarray(tokens), jnp.asarray(pos),
-            jnp.asarray(tables), jnp.asarray(active), rng,
-            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp))
+        args = [self.params, self.cache.kv, jnp.asarray(tokens),
+                jnp.asarray(pos), jnp.asarray(tables), jnp.asarray(active),
+                rng, jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp)]
+        if self._cross_kv is not None:
+            args += [self._cross_kv, jnp.asarray(self._has_image)]
+        self.cache.kv, nxt = decode(*args)
         nxt = np.asarray(nxt)
 
         for s in list(self.slots):
@@ -517,5 +620,6 @@ class LLMEngine:
                     s.req.orig_n_prompt, "eos" if hit_eos else "length"))
                 self.cache.release(s.req.req_id)
                 self.slots[s.slot] = None
+                self._has_image[s.slot] = 0.0
             else:
                 s.pending_token = int(nxt[s.slot])

@@ -59,16 +59,19 @@ class EngineLoop:
 
     def submit(self, prompt_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
-               prefix=None) -> Future:
+               prefix=None, cross_states=None) -> Future:
         """Enqueue a request; the future resolves to a :class:`Finished`.
 
-        ``prefix``: optional soft-prefix embeddings [P, dim] (vision tokens).
+        ``prefix``: optional soft-prefix embeddings [P, dim] (vision tokens,
+        LLaVA-style). ``cross_states``: optional mllama cross-attention
+        states [Lv, dim] (gated cross layers attend them).
         """
         if self._stop.is_set():
             raise RuntimeError("engine loop is stopped")
         fut: Future = Future()
         self._submit_q.put(
-            (list(prompt_ids), params or SamplingParams(), prefix, fut))
+            (list(prompt_ids), params or SamplingParams(),
+             (prefix, cross_states), fut))
         # close the put-after-drain window: if the loop died between our
         # _stop check and the put, nobody will ever drain this item
         if self._stop.is_set():
@@ -77,9 +80,11 @@ class EngineLoop:
 
     def generate(self, prompt_ids: Sequence[int],
                  params: Optional[SamplingParams] = None,
-                 timeout: Optional[float] = None, prefix=None) -> Finished:
+                 timeout: Optional[float] = None, prefix=None,
+                 cross_states=None) -> Finished:
         """Submit and block — the serving ``infer`` path."""
-        return self.submit(prompt_ids, params, prefix=prefix).result(timeout)
+        return self.submit(prompt_ids, params, prefix=prefix,
+                           cross_states=cross_states).result(timeout)
 
     # -- loop --------------------------------------------------------------
 
@@ -90,9 +95,10 @@ class EngineLoop:
         except queue.Empty:
             return
         while True:
-            ids, params, prefix, fut = item
+            ids, params, (prefix, cross_states), fut = item
             try:
-                rid = self.engine.add_request(ids, params, prefix=prefix)
+                rid = self.engine.add_request(ids, params, prefix=prefix,
+                                              cross_states=cross_states)
                 with self._futures_lock:
                     self._futures[rid] = fut
             except Exception as e:  # bad request (e.g. empty prompt)

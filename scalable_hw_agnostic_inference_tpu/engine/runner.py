@@ -42,6 +42,16 @@ class EngineShardings:
     def __init__(self, mesh, params, cfg: LlamaConfig):
         from ..models.llama import cache_specs, tp_rules
 
+        tp = mesh.shape.get("tp", 1)
+        # fail loudly at construction: a GQA config whose head counts don't
+        # divide tp would otherwise surface as an opaque partitioning error
+        # deep inside the first jitted call
+        if cfg.n_kv_heads % tp or cfg.n_heads % tp:
+            raise ValueError(
+                f"tensor_parallel_size={tp} must divide both n_heads="
+                f"{cfg.n_heads} and n_kv_heads={cfg.n_kv_heads} — pick a tp "
+                f"that divides the GQA head counts (reference vLLM has the "
+                f"same constraint)")
         self.mesh = mesh
         self.rep = NamedSharding(mesh, P())
         specs = tp_rules().tree_specs(params)
@@ -53,6 +63,12 @@ class EngineShardings:
 
     def kv_pool(self, n_layers: int):
         return [dict(self.kv_layer) for _ in range(n_layers)]
+
+    def cross_pool(self, n_cross: int):
+        # mllama cross-kv buffers [B, Lv, Hkv, Dh]: split on the kv-head
+        # axis, same placement as the paged pool
+        spec = NamedSharding(self.mesh, P(None, None, "tp", None))
+        return [{"k": spec, "v": spec} for _ in range(n_cross)]
 
 
 def _rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
@@ -80,6 +96,84 @@ def _mlp(lp: Dict, x: jax.Array) -> jax.Array:
     gate = _proj(x, lp["mlp"]["gate"])
     up = _proj(x, lp["mlp"]["up"])
     return _proj(jax.nn.silu(gate) * up, lp["mlp"]["down"])
+
+
+def _head_rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm over the head dim of ``[B, T, H, Dh]`` (mllama q/k norms)."""
+    x32 = x.astype(jnp.float32)
+    n = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+    return (n * scale).astype(x.dtype)
+
+
+def make_cross_kv(cfg: LlamaConfig):
+    """Compile ``cross_kv(params, states [Lv, dim]) -> [n_cross] x {k, v}``.
+
+    The per-request half of mllama cross-attention: project (and k-norm) the
+    vision states ONCE at admission; prefill/decode then read the projected
+    k/v from slot-indexed buffers every step (vLLM's encoder-cache idea).
+    HF recomputes this lazily inside ``MllamaTextCrossAttention`` (
+    reference capability: ``cova/mllama-32-11b-vllm-trn1-config.yaml``).
+    """
+
+    def cross_kv(params, states):
+        p = params["params"]
+        out = []
+        x = states[None].astype(jnp.bfloat16)      # [1, Lv, dim]
+        for li in cfg.cross_attention_layers:
+            lp = p[f"layer_{li}"]["cross_attn"]
+            Lv = x.shape[1]
+            k = _proj(x, lp["k"]).reshape(1, Lv, cfg.n_kv_heads, cfg.head_dim)
+            v = _proj(x, lp["v"]).reshape(1, Lv, cfg.n_kv_heads, cfg.head_dim)
+            k = _head_rmsnorm(k, lp["k_norm"]["scale"], cfg.rms_eps)
+            out.append({"k": k[0], "v": v[0]})
+        return out
+
+    return jax.jit(cross_kv)
+
+
+def make_cross_slot_write(cfg: LlamaConfig):
+    """Compile ``write(cross_kv, per_layer, slot) -> cross_kv`` — all cross
+    layers' slot rows updated in ONE donated-buffer call (2*n_cross
+    host-dispatched full-buffer copies otherwise; ~400MB per admission at
+    11B scale)."""
+
+    def write(cross_kv, per_layer, slot):
+        out = []
+        for buf, new in zip(cross_kv, per_layer):
+            out.append({
+                "k": buf["k"].at[slot].set(new["k"].astype(buf["k"].dtype)),
+                "v": buf["v"].at[slot].set(new["v"].astype(buf["v"].dtype)),
+            })
+        return out
+
+    return jax.jit(write, donate_argnums=(0,))
+
+
+def _cross_layer(lp: Dict, x: jax.Array, cross_k: jax.Array,
+                 cross_v: jax.Array, has_image: jax.Array,
+                 cfg: LlamaConfig) -> jax.Array:
+    """One mllama gated cross-attention layer.
+
+    ``x`` [B, T, dim]; ``cross_k/v`` [B, Lv, Hkv, Dh] (already k-normed);
+    ``has_image`` [B] float gate — rows without vision states contribute
+    nothing, which is exactly HF's skip-the-layer semantics for text-only
+    requests through an mllama checkpoint.
+    """
+    B, T, _ = x.shape
+    ca = lp["cross_attn"]
+    h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
+    q = _proj(h, ca["q"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
+    q = _head_rmsnorm(q, ca["q_norm"]["scale"], cfg.rms_eps)
+    o = dot_product_attention(q, cross_k.astype(q.dtype),
+                              cross_v.astype(q.dtype))
+    # gate in x's dtype: an f32 gate would promote the residual stream (and
+    # every downstream layer) off bf16
+    gate = has_image.astype(x.dtype)[:, None, None]
+    g_attn = jnp.tanh(lp["gate_attn"]).astype(x.dtype)
+    g_mlp = jnp.tanh(lp["gate_mlp"]).astype(x.dtype)
+    x = x + g_attn * _proj(o.reshape(B, T, -1), ca["o"]) * gate
+    m = _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
+    return x + g_mlp * m * gate
 
 
 def _logits(p: Dict, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
@@ -111,8 +205,10 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     assert bucket % block_size == 0
     assert 0 <= prefix_len < bucket
     m_used = bucket // block_size
+    cross_set = set(cfg.cross_attention_layers)
 
-    def prefill(params, kv, ids, n_text, block_tables, prefix=None):
+    def _prefill_impl(params, kv, ids, n_text, block_tables, prefix=None,
+                      cross_kv=None, has_image=None):
         p = params["params"]
         B = ids.shape[0]  # == n_seqs
         x = p["embed"]["embedding"][ids].astype(jnp.bfloat16)
@@ -122,8 +218,17 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
         n = n_text + prefix_len
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
         tbl = block_tables[:, :m_used]  # [B, m_used]
+        ci = 0
+        pi = 0  # pool index: cross layers own no KV pool entries
         for li in range(cfg.n_layers):
             lp = p[f"layer_{li}"]
+            if li in cross_set:
+                # gated cross-attention over vision states: no rope, no KV
+                # pool traffic — its keys are static per request
+                x = _cross_layer(lp, x, cross_kv[ci]["k"], cross_kv[ci]["v"],
+                                 has_image, cfg)
+                ci += 1
+                continue
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
             q, k, v = _qkv(lp, h, positions, cfg)
             # causal within the prompt; pad keys masked by the true length —
@@ -133,21 +238,41 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
             x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
             x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
             # scatter each row's k/v blocks into the pool ([B, m_used] index)
-            kdst = kv[li]["k"].at[tbl].set(
+            kdst = kv[pi]["k"].at[tbl].set(
                 k.reshape(B, m_used, block_size, cfg.n_kv_heads, cfg.head_dim)
-                .astype(kv[li]["k"].dtype))
-            vdst = kv[li]["v"].at[tbl].set(
+                .astype(kv[pi]["k"].dtype))
+            vdst = kv[pi]["v"].at[tbl].set(
                 v.reshape(B, m_used, block_size, cfg.n_kv_heads, cfg.head_dim)
-                .astype(kv[li]["v"].dtype))
-            kv[li] = {"k": kdst, "v": vdst}
+                .astype(kv[pi]["v"].dtype))
+            kv[pi] = {"k": kdst, "v": vdst}
+            pi += 1
         last = jnp.take_along_axis(x, (n - 1).reshape(B, 1, 1), axis=1)
         return kv, _logits(p, last, cfg)[:, 0]  # [B, V]
+
+    # positional signature per variant (in_shardings needs positional args)
+    if cross_set:
+        assert not prefix_len, "mllama prefill: cross states, not soft prefix"
+
+        def prefill(params, kv, ids, n_text, block_tables, cross_kv, has_image):
+            return _prefill_impl(params, kv, ids, n_text, block_tables,
+                                 cross_kv=cross_kv, has_image=has_image)
+    elif prefix_len:
+        def prefill(params, kv, ids, n_text, block_tables, prefix):
+            return _prefill_impl(params, kv, ids, n_text, block_tables,
+                                 prefix=prefix)
+    else:
+        def prefill(params, kv, ids, n_text, block_tables):
+            return _prefill_impl(params, kv, ids, n_text, block_tables)
 
     if shardings is None:
         return jax.jit(prefill, donate_argnums=(1,))
     sh, rep = shardings, shardings.rep
-    kvsh = sh.kv_pool(cfg.n_layers)
-    in_sh = [sh.params, kvsh, rep, rep, rep] + ([rep] if prefix_len else [])
+    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set))
+    in_sh = [sh.params, kvsh, rep, rep, rep]
+    if cross_set:
+        in_sh += [sh.cross_pool(len(cross_set)), rep]
+    elif prefix_len:
+        in_sh += [rep]
     return jax.jit(prefill, donate_argnums=(1,),
                    in_shardings=tuple(in_sh), out_shardings=(kvsh, rep))
 
@@ -209,8 +334,10 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
             check_rep=False,
         )(q1, kpool, vpool, tables, lengths)
 
-    def decode(params, kv, tokens, pos, tables, active, rng,
-               temperature, top_k, top_p):
+    cross_set = set(cfg.cross_attention_layers)
+
+    def _decode_impl(params, kv, tokens, pos, tables, active, rng,
+                     temperature, top_k, top_p, cross_kv=None, has_image=None):
         p = params["params"]
         B = max_num_seqs
         tables = tables[:, :m_ctx]
@@ -225,13 +352,20 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
             # slot b attends exactly its pos[b]+1 tokens (the one just
             # written included); inactive slots see one dummy token
             mask = (jnp.arange(L)[None, :] <= pos[:, None])[:, None, None, :]
+        ci = 0
+        pi = 0  # pool index: cross layers own no KV pool entries
         for li in range(cfg.n_layers):
             lp = p[f"layer_{li}"]
+            if li in cross_set:
+                x = _cross_layer(lp, x, cross_kv[ci]["k"], cross_kv[ci]["v"],
+                                 has_image, cfg)
+                ci += 1
+                continue
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
             q, k, v = _qkv(lp, h, positions, cfg)
-            pool_shape = kv[li]["k"].shape
-            kflat = kv[li]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-            vflat = kv[li]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            pool_shape = kv[pi]["k"].shape
+            kflat = kv[pi]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            vflat = kv[pi]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
             kflat = kflat.at[widx].set(k[:, 0].astype(kflat.dtype))
             vflat = vflat.at[widx].set(v[:, 0].astype(vflat.dtype))
             if paged:
@@ -239,23 +373,38 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                 vpool = vflat.reshape(pool_shape)
                 o = paged_attn(q[:, 0], kpool, vpool, tables, pos + 1)
                 o = o[:, None]  # [B, 1, H, Dh]
-                kv[li] = {"k": kpool, "v": vpool}
+                kv[pi] = {"k": kpool, "v": vpool}
             else:
                 kctx = kflat[goff]  # [B, L, Hkv, Dh]
                 vctx = vflat[goff]
                 o = dot_product_attention(q, kctx, vctx, mask=mask)
-                kv[li] = {"k": kflat.reshape(pool_shape),
+                kv[pi] = {"k": kflat.reshape(pool_shape),
                           "v": vflat.reshape(pool_shape)}
+            pi += 1
             x = x + _proj(o.reshape(B, 1, -1), lp["attn"]["o"])
             x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
         logits = _logits(p, x, cfg)[:, 0]  # [B, V]
         nxt = sample_logits(logits, rng, temperature, top_k, top_p)
         return kv, nxt
 
+    if cross_set:
+        def decode(params, kv, tokens, pos, tables, active, rng,
+                   temperature, top_k, top_p, cross_kv, has_image):
+            return _decode_impl(params, kv, tokens, pos, tables, active, rng,
+                                temperature, top_k, top_p,
+                                cross_kv=cross_kv, has_image=has_image)
+    else:
+        def decode(params, kv, tokens, pos, tables, active, rng,
+                   temperature, top_k, top_p):
+            return _decode_impl(params, kv, tokens, pos, tables, active, rng,
+                                temperature, top_k, top_p)
+
     if shardings is None:
         return jax.jit(decode, donate_argnums=(1,))
     sh, rep = shardings, shardings.rep
-    kvsh = sh.kv_pool(cfg.n_layers)
+    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set))
     in_sh = (sh.params, kvsh) + (rep,) * 8
+    if cross_set:
+        in_sh += (sh.cross_pool(len(cross_set)), rep)
     return jax.jit(decode, donate_argnums=(1,),
                    in_shardings=in_sh, out_shardings=(kvsh, rep))

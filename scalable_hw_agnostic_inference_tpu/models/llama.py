@@ -55,6 +55,22 @@ class LlamaConfig:
     rope_scaling: Optional[Tuple[float, float, float, int]] = None
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
+    # mllama (Llama-3.2-Vision): indices of gated cross-attention layers that
+    # attend precomputed vision states instead of the token KV cache
+    # (reference serves this architecture via the vLLM fork,
+    # ``cova/mllama-32-11b-vllm-trn1-config.yaml``). Empty = plain llama.
+    cross_attention_layers: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        # sequence fields normalize to tuples so configs hash and compare
+        # stably across a JSON round-trip (the weight-store metadata path)
+        if not isinstance(self.cross_attention_layers, tuple):
+            object.__setattr__(self, "cross_attention_layers",
+                               tuple(self.cross_attention_layers))
+        if (self.rope_scaling is not None
+                and not isinstance(self.rope_scaling, tuple)):
+            object.__setattr__(self, "rope_scaling",
+                               tuple(self.rope_scaling))
 
     @property
     def head_dim(self) -> int:
@@ -87,6 +103,8 @@ class LlamaConfig:
             rope_scaling=rope_scaling_from_hf(getattr(hf, "rope_scaling", None)),
             rms_eps=getattr(hf, "rms_norm_eps", 1e-5),
             tie_embeddings=getattr(hf, "tie_word_embeddings", False),
+            cross_attention_layers=tuple(
+                getattr(hf, "cross_attention_layers", None) or ()),
         )
 
 
@@ -206,6 +224,11 @@ class LlamaForCausalLM(nn.Module):
         write_index: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, Optional[Cache]]:
         cfg = self.cfg
+        if cfg.cross_attention_layers:
+            raise ValueError(
+                "mllama configs (cross_attention_layers) run through the "
+                "paged engine (engine.runner), not the contiguous-cache "
+                "flax path")
         B, T = ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
@@ -308,13 +331,7 @@ def params_from_torch(model_or_sd, cfg: LlamaConfig) -> Dict[str, Any]:
     }
     for i in range(cfg.n_layers):
         lp = f"{pfx}layers.{i}"
-        tree[f"layer_{i}"] = {
-            "attn": {
-                "q": convert.linear(sd, f"{lp}.self_attn.q_proj"),
-                "k": convert.linear(sd, f"{lp}.self_attn.k_proj"),
-                "v": convert.linear(sd, f"{lp}.self_attn.v_proj"),
-                "o": convert.linear(sd, f"{lp}.self_attn.o_proj"),
-            },
+        layer: Dict[str, Any] = {
             "mlp": {
                 "gate": convert.linear(sd, f"{lp}.mlp.gate_proj"),
                 "up": convert.linear(sd, f"{lp}.mlp.up_proj"),
@@ -325,6 +342,26 @@ def params_from_torch(model_or_sd, cfg: LlamaConfig) -> Dict[str, Any]:
                 "scale": convert.t2j(sd[f"{lp}.post_attention_layernorm.weight"])
             },
         }
+        if i in cfg.cross_attention_layers:
+            # mllama gated cross-attention layer (HF MllamaCrossAttentionDecoderLayer)
+            layer["cross_attn"] = {
+                "q": convert.linear(sd, f"{lp}.cross_attn.q_proj"),
+                "k": convert.linear(sd, f"{lp}.cross_attn.k_proj"),
+                "v": convert.linear(sd, f"{lp}.cross_attn.v_proj"),
+                "o": convert.linear(sd, f"{lp}.cross_attn.o_proj"),
+                "q_norm": {"scale": convert.t2j(sd[f"{lp}.cross_attn.q_norm.weight"])},
+                "k_norm": {"scale": convert.t2j(sd[f"{lp}.cross_attn.k_norm.weight"])},
+            }
+            layer["gate_attn"] = convert.t2j(sd[f"{lp}.cross_attn_attn_gate"])
+            layer["gate_mlp"] = convert.t2j(sd[f"{lp}.cross_attn_mlp_gate"])
+        else:
+            layer["attn"] = {
+                "q": convert.linear(sd, f"{lp}.self_attn.q_proj"),
+                "k": convert.linear(sd, f"{lp}.self_attn.k_proj"),
+                "v": convert.linear(sd, f"{lp}.self_attn.v_proj"),
+                "o": convert.linear(sd, f"{lp}.self_attn.o_proj"),
+            }
+        tree[f"layer_{i}"] = layer
     if not cfg.tie_embeddings:
         tree["lm_head"] = convert.linear(sd, "lm_head")
     return {"params": tree}

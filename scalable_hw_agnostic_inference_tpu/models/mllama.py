@@ -1,0 +1,294 @@
+"""Mllama (Llama-3.2-Vision) — the reference's multimodal serving unit.
+
+Parity target: ``app/vllm_model_api_m.py`` serving
+``meta-llama/Llama-3.2-11B-Vision`` through the vLLM neuron fork
+(``cova/mllama-32-11b-vllm-trn1-config.yaml``). The architecture is NOT
+LLaVA: instead of soft-prefix tokens, the language model interleaves
+tanh-gated CROSS-ATTENTION layers that attend precomputed vision states.
+
+Split of responsibilities:
+
+- this module: the two-stage tiled vision encoder (flax) + the
+  ``multi_modal_projector``, and the checkpoint converters. Output:
+  ``cross_states [Lv, text_dim]`` with ``Lv = max_num_tiles * (patches+1)``.
+- ``models.llama.LlamaConfig.cross_attention_layers`` + ``engine.runner``:
+  the text side — gated cross layers run inside the paged engine's
+  prefill/decode executables, with per-slot cross-KV buffers projected once
+  at admission (``engine.runner.make_cross_kv``).
+
+The vision encoder reproduces HF ``MllamaVisionModel`` numerics exactly
+(tests pin it): gated tile/position embeddings, patch padding to a multiple
+of 8, the outer-product padding mask (pairs are masked only when BOTH
+tokens are invalid — the upstream convention), intermediate-layer feature
+concatenation, and the gated global transformer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from . import convert
+
+NEG_INF = jnp.finfo(jnp.float32).min
+
+
+@dataclasses.dataclass(frozen=True)
+class MllamaVisionConfig:
+    image_size: int = 560
+    patch_size: int = 14
+    dim: int = 1280                 # hidden_size
+    n_layers: int = 32              # local transformer
+    n_global_layers: int = 8
+    heads: int = 16
+    mlp_dim: int = 5120             # intermediate_size
+    max_num_tiles: int = 4
+    max_aspect_ratio_id: int = 8
+    intermediate_layers_indices: Tuple[int, ...] = (3, 7, 15, 23, 30)
+    norm_eps: float = 1e-5
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def output_dim(self) -> int:
+        # final hidden + one slice per collected intermediate layer
+        return self.dim * (1 + len(self.intermediate_layers_indices))
+
+    @classmethod
+    def tiny(cls) -> "MllamaVisionConfig":
+        return cls(image_size=32, patch_size=8, dim=32, n_layers=3,
+                   n_global_layers=2, heads=2, mlp_dim=64, max_num_tiles=2,
+                   max_aspect_ratio_id=3, intermediate_layers_indices=(1,))
+
+    @classmethod
+    def from_hf(cls, v) -> "MllamaVisionConfig":
+        return cls(
+            image_size=v.image_size,
+            patch_size=v.patch_size,
+            dim=v.hidden_size,
+            n_layers=v.num_hidden_layers,
+            n_global_layers=v.num_global_layers,
+            heads=v.attention_heads,
+            mlp_dim=v.intermediate_size,
+            max_num_tiles=v.max_num_tiles,
+            max_aspect_ratio_id=v.max_aspect_ratio_id,
+            intermediate_layers_indices=tuple(v.intermediate_layers_indices),
+            norm_eps=getattr(v, "norm_eps", 1e-5),
+        )
+
+
+class _VisionBlock(nn.Module):
+    """Pre-LN encoder block; ``gated`` adds tanh gates on both residuals
+    (HF ``MllamaVisionEncoderLayer(is_gated=True)`` — the global stage)."""
+
+    cfg: MllamaVisionConfig
+    gated: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask_bias: jax.Array) -> jax.Array:
+        c = self.cfg
+        Dh = c.dim // c.heads
+        h = nn.LayerNorm(epsilon=c.norm_eps, dtype=jnp.float32,
+                         name="ln1")(x).astype(self.dtype)
+        B, L, _ = h.shape
+        dense = lambda n, name, bias=True: nn.Dense(
+            n, use_bias=bias, dtype=self.dtype, name=name)
+        q = dense(c.dim, "q", bias=False)(h).reshape(B, L, c.heads, Dh)
+        k = dense(c.dim, "k", bias=False)(h).reshape(B, L, c.heads, Dh)
+        v = dense(c.dim, "v", bias=False)(h).reshape(B, L, c.heads, Dh)
+        s = jnp.einsum("bthd,bshd->bhts", q, k,
+                       preferred_element_type=jnp.float32) / math.sqrt(Dh)
+        s = s + mask_bias  # [B, 1, L, L] additive (the outer-product mask)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bhts,bshd->bthd", p, v).reshape(B, L, c.dim)
+        o = dense(c.dim, "o", bias=False)(o)
+        if self.gated:
+            o = jnp.tanh(self.param("gate_attn", nn.initializers.constant(
+                math.pi / 4), (1,))) * o
+        x = x + o
+        h = nn.LayerNorm(epsilon=c.norm_eps, dtype=jnp.float32,
+                         name="ln2")(x).astype(self.dtype)
+        h = dense(c.mlp_dim, "fc1")(h)
+        h = dense(c.dim, "fc2")(jax.nn.gelu(h, approximate=False))
+        if self.gated:
+            h = jnp.tanh(self.param("gate_mlp", nn.initializers.constant(
+                math.pi / 4), (1,))) * h
+        return x + h
+
+
+class MllamaVisionModel(nn.Module):
+    """pixels ``[B, tiles, H, W, 3]`` (NHWC) + aspect ratio id/mask →
+    vision features ``[B, tiles, patches+1, output_dim]``."""
+
+    cfg: MllamaVisionConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixels: jax.Array, aspect_ratio_ids: jax.Array,
+                 aspect_ratio_mask: jax.Array) -> jax.Array:
+        c = self.cfg
+        B, T, H, W, _ = pixels.shape
+        P = c.n_patches
+        x = nn.Conv(c.dim, (c.patch_size, c.patch_size),
+                    strides=(c.patch_size, c.patch_size), padding="VALID",
+                    use_bias=False, dtype=self.dtype, name="patch")(
+            pixels.reshape(B * T, H, W, 3).astype(self.dtype))
+        x = x.reshape(B, T, P, c.dim)
+
+        # pre-tile positional embedding (gated table lookup by aspect ratio)
+        pre_tab = self.param("pre_tile_emb", nn.initializers.normal(0.02),
+                             (c.max_aspect_ratio_id + 1, c.max_num_tiles, c.dim))
+        pre_gate = self.param("pre_tile_gate", nn.initializers.zeros, (1,))
+        x = x + (jnp.tanh(pre_gate) * pre_tab[aspect_ratio_ids])[:, :, None, :]
+
+        # class token per tile
+        cls = self.param("cls", nn.initializers.normal(0.02), (c.dim,))
+        cls_tok = jnp.broadcast_to(cls, (B, T, 1, c.dim)).astype(x.dtype)
+        x = jnp.concatenate([cls_tok, x], axis=2)
+        P1 = P + 1
+
+        # gated position embedding: (1 - tanh g) * per-patch + tanh g * tiled
+        pos = self.param("pos", nn.initializers.normal(0.02), (P1, c.dim))
+        pos_gate = self.param("pos_gate", nn.initializers.zeros, (1,))
+        tile_tab = self.param(
+            "tile_pos_emb", nn.initializers.normal(0.02),
+            (c.max_aspect_ratio_id + 1, c.max_num_tiles, P1, c.dim))
+        x = x + (1.0 - jnp.tanh(pos_gate)) * pos[None, None]
+        x = x + jnp.tanh(pos_gate) * tile_tab[aspect_ratio_ids]
+
+        x = nn.LayerNorm(epsilon=c.norm_eps, dtype=jnp.float32,
+                         name="ln_pre")(x).astype(self.dtype)
+
+        # pad the patch dim to a multiple of 8 (HF does the same)
+        pad = (8 - P1 % 8) % 8
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        Pp = P1 + pad
+        L = T * Pp
+
+        # upstream mask convention: token invalid iff its tile is masked OR
+        # it is padding; a PAIR is masked only when BOTH ends are invalid
+        invalid = jnp.ones((B, T, Pp))
+        invalid = invalid * (1.0 - aspect_ratio_mask.astype(jnp.float32))[:, :, None]
+        if pad:
+            invalid = invalid.at[:, :, -pad:].set(1.0)
+        inv = invalid.reshape(B, L, 1)
+        mask_bias = (inv @ jnp.swapaxes(inv, 1, 2) * NEG_INF)[:, None]
+
+        x = x.reshape(B, L, c.dim)
+        # HF convention: hidden_states[i] = OUTPUT of local layer i (no
+        # embedding entry) — intermediate_layers_indices index into that
+        hidden = []
+        for i in range(c.n_layers):
+            x = _VisionBlock(c, gated=False, dtype=self.dtype,
+                             name=f"layer_{i}")(x, mask_bias)
+            hidden.append(x)
+        x = nn.LayerNorm(epsilon=c.norm_eps, dtype=jnp.float32,
+                         name="ln_post")(x).astype(self.dtype)
+
+        # post-tile embedding, then the gated global transformer
+        x = x.reshape(B, T, Pp, c.dim)
+        post_tab = self.param("post_tile_emb", nn.initializers.normal(0.02),
+                              (c.max_aspect_ratio_id + 1, c.max_num_tiles, c.dim))
+        post_gate = self.param("post_tile_gate", nn.initializers.zeros, (1,))
+        x = x + (jnp.tanh(post_gate) * post_tab[aspect_ratio_ids])[:, :, None, :]
+        x = x.reshape(B, L, c.dim)
+        for i in range(c.n_global_layers):
+            x = _VisionBlock(c, gated=True, dtype=self.dtype,
+                             name=f"global_{i}")(x, mask_bias)
+
+        # strip padding, concat final + collected intermediate features
+        x = x.reshape(B, T, Pp, c.dim)[:, :, :P1]
+        inter = jnp.stack([hidden[i] for i in c.intermediate_layers_indices],
+                          axis=-1)  # [B, L, dim, k]
+        inter = inter.reshape(B, T, Pp, -1)[:, :, :P1]
+        return jnp.concatenate([x, inter], axis=-1)  # [B, T, P1, output_dim]
+
+
+class MllamaProjector(nn.Module):
+    """vision features ``[B, T, P1, output_dim]`` → cross-attention states
+    ``[B, T*(P1), text_dim]`` (HF ``multi_modal_projector`` + reshape)."""
+
+    cfg: MllamaVisionConfig
+    text_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, feats: jax.Array) -> jax.Array:
+        B, T, P1, _ = feats.shape
+        x = nn.Dense(self.text_dim, dtype=self.dtype, name="proj")(
+            feats.astype(self.dtype))
+        return x.reshape(B, T * P1, self.text_dim)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint conversion (HF MllamaForConditionalGeneration vision side)
+# ---------------------------------------------------------------------------
+
+def _vision_block(sd, p: str, gated: bool) -> Dict[str, Any]:
+    out = {
+        "ln1": convert.layer_norm(sd, f"{p}.input_layernorm"),
+        "ln2": convert.layer_norm(sd, f"{p}.post_attention_layernorm"),
+        "q": convert.linear(sd, f"{p}.self_attn.q_proj"),
+        "k": convert.linear(sd, f"{p}.self_attn.k_proj"),
+        "v": convert.linear(sd, f"{p}.self_attn.v_proj"),
+        "o": convert.linear(sd, f"{p}.self_attn.o_proj"),
+        "fc1": convert.linear(sd, f"{p}.mlp.fc1"),
+        "fc2": convert.linear(sd, f"{p}.mlp.fc2"),
+    }
+    if gated:
+        out["gate_attn"] = convert.t2j(sd[f"{p}.gate_attn"]).reshape(1)
+        out["gate_mlp"] = convert.t2j(sd[f"{p}.gate_ffn"]).reshape(1)
+    return out
+
+
+def vision_params_from_torch(model_or_sd, cfg: MllamaVisionConfig,
+                             text_dim: int) -> Tuple[Dict, Dict]:
+    """HF mllama state dict → (vision params, projector params)."""
+    sd = convert.state_dict_of(model_or_sd)
+    vm = ("model.vision_model"
+          if any(k.startswith("model.vision_model.") for k in sd)
+          else "vision_model")
+    mp = ("model.multi_modal_projector"
+          if any(k.startswith("model.multi_modal_projector.") for k in sd)
+          else "multi_modal_projector")
+    P1 = cfg.n_patches + 1
+    tree: Dict[str, Any] = {
+        "patch": {"kernel": convert.t2j(
+            sd[f"{vm}.patch_embedding.weight"]).transpose(2, 3, 1, 0)},
+        "cls": convert.t2j(sd[f"{vm}.class_embedding"]),
+        "pos": convert.t2j(sd[f"{vm}.gated_positional_embedding.embedding"]),
+        "pos_gate": convert.t2j(
+            sd[f"{vm}.gated_positional_embedding.gate"]).reshape(1),
+        "tile_pos_emb": convert.t2j(
+            sd[f"{vm}.gated_positional_embedding.tile_embedding.weight"]
+        ).reshape(cfg.max_aspect_ratio_id + 1, cfg.max_num_tiles, P1, cfg.dim),
+        "pre_tile_emb": convert.t2j(
+            sd[f"{vm}.pre_tile_positional_embedding.embedding.weight"]
+        ).reshape(cfg.max_aspect_ratio_id + 1, cfg.max_num_tiles, cfg.dim),
+        "pre_tile_gate": convert.t2j(
+            sd[f"{vm}.pre_tile_positional_embedding.gate"]).reshape(1),
+        "post_tile_emb": convert.t2j(
+            sd[f"{vm}.post_tile_positional_embedding.embedding.weight"]
+        ).reshape(cfg.max_aspect_ratio_id + 1, cfg.max_num_tiles, cfg.dim),
+        "post_tile_gate": convert.t2j(
+            sd[f"{vm}.post_tile_positional_embedding.gate"]).reshape(1),
+        "ln_pre": convert.layer_norm(sd, f"{vm}.layernorm_pre"),
+        "ln_post": convert.layer_norm(sd, f"{vm}.layernorm_post"),
+    }
+    for i in range(cfg.n_layers):
+        tree[f"layer_{i}"] = _vision_block(
+            sd, f"{vm}.transformer.layers.{i}", gated=False)
+    for i in range(cfg.n_global_layers):
+        tree[f"global_{i}"] = _vision_block(
+            sd, f"{vm}.global_transformer.layers.{i}", gated=True)
+    proj = {"proj": convert.linear(sd, mp)}
+    return {"params": tree}, {"params": proj}

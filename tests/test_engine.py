@@ -250,8 +250,21 @@ def _tp_engine(params, cfg, tp, **over):
 
 @pytest.mark.parametrize("tp", [2, 8])
 def test_engine_tp_greedy_parity(tiny_model, tp):
-    """tp=2 / tp=8 sharded engine matches the single-device engine greedily."""
-    cfg, _, params = tiny_model
+    """tp=2 / tp=8 sharded engine matches the single-device engine greedily.
+
+    tp must divide the GQA head counts (the loud-rejection contract), so the
+    tp=8 leg widens the model to 8 q/kv heads instead of silently
+    replicating a 2-kv-head pool.
+    """
+    if tp <= 2:
+        cfg, _, params = tiny_model
+    else:
+        cfg = LlamaConfig(
+            vocab_size=512, dim=64, n_layers=2, n_heads=8, n_kv_heads=8,
+            mlp_dim=128, max_seq_len=256, rope_theta=10000.0,
+            tie_embeddings=True)
+        model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
     prompts = [[1, 17, 42, 99, 7], [3, 5], list(range(2, 22))]
     sp = SamplingParams(temperature=0.0, max_new_tokens=8)
 
@@ -262,7 +275,7 @@ def test_engine_tp_greedy_parity(tiny_model, tp):
     got = [f.token_ids for f in eng.generate(prompts, sp)]
     assert got == want
 
-    # the pool is actually sharded over the mesh (kv heads when divisible)
+    # the pool is actually sharded over the mesh (kv heads)
     kv0 = eng.cache.kv[0]["k"]
     assert len(kv0.sharding.device_set) == tp
 
@@ -425,3 +438,17 @@ def test_batched_prefill_stays_within_warmed_ladder(tiny_model):
             done[f.req_id] = f
     assert len(done) == 3
     assert eng.n_executables == count, "post-warm prefill compiled a new executable"
+
+
+def test_engine_tp_rejects_indivisible_kv_heads(tiny_model, devices):
+    """GQA head counts that don't divide tp must fail loudly at engine
+    construction, not as an opaque partitioning error mid-jit."""
+    from scalable_hw_agnostic_inference_tpu.core.mesh import build_mesh
+
+    cfg, _, params = tiny_model     # tiny: n_heads=4, n_kv_heads=2
+    mesh = build_mesh("tp=8", devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        LLMEngine(cfg, params, EngineConfig(
+            max_model_len=64, max_num_seqs=2, block_size=8,
+            context_encoding_buckets=(16,), tensor_parallel_size=8),
+            mesh=mesh)
