@@ -101,6 +101,16 @@ def bench_llama(tiny: bool) -> dict:
 
     if tiny:
         cfg, batch, prompt, new = LlamaConfig.tiny(), 2, 32, 16
+        name = "tiny"
+    elif "llama3b" in sys.argv:
+        # Llama-3.2-3B geometry (hidden 3072, 28 layers, 24 q / 8 kv heads)
+        # — the largest Llama that fits one v5e chip in bf16 with headroom
+        cfg = LlamaConfig(
+            vocab_size=128256, dim=3072, n_layers=28, n_heads=24, n_kv_heads=8,
+            mlp_dim=8192, max_seq_len=4096, rope_theta=500000.0,
+            tie_embeddings=True)
+        batch, prompt, new = 8, 128, 128
+        name = "llama3.2-3b-geometry"
     else:
         # Llama-3.2-1B geometry (hidden 2048, 16 layers, 32 q / 8 kv heads)
         cfg = LlamaConfig(
@@ -108,6 +118,7 @@ def bench_llama(tiny: bool) -> dict:
             mlp_dim=8192, max_seq_len=4096, rope_theta=500000.0,
             tie_embeddings=True)
         batch, prompt, new = 8, 128, 128
+        name = "llama3.2-1b-geometry"
 
     from scalable_hw_agnostic_inference_tpu.models.convert import cast_f32_to_bf16
 
@@ -128,13 +139,15 @@ def bench_llama(tiny: bool) -> dict:
     out.tokens.block_until_ready()
     dt = (time.perf_counter() - t0) / runs
     toks = batch * new / dt
+    key = {"llama3.2-1b-geometry": "llama1b_decode_tok_s",
+           "llama3.2-3b-geometry": "llama3b_decode_tok_s"}.get(name)
     try:
         published = json.load(open("BASELINE.json"))["published"]
-        base = published.get("llama1b_decode_tok_s")
+        base = published.get(key)
     except Exception:
         base = None
     return {
-        "metric": f"llama3.2-1b-geometry decode tok/s (bs={batch}, "
+        "metric": f"{name} decode tok/s (bs={batch}, "
                   f"{jax.devices()[0].platform})",
         "value": round(toks, 2),
         "unit": "tokens/sec",
@@ -144,7 +157,7 @@ def bench_llama(tiny: bool) -> dict:
 
 def inner_main() -> None:
     tiny = jax.devices()[0].platform == "cpu"
-    which = "llama" if "llama" in sys.argv else "sd"
+    which = "llama" if any(a.startswith("llama") for a in sys.argv) else "sd"
     out = bench_llama(tiny) if which == "llama" else bench_sd(tiny)
     print(json.dumps(out))
 
@@ -167,6 +180,8 @@ def _clear_stale_locks() -> None:
 def _run_child(which: str, cpu: bool, timeout: float) -> tuple[dict | None, str]:
     """Run one measurement attempt in a child; return (result, error_tail)."""
     args = [sys.executable, os.path.abspath(__file__), "--inner", which]
+    if "llama3b" in sys.argv and "llama3b" not in args:
+        args.append("llama3b")
     if cpu:
         args.append("--cpu")
     try:
@@ -185,7 +200,7 @@ def _run_child(which: str, cpu: bool, timeout: float) -> tuple[dict | None, str]
 
 
 def main() -> None:
-    which = "llama" if "llama" in sys.argv else "sd"
+    which = "llama" if any(a.startswith("llama") for a in sys.argv) else "sd"
     unit = "tokens/sec" if which == "llama" else "images/sec"
     force_cpu = "--cpu" in sys.argv
 
@@ -230,7 +245,8 @@ if __name__ == "__main__":
             print(json.dumps({
                 "metric": "bench harness crashed",
                 "value": 0.0,
-                "unit": ("tokens/sec" if "llama" in sys.argv
+                "unit": ("tokens/sec"
+                         if any(a.startswith("llama") for a in sys.argv)
                          else "images/sec"),
                 "vs_baseline": 0.0,
                 "error": f"{type(e).__name__}: {e}"[:700],

@@ -101,6 +101,34 @@ def dot_product_attention(
                 and T * S <= _XLA_SCORE_BUDGET):
             impl = "xla"
 
+    if impl == "jax-flash":
+        # jax's shipped, block-tuned TPU flash kernel (public pallas ops) —
+        # a dispatch option for big self-attention shapes; requires MHA,
+        # no mask/bias/lengths, tiling-friendly T/S, causal only when T == S
+        # (the kernel aligns the diagonal at 0, this API's offset is S - T),
+        # and a real TPU (no interpreter mode)
+        eligible = (mask is None and bias is None and kv_lengths is None
+                    and H == k.shape[2] and T % 128 == 0 and S % 128 == 0
+                    and (not causal or T == S))
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        if eligible and on_tpu:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as jax_flash,
+            )
+
+            out = jax_flash(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=causal, sm_scale=scale)
+            return out.transpose(0, 2, 1, 3)
+        if not eligible:
+            # mirror impl="pallas": an explicit-but-ineligible request fails
+            # loudly so measured dispatch tables never time the wrong path
+            raise ValueError(
+                f"jax-flash not eligible for q={q.shape} k={k.shape} "
+                f"(mask={mask is not None}, bias={bias is not None}, "
+                f"lengths={kv_lengths is not None}, causal={causal})")
+        impl = "xla"  # eligible shape, no TPU: interpreter unsupported
+
     if impl in ("auto", "pallas"):
         # the flash kernel applies causal + length masking itself; arbitrary
         # masks and biases take the XLA path

@@ -1,0 +1,33 @@
+#!/bin/bash
+# One-shot on-chip measurement session: run everything the perf work needs
+# the moment the TPU tunnel is alive, saving output to scripts/chip_session.log.
+# Usage: bash scripts/chip_session.sh
+set -u
+cd "$(dirname "$0")/.."
+LOG=scripts/chip_session.log
+: > "$LOG"
+note() { echo "=== $* ===" | tee -a "$LOG"; }
+
+note "probe"
+timeout 120 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+print(np.asarray(x@x)[0,0]); print('tpu alive')" 2>&1 | grep -v WARNING | tee -a "$LOG"
+grep -q "tpu alive" "$LOG" || { note "TPU DEAD — aborting"; exit 1; }
+
+note "attention micro-bench (xla vs pallas vs jax-flash)"
+PYTHONPATH=$PWD:$PYTHONPATH timeout 1800 python scripts/perf_attn.py 2>&1 | grep -v WARNING | tee -a "$LOG"
+
+note "SD component breakdown (current dispatch)"
+PYTHONPATH=$PWD:$PYTHONPATH timeout 2400 python scripts/perf_sd.py 2>&1 | grep -v WARNING | tee -a "$LOG"
+
+note "bench sd"
+timeout 2700 python bench.py 2>&1 | tail -1 | tee -a "$LOG"
+
+note "bench llama (1B geometry)"
+timeout 2700 python bench.py llama 2>&1 | tail -1 | tee -a "$LOG"
+
+note "bench llama (3B geometry)"
+timeout 2700 python bench.py llama3b 2>&1 | tail -1 | tee -a "$LOG"
+
+note "done"

@@ -61,15 +61,18 @@ def bench_impl(B, T, S, H, D, impl) -> float:
 
 
 def main() -> None:
-    print(f"{'shape':16s} {'xla us':>10s} {'pallas us':>10s}  winner")
+    impls = ("xla", "pallas", "jax-flash")
+    print(f"{'shape':16s} " + " ".join(f"{i:>10s}" for i in impls) + "  winner")
     for label, B, T, S, H, D in SHAPES:
-        t_xla = bench_impl(B, T, S, H, D, "xla")
-        try:
-            t_pl = bench_impl(B, T, S, H, D, "pallas")
-        except Exception as e:
-            t_pl = float("inf")
-        win = "xla" if t_xla <= t_pl else "pallas"
-        print(f"{label:16s} {t_xla:10.1f} {t_pl:10.1f}  {win}  (T*S={T*S})")
+        times = []
+        for impl in impls:
+            try:
+                times.append(bench_impl(B, T, S, H, D, impl))
+            except Exception:
+                times.append(float("inf"))
+        win = impls[times.index(min(times))]
+        print(f"{label:16s} " + " ".join(f"{t:10.1f}" for t in times)
+              + f"  {win}  (T*S={T*S})")
 
 
 if __name__ == "__main__":

@@ -315,8 +315,8 @@ def test_engine_warm_executables_closed_set(tiny_model):
     assert n == count
     # buckets (16, 32) x prefill batch {1, 2} (max_num_seqs=3 caps the
     # power-of-two ladder) = 4, plus buckets x prefix 6 at K=1 = 2,
-    # plus ctx buckets {2, 8} = 2 decodes
-    assert count == 8
+    # plus ctx buckets {2, 8} x decode batch buckets {1, 2, 3} = 6
+    assert count == 12
     prompts = [[1, 2, 3], list(range(2, 20)), [7] * 30]
     eng.generate(prompts, SamplingParams(temperature=0.0, max_new_tokens=12))
     assert eng.n_executables == count, "post-warm request compiled a new executable"
@@ -330,9 +330,10 @@ def test_engine_decode_ctx_bucket_dispatch(tiny_model):
     assert eng._ctx_buckets == [2, 8]  # 16 tokens / bs 8, and 64/8
     sp = SamplingParams(temperature=0.0, max_new_tokens=4)
     [f] = eng.generate([[1, 2, 3]], sp)   # 3+4 tokens fit the 2-block bucket
-    assert list(eng._decode_fns) == [2]
+    # (ctx_bucket, batch_bucket): one sequence -> batch bucket 1
+    assert list(eng._decode_fns) == [(2, 1)]
     [f] = eng.generate([list(range(2, 20))], sp)  # 18+4 tokens need 8 blocks
-    assert sorted(eng._decode_fns) == [2, 8]
+    assert sorted(eng._decode_fns) == [(2, 1), (8, 1)]
 
 
 def test_batched_prefill_parity_and_one_call(tiny_model):
