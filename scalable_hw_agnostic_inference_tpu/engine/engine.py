@@ -62,8 +62,11 @@ class Request:
     # reference ``vllm_model_api_m.py:42-66``); occupy the first P positions
     prefix: Optional[np.ndarray] = None
     # mllama cross-attention states [Lv, dim] (projected vision features);
-    # attended by the gated cross layers, never part of the token sequence
+    # attended by the gated cross layers, never part of the token sequence.
+    # cross_len: valid rows (multi-tile images fill a tile-count-dependent
+    # prefix of the static buffer; 0/None = all rows valid)
     cross_states: Optional[np.ndarray] = None
+    cross_len: int = 0
     # tokens generated before a recompute-preemption (they re-enter the
     # cache as prompt suffix but remain part of the client-visible output)
     already_generated: List[int] = dataclasses.field(default_factory=list)
@@ -143,6 +146,8 @@ class LLMEngine:
         self._cross_kv = None      # mllama slot-indexed encoder cache
         self._cross_embed = None   # jitted states -> per-layer k/v
         self._has_image = np.zeros((ecfg.max_num_seqs,), np.float32)
+        self._cross_len = np.full((ecfg.max_num_seqs,), max(cross_seq_len, 1),
+                                  np.int32)
         if model_cfg.cross_attention_layers:
             from .runner import make_cross_kv, make_cross_slot_write
 
@@ -177,7 +182,8 @@ class LLMEngine:
     def add_request(self, prompt_ids: Sequence[int],
                     params: Optional[SamplingParams] = None,
                     prefix: Optional[np.ndarray] = None,
-                    cross_states: Optional[np.ndarray] = None) -> int:
+                    cross_states: Optional[np.ndarray] = None,
+                    cross_len: int = 0) -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -188,6 +194,9 @@ class LLMEngine:
                 raise ValueError(
                     f"cross_states must be [{self.cross_seq_len}, "
                     f"{self.cfg.dim}], got {cross_states.shape}")
+            if not 0 <= cross_len <= self.cross_seq_len:
+                raise ValueError(
+                    f"cross_len={cross_len} out of [0, {self.cross_seq_len}]")
         if prefix is not None and self._cross_kv is not None:
             # a prefix on a cross model would assert deep inside make_prefill
             # and kill the engine loop — reject it as a per-request error
@@ -203,7 +212,8 @@ class LLMEngine:
             prompt_ids = list(prompt_ids)[-max_prompt:]  # keep the tail
         rid = next(self._ids)
         self.waiting.append(Request(rid, list(prompt_ids), params,
-                                    prefix=prefix, cross_states=cross_states))
+                                    prefix=prefix, cross_states=cross_states,
+                                    cross_len=cross_len))
         return rid
 
     @property
@@ -305,19 +315,25 @@ class LLMEngine:
         """Project the request's vision states into the slot's cross-kv
         buffer rows (or gate the slot off for text-only). Returns the
         ``(cross_kv [1, Lv, ...], has_image [1])`` prefill args."""
+        Lv = max(self.cross_seq_len, 1)
         if req.cross_states is None:
             self._has_image[slot] = 0.0
-            return (self._cross_zeros(1), jnp.zeros((1,), jnp.float32))
+            self._cross_len[slot] = Lv
+            return (self._cross_zeros(1), jnp.zeros((1,), jnp.float32),
+                    jnp.full((1,), Lv, jnp.int32))
         per_layer = self._cross_embed(self.params,
                                       jnp.asarray(req.cross_states))
         self._cross_kv = self._cross_write(
             self._cross_kv, per_layer, jnp.int32(slot))
         self._has_image[slot] = 1.0
+        n_valid = req.cross_len or Lv
+        self._cross_len[slot] = n_valid
         # prefill arg dtype must match the warmed signature (buffer dtype)
         dt = self._cross_kv[0]["k"].dtype
         one = [{"k": c["k"][None].astype(dt), "v": c["v"][None].astype(dt)}
                for c in per_layer]
-        return (one, jnp.ones((1,), jnp.float32))
+        return (one, jnp.ones((1,), jnp.float32),
+                jnp.full((1,), n_valid, jnp.int32))
 
     def _cross_zeros(self, K: int):
         """Zero cross-kv prefill args for text-only rows, cached per K."""
@@ -401,7 +417,8 @@ class LLMEngine:
         args = [self.params, self.cache.kv, jnp.asarray(ids),
                 jnp.asarray(n_text), jnp.asarray(tables)]
         if self._cross_kv is not None:  # text-only rows through a cross model
-            args += [self._cross_zeros(Kp), jnp.zeros((Kp,), jnp.float32)]
+            args += [self._cross_zeros(Kp), jnp.zeros((Kp,), jnp.float32),
+                     jnp.full((Kp,), max(self.cross_seq_len, 1), jnp.int32)]
         self.cache.kv, logits = fn(*args)
         rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
         toks = np.asarray(self._sample1(
@@ -494,7 +511,8 @@ class LLMEngine:
             if P_:
                 args.append(jnp.zeros((K, P_, self.cfg.dim), jnp.float32))
             if self._cross_kv is not None:
-                args += [self._cross_zeros(K), jnp.zeros((K,), jnp.float32)]
+                args += [self._cross_zeros(K), jnp.zeros((K,), jnp.float32),
+                         jnp.full((K,), max(self.cross_seq_len, 1), jnp.int32)]
             self.cache.kv, logits = fn(*args)
             logits.block_until_ready()
         for (m, bb), fn in list(self._decode_fns.items()):
@@ -505,7 +523,8 @@ class LLMEngine:
                     jnp.ones((bb,), jnp.float32)]
             if self._cross_kv is not None:
                 args += [self._cross_kv, jnp.zeros((bb,), jnp.float32),
-                         jnp.zeros((bb,), jnp.int32)]
+                         jnp.zeros((bb,), jnp.int32),
+                         jnp.full((bb,), max(self.cross_seq_len, 1), jnp.int32)]
             self.cache.kv, nxt = fn(*args)
             nxt.block_until_ready()
         if self._cross_embed is not None:  # the admission-time projector
@@ -566,6 +585,7 @@ class LLMEngine:
             params,
             prefix=victim.req.prefix,
             cross_states=victim.req.cross_states,
+            cross_len=victim.req.cross_len,
             already_generated=emitted,
             orig_n_prompt=victim.req.orig_n_prompt))
 
@@ -611,6 +631,7 @@ class LLMEngine:
         topp = np.ones((Bb,), np.float32)
         slot_idx = np.zeros((Bb,), np.int32)
         has_image = np.zeros((Bb,), np.float32)
+        cross_len = np.full((Bb,), max(self.cross_seq_len, 1), np.int32)
         for i, s in enumerate(running):
             alloc = self.cache.seq(s.req.req_id)
             tokens[i] = s.pending_token
@@ -622,6 +643,7 @@ class LLMEngine:
             topp[i] = s.req.params.top_p
             slot_idx[i] = s.slot
             has_image[i] = self._has_image[s.slot]
+            cross_len[i] = self._cross_len[s.slot]
 
         rng = jax.random.fold_in(self._rng, self._step_count * 2)
         args = [self.params, self.cache.kv, jnp.asarray(tokens),
@@ -629,7 +651,7 @@ class LLMEngine:
                 rng, jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp)]
         if self._cross_kv is not None:
             args += [self._cross_kv, jnp.asarray(has_image),
-                     jnp.asarray(slot_idx)]
+                     jnp.asarray(slot_idx), jnp.asarray(cross_len)]
         self.cache.kv, nxt = decode(*args)
         nxt = np.asarray(nxt)
 

@@ -59,7 +59,7 @@ class EngineLoop:
 
     def submit(self, prompt_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
-               prefix=None, cross_states=None) -> Future:
+               prefix=None, cross_states=None, cross_len: int = 0) -> Future:
         """Enqueue a request; the future resolves to a :class:`Finished`.
 
         ``prefix``: optional soft-prefix embeddings [P, dim] (vision tokens,
@@ -71,7 +71,7 @@ class EngineLoop:
         fut: Future = Future()
         self._submit_q.put(
             (list(prompt_ids), params or SamplingParams(),
-             (prefix, cross_states), fut))
+             (prefix, cross_states, cross_len), fut))
         # close the put-after-drain window: if the loop died between our
         # _stop check and the put, nobody will ever drain this item
         if self._stop.is_set():
@@ -81,10 +81,11 @@ class EngineLoop:
     def generate(self, prompt_ids: Sequence[int],
                  params: Optional[SamplingParams] = None,
                  timeout: Optional[float] = None, prefix=None,
-                 cross_states=None) -> Finished:
+                 cross_states=None, cross_len: int = 0) -> Finished:
         """Submit and block — the serving ``infer`` path."""
         return self.submit(prompt_ids, params, prefix=prefix,
-                           cross_states=cross_states).result(timeout)
+                           cross_states=cross_states,
+                           cross_len=cross_len).result(timeout)
 
     # -- loop --------------------------------------------------------------
 
@@ -95,10 +96,11 @@ class EngineLoop:
         except queue.Empty:
             return
         while True:
-            ids, params, (prefix, cross_states), fut = item
+            ids, params, (prefix, cross_states, cross_len), fut = item
             try:
                 rid = self.engine.add_request(ids, params, prefix=prefix,
-                                              cross_states=cross_states)
+                                              cross_states=cross_states,
+                                              cross_len=cross_len)
                 with self._futures_lock:
                     self._futures[rid] = fut
             except Exception as e:  # bad request (e.g. empty prompt)

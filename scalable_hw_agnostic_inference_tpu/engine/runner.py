@@ -151,13 +151,15 @@ def make_cross_slot_write(cfg: LlamaConfig):
 
 def _cross_layer(lp: Dict, x: jax.Array, cross_k: jax.Array,
                  cross_v: jax.Array, has_image: jax.Array,
-                 cfg: LlamaConfig) -> jax.Array:
+                 cfg: LlamaConfig, cross_len=None) -> jax.Array:
     """One mllama gated cross-attention layer.
 
     ``x`` [B, T, dim]; ``cross_k/v`` [B, Lv, Hkv, Dh] (already k-normed);
     ``has_image`` [B] float gate — rows without vision states contribute
     nothing, which is exactly HF's skip-the-layer semantics for text-only
-    requests through an mllama checkpoint.
+    requests through an mllama checkpoint. ``cross_len`` [B] marks the valid
+    vision-token count per row (multi-tile images use a tile-count-dependent
+    prefix of the static Lv buffer; the rest is masked).
     """
     B, T, _ = x.shape
     ca = lp["cross_attn"]
@@ -165,7 +167,7 @@ def _cross_layer(lp: Dict, x: jax.Array, cross_k: jax.Array,
     q = _proj(h, ca["q"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
     q = _head_rmsnorm(q, ca["q_norm"]["scale"], cfg.rms_eps)
     o = dot_product_attention(q, cross_k.astype(q.dtype),
-                              cross_v.astype(q.dtype))
+                              cross_v.astype(q.dtype), kv_lengths=cross_len)
     # gate in x's dtype: an f32 gate would promote the residual stream (and
     # every downstream layer) off bf16
     gate = has_image.astype(x.dtype)[:, None, None]
@@ -208,7 +210,7 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     cross_set = set(cfg.cross_attention_layers)
 
     def _prefill_impl(params, kv, ids, n_text, block_tables, prefix=None,
-                      cross_kv=None, has_image=None):
+                      cross_kv=None, has_image=None, cross_len=None):
         p = params["params"]
         B = ids.shape[0]  # == n_seqs
         x = p["embed"]["embedding"][ids].astype(jnp.bfloat16)
@@ -226,7 +228,7 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                 # gated cross-attention over vision states: no rope, no KV
                 # pool traffic — its keys are static per request
                 x = _cross_layer(lp, x, cross_kv[ci]["k"], cross_kv[ci]["v"],
-                                 has_image, cfg)
+                                 has_image, cfg, cross_len=cross_len)
                 ci += 1
                 continue
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
@@ -253,9 +255,11 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     if cross_set:
         assert not prefix_len, "mllama prefill: cross states, not soft prefix"
 
-        def prefill(params, kv, ids, n_text, block_tables, cross_kv, has_image):
+        def prefill(params, kv, ids, n_text, block_tables, cross_kv,
+                    has_image, cross_len):
             return _prefill_impl(params, kv, ids, n_text, block_tables,
-                                 cross_kv=cross_kv, has_image=has_image)
+                                 cross_kv=cross_kv, has_image=has_image,
+                                 cross_len=cross_len)
     elif prefix_len:
         def prefill(params, kv, ids, n_text, block_tables, prefix):
             return _prefill_impl(params, kv, ids, n_text, block_tables,
@@ -270,7 +274,7 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     kvsh = sh.kv_pool(cfg.n_layers - len(cross_set))
     in_sh = [sh.params, kvsh, rep, rep, rep]
     if cross_set:
-        in_sh += [sh.cross_pool(len(cross_set)), rep]
+        in_sh += [sh.cross_pool(len(cross_set)), rep, rep]
     elif prefix_len:
         in_sh += [rep]
     return jax.jit(prefill, donate_argnums=(1,),
@@ -344,7 +348,7 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
 
     def _decode_impl(params, kv, tokens, pos, tables, active, rng,
                      temperature, top_k, top_p, cross_kv=None, has_image=None,
-                     slot_idx=None):
+                     slot_idx=None, cross_len=None):
         p = params["params"]
         B = max_num_seqs
         tables = tables[:, :m_ctx]
@@ -369,7 +373,8 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                 # attention read)
                 ck = cross_kv[ci]["k"][slot_idx]
                 cv = cross_kv[ci]["v"][slot_idx]
-                x = _cross_layer(lp, x, ck, cv, has_image, cfg)
+                x = _cross_layer(lp, x, ck, cv, has_image, cfg,
+                                 cross_len=cross_len)
                 ci += 1
                 continue
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
@@ -400,11 +405,12 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
 
     if cross_set:
         def decode(params, kv, tokens, pos, tables, active, rng,
-                   temperature, top_k, top_p, cross_kv, has_image, slot_idx):
+                   temperature, top_k, top_p, cross_kv, has_image, slot_idx,
+                   cross_len):
             return _decode_impl(params, kv, tokens, pos, tables, active, rng,
                                 temperature, top_k, top_p,
                                 cross_kv=cross_kv, has_image=has_image,
-                                slot_idx=slot_idx)
+                                slot_idx=slot_idx, cross_len=cross_len)
     else:
         def decode(params, kv, tokens, pos, tables, active, rng,
                    temperature, top_k, top_p):
@@ -417,6 +423,6 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     kvsh = sh.kv_pool(cfg.n_layers - len(cross_set))
     in_sh = (sh.params, kvsh) + (rep,) * 8
     if cross_set:
-        in_sh += (sh.cross_pool(len(cross_set)), rep, rep)
+        in_sh += (sh.cross_pool(len(cross_set)), rep, rep, rep)
     return jax.jit(decode, donate_argnums=(1,),
                    in_shardings=in_sh, out_shardings=(kvsh, rep))

@@ -230,6 +230,77 @@ class MllamaProjector(nn.Module):
 
 
 # ---------------------------------------------------------------------------
+# image preprocessing (HF MllamaImageProcessor tiling, minus the dependency)
+# ---------------------------------------------------------------------------
+
+CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+def optimal_canvas(h: int, w: int, supported, tile: int):
+    """HF ``get_optimal_tiled_canvas``: smallest upscale if possible, else
+    least downscale; ties broken by minimum area."""
+    import numpy as np
+
+    grids = np.array(supported)                    # [(th, tw)]
+    canvases = grids * tile
+    scale_h = canvases[:, 0] / h
+    scale_w = canvases[:, 1] / w
+    scales = np.minimum(scale_h, scale_w)
+    up = scales[scales >= 1]
+    sel = np.min(up) if len(up) else np.max(scales[scales < 1])
+    cands = canvases[scales == sel]
+    areas = cands[:, 0] * cands[:, 1]
+    return tuple(int(x) for x in cands[int(np.argmin(areas))])
+
+
+def fit_to_canvas(h: int, w: int, ch: int, cw: int, tile: int):
+    """HF ``get_image_size_fit_to_canvas`` (aspect-preserving)."""
+    th = min(max(h, tile), ch)
+    tw = min(max(w, tile), cw)
+    scale_h, scale_w = th / h, tw / w
+    if scale_w < scale_h:
+        return min(math.floor(h * scale_w) or 1, th), tw
+    return th, min(math.floor(w * scale_h) or 1, tw)
+
+
+def preprocess_tiled(img, cfg: MllamaVisionConfig, supported,
+                     mean=CLIP_MEAN, std=CLIP_STD):
+    """PIL image → (tiles ``[max_num_tiles, ts, ts, 3]`` normalized,
+    zero-padded, NHWC), aspect ratio id, valid tile count.
+
+    Mirrors HF's processor: canvas selection, aspect-preserving resize,
+    rescale + normalize (``mean``/``std`` come from the checkpoint's
+    preprocessor_config.json; CLIP stats by default), zero-pad to the
+    canvas, split into tiles (row-major), pad the tile dim to
+    ``max_num_tiles``.
+    """
+    import numpy as np
+
+    from PIL import Image
+
+    ts = cfg.image_size
+    img = img.convert("RGB")
+    ch, cw = optimal_canvas(img.height, img.width, supported, ts)
+    nh, nw = fit_to_canvas(img.height, img.width, ch, cw, ts)
+    img = img.resize((nw, nh), Image.BILINEAR)  # HF processor's resample
+    arr = np.asarray(img, np.float32) / 255.0
+    # HF pads the RAW rescaled canvas with zeros, then normalizes — padding
+    # pixels land at (0 - mean) / std, not 0
+    canvas = np.zeros((ch, cw, 3), np.float32)
+    canvas[:nh, :nw] = arr
+    canvas = (canvas - np.asarray(mean, np.float32)) / np.asarray(
+        std, np.float32)
+    th, tw = ch // ts, cw // ts
+    tiles = canvas.reshape(th, ts, tw, ts, 3).transpose(0, 2, 1, 3, 4)
+    tiles = tiles.reshape(th * tw, ts, ts, 3)
+    out = np.zeros((cfg.max_num_tiles, ts, ts, 3), np.float32)
+    out[: th * tw] = tiles
+    ar_id = list(map(list, supported)).index([th, tw]) + 1
+    return out, ar_id, th * tw
+
+
+# ---------------------------------------------------------------------------
 # checkpoint conversion (HF MllamaForConditionalGeneration vision side)
 # ---------------------------------------------------------------------------
 

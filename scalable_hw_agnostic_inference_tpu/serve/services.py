@@ -261,9 +261,11 @@ def _load_mllama(cfg: ServeConfig, model_id: str, hf_cfg=None):
     The actual mllama layout (VERDICT r2 missing #4), not a LLaVA stand-in:
     the tiled two-stage vision encoder + projector produce cross-attention
     states the engine's cross layers attend (``engine.runner._cross_layer``).
-    Single-tile preprocessing (the image resized to one tile, remaining tile
-    slots zero-padded exactly like the HF processor) — the valid states are
-    the first ``patches+1`` rows, so ``cross_seq_len = patches + 1``.
+    Preprocessing reproduces the HF processor's tiling (canvas selection,
+    aspect-preserving resize, pad, split — ``models.mllama.preprocess_tiled``,
+    parity-tested); the engine's static buffer holds
+    ``cross_seq_len = max_num_tiles * (patches+1)`` rows, of which the first
+    ``n_tiles * (patches+1)`` are valid per request (``cross_len``).
     """
     import torch  # noqa: F401
     from transformers import AutoConfig, AutoModelForImageTextToText
@@ -295,23 +297,43 @@ def _load_mllama(cfg: ServeConfig, model_id: str, hf_cfg=None):
     vparams = jax.device_put(cast_f32_to_bf16(vparams))
     pparams = jax.device_put(cast_f32_to_bf16(pparams))
     P1 = vcfg.n_patches + 1
-    # single tile: aspect ratio [1, 1]; HF ids are 1-based into the
-    # supported list, with 0 reserved for padding
     supported = list(getattr(hf_cfg.vision_config, "supported_aspect_ratios",
                              [[1, 1]]))
-    ar_id = supported.index([1, 1]) + 1 if [1, 1] in supported else 1
-    ar_ids = jnp.asarray([ar_id], jnp.int32)
-    ar_mask = jnp.zeros((1, vcfg.max_num_tiles), jnp.int32).at[0, 0].set(1)
+    # normalization stats from the checkpoint's preprocessor config (real
+    # Llama-3.2-Vision ships its own); CLIP stats as the fallback
+    img_mean, img_std = mllama.CLIP_MEAN, mllama.CLIP_STD
+    try:
+        from transformers import AutoImageProcessor
 
-    def encode_image(px):  # [1, H, W, 3] -> [P1, dim] cross states
-        tiles = jnp.zeros((1, vcfg.max_num_tiles, vcfg.image_size,
-                           vcfg.image_size, 3), px.dtype).at[:, 0].set(px)
+        ip = AutoImageProcessor.from_pretrained(model_id,
+                                                token=cfg.hf_token or None)
+        if getattr(ip, "image_mean", None) and getattr(ip, "image_std", None):
+            img_mean, img_std = tuple(ip.image_mean), tuple(ip.image_std)
+    except Exception:
+        pass
+
+    @jax.jit
+    def _encode(tiles, ar_ids, ar_mask):
+        # tiles [1, max_num_tiles, ts, ts, 3] -> [max_tiles*P1, dim] states
         feats = vm.apply(vparams, tiles, ar_ids, ar_mask)
-        states = proj.apply(pparams, feats)   # [1, T*P1, dim]
-        return states[0, :P1].astype(jnp.float32)
+        return proj.apply(pparams, feats)[0].astype(jnp.float32)
 
+    def encode_image(img):
+        """PIL image → (cross_states [Lv, dim], n_valid) with HF's tiling
+        (``models.mllama.preprocess_tiled``); the valid states are the
+        first ``n_tiles * P1`` rows (tiles lead the flattened layout)."""
+        tiles, ar_id, n_tiles = mllama.preprocess_tiled(
+            img, vcfg, supported, mean=img_mean, std=img_std)
+        ar_mask = np.zeros((1, vcfg.max_num_tiles), np.int32)
+        ar_mask[0, :n_tiles] = 1
+        states = _encode(jnp.asarray(tiles)[None],
+                         jnp.asarray([ar_id], jnp.int32),
+                         jnp.asarray(ar_mask))
+        return np.asarray(states), n_tiles * P1
+
+    lv = vcfg.max_num_tiles * P1
     tokenizer = _hf_tokenizer(model_id, cfg.hf_token)
-    return mcfg, params, vcfg, jax.jit(encode_image), P1, tokenizer
+    return mcfg, params, vcfg, encode_image, lv, tokenizer
 
 
 def _autoconfig_of(cfg: ServeConfig, model_id: str):
@@ -886,9 +908,11 @@ class VllmService(ModelService):
             self._vision[1](jnp.zeros(
                 (1, vcfg.image_size, vcfg.image_size, 3))).block_until_ready()
         if self._mllama is not None:  # so is the mllama vision front-end
-            mvcfg, encode_image, _p1 = self._mllama
-            encode_image(jnp.zeros(
-                (1, mvcfg.image_size, mvcfg.image_size, 3))).block_until_ready()
+            from PIL import Image
+
+            mvcfg, encode_image, _lv = self._mllama
+            encode_image(Image.new(
+                "RGB", (mvcfg.image_size, mvcfg.image_size), (127, 127, 127)))
         # compile the CLOSED executable set — every (bucket, prefix) prefill
         # plus every context-bucket decode — BEFORE the engine loop starts
         # serving, so no post-ready request ever eats an XLA compile (the
@@ -959,17 +983,25 @@ class VllmService(ModelService):
                 f"MAX_NEW_TOKENS={self.ecfg.max_new_tokens}")
         prefix = None
         cross_states = None
+        cross_len = 0
         if payload.get("image_b64"):
             if self._mllama is not None:
-                mvcfg, encode_image, _p1 = self._mllama
+                from PIL import Image
+
+                mvcfg, encode_image, _lv = self._mllama
+                b64 = payload["image_b64"]
                 try:
-                    px = decode_image(
-                        payload, mvcfg.image_size,
-                        mean=(0.48145466, 0.4578275, 0.40821073),   # CLIP
-                        std=(0.26862954, 0.26130258, 0.27577711))
+                    if b64 == "random":  # benchmark/warm contract
+                        rng = np.random.default_rng(0)
+                        img = Image.fromarray(rng.integers(
+                            0, 255, (mvcfg.image_size, mvcfg.image_size, 3),
+                            np.uint8), "RGB")
+                    else:
+                        img = Image.open(io.BytesIO(base64.b64decode(b64)))
+                        img.load()
                 except Exception as e:
                     raise HTTPError(400, f"bad image_b64: {type(e).__name__}")
-                cross_states = np.asarray(encode_image(jnp.asarray(px)))
+                cross_states, cross_len = encode_image(img)
             elif self._vision is not None:
                 vcfg, vision_fn = self._vision
                 try:
@@ -982,7 +1014,8 @@ class VllmService(ModelService):
                     400, "this deployment's model has no vision tower; "
                          "multimodal requests need a VLM unit")
         fin = self.loop.generate(ids, params, timeout=600.0, prefix=prefix,
-                                 cross_states=cross_states)
+                                 cross_states=cross_states,
+                                 cross_len=cross_len)
         if fin.stop_reason == "rejected":
             raise HTTPError(503, "request rejected: prompt cannot fit the KV pool")
         return {

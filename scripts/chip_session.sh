@@ -16,10 +16,10 @@ print(np.asarray(x@x)[0,0]); print('tpu alive')" 2>&1 | grep -v WARNING | tee -a
 grep -q "tpu alive" "$LOG" || { note "TPU DEAD — aborting"; exit 1; }
 
 note "attention micro-bench (xla vs pallas vs jax-flash)"
-PYTHONPATH=$PWD:$PYTHONPATH timeout 1800 python scripts/perf_attn.py 2>&1 | grep -v WARNING | tee -a "$LOG"
+PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 1800 python scripts/perf_attn.py 2>&1 | grep -v WARNING | tee -a "$LOG"
 
 note "SD component breakdown (current dispatch)"
-PYTHONPATH=$PWD:$PYTHONPATH timeout 2400 python scripts/perf_sd.py 2>&1 | grep -v WARNING | tee -a "$LOG"
+PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 2400 python scripts/perf_sd.py 2>&1 | grep -v WARNING | tee -a "$LOG"
 
 note "bench sd"
 timeout 2700 python bench.py 2>&1 | tail -1 | tee -a "$LOG"

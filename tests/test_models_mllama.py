@@ -151,7 +151,8 @@ def test_cross_attention_prefill_logits_match_hf(hf_model):
     table = jnp.asarray([[1, 2, 0, 0]], jnp.int32)
     _, logits = fn(params, cache.kv, jnp.asarray(ids),
                    jnp.asarray([len(prompt)], jnp.int32), table,
-                   cross1, jnp.ones((1,), jnp.float32))
+                   cross1, jnp.ones((1,), jnp.float32),
+                   jnp.full((1,), Lv, jnp.int32))
     # bf16 activations inside the engine path vs HF fp32: loose-ish bars
     np.testing.assert_allclose(np.asarray(logits)[0], want, rtol=0.1,
                                atol=0.1)
@@ -269,3 +270,67 @@ async def test_vllm_service_serves_mllama_checkpoint(hf_model, tmp_path):
         r_img2 = await c.post("/generate", json={**base, "image_b64": img})
         assert (r_img2.json()["generated_text"]
                 == r_img.json()["generated_text"])
+
+
+def test_tiled_preprocessing_matches_hf_processor(hf_model):
+    """Our tiling (canvas pick, fit-resize, normalize, pad, split) matches
+    the HF MllamaImageProcessor output for a non-square image."""
+    from PIL import Image
+    from transformers.models.mllama.image_processing_mllama import (
+        MllamaImageProcessor,
+    )
+
+    vcfg = mllama.MllamaVisionConfig.from_hf(hf_model.config.vision_config)
+    supported = hf_model.config.vision_config.supported_aspect_ratios
+    proc = MllamaImageProcessor(
+        size={"height": vcfg.image_size, "width": vcfg.image_size},
+        max_image_tiles=vcfg.max_num_tiles)
+    rng = np.random.default_rng(0)
+    img = Image.fromarray(
+        rng.integers(0, 255, (40, 70, 3), np.uint8), "RGB")  # wide: 1x2 grid
+
+    want = proc(images=img, return_tensors="np")
+    tiles, ar_id, n_tiles = mllama.preprocess_tiled(
+        img, vcfg, supported, mean=tuple(proc.image_mean),
+        std=tuple(proc.image_std))
+    assert ar_id == int(want["aspect_ratio_ids"][0, 0])
+    assert n_tiles == int(want["aspect_ratio_mask"][0, 0].sum())
+    got = tiles.transpose(0, 3, 1, 2)  # NHWC -> NCHW for comparison
+    np.testing.assert_allclose(got, want["pixel_values"][0, 0], rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_engine_cross_len_masks_padding_states(hf_model):
+    """A request whose image fills only part of the static Lv buffer must
+    ignore the padding rows: output equals a run where padding rows carry
+    garbage."""
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+
+    hf_cfg = hf_model.config
+    mcfg = llama.LlamaConfig.from_hf(hf_cfg.text_config)
+    params = llama.params_from_torch(_lm_state_dict(hf_model.state_dict()),
+                                     mcfg)
+    Lv, valid = 34, 17  # one of two tiles valid
+    ecfg = EngineConfig(max_model_len=64, max_num_seqs=2, block_size=8,
+                        context_encoding_buckets=(16,), max_new_tokens=8)
+    rng = np.random.default_rng(3)
+    base = rng.standard_normal((Lv, mcfg.dim)).astype(np.float32)
+    garbage = base.copy()
+    garbage[valid:] = 1e3 * rng.standard_normal((Lv - valid, mcfg.dim))
+    prompt = [5, 17, 42]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+
+    def run(states, n):
+        eng = LLMEngine(mcfg, params, ecfg, cross_seq_len=Lv)
+        rid = eng.add_request(prompt, sp, cross_states=states, cross_len=n)
+        done = {}
+        while eng.has_work:
+            for f in eng.step():
+                done[f.req_id] = f
+        return done[rid].token_ids
+
+    assert run(base, valid) == run(garbage, valid)
