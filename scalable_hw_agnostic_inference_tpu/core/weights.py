@@ -75,14 +75,29 @@ def load_meta(root: str, key: str) -> Dict[str, Any]:
 
 
 def get_or_convert(root: str, key: str, convert_fn, meta_fn=None,
-                   like: Any = None) -> Tuple[Any, Dict[str, Any]]:
+                   like: Any = None, required_meta=()) -> Tuple[Any, Dict[str, Any]]:
     """Load the artifact if present, else run ``convert_fn()`` (the torch
-    path) and persist its result. Returns ``(params, meta)``."""
+    path) and persist its result. Returns ``(params, meta)``.
+
+    ``convert_fn`` may return either ``params`` or ``(params, meta)``
+    (``meta_fn`` then unused). ``required_meta`` names keys the artifact's
+    meta must carry — a partial artifact (e.g. a meta write that failed on
+    an old store) falls back to conversion instead of crash-looping the
+    serving pod on a KeyError.
+    """
     if has_params(root, key):
-        log.info("weights %s: loading artifact (skipping torch convert)", key)
-        return load_params(root, key, like=like), load_meta(root, key)
-    params = convert_fn()
-    meta = meta_fn() if meta_fn else {}
+        meta = load_meta(root, key)
+        if all(k in meta for k in required_meta):
+            log.info("weights %s: loading artifact (skipping torch convert)",
+                     key)
+            return load_params(root, key, like=like), meta
+        log.warning("weights %s: artifact missing meta keys %s — reconverting",
+                    key, [k for k in required_meta if k not in meta])
+    out = convert_fn()
+    if isinstance(out, tuple):
+        params, meta = out
+    else:
+        params, meta = out, (meta_fn() if meta_fn else {})
     try:
         save_params(root, key, params, meta)
     except Exception:

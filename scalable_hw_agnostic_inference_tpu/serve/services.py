@@ -267,50 +267,78 @@ def _load_mllama(cfg: ServeConfig, model_id: str, hf_cfg=None):
     ``cross_seq_len = max_num_tiles * (patches+1)`` rows, of which the first
     ``n_tiles * (patches+1)`` are valid per request (``cross_len``).
     """
-    import torch  # noqa: F401
-    from transformers import AutoConfig, AutoModelForImageTextToText
-
+    from ..core import weights as wstore
     from ..models import llama, mllama
     from ..models.convert import cast_f32_to_bf16
 
-    if hf_cfg is None:
-        hf_cfg = AutoConfig.from_pretrained(model_id,
-                                            token=cfg.hf_token or None)
-    tm = AutoModelForImageTextToText.from_pretrained(
-        model_id, token=cfg.hf_token or None)
-    sd = tm.state_dict()
-    mcfg = llama.LlamaConfig.from_hf(hf_cfg.text_config)
-    vcfg = mllama.MllamaVisionConfig.from_hf(hf_cfg.vision_config)
-    vparams, pparams = mllama.vision_params_from_torch(sd, vcfg, mcfg.dim)
-    if any(k.startswith("language_model.") for k in sd):
-        lm_sd = {k[len("language_model."):]: v for k, v in sd.items()
-                 if k.startswith("language_model.")}
-    else:
-        lm_sd = {k[len("model.language_model."):]: v for k, v in sd.items()
-                 if k.startswith("model.language_model.")}
-        lm_sd.update({k: v for k, v in sd.items() if k.startswith("lm_head.")})
-    del tm
-    params = cast_f32_to_bf16(llama.params_from_torch(lm_sd, mcfg))
+    def _convert():
+        # the torch path: convert the checkpoint + collect preprocessing meta
+        import torch  # noqa: F401
+        from transformers import AutoConfig, AutoModelForImageTextToText
+
+        hcfg = hf_cfg
+        if hcfg is None:
+            hcfg = AutoConfig.from_pretrained(model_id,
+                                              token=cfg.hf_token or None)
+        tm = AutoModelForImageTextToText.from_pretrained(
+            model_id, token=cfg.hf_token or None)
+        sd = tm.state_dict()
+        mcfg = llama.LlamaConfig.from_hf(hcfg.text_config)
+        vcfg = mllama.MllamaVisionConfig.from_hf(hcfg.vision_config)
+        vparams, pparams = mllama.vision_params_from_torch(sd, vcfg, mcfg.dim)
+        if any(k.startswith("language_model.") for k in sd):
+            lm_sd = {k[len("language_model."):]: v for k, v in sd.items()
+                     if k.startswith("language_model.")}
+        else:
+            lm_sd = {k[len("model.language_model."):]: v for k, v in sd.items()
+                     if k.startswith("model.language_model.")}
+            lm_sd.update({k: v for k, v in sd.items()
+                          if k.startswith("lm_head.")})
+        del tm
+        tree = {"text": cast_f32_to_bf16(llama.params_from_torch(lm_sd, mcfg)),
+                "vision": cast_f32_to_bf16(vparams),
+                "proj": cast_f32_to_bf16(pparams)}
+        supported = list(getattr(hcfg.vision_config,
+                                 "supported_aspect_ratios", [[1, 1]]))
+        # normalization stats from the checkpoint's preprocessor config
+        # (real Llama-3.2-Vision ships its own); CLIP stats as the fallback
+        img_mean, img_std = mllama.CLIP_MEAN, mllama.CLIP_STD
+        try:
+            from transformers import AutoImageProcessor
+
+            ip = AutoImageProcessor.from_pretrained(
+                model_id, token=cfg.hf_token or None)
+            if (getattr(ip, "image_mean", None)
+                    and getattr(ip, "image_std", None)):
+                img_mean = tuple(ip.image_mean)
+                img_std = tuple(ip.image_std)
+        except Exception:
+            pass
+        meta = {"text_config": wstore.config_meta(mcfg),
+                "vision_config": wstore.config_meta(vcfg),
+                "supported_aspect_ratios": [list(x) for x in supported],
+                "image_mean": list(img_mean), "image_std": list(img_std)}
+        return tree, meta
+
+    tree, meta = wstore.get_or_convert(
+        cfg.artifact_root, f"mllama--{model_id}", _convert,
+        required_meta=("text_config", "vision_config",
+                       "supported_aspect_ratios", "image_mean", "image_std"))
+    mcfg = llama.LlamaConfig(**meta["text_config"])
+    vcfg = mllama.MllamaVisionConfig(**{
+        **meta["vision_config"],
+        "intermediate_layers_indices": tuple(
+            meta["vision_config"]["intermediate_layers_indices"])})
+    supported = [list(x) for x in meta["supported_aspect_ratios"]]
+    img_mean = tuple(meta["image_mean"])
+    img_std = tuple(meta["image_std"])
+    params, vparams, pparams = tree["text"], tree["vision"], tree["proj"]
 
     vm = mllama.MllamaVisionModel(vcfg, dtype=jnp.bfloat16)
     proj = mllama.MllamaProjector(vcfg, mcfg.dim, dtype=jnp.bfloat16)
-    vparams = jax.device_put(cast_f32_to_bf16(vparams))
-    pparams = jax.device_put(cast_f32_to_bf16(pparams))
+    vparams = jax.device_put(vparams)
+    pparams = jax.device_put(pparams)
     P1 = vcfg.n_patches + 1
-    supported = list(getattr(hf_cfg.vision_config, "supported_aspect_ratios",
-                             [[1, 1]]))
-    # normalization stats from the checkpoint's preprocessor config (real
-    # Llama-3.2-Vision ships its own); CLIP stats as the fallback
-    img_mean, img_std = mllama.CLIP_MEAN, mllama.CLIP_STD
-    try:
-        from transformers import AutoImageProcessor
-
-        ip = AutoImageProcessor.from_pretrained(model_id,
-                                                token=cfg.hf_token or None)
-        if getattr(ip, "image_mean", None) and getattr(ip, "image_std", None):
-            img_mean, img_std = tuple(ip.image_mean), tuple(ip.image_std)
-    except Exception:
-        pass
 
     @jax.jit
     def _encode(tiles, ar_ids, ar_mask):
@@ -375,14 +403,10 @@ def _load_causal_lm(cfg: ServeConfig, model_id: str):
 
     from ..core import weights as wstore
 
-    key = f"causal-lm--{model_id}"
-    if wstore.has_params(cfg.artifact_root, key):
-        # artifact path: no torch import, no HF model download — the
-        # reference's COMPILED_MODEL_ID pull, orbax-shaped (SURVEY.md §5)
-        meta = wstore.load_meta(cfg.artifact_root, key)
-        mcfg = llama.LlamaConfig(**meta["config"])
-        params = wstore.load_params(cfg.artifact_root, key)
-    else:
+    def _convert():
+        # torch path — the reference's COMPILED_MODEL_ID pull, orbax-shaped
+        # (SURVEY.md §5); bf16 on device: the module computes in bf16
+        # regardless, and fp32 placement would double HBM
         import torch  # noqa: F401
         from transformers import AutoModelForCausalLM
 
@@ -391,15 +415,14 @@ def _load_causal_lm(cfg: ServeConfig, model_id: str):
         tm = AutoModelForCausalLM.from_pretrained(
             model_id, token=cfg.hf_token or None)
         mcfg = llama.LlamaConfig.from_hf(tm.config)
-        # bf16 on device: the module computes in bf16 regardless, and fp32
-        # placement would double HBM (8B fp32 > one v5e chip)
         params = cast_f32_to_bf16(llama.params_from_torch(tm, mcfg))
         del tm
-        try:
-            wstore.save_params(cfg.artifact_root, key, params,
-                               {"config": wstore.config_meta(mcfg)})
-        except Exception:
-            log.exception("weight-artifact save failed (serving anyway)")
+        return params, {"config": wstore.config_meta(mcfg)}
+
+    params, meta = wstore.get_or_convert(
+        cfg.artifact_root, f"causal-lm--{model_id}", _convert,
+        required_meta=("config",))
+    mcfg = llama.LlamaConfig(**meta["config"])
     model = llama.LlamaForCausalLM(mcfg, dtype=jnp.bfloat16)
     tokenizer = _hf_tokenizer(model_id, cfg.hf_token)
     # `is not None` (not truthiness): token id 0 is a legitimate id

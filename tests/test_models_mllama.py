@@ -245,6 +245,7 @@ async def test_vllm_service_serves_mllama_checkpoint(hf_model, tmp_path):
 
     cfg = ServeConfig(app="mllama", model_id=str(ckpt), device="cpu",
                       max_seq_len=32, max_new_tokens=8,
+                      artifact_root=str(tmp_path / "artifacts"),
                       vllm_config="/nonexistent.yaml")
     service = get_model("vllm")(cfg)
     app = create_app(cfg, service)
@@ -334,3 +335,59 @@ def test_engine_cross_len_masks_padding_states(hf_model):
         return done[rid].token_ids
 
     assert run(base, valid) == run(garbage, valid)
+
+
+@pytest.mark.asyncio
+async def test_mllama_artifact_boot_skips_torch(hf_model, tmp_path,
+                                                monkeypatch):
+    """Second boot from the same artifact root restores the converted trees
+    (orbax) without touching the HF torch model — the compile-Job →
+    serving-pod artifact flow for the multimodal unit."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from transformers import PreTrainedTokenizerFast
+
+    import transformers
+
+    from scalable_hw_agnostic_inference_tpu.core import weights as wstore
+    from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    ckpt = tmp_path / "mllama-tiny"
+    hf_model.save_pretrained(ckpt)
+    vocab = {f"tok{i}": i for i in range(125)}
+    vocab.update({"<pad>": 125, "<s>": 126, "</s>": 127})
+    tok = Tokenizer(WordLevel(vocab, unk_token="tok0"))
+    tok.pre_tokenizer = Whitespace()
+    PreTrainedTokenizerFast(
+        tokenizer_object=tok, pad_token="<pad>", bos_token="<s>",
+        eos_token="</s>").save_pretrained(ckpt)
+
+    def make(app):
+        cfg = ServeConfig(app=app, model_id=str(ckpt), device="cpu",
+                          max_seq_len=32, max_new_tokens=8,
+                          artifact_root=str(tmp_path / "artifacts"),
+                          vllm_config="/nonexistent.yaml")
+        return get_model("vllm")(cfg)
+
+    svc = make("m1")
+    svc.load()
+    key = f"mllama--{ckpt}"
+    assert wstore.has_params(str(tmp_path / "artifacts"), key)
+    want = svc.infer({"prompt": "tok5 tok9", "temperature": 0.0,
+                      "max_new_tokens": 4})
+    svc.loop.stop()
+
+    # second boot: the torch model class must never be constructed
+    def boom(*a, **k):
+        raise AssertionError("artifact boot must not load the torch model")
+
+    monkeypatch.setattr(transformers.AutoModelForImageTextToText,
+                        "from_pretrained", boom)
+    svc2 = make("m2")
+    svc2.load()
+    got = svc2.infer({"prompt": "tok5 tok9", "temperature": 0.0,
+                      "max_new_tokens": 4})
+    assert got["generated_text"] == want["generated_text"]
+    svc2.loop.stop()
