@@ -156,6 +156,16 @@ def bench_llama(tiny: bool) -> dict:
 
 
 def inner_main() -> None:
+    if "--probe" in sys.argv:
+        # liveness: a real device round-trip (completion signals can lie
+        # over the tunnel — only a host transfer proves execution)
+        import numpy as np
+
+        x = jnp.ones((128, 128), jnp.bfloat16)
+        np.asarray(x @ x)
+        print(json.dumps({"metric": "probe", "value": 1.0, "unit": "ok",
+                          "vs_baseline": 1.0}))
+        return
     tiny = jax.devices()[0].platform == "cpu"
     which = "llama" if any(a.startswith("llama") for a in sys.argv) else "sd"
     out = bench_llama(tiny) if which == "llama" else bench_sd(tiny)
@@ -208,6 +218,16 @@ def main() -> None:
     attempts = 1 if force_cpu else 3
     for i in range(attempts):
         _clear_stale_locks()
+        if not force_cpu:
+            # cheap liveness gate: a WEDGED tunnel hangs in backend init
+            # without erroring — probing first (3 min cap) keeps a dead
+            # backend from burning the full measurement timeout per attempt
+            probe, perr = _run_child("--probe", cpu=False, timeout=180)
+            if probe is None:
+                last_err = f"device probe failed: {perr}"
+                if i + 1 < attempts:
+                    time.sleep(20 * (i + 1))
+                continue
         out, last_err = _run_child(which, force_cpu, timeout=2400)
         if out is not None:
             print(json.dumps(out))

@@ -839,11 +839,21 @@ class VllmService(ModelService):
         model_id = ecfg.model or cfg.model_id
         vlm_parts = None
         self._mllama = None
-        hf_cfg = _autoconfig_of(cfg, model_id)
-        is_vlm = (hf_cfg is not None and hasattr(hf_cfg, "vision_config")
-                  and hasattr(hf_cfg, "text_config"))
+        # a populated mllama artifact routes the boot by itself — a serving
+        # pod with the artifacts PVC must not need hub access to know what
+        # architecture it is serving
+        from ..core import weights as wstore
+
+        has_mllama_artifact = (
+            model_id not in ("", "tiny")
+            and wstore.has_params(cfg.artifact_root, f"mllama--{model_id}"))
+        hf_cfg = None if has_mllama_artifact else _autoconfig_of(cfg, model_id)
+        is_vlm = has_mllama_artifact or (
+            hf_cfg is not None and hasattr(hf_cfg, "vision_config")
+            and hasattr(hf_cfg, "text_config"))
         if is_vlm:
-            if getattr(hf_cfg, "model_type", "") == "mllama":
+            if (has_mllama_artifact
+                    or getattr(hf_cfg, "model_type", "") == "mllama"):
                 # Llama-3.2-Vision: gated cross-attention architecture —
                 # the reference's actual multimodal unit
                 # (cova/mllama-32-11b-vllm-trn1-config.yaml)

@@ -30,4 +30,7 @@ timeout 2700 python bench.py llama 2>&1 | tail -1 | tee -a "$LOG"
 note "bench llama (3B geometry)"
 timeout 2700 python bench.py llama3b 2>&1 | tail -1 | tee -a "$LOG"
 
+note "paged vs dense decode attention"
+PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 2400 python scripts/perf_paged.py 2>&1 | grep -v WARNING | tee -a "$LOG"
+
 note "done"
