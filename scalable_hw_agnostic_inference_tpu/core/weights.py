@@ -28,6 +28,13 @@ def _dir_for(root: str, key: str) -> str:
     return os.path.join(root, "weights", safe)
 
 
+def aux_dir(root: str, key: str, name: str) -> str:
+    """Path for a named auxiliary artifact (e.g. tokenizer files) living
+    alongside the weight checkpoint of ``key`` — pulled with the same PVC,
+    so a hub-less pod boots fully from the artifact root."""
+    return os.path.join(_dir_for(root, key), name)
+
+
 def save_params(root: str, key: str, params: Any,
                 meta: Optional[Dict[str, Any]] = None) -> str:
     """Persist a param pytree (+ JSON-able metadata). Returns the dir."""

@@ -55,10 +55,37 @@ class HashTokenizer:
         return np.array(ids), np.array(mask)
 
 
-def _hf_tokenizer(model_id: str, token: str = ""):
+def _hf_tokenizer(model_id: str, token: str = "", cache: str = ""):
+    """Load an HF tokenizer, optionally backed by an artifact-local copy.
+
+    ``cache`` names a directory under the weight artifact (the reference's
+    COMPILED_MODEL_ID pull carries tokenizer files alongside the NEFFs, so a
+    hub-less pod still boots). First hub fetch persists the files there; a
+    later boot with the artifacts PVC but no hub access restores from it.
+    """
+    import os
+    import shutil
+
     from transformers import AutoTokenizer
 
-    return AutoTokenizer.from_pretrained(model_id, token=token or None)
+    if cache and os.path.isdir(cache):
+        try:
+            return AutoTokenizer.from_pretrained(cache)
+        except Exception:
+            # a torn save must not poison every later boot — fall through
+            # to the hub path, which rewrites the cache
+            log.exception("tokenizer artifact unreadable — refetching")
+    tok = AutoTokenizer.from_pretrained(model_id, token=token or None)
+    if cache:
+        try:
+            tmp = f"{cache}.{os.getpid()}.tmp"
+            tok.save_pretrained(tmp)
+            if os.path.isdir(cache):
+                shutil.rmtree(cache)
+            os.rename(tmp, cache)  # a crash leaves only the .tmp dir behind
+        except Exception:
+            log.exception("tokenizer artifact save failed (serving anyway)")
+    return tok
 
 
 IMAGENET_MEAN = (0.485, 0.456, 0.406)
@@ -360,7 +387,8 @@ def _load_mllama(cfg: ServeConfig, model_id: str, hf_cfg=None):
         return np.asarray(states), n_tiles * P1
 
     lv = vcfg.max_num_tiles * P1
-    tokenizer = _hf_tokenizer(model_id, cfg.hf_token)
+    tokenizer = _hf_tokenizer(model_id, cfg.hf_token, cache=wstore.aux_dir(
+        cfg.artifact_root, f"mllama--{model_id}", "tokenizer"))
     return mcfg, params, vcfg, encode_image, lv, tokenizer
 
 
@@ -424,7 +452,8 @@ def _load_causal_lm(cfg: ServeConfig, model_id: str):
         required_meta=("config",))
     mcfg = llama.LlamaConfig(**meta["config"])
     model = llama.LlamaForCausalLM(mcfg, dtype=jnp.bfloat16)
-    tokenizer = _hf_tokenizer(model_id, cfg.hf_token)
+    tokenizer = _hf_tokenizer(model_id, cfg.hf_token, cache=wstore.aux_dir(
+        cfg.artifact_root, f"causal-lm--{model_id}", "tokenizer"))
     # `is not None` (not truthiness): token id 0 is a legitimate id
     eos = tokenizer.eos_token_id
     if eos is None:

@@ -1,0 +1,64 @@
+#!/bin/bash
+# Bench watcher: keep probing the device tunnel and run each missing bench
+# the moment it is alive. Retries survive tunnel wedges because bench.py's
+# inner process uses the persistent XLA cache (SHAI_XLA_CACHE) — every
+# successful compile is banked, so later attempts only pay the remainder.
+#
+# Usage: bash scripts/bench_watch.sh [deadline_seconds]
+# Results land in scripts/bench_results.json (one key per bench) and the
+# session narrative in scripts/bench_watch.log.
+set -u
+cd "$(dirname "$0")/.."
+LOG=scripts/bench_watch.log
+RES=scripts/bench_results.json
+export SHAI_XLA_CACHE=${SHAI_XLA_CACHE:-/tmp/shai-xla-cache}
+DEADLINE=$(( $(date +%s) + ${1:-21600} ))
+note() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$LOG"; }
+
+[ -f "$RES" ] || echo '{}' > "$RES"
+
+have() {  # have <key>: does RES already hold a real on-device result?
+  python - "$1" <<'EOF'
+import json, sys
+r = json.load(open("scripts/bench_results.json"))
+v = r.get(sys.argv[1])
+ok = bool(v) and "error" not in v and "(cpu)" not in v.get("metric", "")
+sys.exit(0 if ok else 1)
+EOF
+}
+
+note "watcher start (deadline in $(( (DEADLINE - $(date +%s)) / 60 )) min)"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  missing=""
+  for w in sd llama llama3b; do have "$w" || missing="$missing $w"; done
+  [ -z "$missing" ] && { note "all benches done"; break; }
+
+  probe=$(timeout 200 python bench.py --inner --probe 2>/dev/null | tail -1)
+  if ! echo "$probe" | grep -q '"probe"'; then
+    note "tunnel down (missing:$missing) — sleeping 300s"
+    sleep 300
+    continue
+  fi
+
+  for w in $missing; do
+    note "tunnel alive — running bench $w"
+    line=$(timeout 3000 python bench.py "$w" 2>/dev/null | tail -1)
+    note "bench $w -> $line"
+    python - "$w" "$line" <<'EOF'
+import json, sys
+key, line = sys.argv[1], sys.argv[2]
+try:
+    obj = json.loads(line)
+except ValueError:
+    sys.exit(0)
+res = json.load(open("scripts/bench_results.json"))
+cur = res.get(key)
+better = (cur is None or "error" in cur
+          or ("error" not in obj and obj.get("value", 0) > cur.get("value", 0)))
+if "metric" in obj and better:
+    res[key] = obj
+    json.dump(res, open("scripts/bench_results.json", "w"), indent=1)
+EOF
+  done
+done
+note "watcher exit"

@@ -58,13 +58,21 @@ def bench(cfg, params, kv, ctx_blocks, n_active, paged):
 
 
 def main() -> None:
+    from scalable_hw_agnostic_inference_tpu.core.aot import (
+        enable_persistent_cache_from_env,
+        host_init,
+        to_default_device,
+    )
+
+    enable_persistent_cache_from_env()
     cfg = LlamaConfig(
         vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
         mlp_dim=8192, max_seq_len=32768, rope_theta=500000.0,
         tie_embeddings=True)
     model = LlamaForCausalLM(cfg, dtype=jnp.bfloat16)
-    params = cast_f32_to_bf16(jax.jit(model.init)(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)))
+    params = to_default_device(cast_f32_to_bf16(host_init(
+        model.init, lambda: jax.random.PRNGKey(0),
+        lambda: jnp.zeros((1, 8), jnp.int32))))
 
     print(f"{'ctx tokens':>10s} {'occ':>4s} {'dense ms':>9s} {'paged ms':>9s}")
     for ctx_tokens in (1024, 4096, 16384):

@@ -43,21 +43,32 @@ def timed(fn, *args, runs=5, warm=1):
 
 
 def main() -> None:
+    from scalable_hw_agnostic_inference_tpu.core.aot import (
+        enable_persistent_cache_from_env,
+        host_init,
+        to_default_device,
+    )
+
+    enable_persistent_cache_from_env()
+
     size, steps, seq = 512, 25, 77
     variant = sd_mod.SDVariant.sd21_base()
-    rng = jax.random.PRNGKey(0)
     unet = sd_mod.UNet2DCondition(variant.unet)
     f = 2 ** (len(variant.vae.block_out) - 1)
     lat = size // f
     D = variant.unet.cross_attention_dim
 
-    unet_params = jax.jit(unet.init)(
-        rng, jnp.zeros((1, lat, lat, variant.unet.in_channels)),
-        jnp.zeros((1,), jnp.int32), jnp.zeros((1, seq, D)))
-    unet_params = cast_f32_to_bf16(unet_params)
+    unet_params = host_init(
+        unet.init, lambda: jax.random.PRNGKey(0),
+        lambda: jnp.zeros((1, lat, lat, variant.unet.in_channels)),
+        lambda: jnp.zeros((1,), jnp.int32),
+        lambda: jnp.zeros((1, seq, D)))
+    unet_params = to_default_device(cast_f32_to_bf16(unet_params))
     vae = sd_mod.AutoencoderKL(variant.vae)
-    vae_params = jax.jit(vae.init)(
-        jax.random.PRNGKey(1), jnp.zeros((1, lat, lat, variant.vae.latent_channels)))
+    vae_params = to_default_device(host_init(
+        vae.init, lambda: jax.random.PRNGKey(1),
+        lambda: jnp.zeros((1, lat, lat, variant.vae.latent_channels))))
+    rng = jax.random.PRNGKey(0)
 
     def text_encode(ids):
         return jax.nn.one_hot(ids % D, D, dtype=jnp.bfloat16)

@@ -379,12 +379,26 @@ async def test_mllama_artifact_boot_skips_torch(hf_model, tmp_path,
                       "max_new_tokens": 4})
     svc.loop.stop()
 
-    # second boot: the torch model class must never be constructed
+    # second boot: the torch model class must never be constructed, and the
+    # tokenizer must restore from the artifact-local copy, not the
+    # checkpoint/hub (the hub-less serving pod with only the artifacts PVC)
+    import os as _os
+
     def boom(*a, **k):
         raise AssertionError("artifact boot must not load the torch model")
 
     monkeypatch.setattr(transformers.AutoModelForImageTextToText,
                         "from_pretrained", boom)
+    tok_dir = wstore.aux_dir(str(tmp_path / "artifacts"), key, "tokenizer")
+    assert _os.path.isdir(tok_dir), "first boot must persist tokenizer files"
+    real_tok = transformers.AutoTokenizer.from_pretrained.__func__
+
+    def guarded(pretrained, *a, **k):
+        assert str(pretrained) != str(ckpt), \
+            "hub-less boot must not fetch the checkpoint tokenizer"
+        return real_tok(transformers.AutoTokenizer, pretrained, *a, **k)
+
+    monkeypatch.setattr(transformers.AutoTokenizer, "from_pretrained", guarded)
     svc2 = make("m2")
     svc2.load()
     got = svc2.infer({"prompt": "tok5 tok9", "temperature": 0.0,
