@@ -107,6 +107,7 @@ def bench_llama(tiny: bool) -> dict:
         LlamaForCausalLM,
     )
 
+    quant = "int8" in sys.argv
     if tiny:
         cfg, batch, prompt, new = LlamaConfig.tiny(), 2, 32, 16
         name = "tiny"
@@ -134,11 +135,23 @@ def bench_llama(tiny: bool) -> dict:
     )
     from scalable_hw_agnostic_inference_tpu.models.convert import cast_f32_to_bf16
 
-    model = LlamaForCausalLM(cfg, dtype=jnp.bfloat16)
-    params = host_init(model.init, lambda: jax.random.PRNGKey(0),
+    # init the float model on CPU; the int8 variant quantizes host-side
+    # (the serving boot path: ops.quant.quantize_params_tree) and runs the
+    # same geometry through QuantDense weights
+    float_model = LlamaForCausalLM(cfg, dtype=jnp.bfloat16)
+    params = host_init(float_model.init, lambda: jax.random.PRNGKey(0),
                        lambda: jnp.zeros((1, 8), jnp.int32))
-    params = to_default_device(cast_f32_to_bf16(params))
+    params = cast_f32_to_bf16(params)
+    if quant:
+        from scalable_hw_agnostic_inference_tpu.ops.quant import (
+            quantize_params_tree,
+        )
+
+        params = quantize_params_tree(params)
+        name += "-int8"
+    params = to_default_device(params)
     rng = jax.random.PRNGKey(0)
+    model = LlamaForCausalLM(cfg, dtype=jnp.bfloat16, quant=quant)
     gen = make_generate(model, cfg, prompt_bucket=prompt, max_new_tokens=new,
                         eos_id=-1)
     ids = jax.random.randint(rng, (batch, prompt), 3, cfg.vocab_size, jnp.int32)
@@ -153,7 +166,9 @@ def bench_llama(tiny: bool) -> dict:
     dt = (time.perf_counter() - t0) / runs
     toks = batch * new / dt
     key = {"llama3.2-1b-geometry": "llama1b_decode_tok_s",
-           "llama3.2-3b-geometry": "llama3b_decode_tok_s"}.get(name)
+           "llama3.2-3b-geometry": "llama3b_decode_tok_s",
+           "llama3.2-1b-geometry-int8": "llama1b_int8_decode_tok_s",
+           "llama3.2-3b-geometry-int8": "llama3b_int8_decode_tok_s"}.get(name)
     try:
         published = json.load(open("BASELINE.json"))["published"]
         base = published.get(key)
@@ -210,8 +225,9 @@ def _clear_stale_locks() -> None:
 def _run_child(which: str, cpu: bool, timeout: float) -> tuple[dict | None, str]:
     """Run one measurement attempt in a child; return (result, error_tail)."""
     args = [sys.executable, os.path.abspath(__file__), "--inner", which]
-    if "llama3b" in sys.argv and "llama3b" not in args:
-        args.append("llama3b")
+    for tok in ("llama3b", "int8"):
+        if tok in sys.argv and tok not in args:
+            args.append(tok)
     if cpu:
         args.append("--cpu")
     try:

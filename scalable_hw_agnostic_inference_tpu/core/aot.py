@@ -64,11 +64,12 @@ def host_init(init_fn, *arg_thunks):
 
 
 def to_default_device(tree):
-    """Transfer a host pytree to the default (accelerator) device."""
+    """Transfer a host pytree to the default (accelerator) device in one
+    batched ``device_put`` (per-leaf puts would pay a tunnel round trip
+    each)."""
     import jax
 
-    dev = jax.devices()[0]
-    return jax.tree.map(lambda x: jax.device_put(x, dev), tree)
+    return jax.device_put(tree, jax.devices()[0])
 
 
 def _spec_of(x) -> Dict[str, Any]:

@@ -30,6 +30,9 @@ class EngineConfig:
     max_prefill_batch: int = 4
     tensor_parallel_size: int = 1
     dtype: str = "bfloat16"
+    # weight-only quantization: None/"" = bf16 weights, "int8" = per-channel
+    # int8 (ops.quant) — the vLLM `quantization:` config key, TPU-natively
+    quantization: Optional[str] = None
     # on-device sampling (reference: global_topk 64, dynamic)
     global_topk: int = 64
     max_new_tokens: int = 128
@@ -51,6 +54,10 @@ class EngineConfig:
             raise ValueError(
                 f"prefill buckets {misaligned} not multiples of "
                 f"block_size={self.block_size}")
+        if self.quantization not in (None, "", "int8"):
+            raise ValueError(
+                f"unsupported quantization {self.quantization!r} "
+                f"(supported: int8)")
 
     @property
     def blocks_per_seq(self) -> int:

@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.llama import LlamaConfig
 from ..ops.attention import dot_product_attention
+from ..ops.quant import quant_matmul
 from ..ops.rope import apply_rope
 from ..ops.sampling import sample_logits
 
@@ -78,7 +79,9 @@ def _rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
 
 
 def _proj(x: jax.Array, p: Dict[str, jax.Array]) -> jax.Array:
-    return x @ p["kernel"].astype(x.dtype)
+    # plain or int8 weight-only projections (ops.quant): decode re-reads all
+    # weights per token, so int8 halves its HBM traffic
+    return quant_matmul(x, p)
 
 
 def _qkv(lp: Dict, x: jax.Array, positions: jax.Array, cfg: LlamaConfig):

@@ -122,10 +122,23 @@ def rope_scaling_from_hf(rs) -> Optional[Tuple[float, float, float, int]]:
             int(rs["original_max_position_embeddings"]))
 
 
+def _dense_factory(dtype, quant: bool):
+    """Projection factory: ``nn.Dense`` or its int8 weight-only drop-in
+    (``ops.quant.QuantDense``) — same call signature, different param tree
+    (kernel_q + scale), produced by ``ops.quant.quantize_params_tree``."""
+    if quant:
+        from ..ops.quant import QuantDense
+
+        return lambda n_out, name: QuantDense(n_out, dtype=dtype, name=name)
+    return lambda n_out, name: nn.Dense(
+        n_out, use_bias=False, dtype=dtype, name=name)
+
+
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"
+    quant: bool = False
 
     @nn.compact
     def __call__(
@@ -139,9 +152,7 @@ class LlamaAttention(nn.Module):
         cfg = self.cfg
         B, T, _ = x.shape
         Dh = cfg.head_dim
-        dense = lambda n_out, name: nn.Dense(
-            n_out, use_bias=False, dtype=self.dtype, name=name
-        )
+        dense = _dense_factory(self.dtype, self.quant)
         q = dense(cfg.n_heads * Dh, "q")(x).reshape(B, T, cfg.n_heads, Dh)
         k = dense(cfg.n_kv_heads * Dh, "k")(x).reshape(B, T, cfg.n_kv_heads, Dh)
         v = dense(cfg.n_kv_heads * Dh, "v")(x).reshape(B, T, cfg.n_kv_heads, Dh)
@@ -173,13 +184,12 @@ class LlamaAttention(nn.Module):
 class LlamaMLP(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.bfloat16
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
-        dense = lambda n_out, name: nn.Dense(
-            n_out, use_bias=False, dtype=self.dtype, name=name
-        )
+        dense = _dense_factory(self.dtype, self.quant)
         gate = dense(cfg.mlp_dim, "gate")(x)
         up = dense(cfg.mlp_dim, "up")(x)
         return dense(cfg.dim, "down")(nn.silu(gate) * up)
@@ -189,16 +199,19 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x, positions, layer_cache, mask, write_index):
         cfg = self.cfg
         norm = lambda name: RMSNorm(eps=cfg.rms_eps, dtype=self.dtype, name=name)
         h, new_cache = LlamaAttention(
-            cfg, dtype=self.dtype, attn_impl=self.attn_impl, name="attn"
+            cfg, dtype=self.dtype, attn_impl=self.attn_impl, quant=self.quant,
+            name="attn"
         )(norm("attn_norm")(x), positions, layer_cache, mask, write_index)
         x = x + h
-        x = x + LlamaMLP(cfg, dtype=self.dtype, name="mlp")(norm("mlp_norm")(x))
+        x = x + LlamaMLP(cfg, dtype=self.dtype, quant=self.quant, name="mlp")(
+            norm("mlp_norm")(x))
         return x, new_cache
 
 
@@ -213,6 +226,8 @@ class LlamaForCausalLM(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"
+    # int8 weight-only serving (params via ops.quant.quantize_params_tree)
+    quant: bool = False
 
     @nn.compact
     def __call__(
@@ -240,7 +255,8 @@ class LlamaForCausalLM(nn.Module):
         new_cache: Optional[Cache] = [] if cache is not None else None
         for i in range(cfg.n_layers):
             x, lc = LlamaBlock(
-                cfg, dtype=self.dtype, attn_impl=self.attn_impl, name=f"layer_{i}"
+                cfg, dtype=self.dtype, attn_impl=self.attn_impl,
+                quant=self.quant, name=f"layer_{i}"
             )(x, positions, cache[i] if cache is not None else None, mask, write_index)
             if new_cache is not None:
                 new_cache.append(lc)
@@ -248,9 +264,8 @@ class LlamaForCausalLM(nn.Module):
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:
-            logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head"
-            )(x)
+            logits = _dense_factory(self.dtype, self.quant)(
+                cfg.vocab_size, "lm_head")(x)
         return logits.astype(jnp.float32), new_cache
 
 
@@ -297,11 +312,18 @@ def tp_rules(axis: str = "tp") -> ShardingRules:
     """
     return ShardingRules([
         (r"embed/embedding", P(None, axis)),
+        # `kernel` patterns match `kernel_q` too (search semantics) — the
+        # int8 kernel shards exactly like its float original; the [out]
+        # per-channel scale splits with column-parallel outputs and stays
+        # replicated after row-parallel psums
         (r"attn/(q|k|v)/kernel", P(None, axis)),
+        (r"attn/(q|k|v)/scale", P(axis)),
         (r"attn/o/kernel", P(axis, None)),
         (r"mlp/(gate|up)/kernel", P(None, axis)),
+        (r"mlp/(gate|up)/scale", P(axis)),
         (r"mlp/down/kernel", P(axis, None)),
         (r"lm_head/kernel", P(None, axis)),
+        (r"lm_head/scale", P(axis)),
         (r".*norm/scale", P()),
     ])
 

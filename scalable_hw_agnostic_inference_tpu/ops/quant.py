@@ -1,0 +1,88 @@
+"""Int8 weight-only quantization for the causal-LM serving path.
+
+Decode is HBM-bandwidth-bound: every generated token re-reads the full
+weight set, so halving weight bytes is a near-2x tokens/sec lever on chip.
+Weights are quantized per-output-channel symmetric int8; activations stay
+bf16 and the scale multiplies the matmul OUTPUT — ``(x @ Wq) * scale`` is
+exactly ``x @ (Wq * scale)`` because the scale is per output column, so XLA
+loads int8 tiles from HBM and converts in-register instead of materializing
+a dequantized copy.
+
+The reference reaches the same capability class through the vLLM fork's
+neuron quantization knob (``vllm_config.yaml`` — SURVEY.md §2.6 row 5);
+here it is first-party and rides the same config contract
+(``quantization: int8`` in the ConfigMap, ``engine.config.EngineConfig``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# parent paths (dicts holding a single 2-D "kernel") that quantize; embed
+# tables and norms stay high-precision
+_QUANT_PARENT = re.compile(
+    r"(attn/(q|k|v|o)|cross_attn/(q|k|v|o)|mlp/(gate|up|down)|lm_head)$")
+
+
+def quantize_weight(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """``[in, out]`` float kernel -> (int8 kernel, [out] f32 scale)."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_weight(q: jax.Array, scale: jax.Array, dtype=jnp.float32):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quantize_params_tree(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Replace every quantizable ``{"kernel": W}`` with
+    ``{"kernel_q": int8, "scale": f32}`` (host-side, one pass at boot)."""
+
+    def rec(node, path):
+        if isinstance(node, dict):
+            if (set(node) == {"kernel"} and _QUANT_PARENT.search(path)
+                    and getattr(node["kernel"], "ndim", 0) == 2):
+                q, s = quantize_weight(node["kernel"])
+                return {"kernel_q": q, "scale": s}
+            return {k: rec(v, f"{path}/{k}") for k, v in node.items()}
+        return node
+
+    return rec(params, "")
+
+
+def quant_matmul(x: jax.Array, p: Dict[str, jax.Array]) -> jax.Array:
+    """``x @ W`` for either a plain or a quantized projection dict."""
+    if "kernel_q" in p:
+        y = x @ p["kernel_q"].astype(x.dtype)
+        return y * p["scale"].astype(x.dtype)
+    return x @ p["kernel"].astype(x.dtype)
+
+
+class QuantDense(nn.Module):
+    """Drop-in for ``nn.Dense(use_bias=False)`` with int8 weights.
+
+    Param tree: ``kernel_q`` [in, out] int8 + ``scale`` [out] f32 — produced
+    by :func:`quantize_params_tree` from a converted checkpoint (the zeros
+    init only exists so ``init`` builds the right structure).
+    """
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel_q = self.param(
+            "kernel_q", nn.initializers.zeros_init(),
+            (jnp.shape(x)[-1], self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (self.features,), jnp.float32)
+        # one copy of the dequant math — identical to the engine runner path
+        return quant_matmul(x.astype(self.dtype),
+                            {"kernel_q": kernel_q, "scale": scale})

@@ -72,19 +72,21 @@ def _hf_tokenizer(model_id: str, token: str = "", cache: str = ""):
         try:
             return AutoTokenizer.from_pretrained(cache)
         except Exception:
-            # a torn save must not poison every later boot — fall through
-            # to the hub path, which rewrites the cache
+            # a torn save must not poison every later boot: remove the
+            # broken dir here so the refetch below can repair the cache
             log.exception("tokenizer artifact unreadable — refetching")
+            shutil.rmtree(cache, ignore_errors=True)
     tok = AutoTokenizer.from_pretrained(model_id, token=token or None)
     if cache:
+        tmp = f"{cache}.{os.getpid()}.tmp"
         try:
-            tmp = f"{cache}.{os.getpid()}.tmp"
             tok.save_pretrained(tmp)
-            if os.path.isdir(cache):
-                shutil.rmtree(cache)
-            os.rename(tmp, cache)  # a crash leaves only the .tmp dir behind
+            # atomic when cache doesn't exist; if a concurrent pod won the
+            # race the rename fails and we just keep their copy
+            os.rename(tmp, cache)
         except Exception:
             log.exception("tokenizer artifact save failed (serving anyway)")
+            shutil.rmtree(tmp, ignore_errors=True)
     return tok
 
 
@@ -248,37 +250,56 @@ def _load_vlm(cfg: ServeConfig, model_id: str, hf_cfg=None):
     Parity with the reference's multimodal unit
     (``vllm_model_api_m.py:42-66``): one checkpoint carries the vision tower
     + projector and the language model; both convert to flax here (layouts in
-    ``models.vlm.params_from_torch`` / ``models.llama.params_from_torch``).
+    ``models.vlm.params_from_torch`` / ``models.llama.params_from_torch``)
+    and persist under the artifact root (hub-less boot, same flow as the
+    mllama and causal-lm loaders).
     """
-    import torch  # noqa: F401
-    from transformers import AutoConfig, AutoModelForImageTextToText
-
+    from ..core import weights as wstore
     from ..models import llama, vlm
-    from ..models.convert import cast_f32_to_bf16
 
-    if hf_cfg is None:
-        hf_cfg = AutoConfig.from_pretrained(model_id,
-                                            token=cfg.hf_token or None)
-    tm = AutoModelForImageTextToText.from_pretrained(
-        model_id, token=cfg.hf_token or None)
-    sd = tm.state_dict()
-    del tm
-    mcfg = llama.LlamaConfig.from_hf(hf_cfg.text_config)
-    vcfg = vlm.VisionTowerConfig.from_hf(hf_cfg, lm_dim=mcfg.dim)
-    # strip the llava wrapper prefix so the llama converter sees its usual
-    # "model.*"/"lm_head.*" keys (old layout "language_model.model.*", new
-    # "model.language_model.*")
-    if any(k.startswith("language_model.") for k in sd):
-        lm_sd = {k[len("language_model."):]: v for k, v in sd.items()
-                 if k.startswith("language_model.")}
-    else:
-        lm_sd = {k[len("model.language_model."):]: v for k, v in sd.items()
-                 if k.startswith("model.language_model.")}
-        lm_sd.update({k: v for k, v in sd.items() if k.startswith("lm_head.")})
-    params = cast_f32_to_bf16(llama.params_from_torch(lm_sd, mcfg))
-    vparams = cast_f32_to_bf16(vlm.params_from_torch(sd, vcfg))
-    tokenizer = _hf_tokenizer(model_id, cfg.hf_token)
-    return mcfg, params, vcfg, vparams, tokenizer
+    key = f"vlm--{model_id}"
+
+    def _convert():
+        nonlocal hf_cfg
+        import torch  # noqa: F401
+        from transformers import AutoConfig, AutoModelForImageTextToText
+
+        from ..models.convert import cast_f32_to_bf16
+
+        if hf_cfg is None:
+            hf_cfg = AutoConfig.from_pretrained(model_id,
+                                                token=cfg.hf_token or None)
+        tm = AutoModelForImageTextToText.from_pretrained(
+            model_id, token=cfg.hf_token or None)
+        sd = tm.state_dict()
+        del tm
+        mcfg = llama.LlamaConfig.from_hf(hf_cfg.text_config)
+        vcfg = vlm.VisionTowerConfig.from_hf(hf_cfg, lm_dim=mcfg.dim)
+        # strip the llava wrapper prefix so the llama converter sees its
+        # usual "model.*"/"lm_head.*" keys (old layout
+        # "language_model.model.*", new "model.language_model.*")
+        if any(k.startswith("language_model.") for k in sd):
+            lm_sd = {k[len("language_model."):]: v for k, v in sd.items()
+                     if k.startswith("language_model.")}
+        else:
+            lm_sd = {k[len("model.language_model."):]: v for k, v in sd.items()
+                     if k.startswith("model.language_model.")}
+            lm_sd.update({k: v for k, v in sd.items()
+                          if k.startswith("lm_head.")})
+        tree = {"lm": cast_f32_to_bf16(llama.params_from_torch(lm_sd, mcfg)),
+                "vision": cast_f32_to_bf16(vlm.params_from_torch(sd, vcfg))}
+        meta = {"text_config": wstore.config_meta(mcfg),
+                "vision_config": wstore.config_meta(vcfg)}
+        return tree, meta
+
+    tree, meta = wstore.get_or_convert(
+        cfg.artifact_root, key, _convert,
+        required_meta=("text_config", "vision_config"))
+    mcfg = llama.LlamaConfig(**meta["text_config"])
+    vcfg = vlm.VisionTowerConfig(**meta["vision_config"])
+    tokenizer = _hf_tokenizer(model_id, cfg.hf_token, cache=wstore.aux_dir(
+        cfg.artifact_root, key, "tokenizer"))
+    return mcfg, tree["lm"], vcfg, tree["vision"], tokenizer
 
 
 def _load_mllama(cfg: ServeConfig, model_id: str, hf_cfg=None):
@@ -873,11 +894,14 @@ class VllmService(ModelService):
         # architecture it is serving
         from ..core import weights as wstore
 
-        has_mllama_artifact = (
-            model_id not in ("", "tiny")
-            and wstore.has_params(cfg.artifact_root, f"mllama--{model_id}"))
-        hf_cfg = None if has_mllama_artifact else _autoconfig_of(cfg, model_id)
-        is_vlm = has_mllama_artifact or (
+        real_id = model_id not in ("", "tiny")
+        has_mllama_artifact = real_id and wstore.has_params(
+            cfg.artifact_root, f"mllama--{model_id}")
+        has_vlm_artifact = real_id and wstore.has_params(
+            cfg.artifact_root, f"vlm--{model_id}")
+        offline = has_mllama_artifact or has_vlm_artifact
+        hf_cfg = None if offline else _autoconfig_of(cfg, model_id)
+        is_vlm = offline or (
             hf_cfg is not None and hasattr(hf_cfg, "vision_config")
             and hasattr(hf_cfg, "text_config"))
         if is_vlm:
@@ -911,9 +935,16 @@ class VllmService(ModelService):
                 block_size=16, context_encoding_buckets=(32, 64, 128),
                 token_generation_buckets=ecfg.token_generation_buckets,
                 tensor_parallel_size=ecfg.tensor_parallel_size,
+                quantization=ecfg.quantization,
                 max_new_tokens=min(ecfg.max_new_tokens, 64))
 
         self.ecfg = ecfg
+        if ecfg.quantization == "int8":
+            # weight-only int8 at boot (host-side, one pass): halves decode
+            # HBM traffic; the vLLM `quantization:` ConfigMap knob
+            from ..ops.quant import quantize_params_tree
+
+            params = quantize_params_tree(params)
         # tensor_parallel_size is honored, never silently dropped: the
         # reference's TP=32 serving tier (compile-vllm-job.yaml:54-55) maps to
         # a tp mesh over local chips; an over-sized config is a deploy error

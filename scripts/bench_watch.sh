@@ -30,7 +30,9 @@ EOF
 note "watcher start (deadline in $(( (DEADLINE - $(date +%s)) / 60 )) min)"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   missing=""
-  for w in sd llama llama3b; do have "$w" || missing="$missing $w"; done
+  for w in sd llama llama3b llama_int8 llama3b_int8; do
+    have "$w" || missing="$missing $w"
+  done
   [ -z "$missing" ] && { note "all benches done"; break; }
 
   probe=$(timeout 200 python bench.py --inner --probe 2>/dev/null | tail -1)
@@ -42,7 +44,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
 
   for w in $missing; do
     note "tunnel alive — running bench $w"
-    line=$(timeout 3000 python bench.py "$w" 2>/dev/null | tail -1)
+    line=$(timeout 3000 python bench.py ${w//_/ } 2>/dev/null | tail -1)
     note "bench $w -> $line"
     python - "$w" "$line" <<'EOF'
 import json, sys

@@ -56,6 +56,34 @@ async def test_vllm_service_generate_and_batching():
 
 
 @pytest.mark.asyncio
+async def test_vllm_service_int8_quantized(tmp_path):
+    """`quantization: int8` in the mounted vllm_config.yaml boots the engine
+    on int8 weights (the vLLM ConfigMap knob, TPU-natively) and still serves
+    deterministic greedy generations."""
+    y = tmp_path / "vllm_config.yaml"
+    y.write_text("model: tiny\nmax_model_len: 256\nblock_size: 16\n"
+                 "max_num_seqs: 4\ncontext_encoding_buckets: [32, 64]\n"
+                 "quantization: int8\nmax_new_tokens: 8\n")
+    cfg = ServeConfig(app="llm", model_id="tiny", device="cpu",
+                      max_new_tokens=8, vllm_config=str(y))
+    service = get_model("vllm")(cfg)
+    app = create_app(cfg, service)
+    async with make_client(app) as c:
+        r = await wait_ready(c, timeout=300.0)
+        assert r.status_code == 200, r.text
+        # the engine really runs on int8 kernels
+        p = service._engine.params["params"]
+        assert p["layer_0"]["attn"]["q"]["kernel_q"].dtype == jnp.int8
+        payload = {"prompt": "hello world", "temperature": 0.0,
+                   "max_new_tokens": 6}
+        r1 = await c.post("/generate", json=payload)
+        r2 = await c.post("/generate", json=payload)
+        assert r1.status_code == 200, r1.text
+        assert r1.json()["n_tokens"] == 6
+        assert r1.json()["generated_text"] == r2.json()["generated_text"]
+
+
+@pytest.mark.asyncio
 async def test_vllm_service_multimodal_generate():
     """vllm_model_api_m parity: optional base64 image conditions generation."""
     import base64
