@@ -74,6 +74,42 @@ def local_devices(device: Optional[str] = None) -> List:
     return devs or list(jax.devices("cpu"))
 
 
+def maybe_distributed_init(env=None) -> bool:
+    """Join a multi-host JAX cluster when the pod env asks for it.
+
+    The reference's multi-host serving tier runs TP=32 over 8 Neuron devices
+    through NxD's NeuronLink/EFA collectives (``compile-vllm-job.yaml:38-44``,
+    SURVEY.md §2.7). TPU-natively a multi-host slice (v5e-16+) is one JAX
+    cluster: after ``jax.distributed.initialize`` every process sees the
+    GLOBAL device set, the same ``NamedSharding`` meshes span hosts, and XLA
+    routes collectives over ICI within the slice and DCN across slices —
+    no NCCL/MPI equivalent to manage.
+
+    Env contract (set by the StatefulSet manifest from the pod ordinal):
+
+    - ``SHAI_COORDINATOR``: ``host:port`` of process 0 (its headless-service
+      DNS name, e.g. ``llama-mh-0.llama-mh:8476``)
+    - ``SHAI_NUM_PROCESSES``: total host processes in the unit
+    - ``SHAI_PROCESS_ID``: this pod's ordinal
+
+    Returns True when distributed init ran. Must be called before the first
+    backend touch (same rule as :func:`apply_platform`).
+    """
+    env = os.environ if env is None else env
+    coord = env.get("SHAI_COORDINATOR", "")
+    if not coord:
+        return False
+    import jax
+
+    n = int(env["SHAI_NUM_PROCESSES"])
+    pid = int(env["SHAI_PROCESS_ID"])
+    log.info("joining multi-host cluster: coordinator=%s process %d/%d",
+             coord, pid, n)
+    jax.distributed.initialize(coordinator_address=coord, num_processes=n,
+                               process_id=pid)
+    return True
+
+
 def force_host_device_count(n: int) -> None:
     """Configure N virtual CPU devices (tests / multi-chip dry runs).
 

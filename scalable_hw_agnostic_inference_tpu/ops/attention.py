@@ -29,6 +29,24 @@ NEG_INF = -1e30
 # masked softmax cannot.
 _XLA_SCORE_BUDGET = 64 * 1024 * 1024
 
+# Measured exception inside the XLA budget (scripts/perf_attn.py on v5e,
+# round 3): at T*S ~ 1M (the UNet's 32x32 self-attention level, T=S=1024)
+# jax's shipped block-tuned TPU flash kernel beat XLA's fused softmax
+# (1163us vs 1481us above the sync floor) while losing at 16.7M (4910 vs
+# 3225) and being noise at <=65k. The window dispatches exactly that level.
+_JAX_FLASH_WINDOW = (2 ** 20, 2 ** 21)
+
+
+def _jax_flash_eligible(q, k, mask, bias, kv_lengths, causal) -> bool:
+    """Shapes jax's shipped TPU flash kernel covers: MHA, no mask/bias/
+    lengths, tiling-friendly T/S, causal only when T == S (the kernel aligns
+    the diagonal at 0; this API's decode offset is S - T)."""
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    return (mask is None and bias is None and kv_lengths is None
+            and H == k.shape[2] and T % 128 == 0 and S % 128 == 0
+            and (not causal or T == S))
+
 
 def _xla_attention(q, k, v, mask, bias, scale) -> jax.Array:
     """Reference implementation: [B,T,H,D] x [B,S,Hkv,D] -> [B,T,H,D]."""
@@ -97,19 +115,19 @@ def dot_product_attention(
         import os
 
         impl = os.environ.get("SHAI_ATTN_IMPL", "auto")
-        if (impl == "auto" and not causal and kv_lengths is None
-                and T * S <= _XLA_SCORE_BUDGET):
-            impl = "xla"
+        if impl == "auto" and not causal and kv_lengths is None:
+            if (_jax_flash_eligible(q, k, mask, bias, kv_lengths, causal)
+                    and _JAX_FLASH_WINDOW[0] <= T * S < _JAX_FLASH_WINDOW[1]
+                    and jax.default_backend() in ("tpu", "axon")):
+                impl = "jax-flash"
+            elif T * S <= _XLA_SCORE_BUDGET:
+                impl = "xla"
 
     if impl == "jax-flash":
         # jax's shipped, block-tuned TPU flash kernel (public pallas ops) —
-        # a dispatch option for big self-attention shapes; requires MHA,
-        # no mask/bias/lengths, tiling-friendly T/S, causal only when T == S
-        # (the kernel aligns the diagonal at 0, this API's offset is S - T),
-        # and a real TPU (no interpreter mode)
-        eligible = (mask is None and bias is None and kv_lengths is None
-                    and H == k.shape[2] and T % 128 == 0 and S % 128 == 0
-                    and (not causal or T == S))
+        # a dispatch option for big self-attention shapes; needs a real TPU
+        # (no interpreter mode)
+        eligible = _jax_flash_eligible(q, k, mask, bias, kv_lengths, causal)
         on_tpu = jax.default_backend() in ("tpu", "axon")
         if eligible and on_tpu:
             from jax.experimental.pallas.ops.tpu.flash_attention import (

@@ -27,15 +27,25 @@ def main() -> None:
         raise SystemExit(2)
     cfg = ServeConfig.from_env()
     from ..core.aot import enable_persistent_cache
-    from ..core.device import apply_platform
+    from ..core.device import apply_platform, maybe_distributed_init
 
     apply_platform(cfg.device)
+    # multi-host slice units (SHAI_COORDINATOR set by the StatefulSet): join
+    # the cluster before any backend touch so meshes span all hosts
+    multihost = maybe_distributed_init()
     # consume compile-Job artifacts: a pod booting with the same artifact
     # root skips the cold XLA compile (reference's COMPILED_MODEL_ID pull,
     # ``sd21-inf2-deploy.yaml:60-61``, minus the hub round-trip)
     enable_persistent_cache(f"{cfg.artifact_root}/xla-cache")
     service = get_model(name)(cfg)
-    serve_forever(cfg, service)
+    if multihost:
+        # leader owns HTTP and broadcasts every request; followers mirror it
+        # so their devices enter the same collectives (serve.multihost)
+        from .multihost import serve_multihost
+
+        serve_multihost(cfg, service)
+    else:
+        serve_forever(cfg, service)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,157 @@
+"""Multi-host serving driver: leader owns HTTP, followers mirror its work.
+
+Under multi-controller JAX every process of the cluster must enter the SAME
+jitted computation for its collectives to complete — a request handled by
+one pod alone would hang the whole slice. So the multi-host unit
+(``deploy/units/llama-mh-tpu-deploy.yaml``) serves like JetStream does:
+
+- **process 0 (leader)**: runs the normal HTTP surface; every ``infer`` is
+  wrapped to first broadcast the request payload to all hosts, then run it.
+- **process > 0 (follower)**: binds only ``/health``+``/readiness`` (the
+  probes) and loops on the broadcast channel, running the identical
+  ``service.infer(payload)`` so its devices participate in the collectives.
+
+Determinism contract: a service's ``infer`` must reach the device only
+through the payload (services derive rngs from ``payload["seed"]``), which
+the serving layer already guarantees for the generate paths. The broadcast
+is two ``multihost_utils.broadcast_one_to_all`` rounds (fixed-shape header,
+then the pickled payload), serialized by a lock so every host observes the
+same request order.
+
+Failure semantics are fail-together: the coordination service heartbeat
+kills every process when a peer dies (jax.distributed's behavior), the
+StatefulSet restarts the pods, and the cluster re-forms — there is no
+single-pod rejoin, matching the reference's whole-unit restart on a dead
+vLLM rank.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+from typing import Any, Dict
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_OP_SHUTDOWN = 0
+_OP_INFER = 1
+
+
+def _broadcast_bytes(payload: bytes | None) -> bytes:
+    """Two-round fixed-shape broadcast of a variable-length byte string."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    leader = jax.process_index() == 0
+    hdr = np.array([len(payload) if leader else 0], np.int32)
+    hdr = np.asarray(multihost_utils.broadcast_one_to_all(hdr))
+    n = int(hdr[0])
+    buf = np.zeros((n,), np.uint8)
+    if leader:
+        buf[:n] = np.frombuffer(payload, np.uint8)
+    buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return buf.tobytes()
+
+
+class MultihostDriver:
+    """Request mirroring over the cluster's broadcast channel."""
+
+    def __init__(self, service):
+        self.service = service
+        self._lock = threading.Lock()
+
+    # -- leader side --------------------------------------------------------
+    def wrap_leader(self) -> None:
+        """Wrap ``service.infer`` so every request reaches all hosts."""
+        inner = self.service.infer
+
+        def infer(payload: Dict[str, Any]) -> Dict[str, Any]:
+            with self._lock:
+                _broadcast_bytes(pickle.dumps((_OP_INFER, payload)))
+                return inner(payload)
+
+        self.service.infer = infer
+
+    def shutdown(self) -> None:
+        with self._lock:
+            _broadcast_bytes(pickle.dumps((_OP_SHUTDOWN, None)))
+
+    # -- follower side ------------------------------------------------------
+    def follower_loop(self) -> None:
+        """Mirror the leader's inferences until a shutdown broadcast.
+
+        A mirrored ``infer`` that raises means this host diverged from the
+        leader — it may have failed BEFORE entering the jitted call (e.g. a
+        lazy bucket compile hit a full disk) while the other hosts are
+        already inside the collective, which would hang them forever (no
+        collective timeout, /health still green). Fail-together is the only
+        safe semantic: re-raise so this process dies, the coordination-
+        service heartbeat kills the peers, and the StatefulSet re-forms the
+        cluster.
+        """
+        while True:
+            op, payload = pickle.loads(_broadcast_bytes(None))
+            if op == _OP_SHUTDOWN:
+                log.info("follower: shutdown broadcast received")
+                return
+            try:
+                self.service.infer(payload)
+            except Exception:
+                log.exception("follower: mirrored infer diverged — dying so "
+                              "the unit restarts together")
+                raise
+
+
+def serve_multihost(cfg, service) -> None:
+    """Multi-host entrypoint: leader serves HTTP, followers mirror.
+
+    Followers still load+warm the model (identical compiled executables on
+    every host) and expose probe endpoints so Kubernetes sees them.
+    """
+    import jax
+
+    from .app import serve_forever
+    from .asgi import App, Response
+    from .httpd import Server
+
+    driver = MultihostDriver(service)
+    if jax.process_index() == 0:
+        # warmup happens inside serve_forever's loader thread AFTER the wrap,
+        # so followers mirror the warmup inference too
+        driver.wrap_leader()
+        try:
+            serve_forever(cfg, service)
+        finally:
+            driver.shutdown()
+        return
+
+    probes = App()
+    state = {"ready": False}
+
+    @probes.route("/health", methods=("GET",))
+    async def health(req):  # noqa: ANN001
+        return Response({"status": "ok", "role": "follower",
+                         "process": jax.process_index()})
+
+    @probes.route("/readiness", methods=("GET",))
+    async def readiness(req):  # noqa: ANN001
+        if not state["ready"]:
+            return Response({"status": "loading"}, status=503)
+        return Response({"status": "ready", "role": "follower"})
+
+    @probes.route("/metrics", methods=("GET",))
+    async def metrics(req):  # noqa: ANN001
+        # followers serve no requests; an empty exposition keeps the pod
+        # template's scrape annotations from generating 404 target errors
+        return Response("", media_type="text/plain; version=0.0.4")
+
+    server = Server(probes, port=cfg.port)
+    server.start_background()
+    service.load()
+    state["ready"] = True
+    log.info("follower %d: model loaded, entering mirror loop",
+             jax.process_index())
+    driver.follower_loop()

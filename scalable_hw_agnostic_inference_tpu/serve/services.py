@@ -68,19 +68,23 @@ def _hf_tokenizer(model_id: str, token: str = "", cache: str = ""):
 
     from transformers import AutoTokenizer
 
+    cached_bad = False
     if cache and os.path.isdir(cache):
         try:
             return AutoTokenizer.from_pretrained(cache)
         except Exception:
-            # a torn save must not poison every later boot: remove the
-            # broken dir here so the refetch below can repair the cache
+            # do NOT delete here: the read failure may be transient and the
+            # cache dir is shared across pods on the artifacts PVC —
+            # destroy a (possibly torn) copy only with a good one in hand
             log.exception("tokenizer artifact unreadable — refetching")
-            shutil.rmtree(cache, ignore_errors=True)
+            cached_bad = True
     tok = AutoTokenizer.from_pretrained(model_id, token=token or None)
     if cache:
         tmp = f"{cache}.{os.getpid()}.tmp"
         try:
             tok.save_pretrained(tmp)
+            if cached_bad:
+                shutil.rmtree(cache, ignore_errors=True)
             # atomic when cache doesn't exist; if a concurrent pod won the
             # race the rename fails and we just keep their copy
             os.rename(tmp, cache)

@@ -63,11 +63,20 @@ def test_httproute_parents_are_defined_gateways(objects):
 
 
 def test_service_selectors_match_a_deployment(objects):
-    """Every unit Service selects pods some Deployment actually labels."""
+    """Every unit Service selects pods some workload actually labels."""
     pod_labels = []
-    for name, (path, doc) in objects.get("Deployment", {}).items():
-        pod_labels.append(
-            doc["spec"]["template"]["metadata"].get("labels", {}))
+    for kind in ("Deployment", "StatefulSet"):
+        for name, (path, doc) in objects.get(kind, {}).items():
+            labels = dict(doc["spec"]["template"]["metadata"].get("labels", {}))
+            if kind == "StatefulSet":
+                # the controller injects this label with the generated pod
+                # name <name>-<ordinal>; resolve it like the cluster would
+                for i in range(int(doc["spec"].get("replicas", 1))):
+                    pod_labels.append({
+                        **labels,
+                        "statefulset.kubernetes.io/pod-name": f"{name}-{i}"})
+            else:
+                pod_labels.append(labels)
     for name, (path, doc) in objects.get("Service", {}).items():
         sel = doc["spec"].get("selector")
         if not sel:

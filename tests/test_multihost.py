@@ -1,0 +1,142 @@
+"""Multi-host cluster formation: two coordinated processes form ONE global
+device mesh and agree on a cross-host collective.
+
+This is SURVEY.md §4's prescribed "multi-host logic tests via JAX
+multi-process simulation on CPU devices": each subprocess owns 2 local CPU
+devices, joins via ``core.device.maybe_distributed_init`` (the env contract
+the multi-host StatefulSet sets from pod ordinals), builds the SAME
+``dp=-1`` mesh over the 4 GLOBAL devices, and psums across hosts — the
+TPU-native analog of the reference's NxD collective bring-up
+(``compile-vllm-job.yaml:38-44``).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+from scalable_hw_agnostic_inference_tpu.core.device import maybe_distributed_init
+
+assert maybe_distributed_init(), "env contract must trigger distributed init"
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from scalable_hw_agnostic_inference_tpu.core.mesh import build_mesh
+
+assert jax.device_count() == 4, jax.device_count()
+assert jax.local_device_count() == 2, jax.local_device_count()
+mesh = build_mesh("dp=-1")   # spans BOTH processes' devices
+assert mesh.devices.size == 4
+
+f = shard_map(lambda: jax.lax.psum(jnp.ones((1,)), "dp"),
+              mesh=mesh, in_specs=(), out_specs=P())
+out = jax.jit(f)()
+val = float(np.asarray(out.addressable_shards[0].data)[0])
+print("MULTIHOST_OK", jax.process_index(), val, flush=True)
+"""
+
+
+_MIRROR_WORKER = r"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+from scalable_hw_agnostic_inference_tpu.core.device import maybe_distributed_init
+
+assert maybe_distributed_init()
+
+from scalable_hw_agnostic_inference_tpu.serve.multihost import MultihostDriver
+
+
+class Svc:
+    def __init__(self):
+        self.seen = []
+
+    def infer(self, payload):
+        self.seen.append(payload)
+        return {"ok": True}
+
+
+svc = Svc()
+drv = MultihostDriver(svc)
+want = [{"prompt": f"p{i}", "seed": i} for i in range(3)]
+if jax.process_index() == 0:
+    drv.wrap_leader()
+    for p in want:
+        assert svc.infer(dict(p)) == {"ok": True}
+    drv.shutdown()
+    assert svc.seen == want, svc.seen
+    print("MULTIHOST_OK 0 leader", flush=True)
+else:
+    drv.follower_loop()   # returns on the shutdown broadcast
+    assert svc.seen == want, svc.seen
+    print("MULTIHOST_OK 1 follower", flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_cluster(worker_src: str, n: int = 2):
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for pid in range(n):
+        env = dict(os.environ)
+        env.update({
+            "SHAI_COORDINATOR": f"127.0.0.1:{port}",
+            "SHAI_NUM_PROCESSES": str(n),
+            "SHAI_PROCESS_ID": str(pid),
+            "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        # subprocesses pin their own platform; scrub the parent's test pins
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", worker_src], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host worker hung")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-2000:]}"
+        assert "MULTIHOST_OK" in out, out
+    return outs
+
+
+def test_two_process_mesh_and_psum():
+    outs = _run_cluster(_WORKER)
+    for _, out, _ in outs:
+        # psum over dp=4 of ones == 4 on every host
+        assert float(out.strip().split()[-1]) == 4.0
+
+
+def test_leader_follower_request_mirroring():
+    """The serving driver's broadcast protocol: every leader infer reaches
+    the follower in order, and the shutdown broadcast ends its loop."""
+    outs = _run_cluster(_MIRROR_WORKER)
+    roles = sorted(out.strip().split()[-1] for _, out, _ in outs)
+    assert roles == ["follower", "leader"]
