@@ -55,6 +55,13 @@ class ModelService:
     #: this to their slot count — infer() then only enqueues into the engine
     #: loop (which owns the device), so concurrent requests batch together.
     concurrency: int = 1
+    #: multi-host serving contract (serve.multihost): True only when EVERY
+    #: path to the device — warmup, infer, extra routes — goes through the
+    #: methods named in ``mirror_methods``, so followers can mirror each
+    #: call and join its collectives. A service with an unmirrored device
+    #: entry would wedge the slice; serve_multihost refuses it.
+    supports_multihost: bool = False
+    mirror_methods: Tuple[str, ...] = ("infer",)
 
     def __init__(self, cfg: ServeConfig):
         self.cfg = cfg

@@ -57,23 +57,33 @@ def _broadcast_bytes(payload: bytes | None) -> bytes:
 
 
 class MultihostDriver:
-    """Request mirroring over the cluster's broadcast channel."""
+    """Request mirroring over the cluster's broadcast channel.
+
+    Mirroring happens at the service's declared ``mirror_methods`` — the
+    LOWEST entry points through which requests reach the device (for the
+    llama unit that is ``generate_text``, which both ``/generate`` and the
+    ``/sentiment`` extra route call) — so no route can enter a collective
+    leader-only and wedge the slice.
+    """
 
     def __init__(self, service):
         self.service = service
         self._lock = threading.Lock()
+        self.methods = tuple(getattr(service, "mirror_methods", ("infer",)))
 
     # -- leader side --------------------------------------------------------
     def wrap_leader(self) -> None:
-        """Wrap ``service.infer`` so every request reaches all hosts."""
-        inner = self.service.infer
+        """Wrap each mirror method so every call reaches all hosts."""
+        for name in self.methods:
+            inner = getattr(self.service, name)
 
-        def infer(payload: Dict[str, Any]) -> Dict[str, Any]:
-            with self._lock:
-                _broadcast_bytes(pickle.dumps((_OP_INFER, payload)))
-                return inner(payload)
+            def wrapped(*args, _inner=inner, _name=name, **kwargs):
+                with self._lock:
+                    _broadcast_bytes(
+                        pickle.dumps((_OP_INFER, (_name, args, kwargs))))
+                    return _inner(*args, **kwargs)
 
-        self.service.infer = infer
+            setattr(self.service, name, wrapped)
 
     def shutdown(self) -> None:
         with self._lock:
@@ -81,27 +91,39 @@ class MultihostDriver:
 
     # -- follower side ------------------------------------------------------
     def follower_loop(self) -> None:
-        """Mirror the leader's inferences until a shutdown broadcast.
+        """Mirror the leader's calls until a shutdown broadcast.
 
-        A mirrored ``infer`` that raises means this host diverged from the
-        leader — it may have failed BEFORE entering the jitted call (e.g. a
-        lazy bucket compile hit a full disk) while the other hosts are
-        already inside the collective, which would hang them forever (no
-        collective timeout, /health still green). Fail-together is the only
-        safe semantic: re-raise so this process dies, the coordination-
-        service heartbeat kills the peers, and the StatefulSet re-forms the
-        cluster.
+        Error semantics: an ``HTTPError`` is deterministic host-side
+        validation (bad payload) — the leader raised the SAME error before
+        any device work, turned it into a 4xx, and kept serving; the
+        follower logs and continues, otherwise one malformed request would
+        restart the whole slice. Any OTHER exception means this host
+        diverged from its peers (e.g. a lazy bucket compile failed here
+        while the others are already inside the collective — which would
+        hang them forever, with /health still green). Fail-together is the
+        only safe semantic there: re-raise so this process dies, the
+        coordination-service heartbeat kills the peers, and the StatefulSet
+        re-forms the cluster.
         """
+        from .asgi import HTTPError
+
         while True:
-            op, payload = pickle.loads(_broadcast_bytes(None))
+            op, msg = pickle.loads(_broadcast_bytes(None))
             if op == _OP_SHUTDOWN:
                 log.info("follower: shutdown broadcast received")
                 return
+            name, args, kwargs = msg
+            if name not in self.methods:
+                log.error("follower: refusing unmirrored method %r", name)
+                raise ValueError(f"unmirrored method {name!r}")
             try:
-                self.service.infer(payload)
+                getattr(self.service, name)(*args, **kwargs)
+            except HTTPError as e:
+                log.info("follower: mirrored %s rejected the payload "
+                         "symmetrically (%s) — continuing", name, e)
             except Exception:
-                log.exception("follower: mirrored infer diverged — dying so "
-                              "the unit restarts together")
+                log.exception("follower: mirrored %s diverged — dying so "
+                              "the unit restarts together", name)
                 raise
 
 
@@ -117,6 +139,11 @@ def serve_multihost(cfg, service) -> None:
     from .asgi import App, Response
     from .httpd import Server
 
+    if not getattr(service, "supports_multihost", False):
+        raise ValueError(
+            f"{type(service).__name__} does not declare supports_multihost: "
+            f"its device entries are not guaranteed to funnel through "
+            f"mirror_methods, and an unmirrored entry would wedge the slice")
     driver = MultihostDriver(service)
     if jax.process_index() == 0:
         # warmup happens inside serve_forever's loader thread AFTER the wrap,

@@ -500,6 +500,11 @@ class LlamaService(ModelService):
 
     task = "text-generation"
     infer_route = "/generate"
+    # multi-host unit contract: EVERY device entry (infer, /sentiment,
+    # default warmup) funnels through generate_text, so mirroring it covers
+    # the whole surface (deploy/units/llama-mh-tpu-deploy.yaml)
+    supports_multihost = True
+    mirror_methods = ("generate_text",)
 
     def load(self) -> None:
         from ..core.bucketing import BucketRegistry, pow2_buckets

@@ -16,6 +16,7 @@ DEADLINE=$(( $(date +%s) + ${1:-21600} ))
 note() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$LOG"; }
 
 [ -f "$RES" ] || echo '{}' > "$RES"
+export SHAI_BENCH_COMMIT=$(git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
 have() {  # have <key>: does RES already hold a real on-device result?
   python - "$1" <<'EOF'
@@ -47,12 +48,16 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     line=$(timeout 3000 python bench.py ${w//_/ } 2>/dev/null | tail -1)
     note "bench $w -> $line"
     python - "$w" "$line" <<'EOF'
-import json, sys
+import datetime, json, os, sys
 key, line = sys.argv[1], sys.argv[2]
 try:
     obj = json.loads(line)
 except ValueError:
     sys.exit(0)
+# provenance: exactly which code produced this number, and when
+obj["commit"] = os.environ.get("SHAI_BENCH_COMMIT", "unknown")
+obj["measured_at"] = datetime.datetime.now(
+    datetime.timezone.utc).isoformat(timespec="seconds")
 res = json.load(open("scripts/bench_results.json"))
 cur = res.get(key)
 better = (cur is None or "error" in cur

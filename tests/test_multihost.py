@@ -58,6 +58,7 @@ from scalable_hw_agnostic_inference_tpu.core.device import maybe_distributed_ini
 
 assert maybe_distributed_init()
 
+from scalable_hw_agnostic_inference_tpu.serve.asgi import HTTPError
 from scalable_hw_agnostic_inference_tpu.serve.multihost import MultihostDriver
 
 
@@ -66,6 +67,8 @@ class Svc:
         self.seen = []
 
     def infer(self, payload):
+        if payload.get("bad"):
+            raise HTTPError(400, "bad payload")
         self.seen.append(payload)
         return {"ok": True}
 
@@ -75,13 +78,21 @@ drv = MultihostDriver(svc)
 want = [{"prompt": f"p{i}", "seed": i} for i in range(3)]
 if jax.process_index() == 0:
     drv.wrap_leader()
-    for p in want:
+    for p in want[:2]:
         assert svc.infer(dict(p)) == {"ok": True}
+    # symmetric validation error: a 400 on the leader must NOT kill the
+    # follower's mirror loop (both sides reject before device work)
+    try:
+        svc.infer({"bad": True})
+        raise SystemExit("HTTPError expected")
+    except HTTPError:
+        pass
+    assert svc.infer(dict(want[2])) == {"ok": True}
     drv.shutdown()
     assert svc.seen == want, svc.seen
     print("MULTIHOST_OK 0 leader", flush=True)
 else:
-    drv.follower_loop()   # returns on the shutdown broadcast
+    drv.follower_loop()   # survives the bad payload, ends on shutdown
     assert svc.seen == want, svc.seen
     print("MULTIHOST_OK 1 follower", flush=True)
 """
