@@ -95,6 +95,9 @@ class _Running:
     slot: int
     generated: List[int]
     pending_token: int          # sampled but not yet written to the cache
+    # chunked prefill: prompt position of the next chunk, or None when the
+    # prompt is fully encoded (mid-prefill slots don't join the decode batch)
+    prefill_cursor: Optional[int] = None
 
 
 class LLMEngine:
@@ -132,6 +135,12 @@ class LLMEngine:
             else self.shardings.kv_layer,
         )
         self.buckets = BucketRegistry(sorted(ecfg.context_encoding_buckets))
+        # chunked-prefill prompt cap: whole bucket-sized chunks only (the
+        # continuation ladder is a static set of start offsets), and at
+        # least one position left for generation
+        C = self.buckets.max
+        self._chunk_cap = min(ecfg.max_model_len - 1,
+                              (ecfg.max_model_len // C) * C)
         self._prefill = {}
         # decode executables keyed (ctx_bucket, batch_bucket): the attention
         # window is the smallest token_generation_bucket covering the longest
@@ -207,7 +216,16 @@ class LLMEngine:
             raise ValueError(
                 f"prefix of {n_prefix} tokens exceeds the largest prefill "
                 f"bucket {self.buckets.max}")
-        max_prompt = self.buckets.max - n_prefix
+        if n_prefix or cross_states is not None or self._cross_kv is not None:
+            # multimodal requests — and ALL requests on a cross-attention
+            # engine (its prefill executables carry cross args the chunked
+            # path doesn't) — are bucket-bound (single prefill call)
+            max_prompt = self.buckets.max - n_prefix
+        else:
+            # plain text chunks past the largest bucket (chunked prefill) up
+            # to the model-length budget: full chunks only (the continuation
+            # ladder is static), and room left to generate
+            max_prompt = self._chunk_cap
         if len(prompt_ids) > max_prompt:
             prompt_ids = list(prompt_ids)[-max_prompt:]  # keep the tail
         rid = next(self._ids)
@@ -228,9 +246,18 @@ class LLMEngine:
         """
         self._step_count += 1
         self._done_this_step = []
-        if self.waiting and (self.waiting[0].prefix is not None
-                             or self.waiting[0].cross_states is not None):
+        chunking = [s for s in self.slots
+                    if s is not None and s.prefill_cursor is not None]
+        if chunking:
+            # one continuation chunk per step: the long prompt encodes
+            # incrementally while the running batch keeps decoding below
+            self._continue_prefill(chunking[0])
+        elif self.waiting and (self.waiting[0].prefix is not None
+                               or self.waiting[0].cross_states is not None):
             self._admit_one()       # multimodal: single-seq executables
+        elif (self.waiting and self._cross_kv is None
+              and len(self.waiting[0].prompt_ids) > self.buckets.max):
+            self._admit_long()      # chunked prefill, one slot at a time
         else:
             self._admit_batch()
         if any(s is not None for s in self.slots):
@@ -260,6 +287,25 @@ class LLMEngine:
                 return i
         return None
 
+    def _try_reserve(self, req: Request, n_tokens: int) -> bool:
+        """Optimistic admission gate for ``self.waiting[0]``: True when the
+        pool can hold ``n_tokens`` plus one decode block of headroom. When
+        it can't AND nothing is running — the pool is as free as it will
+        ever get — the request is rejected-and-finished so the queue can't
+        starve (and ``generate()`` can't spin forever)."""
+        need = min(self.cache._blocks_needed(n_tokens + self.ecfg.block_size),
+                   self.ecfg.blocks_per_seq)
+        if need <= self.cache.allocator.n_free:
+            return True
+        if not any(s is not None for s in self.slots):
+            self.waiting.popleft()
+            log.error("rejecting req %d: needs %d blocks, pool max %d",
+                      req.req_id, need, self.cache.allocator.n_free)
+            self._finish(Finished(
+                req.req_id, list(req.already_generated),
+                req.orig_n_prompt, "rejected"))
+        return False
+
     def _admit_one(self) -> None:
         if not self.waiting:
             return
@@ -273,21 +319,7 @@ class LLMEngine:
             # the largest prefill bucket — keep the tail (matches add_request)
             req.prompt_ids = req.prompt_ids[-max_text:]
         n = req.prefix_len + len(req.prompt_ids)  # total cache tokens
-        # optimistic admission: prompt blocks plus one decode block of
-        # headroom, capped at what one sequence can ever use
-        need = min(self.cache._blocks_needed(n + self.ecfg.block_size),
-                   self.ecfg.blocks_per_seq)
-        if need > self.cache.allocator.n_free:
-            if not any(s is not None for s in self.slots):
-                # nothing running => the pool is as free as it will ever get;
-                # this request can never be admitted — fail it, don't starve
-                # the queue (and don't let generate() spin forever)
-                self.waiting.popleft()
-                log.error("rejecting req %d: needs %d blocks, pool max %d",
-                          req.req_id, need, self.cache.allocator.n_free)
-                self._finish(Finished(
-                    req.req_id, list(req.already_generated),
-                    req.orig_n_prompt, "rejected"))
+        if not self._try_reserve(req, n):
             return
         self.waiting.popleft()
         P = req.prefix_len
@@ -430,6 +462,78 @@ class LLMEngine:
             self.slots[slot] = _Running(req, slot, [],
                                         pending_token=int(toks[i]))
 
+    def _admit_long(self) -> None:
+        """Admit a prompt longer than the largest prefill bucket: allocate
+        its full block run, encode the first bucket-sized chunk now, and
+        leave a cursor for ``_continue_prefill`` to advance one chunk per
+        step (decode keeps running between chunks). At most one sequence
+        chunks at a time — a second long prompt waits."""
+        if not self.waiting:
+            return
+        slot = self._free_slot()
+        if slot is None:
+            return
+        req = self.waiting[0]
+        if len(req.prompt_ids) > self._chunk_cap:
+            # preemption re-queues prompt+generated directly, which may
+            # exceed the chunkable cap — keep the tail (matches add_request)
+            req.prompt_ids = req.prompt_ids[-self._chunk_cap:]
+        n_total = len(req.prompt_ids)
+        C = self.buckets.max
+        if n_total <= C:
+            # truncation brought it back inside one bucket — normal path
+            self._admit_batch()
+            return
+        if not self._try_reserve(req, n_total):
+            return
+        self.waiting.popleft()
+        self.cache.admit(req.req_id, n_total)
+        table = jnp.asarray(
+            self.cache.seq(req.req_id).table(self.ecfg.blocks_per_seq))[None]
+        ids = np.asarray(req.prompt_ids[:C], np.int32)[None]
+        fn = self._prefill_for(C, 0, 1)
+        self.cache.kv, _ = fn(self.params, self.cache.kv, jnp.asarray(ids),
+                              jnp.asarray([C], jnp.int32), table)
+        self._has_image[slot] = 0.0
+        self.slots[slot] = _Running(req, slot, [], pending_token=-1,
+                                    prefill_cursor=C)
+
+    def _continue_prefill(self, s: _Running) -> None:
+        """Encode the next chunk of a mid-prefill slot; on the final chunk,
+        sample the first token and join the decode batch."""
+        req = s.req
+        start = s.prefill_cursor
+        C = self.buckets.max
+        chunk = req.prompt_ids[start:start + C]
+        n = len(chunk)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :n] = chunk
+        table = jnp.asarray(
+            self.cache.seq(req.req_id).table(self.ecfg.blocks_per_seq))[None]
+        fn = self._cont_for(start // self.ecfg.block_size)
+        self.cache.kv, logits = fn(
+            self.params, self.cache.kv, jnp.asarray(ids),
+            jnp.asarray([n], jnp.int32), table)
+        if start + n >= len(req.prompt_ids):
+            rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
+            tok = int(self._sample1(
+                logits, rng, req.params.temperature, req.params.top_k,
+                req.params.top_p)[0])
+            s.pending_token = tok
+            s.prefill_cursor = None
+        else:
+            s.prefill_cursor = start + C
+
+    def _cont_for(self, start_blocks: int):
+        from .runner import make_prefill_cont
+
+        key = ("cont", start_blocks)
+        if key not in self._prefill:
+            self._prefill[key] = make_prefill_cont(
+                self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
+                self.buckets.max, start_blocks, shardings=self.shardings)
+        return self._prefill[key]
+
     def _prefill_for(self, bucket: int, prefix_len: int = 0, n_seqs: int = 1):
         key = (bucket, prefix_len, n_seqs)
         if key not in self._prefill:
@@ -487,6 +591,15 @@ class LLMEngine:
                 elif 0 < p < b and self._cross_kv is None:
                     self._prefill_for(b, p)  # prefix path stays single-seq
                     n += 1
+        if self._cross_kv is None and self.ecfg.max_model_len > self.buckets.max:
+            # chunked-prefill ladder: one continuation executable per chunk
+            # start past the largest bucket
+            C = self.buckets.max
+            start = C
+            while start + C <= self.ecfg.max_model_len:
+                self._cont_for(start // self.ecfg.block_size)
+                n += 1
+                start += C
         bb = 1
         batch_buckets = []
         while bb < self.ecfg.max_num_seqs:
@@ -504,7 +617,15 @@ class LLMEngine:
     def _run_warm_calls(self) -> None:
         ecfg = self.ecfg
         B, M = ecfg.max_num_seqs, ecfg.blocks_per_seq
-        for (bucket, P_, K), fn in list(self._prefill.items()):
+        for key, fn in list(self._prefill.items()):
+            if key[0] == "cont":
+                ids = jnp.zeros((1, self.buckets.max), jnp.int32)
+                self.cache.kv, logits = fn(
+                    self.params, self.cache.kv, ids,
+                    jnp.ones((1,), jnp.int32), jnp.zeros((1, M), jnp.int32))
+                logits.block_until_ready()
+                continue
+            bucket, P_, K = key
             ids = jnp.zeros((K, bucket - P_), jnp.int32)
             args = [self.params, self.cache.kv, ids,
                     jnp.ones((K,), jnp.int32), jnp.zeros((K, M), jnp.int32)]
@@ -542,7 +663,10 @@ class LLMEngine:
         self._sample1(
             jnp.zeros((1, V), jnp.float32),
             jax.random.PRNGKey(0), 1.0, 0, 1.0).block_until_ready()
-        for (_, P_, K) in self._prefill:
+        for key in self._prefill:
+            if key[0] == "cont":
+                continue
+            _, P_, K = key
             if P_ == 0:
                 self._sample1(
                     jnp.zeros((K, V), jnp.float32), jax.random.PRNGKey(0),
@@ -561,6 +685,11 @@ class LLMEngine:
         self.cache.release(victim.req.req_id)
         self.slots[victim.slot] = None
         self._has_image[victim.slot] = 0.0
+        if victim.prefill_cursor is not None:
+            # mid-prefill victim: nothing generated — the prompt simply
+            # re-queues and re-chunks from the start when blocks free up
+            self.waiting.appendleft(victim.req)
+            return
         # generated + pending tokens become cache prompt suffix, but stay in
         # the client-visible output via already_generated; budget shrinks by
         # what is already committed (pending included — it was sampled)
@@ -594,8 +723,8 @@ class LLMEngine:
         # grow each running seq by one slot for the pending token; preempt on
         # pool exhaustion (never preempt down to zero running sequences)
         for s in list(self.slots):
-            if s is None:
-                continue
+            if s is None or s.prefill_cursor is not None:
+                continue  # mid-prefill slots neither grow nor decode yet
             while True:
                 try:
                     self.cache.extend(s.req.req_id, 1)
@@ -609,7 +738,8 @@ class LLMEngine:
             if self.slots[s.slot] is not s:
                 continue
 
-        running = [s for s in self.slots if s is not None]
+        running = [s for s in self.slots
+                   if s is not None and s.prefill_cursor is None]
         if not running:
             return
         n_active = len(running)

@@ -284,6 +284,80 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                    in_shardings=tuple(in_sh), out_shardings=(kvsh, rep))
 
 
+def make_prefill_cont(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
+                      bucket: int, start_blocks: int,
+                      shardings: Optional[EngineShardings] = None):
+    """Compile a CONTINUATION prefill chunk: ``cont(params, kv, ids, n_text,
+    block_tables) -> (kv, next_logits)``.
+
+    Prompts longer than the largest prefill bucket process in bucket-sized
+    chunks, one per engine step — this executable handles the chunk whose
+    first token sits at the STATIC position ``start_blocks * block_size``.
+    The chunk's queries attend (a) the ``start`` tokens already written to
+    the pool (gathered densely through the block table — amortized over the
+    whole chunk, unlike decode's per-token gather) and (b) the chunk itself,
+    causally. Keys are the exact concatenation [prior, chunk], so the causal
+    offset ``S - T == start`` is exact and the flash kernel stays eligible
+    (``kv_lengths = start + n_text`` masks chunk padding; a padded tail also
+    writes into null block 0 like every other prefill).
+
+    One executable per chunk start (``max_model_len / bucket - 1`` of them)
+    — the static-shape ladder the reference bakes at compile time with its
+    ``context_encoding_buckets`` (``cova/mllama-32-11b-vllm-trn1-config.yaml:10-16``),
+    extended past the largest bucket. This is what makes a 128k
+    ``max_model_len`` practical rather than a config key.
+    """
+    assert bucket % block_size == 0 and start_blocks >= 1
+    if cfg.cross_attention_layers:
+        raise ValueError("chunked prefill serves plain text models; mllama "
+                         "requests are bucket-bound")
+    start = start_blocks * block_size
+    c_blocks = bucket // block_size
+    assert start_blocks + c_blocks <= blocks_per_seq
+
+    def cont(params, kv, ids, n_text, block_tables):
+        p = params["params"]
+        B = ids.shape[0]  # == 1
+        x = p["embed"]["embedding"][ids].astype(jnp.bfloat16)
+        T = x.shape[1]  # == bucket
+        n = n_text + start  # total valid tokens after this chunk
+        positions = start + jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32), (B, T))
+        tbl_prior = block_tables[:, :start_blocks]        # [B, start_blocks]
+        goff = (tbl_prior[:, :, None] * block_size
+                + jnp.arange(block_size)[None, None, :]).reshape(B, start)
+        tbl_chunk = block_tables[:, start_blocks:start_blocks + c_blocks]
+        for li in range(cfg.n_layers):
+            lp = p[f"layer_{li}"]
+            h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
+            q, k, v = _qkv(lp, h, positions, cfg)
+            kflat = kv[li]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            vflat = kv[li]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            kcat = jnp.concatenate(
+                [kflat[goff].astype(q.dtype), k], axis=1)  # [B, start+T, ...]
+            vcat = jnp.concatenate([vflat[goff].astype(q.dtype), v], axis=1)
+            o = dot_product_attention(q, kcat, vcat, kv_lengths=n, causal=True)
+            x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
+            x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
+            kdst = kv[li]["k"].at[tbl_chunk].set(
+                k.reshape(B, c_blocks, block_size, cfg.n_kv_heads,
+                          cfg.head_dim).astype(kv[li]["k"].dtype))
+            vdst = kv[li]["v"].at[tbl_chunk].set(
+                v.reshape(B, c_blocks, block_size, cfg.n_kv_heads,
+                          cfg.head_dim).astype(kv[li]["v"].dtype))
+            kv[li] = {"k": kdst, "v": vdst}
+        last = jnp.take_along_axis(x, (n_text - 1).reshape(B, 1, 1), axis=1)
+        return kv, _logits(p, last, cfg)[:, 0]  # [B, V]
+
+    if shardings is None:
+        return jax.jit(cont, donate_argnums=(1,))
+    sh, rep = shardings, shardings.rep
+    kvsh = sh.kv_pool(cfg.n_layers)
+    return jax.jit(cont, donate_argnums=(1,),
+                   in_shardings=(sh.params, kvsh, rep, rep, rep),
+                   out_shardings=(kvsh, rep))
+
+
 def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                 max_num_seqs: int, ctx_blocks: Optional[int] = None,
                 shardings: Optional[EngineShardings] = None,

@@ -16,7 +16,6 @@ DEADLINE=$(( $(date +%s) + ${1:-21600} ))
 note() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$LOG"; }
 
 [ -f "$RES" ] || echo '{}' > "$RES"
-export SHAI_BENCH_COMMIT=$(git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
 have() {  # have <key>: does RES already hold a real on-device result?
   python - "$1" <<'EOF'
@@ -34,7 +33,14 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   for w in sd llama llama3b llama_int8 llama3b_int8; do
     have "$w" || missing="$missing $w"
   done
-  [ -z "$missing" ] && { note "all benches done"; break; }
+  if [ -z "$missing" ]; then
+    note "all benches done — running perf breakdowns"
+    PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 2400 python scripts/perf_sd.py \
+      2>&1 | grep -v WARNING | tee -a "$LOG"
+    PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 2400 python scripts/perf_paged.py \
+      2>&1 | grep -v WARNING | tee -a "$LOG"
+    break
+  fi
 
   probe=$(timeout 200 python bench.py --inner --probe 2>/dev/null | tail -1)
   if ! echo "$probe" | grep -q '"probe"'; then
@@ -45,6 +51,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
 
   for w in $missing; do
     note "tunnel alive — running bench $w"
+    # stamp with the commit of the code ACTUALLY measured (commits land
+    # mid-round; a watcher-start hash would be stale provenance)
+    export SHAI_BENCH_COMMIT=$(git rev-parse --short HEAD 2>/dev/null || echo unknown)
     line=$(timeout 3000 python bench.py ${w//_/ } 2>/dev/null | tail -1)
     note "bench $w -> $line"
     python - "$w" "$line" <<'EOF'

@@ -315,8 +315,10 @@ def test_engine_warm_executables_closed_set(tiny_model):
     assert n == count
     # buckets (16, 32) x prefill batch {1, 2} (max_num_seqs=3 caps the
     # power-of-two ladder) = 4, plus buckets x prefix 6 at K=1 = 2,
-    # plus ctx buckets {2, 8} x decode batch buckets {1, 2, 3} = 6
-    assert count == 12
+    # plus ctx buckets {2, 8} x decode batch buckets {1, 2, 3} = 6,
+    # plus the chunked-prefill continuation at start=32 (max_model_len 64
+    # exceeds the largest bucket) = 1
+    assert count == 13
     prompts = [[1, 2, 3], list(range(2, 20)), [7] * 30]
     eng.generate(prompts, SamplingParams(temperature=0.0, max_new_tokens=12))
     assert eng.n_executables == count, "post-warm request compiled a new executable"
@@ -453,3 +455,92 @@ def test_engine_tp_rejects_indivisible_kv_heads(tiny_model, devices):
             max_model_len=64, max_num_seqs=2, block_size=8,
             context_encoding_buckets=(16,), tensor_parallel_size=8),
             mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill (prompts past the largest bucket)
+# ---------------------------------------------------------------------------
+
+def test_chunked_prefill_greedy_parity(tiny_model):
+    """A prompt longer than the largest prefill bucket encodes in chunks
+    (initial bucket + continuation executables) and must produce exactly the
+    contiguous path's greedy tokens."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(3)
+    prompt = [int(x) for x in rng.integers(2, cfg.vocab_size, 60)]
+
+    eng = make_engine(tiny_model, max_model_len=128,
+                      context_encoding_buckets=(16, 32))
+    assert len(prompt) > 32  # really takes the chunked path
+    [fin] = eng.generate([prompt], SamplingParams(temperature=0.0,
+                                                  max_new_tokens=8))
+    assert fin.stop_reason == "length" and len(fin.token_ids) == 8
+
+    gen = make_generate(model, cfg, prompt_bucket=64, max_new_tokens=8,
+                        eos_id=-1)
+    ids = np.zeros((1, 64), np.int32)
+    ids[0, :len(prompt)] = prompt
+    res = gen(params, jnp.asarray(ids), jnp.asarray([len(prompt)], jnp.int32),
+              jax.random.PRNGKey(0), 0.0, 0, 1.0)
+    expected = [int(t) for t in np.asarray(res.tokens)[0]]
+    assert fin.token_ids == expected, (
+        f"chunked prefill {fin.token_ids} != contiguous {expected}")
+
+
+def test_chunked_prefill_interleaves_with_decode(tiny_model):
+    """A long prompt must not stall the running batch: short requests keep
+    decoding between its chunks, and everyone's greedy output matches solo
+    runs."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(5)
+    long_prompt = [int(x) for x in rng.integers(2, cfg.vocab_size, 70)]
+    short = [1, 5, 9]
+
+    solo = []
+    for p in (short, long_prompt):
+        eng = make_engine(tiny_model, max_model_len=128,
+                          context_encoding_buckets=(16, 32), max_num_seqs=4)
+        [f] = eng.generate([p], SamplingParams(temperature=0.0,
+                                               max_new_tokens=6))
+        solo.append(f.token_ids)
+
+    eng = make_engine(tiny_model, max_model_len=128,
+                      context_encoding_buckets=(16, 32), max_num_seqs=4)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    rid_short = eng.add_request(short, sp)
+    eng.step()                      # short admits and starts decoding
+    rid_long = eng.add_request(long_prompt, sp)
+    done = {}
+    short_decoded_during_chunking = False
+    while eng.has_work:
+        mid_prefill = any(s is not None and s.prefill_cursor is not None
+                          for s in eng.slots)
+        before = {s.req.req_id: len(s.generated)
+                  for s in eng.slots if s is not None}
+        for f in eng.step():
+            done[f.req_id] = f
+        if mid_prefill:
+            after = {s.req.req_id: len(s.generated)
+                     for s in eng.slots if s is not None}
+            if after.get(rid_short, 0) > before.get(rid_short, 0):
+                short_decoded_during_chunking = True
+    assert done[rid_short].token_ids == solo[0]
+    assert done[rid_long].token_ids == solo[1]
+    assert short_decoded_during_chunking, (
+        "decode made no progress while the long prompt was chunking")
+
+
+def test_chunked_prefill_within_warmed_set(tiny_model):
+    """warm_executables builds the continuation ladder; a long request after
+    warmup must not compile anything new."""
+    eng = make_engine(tiny_model, max_model_len=128,
+                      context_encoding_buckets=(16, 32))
+    eng.warm_executables()
+    count = eng.n_executables
+    assert any(k[0] == "cont" for k in eng._prefill), "no cont executables warmed"
+    rng = np.random.default_rng(7)
+    prompt = [int(x) for x in rng.integers(2, 500, 90)]
+    [fin] = eng.generate([prompt], SamplingParams(temperature=0.0,
+                                                  max_new_tokens=4))
+    assert len(fin.token_ids) == 4
+    assert eng.n_executables == count, "long prompt compiled outside the warmed set"
