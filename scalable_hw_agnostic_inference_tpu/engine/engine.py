@@ -235,6 +235,17 @@ class LLMEngine:
         return rid
 
     @property
+    def max_prompt_len(self) -> int:
+        """Longest prompt the engine accepts un-truncated: one bucket on
+        cross-attention engines, the chunked-prefill cap otherwise (which
+        ``add_request`` enforces exactly — ≥ the largest bucket in every
+        config where ``max_model_len`` exceeds it). The serving layer
+        truncates its tokenizer output to THIS, not to the largest bucket."""
+        if self._cross_kv is not None:
+            return self.buckets.max
+        return self._chunk_cap
+
+    @property
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
 
@@ -252,12 +263,16 @@ class LLMEngine:
             # one continuation chunk per step: the long prompt encodes
             # incrementally while the running batch keeps decoding below
             self._continue_prefill(chunking[0])
-        elif self.waiting and (self.waiting[0].prefix is not None
-                               or self.waiting[0].cross_states is not None):
+        # admission proceeds even while a long prompt chunks (its slot is
+        # untouched) — queued short prompts must not pay k chunk-steps of
+        # TTFT; only a SECOND long prompt waits for the active chunker
+        if self.waiting and (self.waiting[0].prefix is not None
+                             or self.waiting[0].cross_states is not None):
             self._admit_one()       # multimodal: single-seq executables
         elif (self.waiting and self._cross_kv is None
               and len(self.waiting[0].prompt_ids) > self.buckets.max):
-            self._admit_long()      # chunked prefill, one slot at a time
+            if not chunking:
+                self._admit_long()  # chunked prefill, one slot at a time
         else:
             self._admit_batch()
         if any(s is not None for s in self.slots):
@@ -287,14 +302,20 @@ class LLMEngine:
                 return i
         return None
 
+    def _need_blocks(self, n_tokens: int) -> int:
+        """Optimistic admission cost: prompt blocks plus one decode block of
+        headroom, capped at what one sequence can ever use. THE formula —
+        every admission path prices through here."""
+        return min(self.cache._blocks_needed(n_tokens + self.ecfg.block_size),
+                   self.ecfg.blocks_per_seq)
+
     def _try_reserve(self, req: Request, n_tokens: int) -> bool:
         """Optimistic admission gate for ``self.waiting[0]``: True when the
         pool can hold ``n_tokens`` plus one decode block of headroom. When
         it can't AND nothing is running — the pool is as free as it will
         ever get — the request is rejected-and-finished so the queue can't
         starve (and ``generate()`` can't spin forever)."""
-        need = min(self.cache._blocks_needed(n_tokens + self.ecfg.block_size),
-                   self.ecfg.blocks_per_seq)
+        need = self._need_blocks(n_tokens)
         if need <= self.cache.allocator.n_free:
             return True
         if not any(s is not None for s in self.slots):
@@ -409,20 +430,15 @@ class LLMEngine:
             if bucket >= 0 and b != bucket:
                 break  # different bucket: next step's batch
             n = len(req.prompt_ids)
-            need = min(self.cache._blocks_needed(n + self.ecfg.block_size),
-                       self.ecfg.blocks_per_seq)
-            if need > self.cache.allocator.n_free:
-                if not group and not any(s is not None for s in self.slots):
-                    # nothing running and nothing admitted => the pool is as
-                    # free as it gets; this request can never be admitted
-                    self.waiting.popleft()
-                    log.error("rejecting req %d: needs %d blocks, pool max %d",
-                              req.req_id, need, self.cache.allocator.n_free)
-                    self._finish(Finished(
-                        req.req_id, list(req.already_generated),
-                        req.orig_n_prompt, "rejected"))
-                    continue
-                break
+            if self._need_blocks(n) > self.cache.allocator.n_free:
+                if not group:
+                    # delegate: rejects-and-finishes when nothing is running
+                    # (the pool is as free as it gets), else waits
+                    if not self._try_reserve(req, n):
+                        if self.waiting and self.waiting[0] is req:
+                            break  # pool busy — retry next step
+                        continue   # rejected; consider the next head
+                break  # partial group admitted — flush it, retry next step
             bucket = b
             self.waiting.popleft()
             self.cache.admit(req.req_id, n)
@@ -515,7 +531,11 @@ class LLMEngine:
             self.params, self.cache.kv, jnp.asarray(ids),
             jnp.asarray([n], jnp.int32), table)
         if start + n >= len(req.prompt_ids):
-            rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
+            # own stream: admission may also sample this step (fold 2s+1),
+            # and decode uses fold 2s — a double fold can't collide with
+            # either single-fold stream
+            rng = jax.random.fold_in(
+                jax.random.fold_in(self._rng, self._step_count), 3)
             tok = int(self._sample1(
                 logits, rng, req.params.temperature, req.params.top_k,
                 req.params.top_p)[0])

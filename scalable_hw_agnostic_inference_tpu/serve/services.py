@@ -1036,14 +1036,15 @@ class VllmService(ModelService):
         return None
 
     def _encode(self, text: str):
-        # max() not [-1]: YAML bucket lists arrive in arbitrary order
-        max_bucket = max(self.ecfg.context_encoding_buckets)
+        # the engine's true capacity, not the largest bucket — prompts past
+        # the bucket chunk through the continuation-prefill ladder
+        cap = self._engine.max_prompt_len
         if self._byte_tok:
-            ids, n = self.tokenizer.encode(text, max_bucket)
+            ids, n = self.tokenizer.encode(text, cap)
             return [int(i) for i in ids[:n]]
         with self._tok_lock:
             return [int(i) for i in self.tokenizer(
-                text, truncation=True, max_length=max_bucket)["input_ids"]]
+                text, truncation=True, max_length=cap)["input_ids"]]
 
     def _decode(self, ids) -> str:
         if self._byte_tok:

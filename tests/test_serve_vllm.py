@@ -56,6 +56,32 @@ async def test_vllm_service_generate_and_batching():
 
 
 @pytest.mark.asyncio
+async def test_vllm_service_long_prompt_chunks():
+    """A prompt past the largest prefill bucket must reach the engine
+    un-truncated (chunked continuation prefill), not be silently cut at the
+    bucket — and still generate deterministically."""
+    cfg, service = make_service()
+    app = create_app(cfg, service)
+    async with make_client(app) as c:
+        r = await wait_ready(c, timeout=300.0)
+        assert r.status_code == 200, r.text
+        # past the largest bucket (128) but with generation room inside
+        # max_model_len=256 (byte tokenizer: ~4.3 ids per word)
+        long_text = " ".join(f"w{i}" for i in range(40))
+        ids = service._encode(long_text)
+        max_bucket = max(service.ecfg.context_encoding_buckets)
+        assert len(ids) > max_bucket, "prompt must exceed the largest bucket"
+        assert len(ids) <= service._engine.max_prompt_len
+        payload = {"prompt": long_text, "temperature": 0.0,
+                   "max_new_tokens": 6}
+        r1 = await c.post("/generate", json=payload)
+        r2 = await c.post("/generate", json=payload)
+        assert r1.status_code == 200, r1.text
+        assert r1.json()["n_tokens"] == 6
+        assert r1.json()["generated_text"] == r2.json()["generated_text"]
+
+
+@pytest.mark.asyncio
 async def test_vllm_service_int8_quantized(tmp_path):
     """`quantization: int8` in the mounted vllm_config.yaml boots the engine
     on int8 weights (the vLLM ConfigMap knob, TPU-natively) and still serves
