@@ -249,6 +249,19 @@ class LLMEngine:
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
 
+    @property
+    def n_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def n_running(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    @property
+    def n_chunking(self) -> int:
+        return sum(s is not None and s.prefill_cursor is not None
+                   for s in self.slots)
+
     def step(self) -> List[Finished]:
         """Admit (at most one prefill), then decode the running batch.
 
@@ -421,24 +434,29 @@ class LLMEngine:
             req = self.waiting[0]
             if req.prefix is not None or req.cross_states is not None:
                 break  # multimodal: handled by the single-seq path next step
+            if (self._cross_kv is None
+                    and len(req.prompt_ids) > self.buckets.max):
+                # chunk-capable long prompt: NEVER truncate it here — a
+                # later step's _admit_long owns it (step() routes there once
+                # it reaches the queue head)
+                break
             max_text = self.buckets.max
             if len(req.prompt_ids) > max_text:
-                # preemption re-queues prompt+generated and may overflow the
-                # largest bucket — keep the tail (matches add_request)
+                # cross-attention engines are bucket-bound: a preemption
+                # re-queue may overflow the largest bucket — keep the tail
+                # (matches add_request)
                 req.prompt_ids = req.prompt_ids[-max_text:]
             b = self.buckets.bucket_for(len(req.prompt_ids))
             if bucket >= 0 and b != bucket:
                 break  # different bucket: next step's batch
             n = len(req.prompt_ids)
-            if self._need_blocks(n) > self.cache.allocator.n_free:
-                if not group:
-                    # delegate: rejects-and-finishes when nothing is running
-                    # (the pool is as free as it gets), else waits
-                    if not self._try_reserve(req, n):
-                        if self.waiting and self.waiting[0] is req:
-                            break  # pool busy — retry next step
-                        continue   # rejected; consider the next head
-                break  # partial group admitted — flush it, retry next step
+            if group:
+                if self._need_blocks(n) > self.cache.allocator.n_free:
+                    break  # partial group admitted — flush it, retry next step
+            elif not self._try_reserve(req, n):
+                if self.waiting and self.waiting[0] is req:
+                    break  # pool busy — retry next step
+                continue   # rejected-and-finished; consider the next head
             bucket = b
             self.waiting.popleft()
             self.cache.admit(req.req_id, n)

@@ -92,6 +92,14 @@ class ModelService:
         """
         return None
 
+    def extra_stats(self) -> Dict[str, float]:
+        """Numeric service-level gauges, merged into ``/stats`` and exported
+        as ``shai_service_<key>`` Prometheus gauges on ``/metrics`` (so the
+        control plane can scale on queue depth or pool pressure, not just
+        the request counter). Engine-backed services report queue/slot/block
+        occupancy here."""
+        return {}
+
     def export_artifacts(self, artifact_root: str) -> int:
         """Export portable AOT artifacts (StableHLO via ``core.aot.AotCache``)
         under the artifact root; returns how many were written.
@@ -271,11 +279,39 @@ def create_app(
 
     @app.get("/stats")
     def stats(request: Request):
-        return {
+        out = {
             "served": pub.served,
             "latency": collector.report(),
             "count": collector.count,
         }
+        try:
+            svc = service.extra_stats()
+        except Exception:
+            svc = {}
+        if svc:
+            out["service"] = svc
+        return out
+
+    if pub.registry is not None:
+        # service gauges read at scrape time — queue depth / pool occupancy
+        # become autoscaling signals alongside the request counter
+        from prometheus_client.core import GaugeMetricFamily
+
+        class _ServiceStatsCollector:
+            def collect(self):
+                try:
+                    st = service.extra_stats()
+                except Exception:
+                    return
+                for k, v in st.items():
+                    if isinstance(v, (int, float)):
+                        g = GaugeMetricFamily(
+                            f"shai_service_{k}", f"service gauge {k}",
+                            labels=["app"])
+                        g.add_metric([cfg.app], float(v))
+                        yield g
+
+        pub.registry.register(_ServiceStatsCollector())
 
     # one trace at a time; concurrent POSTs must not corrupt the session.
     # "task" pins the stop coroutine — the event loop holds tasks weakly,

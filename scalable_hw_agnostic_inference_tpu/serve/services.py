@@ -1035,16 +1035,19 @@ class VllmService(ModelService):
             return "engine loop is not running"
         return None
 
-    def _encode(self, text: str):
+    def _encode(self, text: str, add_special: bool = True):
         # the engine's true capacity, not the largest bucket — prompts past
-        # the bucket chunk through the continuation-prefill ladder
+        # the bucket chunk through the continuation-prefill ladder.
+        # add_special=False: chat-template output already carries its own
+        # special tokens (a default BOS would double it)
         cap = self._engine.max_prompt_len
         if self._byte_tok:
             ids, n = self.tokenizer.encode(text, cap)
             return [int(i) for i in ids[:n]]
         with self._tok_lock:
             return [int(i) for i in self.tokenizer(
-                text, truncation=True, max_length=cap)["input_ids"]]
+                text, truncation=True, max_length=cap,
+                add_special_tokens=add_special)["input_ids"]]
 
     def _decode(self, ids) -> str:
         if self._byte_tok:
@@ -1060,7 +1063,8 @@ class VllmService(ModelService):
         if "prompt" not in payload and "text" not in payload:
             raise HTTPError(400, "missing 'prompt'")
         prompt = str(payload.get("prompt", payload.get("text", "")))
-        ids = self._encode(prompt)
+        ids = self._encode(
+            prompt, add_special=payload.get("add_special_tokens", True))
         if not ids:
             raise HTTPError(400, "empty prompt")
         mnt = payload.get("max_new_tokens")
@@ -1116,6 +1120,14 @@ class VllmService(ModelService):
                 raise HTTPError(
                     400, "this deployment's model has no vision tower; "
                          "multimodal requests need a VLM unit")
+        if prefix is not None:
+            # soft-prefix requests are bucket-bound (one prefill call): cap
+            # the text HERE so the engine doesn't silently tail-truncate —
+            # head-keep, matching the tokenizer's truncation side
+            max_text = self._engine.buckets.max - int(prefix.shape[0])
+            if max_text < 1:
+                raise HTTPError(400, "image prefix leaves no prompt room")
+            ids = ids[:max_text]
         fin = self.loop.generate(ids, params, timeout=600.0, prefix=prefix,
                                  cross_states=cross_states,
                                  cross_len=cross_len)
@@ -1124,8 +1136,116 @@ class VllmService(ModelService):
         return {
             "generated_text": self._decode(fin.token_ids),
             "n_tokens": len(fin.token_ids),
+            "n_prompt": fin.n_prompt,
             "stop_reason": fin.stop_reason,
         }
+
+    def extra_stats(self) -> Dict[str, float]:
+        eng = self._engine
+        return {
+            "queue_waiting": eng.n_waiting,
+            "seqs_running": eng.n_running,
+            "seqs_chunking": eng.n_chunking,
+            "blocks_free": eng.cache.allocator.n_free,
+            "blocks_total": self.ecfg.total_blocks,
+            "executables": eng.n_executables,
+        }
+
+    # -- OpenAI-compatible surface ------------------------------------------
+    # The industry-standard serving API on the same engine: /v1/models,
+    # /v1/completions, /v1/chat/completions (non-streaming). The reference's
+    # bespoke /generate stays the primary route; this lets OpenAI-SDK
+    # clients point at the unit unchanged.
+
+    def _openai_generate(self, prompt: str, body: Dict[str, Any],
+                         kind: str, add_special: bool = True) -> Dict[str, Any]:
+        import time as _time
+
+        if body.get("stream"):
+            raise HTTPError(400, "streaming is not supported")
+        # 16 is the legacy /v1/completions default; chat has none — an SDK
+        # chat client omitting max_tokens gets the engine cap, not a stub
+        default_mnt = (self.ecfg.max_new_tokens if kind == "chat"
+                       else min(16, self.ecfg.max_new_tokens))
+        out = self.infer({
+            "prompt": prompt,
+            "temperature": body.get("temperature", 1.0),
+            "top_p": body.get("top_p", 1.0),
+            "max_new_tokens": body.get("max_tokens", default_mnt),
+            "add_special_tokens": add_special,
+        })
+        text = out["generated_text"]
+        finish = "stop" if out["stop_reason"] == "eos" else "length"
+        stop = body.get("stop")
+        if stop:
+            for s in ([stop] if isinstance(stop, str) else list(stop)):
+                cut = text.find(s)
+                if cut >= 0:
+                    text = text[:cut]
+                    finish = "stop"
+        usage = {"prompt_tokens": out["n_prompt"],
+                 "completion_tokens": out["n_tokens"],
+                 "total_tokens": out["n_prompt"] + out["n_tokens"]}
+        base = {"id": f"shai-{next(self._openai_ids)}",
+                "created": int(_time.time()),
+                "model": self.cfg.model_id or "tiny", "usage": usage}
+        if kind == "chat":
+            base["object"] = "chat.completion"
+            base["choices"] = [{"index": 0, "finish_reason": finish,
+                                "message": {"role": "assistant",
+                                            "content": text}}]
+        else:
+            base["object"] = "text_completion"
+            base["choices"] = [{"index": 0, "finish_reason": finish,
+                                "text": text}]
+        return base
+
+    def _chat_prompt(self, messages):
+        """Messages → (prompt text, templated) — templated text carries its
+        own special tokens, so tokenization must not add a second BOS."""
+        if not isinstance(messages, list) or not messages:
+            raise HTTPError(400, "messages must be a non-empty list")
+        for m in messages:
+            if not isinstance(m, dict) or "role" not in m or "content" not in m:
+                raise HTTPError(400, "each message needs role and content")
+        tmpl = getattr(self.tokenizer, "apply_chat_template", None)
+        if tmpl is not None and getattr(self.tokenizer, "chat_template", None):
+            with self._tok_lock:
+                return tmpl(messages, tokenize=False,
+                            add_generation_prompt=True), True
+        lines = [f"{m['role']}: {m['content']}" for m in messages]
+        return "\n".join(lines) + "\nassistant:", False
+
+    def extra_routes(self):
+        import itertools
+
+        self._openai_ids = itertools.count()
+
+        def completions(request):
+            body = request.json()
+            prompt = body.get("prompt")
+            if isinstance(prompt, list):
+                if len(prompt) != 1:
+                    raise HTTPError(400, "exactly one prompt per request")
+                prompt = prompt[0]
+            if not isinstance(prompt, str):
+                raise HTTPError(400, "missing 'prompt'")
+            return self._openai_generate(prompt, body, "completion")
+
+        def chat(request):
+            body = request.json()
+            prompt, templated = self._chat_prompt(body.get("messages"))
+            return self._openai_generate(prompt, body, "chat",
+                                         add_special=not templated)
+
+        def models(request):
+            return {"object": "list",
+                    "data": [{"id": self.cfg.model_id or "tiny",
+                              "object": "model", "owned_by": "shai-tpu"}]}
+
+        return [("/v1/completions", ("POST",), completions),
+                ("/v1/chat/completions", ("POST",), chat),
+                ("/v1/models", ("GET",), models)]
 
 
 class T5EmbedService(ModelService):

@@ -544,3 +544,32 @@ def test_chunked_prefill_within_warmed_set(tiny_model):
                                                   max_new_tokens=4))
     assert len(fin.token_ids) == 4
     assert eng.n_executables == count, "long prompt compiled outside the warmed set"
+
+
+def test_long_prompt_behind_short_not_truncated(tiny_model):
+    """A chunk-capable long prompt queued BEHIND a short one must never be
+    tail-truncated by the batch admitter — its greedy output matches a solo
+    run (the batch loop breaks on it; _admit_long picks it up at the head)."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(11)
+    long_prompt = [int(x) for x in rng.integers(2, cfg.vocab_size, 60)]
+    short = [3, 1, 4]
+
+    eng = make_engine(tiny_model, max_model_len=128,
+                      context_encoding_buckets=(16, 32), max_num_seqs=4)
+    [solo_long] = eng.generate([long_prompt],
+                               SamplingParams(temperature=0.0,
+                                              max_new_tokens=6))
+
+    eng = make_engine(tiny_model, max_model_len=128,
+                      context_encoding_buckets=(16, 32), max_num_seqs=4)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    rid_s = eng.add_request(short, sp)      # head: short
+    rid_l = eng.add_request(long_prompt, sp)  # behind it: long
+    done = {}
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    assert done[rid_l].token_ids == solo_long.token_ids
+    assert done[rid_l].n_prompt == len(long_prompt)
+    assert len(done[rid_s].token_ids) == 6

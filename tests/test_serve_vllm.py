@@ -56,6 +56,65 @@ async def test_vllm_service_generate_and_batching():
 
 
 @pytest.mark.asyncio
+async def test_vllm_openai_surface_and_stats():
+    """OpenAI-compatible routes on the engine unit: /v1/models,
+    /v1/completions (usage + stop sequences), /v1/chat/completions
+    (template fallback) — plus engine gauges on /stats and /metrics."""
+    cfg, service = make_service()
+    app = create_app(cfg, service)
+    async with make_client(app) as c:
+        r = await wait_ready(c, timeout=300.0)
+        assert r.status_code == 200, r.text
+
+        r = await c.get("/v1/models")
+        assert r.status_code == 200
+        assert r.json()["data"][0]["id"] == "tiny"
+
+        r = await c.post("/v1/completions", json={
+            "prompt": "hello world", "max_tokens": 6, "temperature": 0.0})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["object"] == "text_completion"
+        assert body["usage"]["completion_tokens"] == 6
+        assert body["usage"]["total_tokens"] == (
+            body["usage"]["prompt_tokens"] + 6)
+        assert body["choices"][0]["finish_reason"] in ("stop", "length")
+        full_text = body["choices"][0]["text"]
+
+        # a stop sequence inside the generation truncates + flips the reason
+        if len(full_text) > 1:
+            r = await c.post("/v1/completions", json={
+                "prompt": "hello world", "max_tokens": 6,
+                "temperature": 0.0, "stop": [full_text[1]]})
+            got = r.json()["choices"][0]
+            assert got["text"] == full_text.split(full_text[1])[0]
+            assert got["finish_reason"] == "stop"
+
+        r = await c.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi there"}],
+            "max_tokens": 4, "temperature": 0.0})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["object"] == "chat.completion"
+        assert body["choices"][0]["message"]["role"] == "assistant"
+        assert body["usage"]["completion_tokens"] == 4
+
+        r = await c.post("/v1/completions", json={
+            "prompt": "x", "stream": True})
+        assert r.status_code == 400
+
+        r = await c.get("/stats")
+        svc = r.json()["service"]
+        assert svc["queue_waiting"] == 0 and svc["seqs_running"] == 0
+        assert svc["blocks_free"] <= svc["blocks_total"]
+        assert svc["executables"] > 0
+
+        r = await c.get("/metrics")
+        if r.status_code == 200:  # prometheus_client present
+            assert "shai_service_queue_waiting" in r.text
+
+
+@pytest.mark.asyncio
 async def test_vllm_service_long_prompt_chunks():
     """A prompt past the largest prefill bucket must reach the engine
     un-truncated (chunked continuation prefill), not be silently cut at the
