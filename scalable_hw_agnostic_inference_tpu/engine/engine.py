@@ -71,6 +71,9 @@ class Request:
     # cache as prompt suffix but remain part of the client-visible output)
     already_generated: List[int] = dataclasses.field(default_factory=list)
     orig_n_prompt: int = -1
+    # streaming: called (engine-loop thread, must be cheap — a queue put)
+    # exactly once per token that will appear in Finished.token_ids, in order
+    on_token: Optional[Any] = None
 
     def __post_init__(self):
         if self.orig_n_prompt < 0:
@@ -192,7 +195,7 @@ class LLMEngine:
                     params: Optional[SamplingParams] = None,
                     prefix: Optional[np.ndarray] = None,
                     cross_states: Optional[np.ndarray] = None,
-                    cross_len: int = 0) -> int:
+                    cross_len: int = 0, on_token=None) -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -231,8 +234,29 @@ class LLMEngine:
         rid = next(self._ids)
         self.waiting.append(Request(rid, list(prompt_ids), params,
                                     prefix=prefix, cross_states=cross_states,
-                                    cross_len=cross_len))
+                                    cross_len=cross_len, on_token=on_token))
         return rid
+
+    def cancel(self, req_id: int) -> Optional[Finished]:
+        """Abort a request wherever it is (queue, mid-prefill, or decoding),
+        reclaiming its slot and blocks. Returns the partial Finished (reason
+        ``"cancelled"``), or None if the id is unknown/already finished.
+        Used by streamed requests that hit a client-side stop sequence — the
+        engine would otherwise decode to max_new_tokens for nobody."""
+        for i, r in enumerate(self.waiting):
+            if r.req_id == req_id:
+                del self.waiting[i]
+                return Finished(req_id, list(r.already_generated),
+                                r.orig_n_prompt, "cancelled")
+        for s in self.slots:
+            if s is not None and s.req.req_id == req_id:
+                self.cache.release(req_id)
+                self.slots[s.slot] = None
+                self._has_image[s.slot] = 0.0
+                return Finished(req_id,
+                                s.req.already_generated + s.generated,
+                                s.req.orig_n_prompt, "cancelled")
+        return None
 
     @property
     def max_prompt_len(self) -> int:
@@ -732,8 +756,14 @@ class LLMEngine:
         # the client-visible output via already_generated; budget shrinks by
         # what is already committed (pending included — it was sampled)
         committed = victim.generated + [victim.pending_token]
-        emitted = victim.req.already_generated + committed
         p = victim.req.params
+        if (victim.req.on_token is not None
+                and victim.pending_token != p.eos_id):
+            # the pending token was sampled but never appended — it WILL be
+            # in the final output (as prompt suffix), so stream it now to
+            # keep the exactly-once-per-output-token invariant
+            victim.req.on_token(victim.pending_token)
+        emitted = victim.req.already_generated + committed
         if victim.pending_token == p.eos_id or len(committed) >= p.max_new_tokens:
             # nothing left to resume — finish right here
             if emitted and emitted[-1] == p.eos_id:
@@ -754,7 +784,8 @@ class LLMEngine:
             cross_states=victim.req.cross_states,
             cross_len=victim.req.cross_len,
             already_generated=emitted,
-            orig_n_prompt=victim.req.orig_n_prompt))
+            orig_n_prompt=victim.req.orig_n_prompt,
+            on_token=victim.req.on_token))
 
     def _decode_step(self) -> None:
         M = self.ecfg.blocks_per_seq
@@ -831,6 +862,8 @@ class LLMEngine:
             hit_eos = s.pending_token == p.eos_id
             if hit_eos:
                 s.generated.pop()  # exclude EOS from the emitted text
+            elif s.req.on_token is not None:
+                s.req.on_token(s.pending_token)  # stream the committed token
             full = len(s.generated) >= p.max_new_tokens
             total = self.cache.seq(s.req.req_id).n_tokens
             out_of_len = total >= self.ecfg.max_model_len

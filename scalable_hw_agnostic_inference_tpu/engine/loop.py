@@ -31,6 +31,7 @@ class EngineLoop:
         )
         self._futures: dict[int, Future] = {}
         self._futures_lock = threading.Lock()
+        self._cancel_q: "queue.Queue[Future]" = queue.Queue()
         self._poll_s = poll_s
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="engine-loop",
@@ -59,24 +60,34 @@ class EngineLoop:
 
     def submit(self, prompt_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
-               prefix=None, cross_states=None, cross_len: int = 0) -> Future:
+               prefix=None, cross_states=None, cross_len: int = 0,
+               on_token=None) -> Future:
         """Enqueue a request; the future resolves to a :class:`Finished`.
 
         ``prefix``: optional soft-prefix embeddings [P, dim] (vision tokens,
         LLaVA-style). ``cross_states``: optional mllama cross-attention
-        states [Lv, dim] (gated cross layers attend them).
+        states [Lv, dim] (gated cross layers attend them). ``on_token``:
+        streaming callback — called from the loop thread, once per output
+        token, in order; must be cheap (a queue put).
         """
         if self._stop.is_set():
             raise RuntimeError("engine loop is stopped")
         fut: Future = Future()
         self._submit_q.put(
             (list(prompt_ids), params or SamplingParams(),
-             (prefix, cross_states, cross_len), fut))
+             (prefix, cross_states, cross_len, on_token), fut))
         # close the put-after-drain window: if the loop died between our
         # _stop check and the put, nobody will ever drain this item
         if self._stop.is_set():
             self._fail_all(RuntimeError("engine loop is stopped"))
         return fut
+
+    def cancel(self, fut: Future) -> None:
+        """Request cancellation of a submitted request (async: the loop
+        thread aborts it between steps and resolves the future with a
+        partial ``"cancelled"`` Finished). Safe to call when the request
+        already finished — it's a no-op then."""
+        self._cancel_q.put(fut)
 
     def generate(self, prompt_ids: Sequence[int],
                  params: Optional[SamplingParams] = None,
@@ -96,11 +107,12 @@ class EngineLoop:
         except queue.Empty:
             return
         while True:
-            ids, params, (prefix, cross_states, cross_len), fut = item
+            ids, params, (prefix, cross_states, cross_len, on_token), fut = item
             try:
                 rid = self.engine.add_request(ids, params, prefix=prefix,
                                               cross_states=cross_states,
-                                              cross_len=cross_len)
+                                              cross_len=cross_len,
+                                              on_token=on_token)
                 with self._futures_lock:
                     self._futures[rid] = fut
             except Exception as e:  # bad request (e.g. empty prompt)
@@ -125,11 +137,30 @@ class EngineLoop:
                     fut.set_exception(err)
             self._futures.clear()
 
+    def _drain_cancels(self) -> None:
+        while True:
+            try:
+                fut = self._cancel_q.get_nowait()
+            except queue.Empty:
+                return
+            with self._futures_lock:
+                rid = next((r for r, f in self._futures.items() if f is fut),
+                           None)
+            if rid is None:
+                continue  # already finished (or never admitted)
+            fin = self.engine.cancel(rid)
+            if fin is not None:
+                with self._futures_lock:
+                    self._futures.pop(rid, None)
+                if not fut.done():
+                    fut.set_result(fin)
+
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
                 # block for work only when idle; never between engine steps
                 self._drain_submissions(block=not self.engine.has_work)
+                self._drain_cancels()
                 if not self.engine.has_work:
                     continue
                 try:

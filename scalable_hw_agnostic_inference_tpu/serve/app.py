@@ -375,12 +375,30 @@ def create_app(
         return Response(html, media_type="text/html")
 
     # -- model-specific routes --------------------------------------------
+    from .asgi import StreamingResponse
+
     for pattern, methods, handler in service.extra_routes():
         def _wrap(h):
             async def _handler(request: Request, **params):
                 _require_ready()
                 t0 = time.perf_counter()
                 out = await _run_model(lambda: h(request, **params))
+                if isinstance(out, StreamingResponse):
+                    # record when the stream DRAINS, not when the handler
+                    # returns (that's just the submission)
+                    inner = out.iterator
+
+                    def timed_iter():
+                        try:
+                            for chunk in inner:
+                                yield chunk
+                        finally:
+                            dt = time.perf_counter() - t0
+                            collector.record(dt)
+                            pub.publish(dt)
+
+                    out.iterator = timed_iter()
+                    return out
                 dt = time.perf_counter() - t0
                 collector.record(dt)
                 pub.publish(dt)

@@ -84,6 +84,23 @@ class Response:
         self.headers.setdefault("content-length", str(len(self.body)))
 
 
+class StreamingResponse(Response):
+    """Incrementally-produced body (SSE token streams). ``iterator`` yields
+    ``str``/``bytes`` chunks — a SYNC generator; the app drives it on an
+    executor thread so a blocking token queue doesn't stall the event loop.
+    No content-length: the server sends it chunked-encoded."""
+
+    def __init__(self, iterator, status: int = 200,
+                 media_type: str = "text/event-stream",
+                 headers: Optional[Dict[str, str]] = None):
+        self.status = status
+        self.headers = dict(headers or {})
+        self.headers.setdefault("content-type", media_type)
+        self.headers.setdefault("cache-control", "no-store")
+        self.body = b""
+        self.iterator = iterator
+
+
 _SEGMENT = re.compile(r"\{(\w+)(?::(int|float|path))?\}")
 _CASTS = {"int": int, "float": float, None: str, "path": str}
 
@@ -246,4 +263,28 @@ class App:
                 ],
             }
         )
+        if isinstance(response, StreamingResponse):
+            import asyncio
+
+            loop = asyncio.get_event_loop()
+            it = iter(response.iterator)
+            _END = object()
+
+            def _next():
+                try:
+                    return next(it)
+                except StopIteration:
+                    return _END
+
+            while True:
+                chunk = await loop.run_in_executor(None, _next)
+                if chunk is _END:
+                    break
+                if isinstance(chunk, str):
+                    chunk = chunk.encode()
+                if chunk:
+                    await send({"type": "http.response.body", "body": chunk,
+                                "more_body": True})
+            await send({"type": "http.response.body", "body": b""})
+            return
         await send({"type": "http.response.body", "body": response.body})

@@ -102,6 +102,7 @@ class _Connection:
         keep_alive = hmap.get(b"connection", b"keep-alive").lower() != b"close"
         sent_body = False
         started_response = False
+        chunked = False
         messages = [{"type": "http.request", "body": body, "more_body": False}]
 
         async def receive():
@@ -110,7 +111,7 @@ class _Connection:
             return {"type": "http.disconnect"}
 
         async def send(message):
-            nonlocal sent_body, started_response
+            nonlocal sent_body, started_response, chunked
             if message["type"] == "http.response.start":
                 started_response = True
                 status = message["status"]
@@ -121,15 +122,27 @@ class _Connection:
                         has_length = True
                     lines.append(k + b": " + v)
                 if not has_length:
-                    lines.append(b"transfer-encoding: identity")
+                    # unknown-length body (streaming/SSE): chunked framing
+                    # keeps the connection reusable after the stream ends
+                    chunked = True
+                    lines.append(b"transfer-encoding: chunked")
                 lines.append(
                     b"connection: keep-alive" if keep_alive else b"connection: close"
                 )
                 self.writer.write(b"\r\n".join(lines) + b"\r\n\r\n")
             elif message["type"] == "http.response.body":
-                self.writer.write(message.get("body", b""))
-                if not message.get("more_body"):
-                    sent_body = True
+                data = message.get("body", b"")
+                if chunked:
+                    if data:
+                        self.writer.write(
+                            f"{len(data):x}\r\n".encode("latin-1") + data + b"\r\n")
+                    if not message.get("more_body"):
+                        self.writer.write(b"0\r\n\r\n")
+                        sent_body = True
+                else:
+                    self.writer.write(data)
+                    if not message.get("more_body"):
+                        sent_body = True
                 await self.writer.drain()
 
         try:

@@ -55,6 +55,81 @@ class HashTokenizer:
         return np.array(ids), np.array(mask)
 
 
+class SseTextAssembler:
+    """Incremental detokenization for SSE token streams.
+
+    Three properties the naive decode-everything loop lacks:
+
+    - **bounded re-decode**: only the held (unflushed) token window is
+      re-decoded per token, compacting at whitespace boundaries — O(n·W),
+      not O(n²), and lock hold time stays constant;
+    - **stop sequences never leak**: text ending with a proper prefix of a
+      stop string is held back until the next token disambiguates, so a stop
+      spanning a token boundary is truncated exactly like the non-streaming
+      path;
+    - **partial-UTF-8 holdback with end flush**: trailing U+FFFD is held (it
+      may be half a multi-byte sequence) but ``finish()`` flushes it, since
+      a model can legitimately end on undecodable bytes.
+    """
+
+    # forced compaction bound: newline boundaries are the safe reset points
+    # (a mid-sequence suffix re-decode can drop a sentencepiece leading
+    # space), so only force a reset once the window grows well past any
+    # reasonable line length
+    COMPACT_AT = 128
+
+    def __init__(self, decode_fn, stops=()):
+        self.decode = decode_fn
+        self.stops = [s for s in stops if s]
+        self.held: list = []
+        self.sent = 0          # chars of the held window already emitted
+        self.stopped = False
+
+    def _holdback(self, h: str) -> int:
+        """Chars at the end of ``h`` that must not be emitted yet."""
+        safe = len(h)
+        while safe > 0 and h[safe - 1] == "�":
+            safe -= 1
+        hold = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, safe), 0, -1):
+                if h[:safe].endswith(s[:k]):
+                    hold = max(hold, k)
+                    break
+        return safe - hold
+
+    def push(self, tok: int) -> str:
+        """Feed one token; return the text delta now safe to emit."""
+        if self.stopped:
+            return ""
+        self.held.append(int(tok))
+        h = self.decode(self.held)
+        for s in self.stops:
+            cut = h.find(s)
+            if cut >= 0:
+                self.stopped = True
+                delta = h[self.sent:cut] if cut > self.sent else ""
+                self.sent = len(h)
+                return delta
+        safe = self._holdback(h)
+        delta = h[self.sent:safe] if safe > self.sent else ""
+        self.sent = safe
+        if (self.sent == len(h) and h
+                and (h.endswith("\n") or len(self.held) >= self.COMPACT_AT)):
+            self.held = []
+            self.sent = 0
+        return delta
+
+    def finish(self) -> str:
+        """End of stream: flush anything the holdbacks retained."""
+        if self.stopped or not self.held:
+            return ""
+        h = self.decode(self.held)
+        delta = h[self.sent:]
+        self.sent = len(h)
+        return delta
+
+
 def _hf_tokenizer(model_id: str, token: str = "", cache: str = ""):
     """Load an HF tokenizer, optionally backed by an artifact-local copy.
 
@@ -1059,14 +1134,10 @@ class VllmService(ModelService):
         return {"prompt": "the quick brown fox", "temperature": 0.0,
                 "max_new_tokens": 8}
 
-    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        if "prompt" not in payload and "text" not in payload:
-            raise HTTPError(400, "missing 'prompt'")
-        prompt = str(payload.get("prompt", payload.get("text", "")))
-        ids = self._encode(
-            prompt, add_special=payload.get("add_special_tokens", True))
-        if not ids:
-            raise HTTPError(400, "empty prompt")
+    def _sampling_from(self, payload: Dict[str, Any]):
+        """Validated SamplingParams from a request payload (400 on bad
+        values; over-cap max_new_tokens is a client error, not a silent
+        clamp — ADVICE r1)."""
         mnt = payload.get("max_new_tokens")
         try:
             mnt = self.ecfg.max_new_tokens if mnt is None else int(mnt)
@@ -1081,13 +1152,22 @@ class VllmService(ModelService):
             raise HTTPError(400, f"bad sampling parameter: {e}")
         if mnt < 1:
             raise HTTPError(400, "max_new_tokens must be >= 1")
-        # same contract as LlamaService.generate_text: over-cap is a client
-        # error, not a silent clamp (ADVICE r1)
         if mnt > self.ecfg.max_new_tokens:
             raise HTTPError(
                 400,
                 f"max_new_tokens={mnt} exceeds this deployment's engine cap "
                 f"MAX_NEW_TOKENS={self.ecfg.max_new_tokens}")
+        return params
+
+    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        if "prompt" not in payload and "text" not in payload:
+            raise HTTPError(400, "missing 'prompt'")
+        prompt = str(payload.get("prompt", payload.get("text", "")))
+        ids = self._encode(
+            prompt, add_special=payload.get("add_special_tokens", True))
+        if not ids:
+            raise HTTPError(400, "empty prompt")
+        params = self._sampling_from(payload)
         prefix = None
         cross_states = None
         cross_len = 0
@@ -1161,8 +1241,6 @@ class VllmService(ModelService):
                          kind: str, add_special: bool = True) -> Dict[str, Any]:
         import time as _time
 
-        if body.get("stream"):
-            raise HTTPError(400, "streaming is not supported")
         # 16 is the legacy /v1/completions default; chat has none — an SDK
         # chat client omitting max_tokens gets the engine cap, not a stub
         default_mnt = (self.ecfg.max_new_tokens if kind == "chat"
@@ -1200,6 +1278,88 @@ class VllmService(ModelService):
                                 "text": text}]
         return base
 
+    def _openai_stream(self, prompt: str, body: Dict[str, Any], kind: str,
+                       add_special: bool = True):
+        """SSE token stream (OpenAI ``stream: true``): the engine's
+        ``on_token`` callback feeds a queue; the response generator decodes
+        incrementally (holding back partial UTF-8 sequences) and emits
+        OpenAI-shaped chunks, finishing with ``data: [DONE]``."""
+        import json as _json
+        import queue as _q
+        import time as _time
+
+        from .asgi import StreamingResponse
+
+        ids = self._encode(prompt, add_special=add_special)
+        if not ids:
+            raise HTTPError(400, "empty prompt")
+        default_mnt = (self.ecfg.max_new_tokens if kind == "chat"
+                       else min(16, self.ecfg.max_new_tokens))
+        params = self._sampling_from({
+            "temperature": body.get("temperature", 1.0),
+            "top_p": body.get("top_p", 1.0),
+            "max_new_tokens": body.get("max_tokens", default_mnt)})
+        stop = body.get("stop") or []
+        stops = [stop] if isinstance(stop, str) else list(stop)
+        tokq: "_q.Queue[int]" = _q.Queue()
+        fut = self.loop.submit(ids, params, on_token=tokq.put)
+        rid = f"shai-{next(self._openai_ids)}"
+        created = int(_time.time())
+        model = self.cfg.model_id or "tiny"
+
+        def event(delta: str, finish, first: bool) -> str:
+            if kind == "chat":
+                d: Dict[str, Any] = {}
+                if first:
+                    d["role"] = "assistant"
+                if delta:
+                    d["content"] = delta
+                choice = {"index": 0, "delta": d, "finish_reason": finish}
+                obj = "chat.completion.chunk"
+            else:
+                choice = {"index": 0, "text": delta, "finish_reason": finish}
+                obj = "text_completion"
+            return "data: " + _json.dumps(
+                {"id": rid, "object": obj, "created": created,
+                 "model": model, "choices": [choice]}) + "\n\n"
+
+        asm = SseTextAssembler(self._decode, stops)
+
+        def chunks():
+            first = True
+            finish = None
+            if kind == "chat":
+                yield event("", None, True)  # role preamble chunk
+                first = False
+            while True:
+                try:
+                    tok = tokq.get(timeout=0.2)
+                except _q.Empty:
+                    if fut.done() and tokq.empty():
+                        break
+                    continue
+                delta = asm.push(tok)
+                if delta:
+                    yield event(delta, None, first)
+                    first = False
+                if asm.stopped:
+                    # the engine would decode to max_new_tokens for nobody —
+                    # abort the request and reclaim its slot/blocks
+                    finish = "stop"
+                    self.loop.cancel(fut)
+                    break
+            fin = fut.result(timeout=600.0)
+            if finish is None:
+                finish = "stop" if fin.stop_reason == "eos" else "length"
+                tail = asm.finish()  # flush the partial-UTF-8 holdback
+                if tail:
+                    yield event(tail, None, first)
+                    first = False
+            yield event("", finish, False)
+            yield "data: [DONE]\n\n"
+
+        return StreamingResponse(chunks())
+
     def _chat_prompt(self, messages):
         """Messages → (prompt text, templated) — templated text carries its
         own special tokens, so tokenization must not add a second BOS."""
@@ -1230,11 +1390,16 @@ class VllmService(ModelService):
                 prompt = prompt[0]
             if not isinstance(prompt, str):
                 raise HTTPError(400, "missing 'prompt'")
+            if body.get("stream"):
+                return self._openai_stream(prompt, body, "completion")
             return self._openai_generate(prompt, body, "completion")
 
         def chat(request):
             body = request.json()
             prompt, templated = self._chat_prompt(body.get("messages"))
+            if body.get("stream"):
+                return self._openai_stream(prompt, body, "chat",
+                                           add_special=not templated)
             return self._openai_generate(prompt, body, "chat",
                                          add_special=not templated)
 

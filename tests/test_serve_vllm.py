@@ -17,6 +17,55 @@ from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
 from test_serve_http import make_client, wait_ready
 
 
+def _char_decode(ids):
+    return "".join(chr(i) for i in ids)
+
+
+def test_sse_assembler_stop_spanning_tokens():
+    """A stop sequence split across token boundaries must never leak its
+    prefix (OpenAI semantics: nothing at or after the stop is emitted)."""
+    from scalable_hw_agnostic_inference_tpu.serve.services import (
+        SseTextAssembler,
+    )
+
+    asm = SseTextAssembler(_char_decode, ["ab"])
+    assert asm.push(ord("x")) == "x"
+    assert asm.push(ord("a")) == ""   # held: could begin "ab"
+    assert asm.push(ord("b")) == ""   # stop confirmed; "a" never leaked
+    assert asm.stopped
+    assert asm.finish() == ""
+
+    # the held prefix releases when the next token disambiguates
+    asm = SseTextAssembler(_char_decode, ["ab"])
+    assert asm.push(ord("x")) == "x"
+    assert asm.push(ord("a")) == ""
+    assert asm.push(ord("c")) == "ac"
+    assert not asm.stopped
+
+
+def test_sse_assembler_utf8_holdback_flushes_at_end():
+    from scalable_hw_agnostic_inference_tpu.serve.services import (
+        SseTextAssembler,
+    )
+
+    asm = SseTextAssembler(lambda ids: "�" * len(ids), [])
+    assert asm.push(1) == ""
+    assert asm.push(2) == ""
+    assert asm.finish() == "��"   # legit undecodable bytes still arrive
+
+
+def test_sse_assembler_compacts_on_newline():
+    from scalable_hw_agnostic_inference_tpu.serve.services import (
+        SseTextAssembler,
+    )
+
+    asm = SseTextAssembler(_char_decode, [])
+    assert asm.push(ord("q")) == "q"
+    assert asm.push(ord("\n")) == "\n"
+    assert asm.held == []          # bounded re-decode window reset
+    assert asm.push(ord("z")) == "z"
+
+
 def make_service(tmp_path=None, **env_over):
     cfg = ServeConfig(app="llm", model_id="tiny", device="cpu",
                       max_new_tokens=8, vllm_config="/nonexistent.yaml",
@@ -99,9 +148,39 @@ async def test_vllm_openai_surface_and_stats():
         assert body["choices"][0]["message"]["role"] == "assistant"
         assert body["usage"]["completion_tokens"] == 4
 
+        # SSE streaming: concatenated deltas must equal the non-streaming
+        # text, chunks are OpenAI-shaped, and the stream terminates [DONE]
+        import json as _json
+
         r = await c.post("/v1/completions", json={
-            "prompt": "x", "stream": True})
-        assert r.status_code == 400
+            "prompt": "hello world", "max_tokens": 6, "temperature": 0.0,
+            "stream": True})
+        assert r.status_code == 200, r.text
+        assert r.headers["content-type"].startswith("text/event-stream")
+        events = [ln[len("data: "):] for ln in r.text.split("\n\n")
+                  if ln.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        parsed = [_json.loads(e) for e in events[:-1]]
+        assert all(p["object"] == "text_completion" for p in parsed)
+        streamed = "".join(p["choices"][0]["text"] for p in parsed)
+        assert streamed == full_text
+        assert parsed[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+        assert all(p["choices"][0]["finish_reason"] is None
+                   for p in parsed[:-1])
+
+        r = await c.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "temperature": 0.0, "stream": True})
+        assert r.status_code == 200, r.text
+        events = [ln[len("data: "):] for ln in r.text.split("\n\n")
+                  if ln.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        parsed = [_json.loads(e) for e in events[:-1]]
+        assert all(p["object"] == "chat.completion.chunk" for p in parsed)
+        assert parsed[0]["choices"][0]["delta"].get("role") == "assistant"
+        content = "".join(p["choices"][0]["delta"].get("content", "")
+                          for p in parsed)
+        assert len(content) > 0
 
         r = await c.get("/stats")
         svc = r.json()["service"]
@@ -112,6 +191,51 @@ async def test_vllm_openai_surface_and_stats():
         r = await c.get("/metrics")
         if r.status_code == 200:  # prometheus_client present
             assert "shai_service_queue_waiting" in r.text
+
+
+def test_vllm_streaming_over_real_socket():
+    """SSE through the real asyncio server: chunked transfer-encoding frames
+    the stream and the connection stays reusable afterwards."""
+    import http.client
+    import json as _json
+    import time
+
+    from scalable_hw_agnostic_inference_tpu.serve.httpd import Server
+
+    cfg, service = make_service()
+    app = create_app(cfg, service)
+    srv = Server(app, host="127.0.0.1", port=0)
+    srv.start_background()
+    port = srv.port
+    deadline = time.time() + 300
+    while True:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/readiness")
+        r = conn.getresponse()
+        r.read()
+        if r.status == 200:
+            break
+        conn.close()
+        assert time.time() < deadline, "service never became ready"
+        time.sleep(1.0)
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/completions",
+                 body=_json.dumps({"prompt": "hello world", "max_tokens": 4,
+                                   "temperature": 0.0, "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    r = conn.getresponse()
+    assert r.status == 200
+    assert r.getheader("transfer-encoding") == "chunked"
+    body = r.read().decode()  # http.client de-chunks transparently
+    assert body.rstrip().endswith("data: [DONE]")
+    # chunked framing ended cleanly: the SAME connection serves another
+    # request (keep-alive survived the stream)
+    conn.request("GET", "/health")
+    r2 = conn.getresponse()
+    assert r2.status == 200
+    r2.read()
+    conn.close()
 
 
 @pytest.mark.asyncio
