@@ -21,10 +21,13 @@ import numpy as np
 
 
 class BlockAllocator:
-    """Free-list allocator over ``total_blocks`` physical blocks.
+    """Refcounted free-list allocator over ``total_blocks`` physical blocks.
 
     Block 0 is reserved as the null block (block tables are padded with 0;
     its contents are garbage but always masked out by sequence lengths).
+    Refcounts exist for prefix caching: a block shared by k sequences (plus
+    possibly the prefix cache itself) is freed only when every holder lets
+    go.
     """
 
     def __init__(self, total_blocks: int):
@@ -32,23 +35,40 @@ class BlockAllocator:
             raise ValueError("need at least 2 blocks (0 is reserved)")
         self.total = total_blocks
         self._free: List[int] = list(range(total_blocks - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
 
     @property
     def n_free(self) -> int:
         return len(self._free)
 
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
     def alloc(self, n: int) -> List[int]:
         if n > len(self._free):
             raise MemoryError(f"wanted {n} blocks, {len(self._free)} free")
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def incref(self, block: int) -> None:
+        if block not in self._ref:
+            raise ValueError(f"incref of unallocated block {block}")
+        self._ref[block] += 1
 
     def free(self, blocks: List[int]) -> None:
+        """Drop one reference per block; a block returns to the free list
+        when its last reference goes."""
         for b in blocks:
             if b == 0:
                 raise ValueError("block 0 is reserved")
-            if b in self._free:
+            if b not in self._ref:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                self._free.append(b)
 
 
 @dataclasses.dataclass
@@ -75,11 +95,27 @@ class PagedKVCache:
 
     def __init__(self, n_layers: int, n_kv_heads: int, head_dim: int,
                  total_blocks: int, block_size: int, blocks_per_seq: int,
-                 dtype=jnp.bfloat16, sharding=None):
+                 dtype=jnp.bfloat16, sharding=None,
+                 enable_prefix_caching: bool = False):
         self.n_layers = n_layers
         self.block_size = block_size
         self.blocks_per_seq = blocks_per_seq
         self.allocator = BlockAllocator(total_blocks)
+        # automatic prefix caching (the vLLM knob): full blocks are
+        # content-addressed by a chain hash over their tokens; the cache
+        # holds one reference per cached block and evicts LRU when the
+        # allocator runs dry. Registered blocks are never written again
+        # (prefill writes only a sequence's OWN fresh blocks; decode writes
+        # past the prompt), so sharing is read-only by construction.
+        self.prefix_caching = enable_prefix_caching
+        self._hash2block: Dict[int, int] = {}
+        self._block2hash: Dict[int, int] = {}
+        self._lru: Dict[int, None] = {}     # insertion-ordered hash -> None
+        # chain links for leaf-first eviction: evicting a chain HEAD first
+        # would strand its cached descendants (lookups break at the missing
+        # head while the tail still pins blocks)
+        self._parent: Dict[int, int] = {}
+        self._nchild: Dict[int, int] = {}
         shape = (total_blocks, block_size, n_kv_heads, head_dim)
 
         def zeros(name: str) -> jax.Array:
@@ -93,17 +129,128 @@ class PagedKVCache:
         self.kv = [{"k": zeros("k"), "v": zeros("v")} for _ in range(n_layers)]
         self._seqs: Dict[int, SeqAllocation] = {}
 
+    # -- prefix cache -------------------------------------------------------
+
+    @staticmethod
+    def _chain_hashes(tokens, block_size: int):
+        """Chain hash per FULL block: h_i commits to every token up to and
+        including block i, so equal hashes mean equal prefixes."""
+        out = []
+        h = 0x5351  # fixed seed: process-local python hashes suffice
+        for i in range(len(tokens) // block_size):
+            h = hash((h, tuple(tokens[i * block_size:(i + 1) * block_size])))
+            out.append(h)
+        return out
+
+    def cached_prefix(self, tokens) -> List[int]:
+        """Longest run of cached blocks matching the prompt's full blocks."""
+        if not self.prefix_caching:
+            return []
+        blocks = []
+        for h in self._chain_hashes(tokens, self.block_size):
+            b = self._hash2block.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+            self._lru.pop(h, None)      # touch: most-recently-used
+            self._lru[h] = None
+        return blocks
+
+    def register_prefix(self, tokens, blocks: List[int]) -> None:
+        """Publish a prefilled prompt's full blocks for future reuse; the
+        cache takes one reference per newly-registered block."""
+        if not self.prefix_caching:
+            return
+        prev = None
+        for h, b in zip(self._chain_hashes(tokens, self.block_size), blocks):
+            if h in self._hash2block:
+                prev = h
+                continue  # an identical block is already published
+            if b in self._block2hash:
+                prev = h
+                continue  # this physical block already backs another hash
+            self._hash2block[h] = b
+            self._block2hash[b] = h
+            self.allocator.incref(b)
+            self._lru[h] = None
+            if prev is not None and prev in self._hash2block:
+                self._parent[h] = prev
+                self._nchild[prev] = self._nchild.get(prev, 0) + 1
+            prev = h
+
+    @property
+    def n_evictable(self) -> int:
+        """Cached blocks held ONLY by the cache (refcount 1) — reclaimable."""
+        return sum(1 for h, b in self._hash2block.items()
+                   if self.allocator.refcount(b) == 1)
+
+    @property
+    def n_available(self) -> int:
+        """Free blocks plus what eviction could reclaim — the admission
+        gate's denominator."""
+        return self.allocator.n_free + self.n_evictable
+
+    def _evict(self, n: int) -> int:
+        """Drop up to ``n`` LRU cache-only blocks, LEAVES first — a chain
+        must shed from the tail or its survivors become unreachable."""
+        dropped = 0
+        progress = True
+        while dropped < n and progress:
+            progress = False
+            for h in list(self._lru):
+                if dropped >= n:
+                    break
+                b = self._hash2block[h]
+                if self.allocator.refcount(b) != 1:
+                    continue  # still shared by a live sequence
+                if self._nchild.get(h, 0):
+                    continue  # cached descendants would be stranded
+                del self._hash2block[h]
+                del self._block2hash[b]
+                del self._lru[h]
+                parent = self._parent.pop(h, None)
+                if parent is not None:
+                    self._nchild[parent] -= 1
+                    if not self._nchild[parent]:
+                        del self._nchild[parent]
+                self.allocator.free([b])
+                dropped += 1
+                progress = True
+        return dropped
+
+    def _alloc(self, n: int) -> List[int]:
+        short = n - self.allocator.n_free
+        if short > 0:
+            self._evict(short)
+        return self.allocator.alloc(n)
+
     # -- host-side sequence lifecycle --------------------------------------
 
     def can_admit(self, n_tokens: int) -> bool:
-        return self._blocks_needed(n_tokens) <= self.allocator.n_free
+        return self._blocks_needed(n_tokens) <= self.n_available
 
-    def admit(self, seq_id: int, n_tokens: int) -> SeqAllocation:
-        """Allocate blocks to cover ``n_tokens`` prompt tokens."""
+    def admit(self, seq_id: int, n_tokens: int,
+              reuse_blocks: Optional[List[int]] = None) -> SeqAllocation:
+        """Allocate blocks to cover ``n_tokens`` prompt tokens.
+
+        ``reuse_blocks``: cached prefix blocks to share (prefix caching) —
+        they are increfed, and only the remainder is freshly allocated.
+        """
         if seq_id in self._seqs:
             raise ValueError(f"seq {seq_id} already admitted")
-        alloc = SeqAllocation(seq_id, self.allocator.alloc(
-            self._blocks_needed(n_tokens)), n_tokens)
+        reuse = list(reuse_blocks or [])
+        need = self._blocks_needed(n_tokens) - len(reuse)
+        assert need >= 0, "reuse longer than the prompt"
+        # pin the reused blocks FIRST: at refcount 2 they are not evictable,
+        # so the allocation below can never evict what we are about to share
+        for b in reuse:
+            self.allocator.incref(b)
+        try:
+            fresh = self._alloc(need)
+        except MemoryError:
+            self.allocator.free(reuse)
+            raise
+        alloc = SeqAllocation(seq_id, reuse + fresh, n_tokens)
         self._seqs[seq_id] = alloc
         return alloc
 
@@ -114,13 +261,13 @@ class PagedKVCache:
         if need > 0:
             if len(alloc.blocks) + need > self.blocks_per_seq:
                 raise MemoryError(f"seq {seq_id} exceeds max_model_len")
-            alloc.blocks.extend(self.allocator.alloc(need))
+            alloc.blocks.extend(self._alloc(need))
         alloc.n_tokens += n_new
         return alloc
 
     def release(self, seq_id: int) -> None:
         alloc = self._seqs.pop(seq_id)
-        self.allocator.free(alloc.blocks)
+        self.allocator.free(alloc.blocks)  # cached blocks survive (cache ref)
 
     def seq(self, seq_id: int) -> SeqAllocation:
         return self._seqs[seq_id]

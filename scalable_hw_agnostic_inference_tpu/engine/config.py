@@ -33,6 +33,10 @@ class EngineConfig:
     # weight-only quantization: None/"" = bf16 weights, "int8" = per-channel
     # int8 (ops.quant) — the vLLM `quantization:` config key, TPU-natively
     quantization: Optional[str] = None
+    # automatic prefix caching (the vLLM knob): shared prompt prefixes reuse
+    # KV blocks (refcounted) and skip their prefill compute via the
+    # continuation-prefill executables
+    enable_prefix_caching: bool = False
     # on-device sampling (reference: global_topk 64, dynamic)
     global_topk: int = 64
     max_new_tokens: int = 128

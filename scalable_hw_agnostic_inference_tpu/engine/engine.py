@@ -136,6 +136,10 @@ class LLMEngine:
             dtype=jnp.bfloat16 if ecfg.dtype == "bfloat16" else jnp.float32,
             sharding=None if self.shardings is None
             else self.shardings.kv_layer,
+            # prefix caching serves the plain-text path only: cross models'
+            # cache semantics (vision states) don't content-address by tokens
+            enable_prefix_caching=(ecfg.enable_prefix_caching
+                                   and not model_cfg.cross_attention_layers),
         )
         self.buckets = BucketRegistry(sorted(ecfg.context_encoding_buckets))
         # chunked-prefill prompt cap: whole bucket-sized chunks only (the
@@ -183,6 +187,7 @@ class LLMEngine:
             self._cross_write = make_cross_slot_write(model_cfg)
         self.waiting: deque[Request] = deque()
         self.slots: List[Optional[_Running]] = [None] * ecfg.max_num_seqs
+        self._warmed = False
         self._ids = itertools.count()
         self._step_count = 0
         self._rng = jax.random.PRNGKey(ecfg.seed)
@@ -306,6 +311,9 @@ class LLMEngine:
         if self.waiting and (self.waiting[0].prefix is not None
                              or self.waiting[0].cross_states is not None):
             self._admit_one()       # multimodal: single-seq executables
+        elif (self.cache.prefix_caching and self.waiting
+              and self._admit_cached()):
+            pass                    # cached-prefix admission handled it
         elif (self.waiting and self._cross_kv is None
               and len(self.waiting[0].prompt_ids) > self.buckets.max):
             if not chunking:
@@ -353,7 +361,7 @@ class LLMEngine:
         ever get — the request is rejected-and-finished so the queue can't
         starve (and ``generate()`` can't spin forever)."""
         need = self._need_blocks(n_tokens)
-        if need <= self.cache.allocator.n_free:
+        if need <= self.cache.n_available:
             return True
         if not any(s is not None for s in self.slots):
             self.waiting.popleft()
@@ -395,6 +403,10 @@ class LLMEngine:
         if self._cross_kv is not None:
             args += list(self._set_slot_cross(slot, req))
         self.cache.kv, logits = fn(*args)
+        # no register_prefix here: this path only ever admits prefix/cross
+        # (vision-conditioned) requests, whose blocks must NOT
+        # content-address by tokens alone — and cross engines disable the
+        # cache at construction anyway
         rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
         tok = int(self._sample1(
             logits, rng, req.params.temperature, req.params.top_k,
@@ -475,7 +487,7 @@ class LLMEngine:
                 break  # different bucket: next step's batch
             n = len(req.prompt_ids)
             if group:
-                if self._need_blocks(n) > self.cache.allocator.n_free:
+                if self._need_blocks(n) > self.cache.n_available:
                     break  # partial group admitted — flush it, retry next step
             elif not self._try_reserve(req, n):
                 if self.waiting and self.waiting[0] is req:
@@ -510,6 +522,9 @@ class LLMEngine:
             args += [self._cross_zeros(Kp), jnp.zeros((Kp,), jnp.float32),
                      jnp.full((Kp,), max(self.cross_seq_len, 1), jnp.int32)]
         self.cache.kv, logits = fn(*args)
+        for req in group:  # batch rows are always plain text
+            self.cache.register_prefix(req.prompt_ids,
+                                       self.cache.seq(req.req_id).blocks)
         rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
         toks = np.asarray(self._sample1(
             logits, rng, jnp.asarray(temp), jnp.asarray(topk),
@@ -519,6 +534,59 @@ class LLMEngine:
             self._has_image[slot] = 0.0
             self.slots[slot] = _Running(req, slot, [],
                                         pending_token=int(toks[i]))
+
+    def _admit_cached(self) -> bool:
+        """Admit the head request reusing its cached prefix blocks: incref
+        the shared blocks, run ONE continuation chunk over just the
+        uncached remainder, and register the result. Returns False when the
+        cache offers no usable (warm-start-aligned) benefit — the caller
+        falls through to the normal admission paths."""
+        req = self.waiting[0]
+        n_total = len(req.prompt_ids)
+        if n_total <= self.ecfg.block_size:
+            return False  # no full block to share
+        cached = self.cache.cached_prefix(req.prompt_ids)
+        start = self._cached_start_for(
+            n_total, len(cached) * self.ecfg.block_size)
+        if start == 0:
+            return False
+        chunk_bucket = self.buckets.bucket_for(n_total - start)
+        sb = start // self.ecfg.block_size
+        if start + chunk_bucket > self.ecfg.max_model_len:
+            return False  # chunk executable would overrun blocks_per_seq
+        if self._warmed and ("cont", sb, chunk_bucket) not in self._prefill:
+            return False  # post-ready compiles are the cold-graph bug
+        slot = self._free_slot()
+        if slot is None:
+            return False
+        need_new = self._need_blocks(n_total) - sb
+        # conservative: pinning the reused blocks removes up to sb blocks
+        # from the evictable supply n_available counts
+        if need_new > self.cache.n_available - sb:
+            return False  # normal paths own reject-vs-wait semantics
+        self.waiting.popleft()
+        try:
+            alloc = self.cache.admit(req.req_id, n_total,
+                                     reuse_blocks=cached[:sb])
+        except MemoryError:
+            self.waiting.appendleft(req)
+            return False  # let the normal paths wait-or-reject
+        table = jnp.asarray(alloc.table(self.ecfg.blocks_per_seq))[None]
+        n = n_total - start
+        ids = np.zeros((1, chunk_bucket), np.int32)
+        ids[0, :n] = req.prompt_ids[start:]
+        fn = self._cont_for(sb, chunk_bucket)
+        self.cache.kv, logits = fn(self.params, self.cache.kv,
+                                   jnp.asarray(ids),
+                                   jnp.asarray([n], jnp.int32), table)
+        self.cache.register_prefix(req.prompt_ids, alloc.blocks)
+        rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
+        tok = int(self._sample1(
+            logits, rng, req.params.temperature, req.params.top_k,
+            req.params.top_p)[0])
+        self._has_image[slot] = 0.0
+        self.slots[slot] = _Running(req, slot, [], pending_token=tok)
+        return True
 
     def _admit_long(self) -> None:
         """Admit a prompt longer than the largest prefill bucket: allocate
@@ -573,6 +641,8 @@ class LLMEngine:
             self.params, self.cache.kv, jnp.asarray(ids),
             jnp.asarray([n], jnp.int32), table)
         if start + n >= len(req.prompt_ids):
+            self.cache.register_prefix(
+                req.prompt_ids, self.cache.seq(req.req_id).blocks)
             # own stream: admission may also sample this step (fold 2s+1),
             # and decode uses fold 2s — a double fold can't collide with
             # either single-fold stream
@@ -586,15 +656,39 @@ class LLMEngine:
         else:
             s.prefill_cursor = start + C
 
-    def _cont_for(self, start_blocks: int):
+    def _cont_for(self, start_blocks: int, bucket: Optional[int] = None):
         from .runner import make_prefill_cont
 
-        key = ("cont", start_blocks)
+        bucket = self.buckets.max if bucket is None else bucket
+        key = ("cont", start_blocks, bucket)
         if key not in self._prefill:
             self._prefill[key] = make_prefill_cont(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
-                self.buckets.max, start_blocks, shardings=self.shardings)
+                bucket, start_blocks, shardings=self.shardings)
         return self._prefill[key]
+
+    def _cached_starts(self) -> List[int]:
+        """THE closed set of continuation starts (token units) — both the
+        warm ladder and cached admission price from this one list: every
+        prefill bucket plus every multiple of the largest bucket."""
+        C = self.buckets.max
+        starts = set(self.buckets.buckets)
+        s = C
+        while s + 1 < self.ecfg.max_model_len:
+            starts.add(s)
+            s += C
+        return sorted(starts)
+
+    def _cached_start_for(self, n_total: int, cached_tokens: int) -> int:
+        """Largest warm continuation start covered by the cached prefix and
+        leaving a remainder that fits ONE chunk executable; 0 = no benefit."""
+        C = self.buckets.max
+        best = 0
+        for s in self._cached_starts():
+            if (s <= cached_tokens and s < n_total
+                    and n_total - s <= C and s > best):
+                best = s
+        return best
 
     def _prefill_for(self, bucket: int, prefix_len: int = 0, n_seqs: int = 1):
         key = (bucket, prefix_len, n_seqs)
@@ -662,6 +756,17 @@ class LLMEngine:
                 self._cont_for(start // self.ecfg.block_size)
                 n += 1
                 start += C
+        if self.cache.prefix_caching:
+            # cached-admission ladder: (warm start, chunk bucket) pairs so a
+            # cache hit never compiles post-ready (closed set — the SAME
+            # _cached_starts list admission picks from)
+            for s in self._cached_starts():
+                for cb in self.buckets.buckets:
+                    if s + cb <= self.ecfg.max_model_len:
+                        key = ("cont", s // self.ecfg.block_size, cb)
+                        if key not in self._prefill:
+                            self._cont_for(s // self.ecfg.block_size, cb)
+                            n += 1
         bb = 1
         batch_buckets = []
         while bb < self.ecfg.max_num_seqs:
@@ -674,6 +779,7 @@ class LLMEngine:
                 n += 1
         # force compilation (jit is lazy until first call) with null args
         self._run_warm_calls()
+        self._warmed = True  # cached admission now refuses cold compiles
         return n
 
     def _run_warm_calls(self) -> None:
@@ -681,7 +787,7 @@ class LLMEngine:
         B, M = ecfg.max_num_seqs, ecfg.blocks_per_seq
         for key, fn in list(self._prefill.items()):
             if key[0] == "cont":
-                ids = jnp.zeros((1, self.buckets.max), jnp.int32)
+                ids = jnp.zeros((1, key[2]), jnp.int32)
                 self.cache.kv, logits = fn(
                     self.params, self.cache.kv, ids,
                     jnp.ones((1,), jnp.int32), jnp.zeros((1, M), jnp.int32))

@@ -1020,6 +1020,7 @@ class VllmService(ModelService):
                 token_generation_buckets=ecfg.token_generation_buckets,
                 tensor_parallel_size=ecfg.tensor_parallel_size,
                 quantization=ecfg.quantization,
+                enable_prefix_caching=ecfg.enable_prefix_caching,
                 max_new_tokens=min(ecfg.max_new_tokens, 64))
 
         self.ecfg = ecfg
@@ -1264,7 +1265,7 @@ class VllmService(ModelService):
         usage = {"prompt_tokens": out["n_prompt"],
                  "completion_tokens": out["n_tokens"],
                  "total_tokens": out["n_prompt"] + out["n_tokens"]}
-        base = {"id": f"shai-{next(self._openai_ids)}",
+        base = {"id": f"shai-{self._next_openai_id()}",
                 "created": int(_time.time()),
                 "model": self.cfg.model_id or "tiny", "usage": usage}
         if kind == "chat":
@@ -1303,7 +1304,7 @@ class VllmService(ModelService):
         stops = [stop] if isinstance(stop, str) else list(stop)
         tokq: "_q.Queue[int]" = _q.Queue()
         fut = self.loop.submit(ids, params, on_token=tokq.put)
-        rid = f"shai-{next(self._openai_ids)}"
+        rid = f"shai-{self._next_openai_id()}"
         created = int(_time.time())
         model = self.cfg.model_id or "tiny"
 
@@ -1328,35 +1329,49 @@ class VllmService(ModelService):
         def chunks():
             first = True
             finish = None
-            if kind == "chat":
-                yield event("", None, True)  # role preamble chunk
-                first = False
-            while True:
-                try:
-                    tok = tokq.get(timeout=0.2)
-                except _q.Empty:
-                    if fut.done() and tokq.empty():
+            try:
+                if kind == "chat":
+                    yield event("", None, True)  # role preamble chunk
+                    first = False
+                while True:
+                    try:
+                        tok = tokq.get(timeout=0.2)
+                    except _q.Empty:
+                        if fut.done() and tokq.empty():
+                            break
+                        continue
+                    delta = asm.push(tok)
+                    if delta:
+                        yield event(delta, None, first)
+                        first = False
+                    if asm.stopped:
+                        # the engine would decode to max_new_tokens for
+                        # nobody — abort and reclaim the slot/blocks
+                        finish = "stop"
+                        self.loop.cancel(fut)
                         break
-                    continue
-                delta = asm.push(tok)
-                if delta:
-                    yield event(delta, None, first)
-                    first = False
-                if asm.stopped:
-                    # the engine would decode to max_new_tokens for nobody —
-                    # abort the request and reclaim its slot/blocks
-                    finish = "stop"
+                fin = fut.result(timeout=600.0)
+                if fin.stop_reason == "rejected":
+                    # headers already went out as 200 — signal in-band
+                    yield ("data: " + _json.dumps({"error": {
+                        "message": "request rejected: prompt cannot fit "
+                                   "the KV pool",
+                        "type": "server_error"}}) + "\n\n")
+                    yield "data: [DONE]\n\n"
+                    return
+                if finish is None:
+                    finish = "stop" if fin.stop_reason == "eos" else "length"
+                    tail = asm.finish()  # flush the partial-UTF-8 holdback
+                    if tail:
+                        yield event(tail, None, first)
+                        first = False
+                yield event("", finish, False)
+                yield "data: [DONE]\n\n"
+            finally:
+                # client disconnect abandons the generator mid-stream — the
+                # engine must not keep decoding into an orphan queue
+                if not fut.done():
                     self.loop.cancel(fut)
-                    break
-            fin = fut.result(timeout=600.0)
-            if finish is None:
-                finish = "stop" if fin.stop_reason == "eos" else "length"
-                tail = asm.finish()  # flush the partial-UTF-8 holdback
-                if tail:
-                    yield event(tail, None, first)
-                    first = False
-            yield event("", finish, False)
-            yield "data: [DONE]\n\n"
 
         return StreamingResponse(chunks())
 
@@ -1376,11 +1391,15 @@ class VllmService(ModelService):
         lines = [f"{m['role']}: {m['content']}" for m in messages]
         return "\n".join(lines) + "\nassistant:", False
 
+    def _next_openai_id(self) -> int:
+        ids = getattr(self, "_openai_ids", None)
+        if ids is None:
+            import itertools
+
+            ids = self._openai_ids = itertools.count()
+        return next(ids)
+
     def extra_routes(self):
-        import itertools
-
-        self._openai_ids = itertools.count()
-
         def completions(request):
             body = request.json()
             prompt = body.get("prompt")

@@ -193,6 +193,34 @@ async def test_vllm_openai_surface_and_stats():
             assert "shai_service_queue_waiting" in r.text
 
 
+def test_stream_abandonment_cancels_engine_request():
+    """A client disconnect abandons the SSE generator; the engine request
+    must be cancelled (slot + blocks reclaimed), not decoded to
+    max_new_tokens for nobody."""
+    import time
+
+    cfg, service = make_service()
+    service.load()
+    try:
+        resp = service._openai_stream(
+            "hello world",
+            {"max_tokens": service.ecfg.max_new_tokens, "temperature": 0.0},
+            "completion")
+        it = iter(resp.iterator)
+        next(it)            # at least one chunk flowed
+        it.close()          # GeneratorExit — simulates the disconnect
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            eng = service._engine
+            if eng.n_running == 0 and eng.n_waiting == 0:
+                break
+            time.sleep(0.1)
+        assert service._engine.n_running == 0, (
+            "engine kept decoding after the stream was abandoned")
+    finally:
+        service.loop.stop()
+
+
 def test_vllm_streaming_over_real_socket():
     """SSE through the real asyncio server: chunked transfer-encoding frames
     the stream and the connection stays reusable afterwards."""
