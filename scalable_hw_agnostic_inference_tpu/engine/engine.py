@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -74,6 +75,8 @@ class Request:
     # streaming: called (engine-loop thread, must be cheap — a queue put)
     # exactly once per token that will appear in Finished.token_ids, in order
     on_token: Optional[Any] = None
+    # submission time (monotonic) for TTFT accounting; survives preemption
+    t_submit: float = 0.0
 
     def __post_init__(self):
         if self.orig_n_prompt < 0:
@@ -101,6 +104,7 @@ class _Running:
     # chunked prefill: prompt position of the next chunk, or None when the
     # prompt is fully encoded (mid-prefill slots don't join the decode batch)
     prefill_cursor: Optional[int] = None
+    t_first: float = 0.0        # first-token time (TPOT accounting)
 
 
 class LLMEngine:
@@ -188,6 +192,13 @@ class LLMEngine:
         self.waiting: deque[Request] = deque()
         self.slots: List[Optional[_Running]] = [None] * ecfg.max_num_seqs
         self._warmed = False
+        # serving-grade latency instruments (vLLM's TTFT/TPOT), exported by
+        # the serving layer's /stats — TTFT includes queue time; TPOT is
+        # per-token decode pace after the first token
+        from ..utils.latency import LatencyCollector
+
+        self.ttft = LatencyCollector()
+        self.tpot = LatencyCollector()
         self._ids = itertools.count()
         self._step_count = 0
         self._rng = jax.random.PRNGKey(ecfg.seed)
@@ -239,7 +250,8 @@ class LLMEngine:
         rid = next(self._ids)
         self.waiting.append(Request(rid, list(prompt_ids), params,
                                     prefix=prefix, cross_states=cross_states,
-                                    cross_len=cross_len, on_token=on_token))
+                                    cross_len=cross_len, on_token=on_token,
+                                    t_submit=time.monotonic()))
         return rid
 
     def cancel(self, req_id: int) -> Optional[Finished]:
@@ -255,6 +267,7 @@ class LLMEngine:
                                 r.orig_n_prompt, "cancelled")
         for s in self.slots:
             if s is not None and s.req.req_id == req_id:
+                self._record_tpot(s)
                 self.cache.release(req_id)
                 self.slots[s.slot] = None
                 self._has_image[s.slot] = 0.0
@@ -327,6 +340,26 @@ class LLMEngine:
     def _finish(self, fin: Finished) -> None:
         self.finished.append(fin)
         self._done_this_step.append(fin)
+
+    def _mark_first_token(self, req: Request) -> float:
+        """TTFT record point (first admission only — a preemption resume is
+        not a new first token); returns the timestamp for TPOT's t_first."""
+        now = time.monotonic()
+        if not req.already_generated and req.t_submit:
+            self.ttft.record(now - req.t_submit)
+        return now
+
+    def _record_tpot(self, s: "_Running") -> None:
+        """Per-token decode pace: elapsed spans sample-of-token-1 through
+        commit-of-token-n — n decode steps — so divide by n, not n-1."""
+        if s.t_first and s.generated:
+            self.tpot.record((time.monotonic() - s.t_first)
+                             / len(s.generated))
+
+    def _start_slot(self, slot: int, req: Request, tok: int) -> None:
+        """Seat a fully-prefilled request with its sampled first token."""
+        self.slots[slot] = _Running(req, slot, [], pending_token=tok,
+                                    t_first=self._mark_first_token(req))
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  params: Optional[SamplingParams] = None) -> List[Finished]:
@@ -411,7 +444,7 @@ class LLMEngine:
         tok = int(self._sample1(
             logits, rng, req.params.temperature, req.params.top_k,
             req.params.top_p)[0])
-        self.slots[slot] = _Running(req, slot, [], pending_token=tok)
+        self._start_slot(slot, req, tok)
 
     def _set_slot_cross(self, slot: int, req: Request):
         """Project the request's vision states into the slot's cross-kv
@@ -532,8 +565,7 @@ class LLMEngine:
         for i, req in enumerate(group):
             slot = self._free_slot()
             self._has_image[slot] = 0.0
-            self.slots[slot] = _Running(req, slot, [],
-                                        pending_token=int(toks[i]))
+            self._start_slot(slot, req, int(toks[i]))
 
     def _admit_cached(self) -> bool:
         """Admit the head request reusing its cached prefix blocks: incref
@@ -585,7 +617,7 @@ class LLMEngine:
             logits, rng, req.params.temperature, req.params.top_k,
             req.params.top_p)[0])
         self._has_image[slot] = 0.0
-        self.slots[slot] = _Running(req, slot, [], pending_token=tok)
+        self._start_slot(slot, req, tok)
         return True
 
     def _admit_long(self) -> None:
@@ -653,6 +685,7 @@ class LLMEngine:
                 req.params.top_p)[0])
             s.pending_token = tok
             s.prefill_cursor = None
+            s.t_first = self._mark_first_token(req)
         else:
             s.prefill_cursor = start + C
 
@@ -871,6 +904,7 @@ class LLMEngine:
             victim.req.on_token(victim.pending_token)
         emitted = victim.req.already_generated + committed
         if victim.pending_token == p.eos_id or len(committed) >= p.max_new_tokens:
+            self._record_tpot(victim)
             # nothing left to resume — finish right here
             if emitted and emitted[-1] == p.eos_id:
                 emitted = emitted[:-1]
@@ -891,7 +925,8 @@ class LLMEngine:
             cross_len=victim.req.cross_len,
             already_generated=emitted,
             orig_n_prompt=victim.req.orig_n_prompt,
-            on_token=victim.req.on_token))
+            on_token=victim.req.on_token,
+            t_submit=victim.req.t_submit))
 
     def _decode_step(self) -> None:
         M = self.ecfg.blocks_per_seq
@@ -974,6 +1009,7 @@ class LLMEngine:
             total = self.cache.seq(s.req.req_id).n_tokens
             out_of_len = total >= self.ecfg.max_model_len
             if hit_eos or full or out_of_len:
+                self._record_tpot(s)
                 self._finish(Finished(
                     s.req.req_id, s.req.already_generated + s.generated,
                     s.req.orig_n_prompt, "eos" if hit_eos else "length"))

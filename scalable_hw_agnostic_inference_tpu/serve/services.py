@@ -1223,7 +1223,7 @@ class VllmService(ModelService):
 
     def extra_stats(self) -> Dict[str, float]:
         eng = self._engine
-        return {
+        out = {
             "queue_waiting": eng.n_waiting,
             "seqs_running": eng.n_running,
             "seqs_chunking": eng.n_chunking,
@@ -1231,6 +1231,15 @@ class VllmService(ModelService):
             "blocks_total": self.ecfg.total_blocks,
             "executables": eng.n_executables,
         }
+        # vLLM-grade latency instruments: TTFT includes queue time, TPOT is
+        # the per-token decode pace — the numbers the breaking-point job
+        # reads for an LLM unit
+        if eng.ttft.count:
+            out["ttft_p50_ms"] = round(eng.ttft.percentile(50) * 1e3, 2)
+            out["ttft_p99_ms"] = round(eng.ttft.percentile(99) * 1e3, 2)
+        if eng.tpot.count:
+            out["tpot_p50_ms"] = round(eng.tpot.percentile(50) * 1e3, 2)
+        return out
 
     # -- OpenAI-compatible surface ------------------------------------------
     # The industry-standard serving API on the same engine: /v1/models,

@@ -1,0 +1,76 @@
+"""Latency percentile collection — dependency-free, shared by the serving
+layer's request collector and the engine's TTFT/TPOT instruments (the engine
+must not import the serve package: layering)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List
+
+PERCENTILES = (0, 50, 90, 95, 99, 100)
+
+
+class LatencyCollector:
+    """Thread-safe reservoir of request latencies with percentile readout."""
+
+    def __init__(self, max_samples: int = 100_000):
+        self._lock = threading.Lock()
+        self._samples: List[float] = []
+        self._max_samples = max_samples
+        self._total = 0
+
+    def record(self, latency_s: float) -> None:
+        with self._lock:
+            self._total += 1
+            if len(self._samples) < self._max_samples:
+                self._samples.append(latency_s)
+            else:
+                # reservoir-style overwrite keeps memory bounded under load
+                self._samples[self._total % self._max_samples] = latency_s
+
+    def timed(self, fn: Callable, *args, **kwargs):
+        """Run ``fn`` and record its wall time; returns ``fn``'s result."""
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        self.record(time.perf_counter() - t0)
+        return out
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._total
+
+    @staticmethod
+    def _interp(data: List[float], p: float) -> float:
+        if not data:
+            return 0.0
+        if p <= 0:
+            return data[0]
+        if p >= 100:
+            return data[-1]
+        # linear interpolation between closest ranks
+        rank = (p / 100.0) * (len(data) - 1)
+        lo = int(rank)
+        hi = min(lo + 1, len(data) - 1)
+        frac = rank - lo
+        return data[lo] * (1.0 - frac) + data[hi] * frac
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            data = sorted(self._samples)
+        return self._interp(data, p)
+
+    def report(self) -> Dict[str, float]:
+        # one locked snapshot + one sort, so percentiles within a report are
+        # mutually consistent under concurrent record()s
+        with self._lock:
+            data = sorted(self._samples)
+        return {f"p{p}": self._interp(data, p) for p in PERCENTILES}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+            self._total = 0
+
+

@@ -187,6 +187,9 @@ async def test_vllm_openai_surface_and_stats():
         assert svc["queue_waiting"] == 0 and svc["seqs_running"] == 0
         assert svc["blocks_free"] <= svc["blocks_total"]
         assert svc["executables"] > 0
+        # requests ran above — the latency instruments must have samples
+        assert svc["ttft_p50_ms"] > 0
+        assert svc["tpot_p50_ms"] > 0
 
         r = await c.get("/metrics")
         if r.status_code == 200:  # prometheus_client present
