@@ -914,6 +914,9 @@ class LLMEngine:
             self._finish(Finished(
                 victim.req.req_id, emitted, victim.req.orig_n_prompt, reason))
             return
+        # record this decode segment's pace before the slot state is lost —
+        # preemption happens at peak load, exactly what TPOT must show
+        self._record_tpot(victim)
         params = dataclasses.replace(
             p, max_new_tokens=p.max_new_tokens - len(committed))
         self.waiting.appendleft(Request(

@@ -1235,10 +1235,11 @@ class VllmService(ModelService):
         # the per-token decode pace — the numbers the breaking-point job
         # reads for an LLM unit
         if eng.ttft.count:
-            out["ttft_p50_ms"] = round(eng.ttft.percentile(50) * 1e3, 2)
-            out["ttft_p99_ms"] = round(eng.ttft.percentile(99) * 1e3, 2)
+            rep = eng.ttft.report()  # one snapshot: p50/p99 stay consistent
+            out["ttft_p50_ms"] = round(rep["p50"] * 1e3, 2)
+            out["ttft_p99_ms"] = round(rep["p99"] * 1e3, 2)
         if eng.tpot.count:
-            out["tpot_p50_ms"] = round(eng.tpot.percentile(50) * 1e3, 2)
+            out["tpot_p50_ms"] = round(eng.tpot.report()["p50"] * 1e3, 2)
         return out
 
     # -- OpenAI-compatible surface ------------------------------------------
