@@ -1209,9 +1209,15 @@ class VllmService(ModelService):
             if max_text < 1:
                 raise HTTPError(400, "image prefix leaves no prompt room")
             ids = ids[:max_text]
-        fin = self.loop.generate(ids, params, timeout=600.0, prefix=prefix,
-                                 cross_states=cross_states,
-                                 cross_len=cross_len)
+        return self._collect(self.loop.submit(
+            ids, params, prefix=prefix, cross_states=cross_states,
+            cross_len=cross_len))
+
+    def _collect(self, fut) -> Dict[str, Any]:
+        """Await one engine future and shape the result — THE translation
+        from Finished to the serving dict (rejected → 503), shared by infer
+        and the OpenAI n>1 fan-out."""
+        fin = fut.result(timeout=600.0)
         if fin.stop_reason == "rejected":
             raise HTTPError(503, "request rejected: prompt cannot fit the KV pool")
         return {
@@ -1252,42 +1258,71 @@ class VllmService(ModelService):
                          kind: str, add_special: bool = True) -> Dict[str, Any]:
         import time as _time
 
+        n = self._openai_n(body)
         # 16 is the legacy /v1/completions default; chat has none — an SDK
         # chat client omitting max_tokens gets the engine cap, not a stub
         default_mnt = (self.ecfg.max_new_tokens if kind == "chat"
                        else min(16, self.ecfg.max_new_tokens))
-        out = self.infer({
+        payload = {
             "prompt": prompt,
             "temperature": body.get("temperature", 1.0),
             "top_p": body.get("top_p", 1.0),
             "max_new_tokens": body.get("max_tokens", default_mnt),
             "add_special_tokens": add_special,
-        })
-        text = out["generated_text"]
-        finish = "stop" if out["stop_reason"] == "eos" else "length"
+        }
+        if n == 1:
+            outs = [self.infer(payload)]
+        else:
+            # n parallel samples: submit together so they join ONE running
+            # batch (and, with prefix caching on, share the prompt's KV)
+            params = self._sampling_from(payload)
+            ids = self._encode(prompt, add_special=add_special)
+            if not ids:
+                raise HTTPError(400, "empty prompt")
+            futs = [self.loop.submit(list(ids), params) for _ in range(n)]
+            outs = []
+            try:
+                for fut in futs:
+                    outs.append(self._collect(fut))
+            except BaseException:
+                # one sample failed (rejected/timeout) — the siblings must
+                # not keep decoding for nobody
+                for fut in futs:
+                    if not fut.done():
+                        self.loop.cancel(fut)
+                raise
         stop = body.get("stop")
-        if stop:
-            for s in ([stop] if isinstance(stop, str) else list(stop)):
+        # filter falsy: '' would truncate everything at position 0 (and the
+        # SSE assembler already filters them — the paths must agree)
+        stops = [s for s in
+                 ([stop] if isinstance(stop, str) else list(stop or [])) if s]
+        choices = []
+        total_completion = 0
+        for i, out in enumerate(outs):
+            text = out["generated_text"]
+            finish = "stop" if out["stop_reason"] == "eos" else "length"
+            for s in stops:
                 cut = text.find(s)
                 if cut >= 0:
                     text = text[:cut]
                     finish = "stop"
-        usage = {"prompt_tokens": out["n_prompt"],
-                 "completion_tokens": out["n_tokens"],
-                 "total_tokens": out["n_prompt"] + out["n_tokens"]}
-        base = {"id": f"shai-{self._next_openai_id()}",
-                "created": int(_time.time()),
-                "model": self.cfg.model_id or "tiny", "usage": usage}
-        if kind == "chat":
-            base["object"] = "chat.completion"
-            base["choices"] = [{"index": 0, "finish_reason": finish,
+            total_completion += out["n_tokens"]
+            if kind == "chat":
+                choices.append({"index": i, "finish_reason": finish,
                                 "message": {"role": "assistant",
-                                            "content": text}}]
-        else:
-            base["object"] = "text_completion"
-            base["choices"] = [{"index": 0, "finish_reason": finish,
-                                "text": text}]
-        return base
+                                            "content": text}})
+            else:
+                choices.append({"index": i, "finish_reason": finish,
+                                "text": text})
+        usage = {"prompt_tokens": outs[0]["n_prompt"],
+                 "completion_tokens": total_completion,
+                 "total_tokens": outs[0]["n_prompt"] + total_completion}
+        return {"id": f"shai-{self._next_openai_id()}",
+                "created": int(_time.time()),
+                "model": self.cfg.model_id or "tiny", "usage": usage,
+                "object": ("chat.completion" if kind == "chat"
+                           else "text_completion"),
+                "choices": choices}
 
     def _openai_stream(self, prompt: str, body: Dict[str, Any], kind: str,
                        add_special: bool = True):
@@ -1301,6 +1336,8 @@ class VllmService(ModelService):
 
         from .asgi import StreamingResponse
 
+        if self._openai_n(body) != 1:
+            raise HTTPError(400, "n > 1 is not supported with stream: true")
         ids = self._encode(prompt, add_special=add_special)
         if not ids:
             raise HTTPError(400, "empty prompt")
@@ -1400,6 +1437,19 @@ class VllmService(ModelService):
                             add_generation_prompt=True), True
         lines = [f"{m['role']}: {m['content']}" for m in messages]
         return "\n".join(lines) + "\nassistant:", False
+
+    def _openai_n(self, body: Dict[str, Any]) -> int:
+        """Validated OpenAI ``n`` (parallel samples); bad values are client
+        errors, not 500s."""
+        try:
+            n = int(body.get("n") or 1)
+        except (TypeError, ValueError):
+            raise HTTPError(400, "n must be an integer")
+        if not 1 <= n <= self.ecfg.max_num_seqs:
+            raise HTTPError(
+                400, f"n must be in [1, {self.ecfg.max_num_seqs}] "
+                     f"(the engine's slot batch)")
+        return n
 
     def _next_openai_id(self) -> int:
         ids = getattr(self, "_openai_ids", None)

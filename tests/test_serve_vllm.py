@@ -148,6 +148,18 @@ async def test_vllm_openai_surface_and_stats():
         assert body["choices"][0]["message"]["role"] == "assistant"
         assert body["usage"]["completion_tokens"] == 4
 
+        # n parallel samples: greedy copies are identical; bad n rejected
+        r = await c.post("/v1/completions", json={
+            "prompt": "hello world", "max_tokens": 4, "temperature": 0.0,
+            "n": 2})
+        assert r.status_code == 200, r.text
+        ch = r.json()["choices"]
+        assert [x["index"] for x in ch] == [0, 1]
+        assert ch[0]["text"] == ch[1]["text"]  # greedy => identical
+        assert r.json()["usage"]["completion_tokens"] == 8
+        r = await c.post("/v1/completions", json={"prompt": "h", "n": 99})
+        assert r.status_code == 400
+
         # SSE streaming: concatenated deltas must equal the non-streaming
         # text, chunks are OpenAI-shaped, and the stream terminates [DONE]
         import json as _json
