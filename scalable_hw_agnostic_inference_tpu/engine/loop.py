@@ -89,15 +89,6 @@ class EngineLoop:
         already finished — it's a no-op then."""
         self._cancel_q.put(fut)
 
-    def generate(self, prompt_ids: Sequence[int],
-                 params: Optional[SamplingParams] = None,
-                 timeout: Optional[float] = None, prefix=None,
-                 cross_states=None, cross_len: int = 0) -> Finished:
-        """Submit and block — the serving ``infer`` path."""
-        return self.submit(prompt_ids, params, prefix=prefix,
-                           cross_states=cross_states,
-                           cross_len=cross_len).result(timeout)
-
     # -- loop --------------------------------------------------------------
 
     def _drain_submissions(self, block: bool) -> None:

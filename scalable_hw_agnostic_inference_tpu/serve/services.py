@@ -1441,9 +1441,10 @@ class VllmService(ModelService):
     def _openai_n(self, body: Dict[str, Any]) -> int:
         """Validated OpenAI ``n`` (parallel samples); bad values are client
         errors, not 500s."""
-        try:
-            n = int(body.get("n") or 1)
-        except (TypeError, ValueError):
+        n = body.get("n")
+        if n is None:
+            n = 1
+        if not isinstance(n, int) or isinstance(n, bool):
             raise HTTPError(400, "n must be an integer")
         if not 1 <= n <= self.ecfg.max_num_seqs:
             raise HTTPError(
