@@ -18,13 +18,8 @@ note() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$LOG"; }
 [ -f "$RES" ] || echo '{}' > "$RES"
 
 have() {  # have <key>: does RES already hold a real on-device result?
-  python - "$1" <<'EOF'
-import json, sys
-r = json.load(open("scripts/bench_results.json"))
-v = r.get(sys.argv[1])
-ok = bool(v) and "error" not in v and "(cpu)" not in v.get("metric", "")
-sys.exit(0 if ok else 1)
-EOF
+  # ONE predicate for done-ness and publishability (promote_results.is_real)
+  python scripts/promote_results.py --check "$1"
 }
 
 note "watcher start (deadline in $(( (DEADLINE - $(date +%s)) / 60 )) min)"
@@ -75,6 +70,9 @@ if "metric" in obj and better:
     res[key] = obj
     json.dump(res, open("scripts/bench_results.json", "w"), indent=1)
 EOF
+    # promote any on-chip llama results into committed artifacts right away
+    # (idempotent — partial sessions still publish what they measured)
+    python scripts/promote_results.py 2>&1 | tee -a "$LOG"
   done
 done
 note "watcher exit"

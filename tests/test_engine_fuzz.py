@@ -47,11 +47,17 @@ def make_engine(tiny_model, **over):
     return LLMEngine(cfg, params, EngineConfig(**kw))
 
 
+_SOLO_CACHE: dict = {}
+
+
 def _solo(tiny_model, prompt, mnt):
-    eng = make_engine(tiny_model, num_blocks=64)  # roomy: no preemption
-    [fin] = eng.generate([prompt], SamplingParams(temperature=0.0,
-                                                  max_new_tokens=mnt))
-    return fin.token_ids
+    key = (tuple(prompt), mnt)
+    if key not in _SOLO_CACHE:   # ~1/3 of fuzz prompts are duplicates
+        eng = make_engine(tiny_model, num_blocks=64)  # roomy: no preemption
+        [fin] = eng.generate([prompt], SamplingParams(temperature=0.0,
+                                                      max_new_tokens=mnt))
+        _SOLO_CACHE[key] = fin.token_ids
+    return _SOLO_CACHE[key]
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
@@ -71,8 +77,6 @@ def test_engine_fuzz_invariants(tiny_model, seed):
             ln = int(rng.choice([3, 9, 17, 40, 60, 90]))
             prompts.append([int(x) for x in rng.integers(2, cfg.vocab_size, ln)])
         mnts.append(int(rng.choice([2, 5, 9])))
-
-    from scalable_hw_agnostic_inference_tpu.engine.engine import Finished
 
     pending = list(range(14))
     rng.shuffle(pending)
