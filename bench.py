@@ -253,14 +253,17 @@ def _banked_result() -> dict | None:
             key += "_int8"
     else:
         key = "sd"
+    root = os.path.dirname(os.path.abspath(__file__))
     try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "scripts", "bench_results.json")) as f:
+        with open(os.path.join(root, "scripts", "bench_results.json")) as f:
             res = json.load(f).get(key)
+        # ONE definition of "real on-device result" (shared with the
+        # watcher's done-check and the artifact promoter)
+        sys.path.insert(0, os.path.join(root, "scripts"))
+        from promote_results import is_real
     except Exception:
         return None
-    if (isinstance(res, dict) and "metric" in res and "error" not in res
-            and "(cpu)" not in res.get("metric", "")):
+    if is_real(res) and "metric" in res:
         return dict(res)
     return None
 

@@ -235,15 +235,15 @@ class LLMEngine:
             raise ValueError(
                 f"prefix of {n_prefix} tokens exceeds the largest prefill "
                 f"bucket {self.buckets.max}")
-        if n_prefix or cross_states is not None or self._cross_kv is not None:
-            # multimodal requests — and ALL requests on a cross-attention
-            # engine (its prefill executables carry cross args the chunked
-            # path doesn't) — are bucket-bound (single prefill call)
+        if n_prefix:
+            # soft-prefix requests are bucket-bound: the prefix occupies
+            # positions inside the single prefill call
             max_prompt = self.buckets.max - n_prefix
         else:
-            # plain text chunks past the largest bucket (chunked prefill) up
-            # to the model-length budget: full chunks only (the continuation
-            # ladder is static), and room left to generate
+            # text AND cross-attention prompts chunk past the largest bucket
+            # (the continuation ladder carries cross args on mllama engines)
+            # up to the model-length budget: full chunks only, room left to
+            # generate
             max_prompt = self._chunk_cap
         if len(prompt_ids) > max_prompt:
             prompt_ids = list(prompt_ids)[-max_prompt:]  # keep the tail
@@ -278,13 +278,12 @@ class LLMEngine:
 
     @property
     def max_prompt_len(self) -> int:
-        """Longest prompt the engine accepts un-truncated: one bucket on
-        cross-attention engines, the chunked-prefill cap otherwise (which
-        ``add_request`` enforces exactly — ≥ the largest bucket in every
-        config where ``max_model_len`` exceeds it). The serving layer
-        truncates its tokenizer output to THIS, not to the largest bucket."""
-        if self._cross_kv is not None:
-            return self.buckets.max
+        """Longest prompt the engine accepts un-truncated: the
+        chunked-prefill cap, which ``add_request`` enforces exactly for
+        text AND cross-attention prompts (≥ the largest bucket whenever
+        ``max_model_len`` exceeds it; soft-prefix requests are additionally
+        capped in the serving layer). The serving layer truncates its
+        tokenizer output to THIS, not to the largest bucket."""
         return self._chunk_cap
 
     @property
@@ -321,16 +320,17 @@ class LLMEngine:
         # admission proceeds even while a long prompt chunks (its slot is
         # untouched) — queued short prompts must not pay k chunk-steps of
         # TTFT; only a SECOND long prompt waits for the active chunker
-        if self.waiting and (self.waiting[0].prefix is not None
-                             or self.waiting[0].cross_states is not None):
-            self._admit_one()       # multimodal: single-seq executables
+        if self.waiting and self.waiting[0].prefix is not None:
+            self._admit_one()       # soft-prefix: bucket-bound single-seq
         elif (self.cache.prefix_caching and self.waiting
               and self._admit_cached()):
             pass                    # cached-prefix admission handled it
-        elif (self.waiting and self._cross_kv is None
+        elif (self.waiting
               and len(self.waiting[0].prompt_ids) > self.buckets.max):
             if not chunking:
-                self._admit_long()  # chunked prefill, one slot at a time
+                self._admit_long()  # chunked prefill (text or cross)
+        elif self.waiting and self.waiting[0].cross_states is not None:
+            self._admit_one()       # short multimodal: single-seq
         else:
             self._admit_batch()
         if any(s is not None for s in self.slots):
@@ -503,18 +503,11 @@ class LLMEngine:
             req = self.waiting[0]
             if req.prefix is not None or req.cross_states is not None:
                 break  # multimodal: handled by the single-seq path next step
-            if (self._cross_kv is None
-                    and len(req.prompt_ids) > self.buckets.max):
+            if len(req.prompt_ids) > self.buckets.max:
                 # chunk-capable long prompt: NEVER truncate it here — a
                 # later step's _admit_long owns it (step() routes there once
                 # it reaches the queue head)
                 break
-            max_text = self.buckets.max
-            if len(req.prompt_ids) > max_text:
-                # cross-attention engines are bucket-bound: a preemption
-                # re-queue may overflow the largest bucket — keep the tail
-                # (matches add_request)
-                req.prompt_ids = req.prompt_ids[-max_text:]
             b = self.buckets.bucket_for(len(req.prompt_ids))
             if bucket >= 0 and b != bucket:
                 break  # different bucket: next step's batch
@@ -640,7 +633,10 @@ class LLMEngine:
         C = self.buckets.max
         if n_total <= C:
             # truncation brought it back inside one bucket — normal path
-            self._admit_batch()
+            if req.cross_states is not None:
+                self._admit_one()
+            else:
+                self._admit_batch()
             return
         if not self._try_reserve(req, n_total):
             return
@@ -650,11 +646,25 @@ class LLMEngine:
             self.cache.seq(req.req_id).table(self.ecfg.blocks_per_seq))[None]
         ids = np.asarray(req.prompt_ids[:C], np.int32)[None]
         fn = self._prefill_for(C, 0, 1)
-        self.cache.kv, _ = fn(self.params, self.cache.kv, jnp.asarray(ids),
-                              jnp.asarray([C], jnp.int32), table)
+        args = [self.params, self.cache.kv, jnp.asarray(ids),
+                jnp.asarray([C], jnp.int32), table]
         self._has_image[slot] = 0.0
+        if self._cross_kv is not None:
+            # seat the vision states (or the text-only gate-off) in the slot
+            # buffers once; every chunk and decode step reads them from there
+            args += list(self._set_slot_cross(slot, req))
+        self.cache.kv, _ = fn(*args)
         self.slots[slot] = _Running(req, slot, [], pending_token=-1,
                                     prefill_cursor=C)
+
+    def _slot_cross_args(self, slot: int):
+        """One-row cross args read back from the slot's buffers (chunk
+        continuations on a cross engine)."""
+        one = [{"k": buf["k"][slot][None], "v": buf["v"][slot][None]}
+               for buf in self._cross_kv]
+        return (one,
+                jnp.asarray([self._has_image[slot]], jnp.float32),
+                jnp.asarray([self._cross_len[slot]], jnp.int32))
 
     def _continue_prefill(self, s: _Running) -> None:
         """Encode the next chunk of a mid-prefill slot; on the final chunk,
@@ -669,9 +679,11 @@ class LLMEngine:
         table = jnp.asarray(
             self.cache.seq(req.req_id).table(self.ecfg.blocks_per_seq))[None]
         fn = self._cont_for(start // self.ecfg.block_size)
-        self.cache.kv, logits = fn(
-            self.params, self.cache.kv, jnp.asarray(ids),
-            jnp.asarray([n], jnp.int32), table)
+        args = [self.params, self.cache.kv, jnp.asarray(ids),
+                jnp.asarray([n], jnp.int32), table]
+        if self._cross_kv is not None:
+            args += list(self._slot_cross_args(s.slot))
+        self.cache.kv, logits = fn(*args)
         if start + n >= len(req.prompt_ids):
             self.cache.register_prefix(
                 req.prompt_ids, self.cache.seq(req.req_id).blocks)
@@ -780,9 +792,10 @@ class LLMEngine:
                 elif 0 < p < b and self._cross_kv is None:
                     self._prefill_for(b, p)  # prefix path stays single-seq
                     n += 1
-        if self._cross_kv is None and self.ecfg.max_model_len > self.buckets.max:
+        if self.ecfg.max_model_len > self.buckets.max:
             # chunked-prefill ladder: one continuation executable per chunk
-            # start past the largest bucket
+            # start past the largest bucket (cross engines included — their
+            # cont executables carry the cross-args tail)
             C = self.buckets.max
             start = C
             while start + C <= self.ecfg.max_model_len:
@@ -820,10 +833,16 @@ class LLMEngine:
         B, M = ecfg.max_num_seqs, ecfg.blocks_per_seq
         for key, fn in list(self._prefill.items()):
             if key[0] == "cont":
-                ids = jnp.zeros((1, key[2]), jnp.int32)
-                self.cache.kv, logits = fn(
-                    self.params, self.cache.kv, ids,
-                    jnp.ones((1,), jnp.int32), jnp.zeros((1, M), jnp.int32))
+                args = [self.params, self.cache.kv,
+                        jnp.zeros((1, key[2]), jnp.int32),
+                        jnp.ones((1,), jnp.int32),
+                        jnp.zeros((1, M), jnp.int32)]
+                if self._cross_kv is not None:
+                    args += [self._cross_zeros(1),
+                             jnp.zeros((1,), jnp.float32),
+                             jnp.full((1,), max(self.cross_seq_len, 1),
+                                      jnp.int32)]
+                self.cache.kv, logits = fn(*args)
                 logits.block_until_ready()
                 continue
             bucket, P_, K = key

@@ -306,16 +306,20 @@ def make_prefill_cont(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     ``context_encoding_buckets`` (``cova/mllama-32-11b-vllm-trn1-config.yaml:10-16``),
     extended past the largest bucket. This is what makes a 128k
     ``max_model_len`` practical rather than a config key.
+
+    Cross-attention (mllama) configs chunk too: the gated cross layers
+    attend the request's static vision states each chunk (no pool traffic,
+    same as ``make_prefill``); the signature gains the
+    ``(cross_kv, has_image, cross_len)`` tail.
     """
     assert bucket % block_size == 0 and start_blocks >= 1
-    if cfg.cross_attention_layers:
-        raise ValueError("chunked prefill serves plain text models; mllama "
-                         "requests are bucket-bound")
     start = start_blocks * block_size
     c_blocks = bucket // block_size
     assert start_blocks + c_blocks <= blocks_per_seq
+    cross_set = set(cfg.cross_attention_layers)
 
-    def cont(params, kv, ids, n_text, block_tables):
+    def _cont_impl(params, kv, ids, n_text, block_tables, cross_kv=None,
+                   has_image=None, cross_len=None):
         p = params["params"]
         B = ids.shape[0]  # == 1
         x = p["embed"]["embedding"][ids].astype(jnp.bfloat16)
@@ -327,35 +331,55 @@ def make_prefill_cont(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
         goff = (tbl_prior[:, :, None] * block_size
                 + jnp.arange(block_size)[None, None, :]).reshape(B, start)
         tbl_chunk = block_tables[:, start_blocks:start_blocks + c_blocks]
+        ci = 0
+        pi = 0  # pool index: cross layers own no KV pool entries
         for li in range(cfg.n_layers):
             lp = p[f"layer_{li}"]
+            if li in cross_set:
+                x = _cross_layer(lp, x, cross_kv[ci]["k"], cross_kv[ci]["v"],
+                                 has_image, cfg, cross_len=cross_len)
+                ci += 1
+                continue
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
             q, k, v = _qkv(lp, h, positions, cfg)
-            kflat = kv[li]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-            vflat = kv[li]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            kflat = kv[pi]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            vflat = kv[pi]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
             kcat = jnp.concatenate(
                 [kflat[goff].astype(q.dtype), k], axis=1)  # [B, start+T, ...]
             vcat = jnp.concatenate([vflat[goff].astype(q.dtype), v], axis=1)
             o = dot_product_attention(q, kcat, vcat, kv_lengths=n, causal=True)
             x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
             x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
-            kdst = kv[li]["k"].at[tbl_chunk].set(
+            kdst = kv[pi]["k"].at[tbl_chunk].set(
                 k.reshape(B, c_blocks, block_size, cfg.n_kv_heads,
-                          cfg.head_dim).astype(kv[li]["k"].dtype))
-            vdst = kv[li]["v"].at[tbl_chunk].set(
+                          cfg.head_dim).astype(kv[pi]["k"].dtype))
+            vdst = kv[pi]["v"].at[tbl_chunk].set(
                 v.reshape(B, c_blocks, block_size, cfg.n_kv_heads,
-                          cfg.head_dim).astype(kv[li]["v"].dtype))
-            kv[li] = {"k": kdst, "v": vdst}
+                          cfg.head_dim).astype(kv[pi]["v"].dtype))
+            kv[pi] = {"k": kdst, "v": vdst}
+            pi += 1
         last = jnp.take_along_axis(x, (n_text - 1).reshape(B, 1, 1), axis=1)
         return kv, _logits(p, last, cfg)[:, 0]  # [B, V]
+
+    if cross_set:
+        def cont(params, kv, ids, n_text, block_tables, cross_kv, has_image,
+                 cross_len):
+            return _cont_impl(params, kv, ids, n_text, block_tables,
+                              cross_kv=cross_kv, has_image=has_image,
+                              cross_len=cross_len)
+    else:
+        def cont(params, kv, ids, n_text, block_tables):
+            return _cont_impl(params, kv, ids, n_text, block_tables)
 
     if shardings is None:
         return jax.jit(cont, donate_argnums=(1,))
     sh, rep = shardings, shardings.rep
-    kvsh = sh.kv_pool(cfg.n_layers)
+    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set))
+    in_sh = [sh.params, kvsh, rep, rep, rep]
+    if cross_set:
+        in_sh += [sh.cross_pool(len(cross_set)), rep, rep]
     return jax.jit(cont, donate_argnums=(1,),
-                   in_shardings=(sh.params, kvsh, rep, rep, rep),
-                   out_shardings=(kvsh, rep))
+                   in_shardings=tuple(in_sh), out_shardings=(kvsh, rep))
 
 
 def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,

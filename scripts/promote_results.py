@@ -57,7 +57,11 @@ def main() -> None:
     bpath = os.path.join(ROOT, "BASELINE.json")
     b = json.load(open(bpath))
     pub = b.setdefault("published", {})
-    pub.update(published)
+    for base_key, value in published.items():
+        # the FIRST on-chip run is the anchor: overwriting it with every
+        # new measurement would collapse vs_baseline toward 1.0 and hide
+        # improvements
+        pub.setdefault(base_key, value)
     pub.setdefault("basis", (
         "self-baseline: single-chip v5e decode tok/s measured by bench.py "
         "(random weights, bs=8, prompt 128, new 128); the reference "
@@ -69,6 +73,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 3 and sys.argv[1] == "--check":
+    if len(sys.argv) > 1 and sys.argv[1] == "--check":
+        if len(sys.argv) < 3:
+            # a malformed check must NOT fall through to main(): the caller
+            # believes this is a read-only probe, and exit 0 would read as
+            # "bench already done"
+            print("usage: promote_results.py --check <key>", file=sys.stderr)
+            sys.exit(2)
         sys.exit(0 if is_real(_load_results().get(sys.argv[2])) else 1)
     main()

@@ -337,6 +337,47 @@ def test_engine_cross_len_masks_padding_states(hf_model):
     assert run(base, valid) == run(garbage, valid)
 
 
+def test_engine_cross_chunked_prefill_parity(hf_model):
+    """A vision-conditioned prompt longer than the largest bucket encodes
+    through the continuation ladder (cross layers attending the slot's
+    states every chunk) and matches a run whose bucket fits the whole
+    prompt in one prefill call."""
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+
+    hf_cfg = hf_model.config
+    mcfg = llama.LlamaConfig.from_hf(hf_cfg.text_config)
+    params = llama.params_from_torch(_lm_state_dict(hf_model.state_dict()),
+                                     mcfg)
+    Lv = 34
+    rng = np.random.default_rng(7)
+    states = rng.standard_normal((Lv, mcfg.dim)).astype(np.float32)
+    prompt = [int(x) for x in rng.integers(2, mcfg.vocab_size, 40)]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+
+    def run(buckets):
+        ecfg = EngineConfig(max_model_len=128, max_num_seqs=2, block_size=8,
+                            context_encoding_buckets=buckets,
+                            max_new_tokens=8)
+        eng = LLMEngine(mcfg, params, ecfg, cross_seq_len=Lv)
+        rid = eng.add_request(list(prompt), sp, cross_states=states,
+                              cross_len=Lv)
+        done = {}
+        while eng.has_work:
+            for f in eng.step():
+                done[f.req_id] = f
+        return done[rid]
+
+    chunked = run((16,))        # 40-token prompt => 16 + 16 + 8 chunks
+    whole = run((16, 64))       # fits one 64 prefill
+    assert chunked.n_prompt == len(prompt)
+    assert chunked.token_ids == whole.token_ids, (
+        f"cross chunked {chunked.token_ids} != whole {whole.token_ids}")
+
+
 @pytest.mark.asyncio
 async def test_mllama_artifact_boot_skips_torch(hf_model, tmp_path,
                                                 monkeypatch):
