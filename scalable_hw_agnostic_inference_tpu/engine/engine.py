@@ -40,8 +40,13 @@ class SamplingParams:
     top_p: float = 1.0
     max_new_tokens: int = 128
     eos_id: int = -1            # -1: never stop on a token
+    # report per-token logprobs with this many top alternatives (0 = off,
+    # capped at runner.K_LOGPROBS — the OpenAI `logprobs` field)
+    logprobs: int = 0
 
     def clamp(self, ecfg: EngineConfig) -> "SamplingParams":
+        from .runner import K_LOGPROBS
+
         # global_topk == 0 means "cap disabled": leave a user-set top_k alone
         if self.top_k and ecfg.global_topk:
             top_k = min(self.top_k, ecfg.global_topk)
@@ -51,6 +56,7 @@ class SamplingParams:
             self,
             max_new_tokens=min(self.max_new_tokens, ecfg.max_new_tokens),
             top_k=top_k,
+            logprobs=min(max(int(self.logprobs), 0), K_LOGPROBS),
         )
 
 
@@ -77,6 +83,9 @@ class Request:
     on_token: Optional[Any] = None
     # submission time (monotonic) for TTFT accounting; survives preemption
     t_submit: float = 0.0
+    # logprob entries for tokens emitted before a preemption (mirrors
+    # already_generated)
+    already_lp: List = dataclasses.field(default_factory=list)
 
     def __post_init__(self):
         if self.orig_n_prompt < 0:
@@ -92,7 +101,10 @@ class Finished:
     req_id: int
     token_ids: List[int]        # generated tokens, EOS excluded
     n_prompt: int
-    stop_reason: str            # "eos" | "length" | "rejected"
+    stop_reason: str            # "eos" | "length" | "rejected" | "cancelled"
+    # one entry per token_ids element when the request asked for logprobs:
+    # {"token", "logprob", "top_ids", "top_logprobs"}
+    logprobs: Optional[List[Dict[str, Any]]] = None
 
 
 @dataclasses.dataclass
@@ -105,6 +117,9 @@ class _Running:
     # prompt is fully encoded (mid-prefill slots don't join the decode batch)
     prefill_cursor: Optional[int] = None
     t_first: float = 0.0        # first-token time (TPOT accounting)
+    # logprob entries in sample order (== append order); only populated
+    # when the request asked for logprobs
+    lps: List = dataclasses.field(default_factory=list)
 
 
 class LLMEngine:
@@ -163,6 +178,9 @@ class LLMEngine:
         self._ctx_buckets = sorted(set(tg) | {ecfg.blocks_per_seq})
         self._decode_fns: Dict[Tuple[int, int], Any] = {}
         self._sample1 = jax.jit(sample_logits)
+        from .runner import token_logprobs
+
+        self._lp1 = jax.jit(token_logprobs)  # prefill-logit logprob readout
         self._cross_kv = None      # mllama slot-indexed encoder cache
         self._cross_embed = None   # jitted states -> per-layer k/v
         self._has_image = np.zeros((ecfg.max_num_seqs,), np.float32)
@@ -264,16 +282,20 @@ class LLMEngine:
             if r.req_id == req_id:
                 del self.waiting[i]
                 return Finished(req_id, list(r.already_generated),
-                                r.orig_n_prompt, "cancelled")
+                                r.orig_n_prompt, "cancelled",
+                                logprobs=(list(r.already_lp)
+                                          if r.params.logprobs else None))
         for s in self.slots:
             if s is not None and s.req.req_id == req_id:
                 self._record_tpot(s)
                 self.cache.release(req_id)
                 self.slots[s.slot] = None
                 self._has_image[s.slot] = 0.0
-                return Finished(req_id,
-                                s.req.already_generated + s.generated,
-                                s.req.orig_n_prompt, "cancelled")
+                return Finished(
+                    req_id, s.req.already_generated + s.generated,
+                    s.req.orig_n_prompt, "cancelled",
+                    logprobs=((s.req.already_lp + s.lps[:len(s.generated)])
+                              if s.req.params.logprobs else None))
         return None
 
     @property
@@ -361,6 +383,24 @@ class LLMEngine:
         self.slots[slot] = _Running(req, slot, [], pending_token=tok,
                                     t_first=self._mark_first_token(req))
 
+    @staticmethod
+    def _lp_entry(n_top: int, tok: int, tok_lp, top_ids, top_lp) -> Dict:
+        return {"token": int(tok), "logprob": float(tok_lp),
+                "top_ids": [int(i) for i in top_ids[:n_top]],
+                "top_logprobs": [float(v) for v in top_lp[:n_top]]}
+
+    def _record_admission_lps(self, logits, toks, rows) -> None:
+        """Per-token logprobs for freshly sampled first tokens — ``rows``
+        maps batch row -> the seated _Running; only called when some row
+        asked for logprobs (logits stay on device otherwise)."""
+        ids, lps, tok_lp = self._lp1(logits, jnp.asarray(toks, jnp.int32))
+        ids, lps, tok_lp = np.asarray(ids), np.asarray(lps), np.asarray(tok_lp)
+        for i, s in rows:
+            n_top = s.req.params.logprobs
+            if n_top:
+                s.lps.append(self._lp_entry(n_top, toks[i], tok_lp[i],
+                                            ids[i], lps[i]))
+
     def generate(self, prompts: Sequence[Sequence[int]],
                  params: Optional[SamplingParams] = None) -> List[Finished]:
         """Offline batch: submit all, run to completion, return in order."""
@@ -402,7 +442,9 @@ class LLMEngine:
                       req.req_id, need, self.cache.allocator.n_free)
             self._finish(Finished(
                 req.req_id, list(req.already_generated),
-                req.orig_n_prompt, "rejected"))
+                req.orig_n_prompt, "rejected",
+                logprobs=(list(req.already_lp)
+                          if req.params.logprobs else None)))
         return False
 
     def _admit_one(self) -> None:
@@ -445,6 +487,9 @@ class LLMEngine:
             logits, rng, req.params.temperature, req.params.top_k,
             req.params.top_p)[0])
         self._start_slot(slot, req, tok)
+        if req.params.logprobs:
+            self._record_admission_lps(logits, [tok],
+                                       [(0, self.slots[slot])])
 
     def _set_slot_cross(self, slot: int, req: Request):
         """Project the request's vision states into the slot's cross-kv
@@ -555,10 +600,16 @@ class LLMEngine:
         toks = np.asarray(self._sample1(
             logits, rng, jnp.asarray(temp), jnp.asarray(topk),
             jnp.asarray(topp)))
+        lp_rows = []
         for i, req in enumerate(group):
             slot = self._free_slot()
             self._has_image[slot] = 0.0
             self._start_slot(slot, req, int(toks[i]))
+            if req.params.logprobs:
+                lp_rows.append((i, self.slots[slot]))
+        if lp_rows:
+            self._record_admission_lps(logits, [int(t) for t in toks],
+                                       lp_rows)
 
     def _admit_cached(self) -> bool:
         """Admit the head request reusing its cached prefix blocks: incref
@@ -611,6 +662,9 @@ class LLMEngine:
             req.params.top_p)[0])
         self._has_image[slot] = 0.0
         self._start_slot(slot, req, tok)
+        if req.params.logprobs:
+            self._record_admission_lps(logits, [tok],
+                                       [(0, self.slots[slot])])
         return True
 
     def _admit_long(self) -> None:
@@ -698,6 +752,8 @@ class LLMEngine:
             s.pending_token = tok
             s.prefill_cursor = None
             s.t_first = self._mark_first_token(req)
+            if req.params.logprobs:
+                self._record_admission_lps(logits, [tok], [(0, s)])
         else:
             s.prefill_cursor = start + C
 
@@ -866,7 +922,7 @@ class LLMEngine:
                 args += [self._cross_kv, jnp.zeros((bb,), jnp.float32),
                          jnp.zeros((bb,), jnp.int32),
                          jnp.full((bb,), max(self.cross_seq_len, 1), jnp.int32)]
-            self.cache.kv, nxt = fn(*args)
+            self.cache.kv, nxt, *_lp = fn(*args)
             nxt.block_until_ready()
         if self._cross_embed is not None:  # the admission-time projector
             per_layer = self._cross_embed(
@@ -925,13 +981,19 @@ class LLMEngine:
         if victim.pending_token == p.eos_id or len(committed) >= p.max_new_tokens:
             self._record_tpot(victim)
             # nothing left to resume — finish right here
+            lps = None
+            if p.logprobs:
+                lps = victim.req.already_lp + victim.lps
             if emitted and emitted[-1] == p.eos_id:
                 emitted = emitted[:-1]
+                if lps:
+                    lps = lps[:-1]
                 reason = "eos"
             else:
                 reason = "length"
             self._finish(Finished(
-                victim.req.req_id, emitted, victim.req.orig_n_prompt, reason))
+                victim.req.req_id, emitted, victim.req.orig_n_prompt, reason,
+                logprobs=lps))
             return
         # record this decode segment's pace before the slot state is lost —
         # preemption happens at peak load, exactly what TPOT must show
@@ -948,7 +1010,9 @@ class LLMEngine:
             already_generated=emitted,
             orig_n_prompt=victim.req.orig_n_prompt,
             on_token=victim.req.on_token,
-            t_submit=victim.req.t_submit))
+            t_submit=victim.req.t_submit,
+            already_lp=(victim.req.already_lp + victim.lps
+                        if p.logprobs else [])))
 
     def _decode_step(self) -> None:
         M = self.ecfg.blocks_per_seq
@@ -1014,8 +1078,12 @@ class LLMEngine:
         if self._cross_kv is not None:
             args += [self._cross_kv, jnp.asarray(has_image),
                      jnp.asarray(slot_idx), jnp.asarray(cross_len)]
-        self.cache.kv, nxt = decode(*args)
+        self.cache.kv, nxt, top_ids_d, top_lp_d, tok_lp_d = decode(*args)
         nxt = np.asarray(nxt)
+        if any(s.req.params.logprobs for s in running):
+            top_ids_d = np.asarray(top_ids_d)
+            top_lp_d = np.asarray(top_lp_d)
+            tok_lp_d = np.asarray(tok_lp_d)
 
         for i, s in enumerate(running):
             if self.slots[s.slot] is not s:
@@ -1025,6 +1093,8 @@ class LLMEngine:
             hit_eos = s.pending_token == p.eos_id
             if hit_eos:
                 s.generated.pop()  # exclude EOS from the emitted text
+                if p.logprobs and s.lps:
+                    s.lps.pop()    # its lp entry goes with it
             elif s.req.on_token is not None:
                 s.req.on_token(s.pending_token)  # stream the committed token
             full = len(s.generated) >= p.max_new_tokens
@@ -1034,9 +1104,15 @@ class LLMEngine:
                 self._record_tpot(s)
                 self._finish(Finished(
                     s.req.req_id, s.req.already_generated + s.generated,
-                    s.req.orig_n_prompt, "eos" if hit_eos else "length"))
+                    s.req.orig_n_prompt, "eos" if hit_eos else "length",
+                    logprobs=((s.req.already_lp + s.lps)
+                              if p.logprobs else None)))
                 self.cache.release(s.req.req_id)
                 self.slots[s.slot] = None
                 self._has_image[s.slot] = 0.0
             else:
                 s.pending_token = int(nxt[i])
+                if p.logprobs:
+                    s.lps.append(self._lp_entry(
+                        p.logprobs, nxt[i], tok_lp_d[i],
+                        top_ids_d[i], top_lp_d[i]))

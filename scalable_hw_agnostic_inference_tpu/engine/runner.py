@@ -108,6 +108,22 @@ def _head_rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     return (n * scale).astype(x.dtype)
 
 
+# top-N alternatives reported per sampled token when a request asks for
+# logprobs (the OpenAI `logprobs` field; 5 is the classic completions cap)
+K_LOGPROBS = 5
+
+
+def token_logprobs(logits: jax.Array, toks: jax.Array):
+    """``[B, V]`` raw logits + ``[B]`` sampled ids → per-token logprob data:
+    ``(top_ids [B, K], top_logprobs [B, K], sampled_logprob [B])``. Raw
+    (pre-temperature) distribution — what the OpenAI field reports."""
+    logp = logits - jax.scipy.special.logsumexp(logits, axis=-1,
+                                                keepdims=True)
+    top_lp, top_ids = jax.lax.top_k(logp, K_LOGPROBS)
+    tok_lp = jnp.take_along_axis(logp, toks[:, None], axis=1)[:, 0]
+    return top_ids.astype(jnp.int32), top_lp, tok_lp
+
+
 def make_cross_kv(cfg: LlamaConfig):
     """Compile ``cross_kv(params, states [Lv, dim]) -> [n_cross] x {k, v}``.
 
@@ -502,7 +518,10 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
             x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
         logits = _logits(p, x, cfg)[:, 0]  # [B, V]
         nxt = sample_logits(logits, rng, temperature, top_k, top_p)
-        return kv, nxt
+        # logprob data rides along (tiny vs the matmuls); the engine only
+        # transfers it to the host when a running request asked for it
+        top_ids, top_lp, tok_lp = token_logprobs(logits, nxt)
+        return kv, nxt, top_ids, top_lp, tok_lp
 
     if cross_set:
         def decode(params, kv, tokens, pos, tables, active, rng,
@@ -526,4 +545,5 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     if cross_set:
         in_sh += (sh.cross_pool(len(cross_set)), rep, rep, rep)
     return jax.jit(decode, donate_argnums=(1,),
-                   in_shardings=in_sh, out_shardings=(kvsh, rep))
+                   in_shardings=in_sh,
+                   out_shardings=(kvsh, rep, rep, rep, rep))

@@ -1148,9 +1148,14 @@ class VllmService(ModelService):
                 top_p=float(payload.get("top_p", 1.0)),
                 max_new_tokens=mnt,
                 eos_id=self.eos_id,
+                logprobs=int(payload.get("logprobs") or 0),
             )
         except (TypeError, ValueError) as e:
             raise HTTPError(400, f"bad sampling parameter: {e}")
+        from ..engine.runner import K_LOGPROBS
+
+        if not 0 <= params.logprobs <= K_LOGPROBS:
+            raise HTTPError(400, f"logprobs must be in [0, {K_LOGPROBS}]")
         if mnt < 1:
             raise HTTPError(400, "max_new_tokens must be >= 1")
         if mnt > self.ecfg.max_new_tokens:
@@ -1220,12 +1225,15 @@ class VllmService(ModelService):
         fin = fut.result(timeout=600.0)
         if fin.stop_reason == "rejected":
             raise HTTPError(503, "request rejected: prompt cannot fit the KV pool")
-        return {
+        out = {
             "generated_text": self._decode(fin.token_ids),
             "n_tokens": len(fin.token_ids),
             "n_prompt": fin.n_prompt,
             "stop_reason": fin.stop_reason,
         }
+        if fin.logprobs is not None:
+            out["logprobs"] = fin.logprobs
+        return out
 
     def extra_stats(self) -> Dict[str, float]:
         eng = self._engine
@@ -1263,12 +1271,27 @@ class VllmService(ModelService):
         # chat client omitting max_tokens gets the engine cap, not a stub
         default_mnt = (self.ecfg.max_new_tokens if kind == "chat"
                        else min(16, self.ecfg.max_new_tokens))
+        # logprobs: completions takes an int (OpenAI caps it at 5, matching
+        # K_LOGPROBS — over-cap is a 400 there too); chat takes a bool plus
+        # top_logprobs 0..20 — we serve up to K_LOGPROBS alternatives and
+        # format exactly the requested count (0 = sampled-token only)
+        from ..engine.runner import K_LOGPROBS
+
+        if kind == "chat":
+            want_lp = 0
+            top_n = 0
+            if body.get("logprobs"):
+                top_n = min(int(body.get("top_logprobs") or 0), K_LOGPROBS)
+                want_lp = max(1, top_n)
+        else:
+            want_lp = top_n = int(body.get("logprobs") or 0)
         payload = {
             "prompt": prompt,
             "temperature": body.get("temperature", 1.0),
             "top_p": body.get("top_p", 1.0),
             "max_new_tokens": body.get("max_tokens", default_mnt),
             "add_special_tokens": add_special,
+            "logprobs": want_lp,
         }
         if n == 1:
             outs = [self.infer(payload)]
@@ -1307,12 +1330,29 @@ class VllmService(ModelService):
                     text = text[:cut]
                     finish = "stop"
             total_completion += out["n_tokens"]
+            lp_field = None
+            if out.get("logprobs") is not None:
+                entries = out["logprobs"]
+                if finish == "stop" and stops:
+                    # logprob entries must cover exactly the RETURNED text
+                    # (OpenAI truncates them with the stop cut): keep the
+                    # shortest token prefix whose decode reaches the text
+                    keep = 0
+                    while (keep < len(entries)
+                           and len(self._decode(
+                               [e["token"] for e in entries[:keep]]))
+                           < len(text)):
+                        keep += 1
+                    entries = entries[:keep]
+                lp_field = self._format_logprobs(entries, kind, top_n)
             if kind == "chat":
                 choices.append({"index": i, "finish_reason": finish,
+                                "logprobs": lp_field,
                                 "message": {"role": "assistant",
                                             "content": text}})
             else:
                 choices.append({"index": i, "finish_reason": finish,
+                                "logprobs": lp_field,
                                 "text": text})
         usage = {"prompt_tokens": outs[0]["n_prompt"],
                  "completion_tokens": total_completion,
@@ -1323,6 +1363,31 @@ class VllmService(ModelService):
                 "object": ("chat.completion" if kind == "chat"
                            else "text_completion"),
                 "choices": choices}
+
+    def _format_logprobs(self, entries, kind: str, top_n: int):
+        """Engine logprob entries → the OpenAI response shape per API;
+        ``top_n`` alternatives are reported exactly (chat's
+        ``top_logprobs: 0`` means sampled-token logprob with no list)."""
+        def tok_str(tid: int) -> str:
+            return self._decode([tid])
+
+        if kind == "chat":
+            return {"content": [
+                {"token": tok_str(e["token"]), "logprob": e["logprob"],
+                 "top_logprobs": [
+                     {"token": tok_str(t), "logprob": lp}
+                     for t, lp in zip(e["top_ids"][:top_n],
+                                      e["top_logprobs"][:top_n])]}
+                for e in entries]}
+        return {
+            "tokens": [tok_str(e["token"]) for e in entries],
+            "token_logprobs": [e["logprob"] for e in entries],
+            "top_logprobs": [
+                {tok_str(t): lp
+                 for t, lp in zip(e["top_ids"][:top_n],
+                                  e["top_logprobs"][:top_n])}
+                for e in entries],
+        }
 
     def _openai_stream(self, prompt: str, body: Dict[str, Any], kind: str,
                        add_special: bool = True):
@@ -1338,6 +1403,9 @@ class VllmService(ModelService):
 
         if self._openai_n(body) != 1:
             raise HTTPError(400, "n > 1 is not supported with stream: true")
+        if body.get("logprobs"):
+            raise HTTPError(400, "logprobs are not supported with "
+                                 "stream: true")
         ids = self._encode(prompt, add_special=add_special)
         if not ids:
             raise HTTPError(400, "empty prompt")

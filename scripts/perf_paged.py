@@ -46,12 +46,12 @@ def bench(cfg, params, kv, ctx_blocks, n_active, paged):
             jnp.asarray(tables), jnp.asarray(np.arange(B) < n_active),
             jax.random.PRNGKey(0), jnp.ones((B,), jnp.float32),
             jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32)]
-    kv2, nxt = fn(*args)
+    kv2, nxt, *_ = fn(*args)
     np.asarray(nxt)  # warm + sync
     t0 = time.perf_counter()
     for _ in range(STEPS):
         args[1] = kv2
-        kv2, nxt = fn(*args)
+        kv2, nxt, *_ = fn(*args)
     np.asarray(nxt)  # one forced sync for the chain
     dt = (time.perf_counter() - t0) / STEPS * 1e3
     return dt, kv2

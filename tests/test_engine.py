@@ -573,3 +573,54 @@ def test_long_prompt_behind_short_not_truncated(tiny_model):
     assert done[rid_l].token_ids == solo_long.token_ids
     assert done[rid_l].n_prompt == len(long_prompt)
     assert len(done[rid_s].token_ids) == 6
+
+
+def test_engine_logprobs(tiny_model):
+    """Per-token logprobs: one entry per emitted token, greedy token's
+    logprob equals its top-1 alternative, and chunked/preempted paths keep
+    the one-entry-per-token invariant."""
+    cfg, model, params = tiny_model
+    eng = make_engine(tiny_model)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6, logprobs=3)
+    [fin] = eng.generate([[1, 17, 42, 9]], sp)
+    assert fin.logprobs is not None
+    assert len(fin.logprobs) == len(fin.token_ids)
+    for tok, e in zip(fin.token_ids, fin.logprobs):
+        assert e["token"] == tok
+        assert len(e["top_ids"]) == 3 and len(e["top_logprobs"]) == 3
+        # greedy: the sampled token IS the argmax => top-1 entry
+        assert e["top_ids"][0] == tok
+        assert abs(e["logprob"] - e["top_logprobs"][0]) < 1e-5
+        assert e["logprob"] <= 0.0
+
+    # plain requests stay logprob-free (no host transfer of the lp arrays)
+    [fin2] = eng.generate([[1, 17, 42, 9]],
+                          SamplingParams(temperature=0.0, max_new_tokens=4))
+    assert fin2.logprobs is None
+
+    # chunked prefill + logprobs: entry count still matches
+    rng = np.random.default_rng(9)
+    long_prompt = [int(x) for x in rng.integers(2, cfg.vocab_size, 60)]
+    eng2 = make_engine(tiny_model, max_model_len=128,
+                       context_encoding_buckets=(16, 32))
+    [fin3] = eng2.generate([long_prompt],
+                           SamplingParams(temperature=0.0, max_new_tokens=5,
+                                          logprobs=2))
+    assert len(fin3.logprobs) == len(fin3.token_ids) == 5
+    assert all(e["token"] == t
+               for e, t in zip(fin3.logprobs, fin3.token_ids))
+
+
+def test_engine_logprobs_survive_preemption(tiny_model):
+    """Preemption re-queues committed tokens as prompt suffix; their
+    logprob entries must survive into the final record."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=12, logprobs=2)
+    # tight pool forces preemption (mirrors the preemption test geometry)
+    eng = make_engine(tiny_model, num_blocks=13)
+    prompts = [[1, 5, 9, 11], [1, 200, 300], [2, 7, 9, 13, 15]]
+    fins = eng.generate(prompts, sp)
+    for f in fins:
+        assert f.stop_reason == "length"
+        assert len(f.logprobs) == len(f.token_ids) == 12
+        assert all(e["token"] == t
+                   for e, t in zip(f.logprobs, f.token_ids))

@@ -148,6 +148,31 @@ async def test_vllm_openai_surface_and_stats():
         assert body["choices"][0]["message"]["role"] == "assistant"
         assert body["usage"]["completion_tokens"] == 4
 
+        # logprobs: completions int form and chat bool+top_logprobs form
+        r = await c.post("/v1/completions", json={
+            "prompt": "hello world", "max_tokens": 4, "temperature": 0.0,
+            "logprobs": 3})
+        assert r.status_code == 200, r.text
+        lp = r.json()["choices"][0]["logprobs"]
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == 4
+        # dict-keyed (OpenAI completions shape): distinct ids may decode to
+        # the same string (byte tokenizer drops out-of-range ids) and merge
+        assert all(1 <= len(d) <= 3 for d in lp["top_logprobs"])
+        assert all(v <= 0.0 for v in lp["token_logprobs"])
+
+        r = await c.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "temperature": 0.0, "logprobs": True,
+            "top_logprobs": 2})
+        assert r.status_code == 200, r.text
+        lp = r.json()["choices"][0]["logprobs"]["content"]
+        assert len(lp) == 3
+        assert all(len(e["top_logprobs"]) == 2 for e in lp)
+
+        r = await c.post("/v1/completions", json={
+            "prompt": "x", "stream": True, "logprobs": 1})
+        assert r.status_code == 400  # not supported while streaming
+
         # n parallel samples: greedy copies are identical; bad n rejected
         r = await c.post("/v1/completions", json={
             "prompt": "hello world", "max_tokens": 4, "temperature": 0.0,
