@@ -40,6 +40,39 @@ if INNER:
 
 # inf2.xlarge SD2.1 breaking point: 0.67 s/img p50 (reference README.md:261)
 SD_BASELINE_IMG_S = 1.0 / 0.67
+# $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
+# (reference README.md:192). The north star is throughput per DOLLAR, so
+# every bench line carries the cost basis it was computed with.
+V5E_COST_HR = 1.20
+INF2_COST_HR = 0.7582
+
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def _published(key: str):
+    """Self-baseline anchor from BASELINE.json.published (repo-root path —
+    cwd-independent), or None before the first promoted on-chip run."""
+    try:
+        with open(os.path.join(_ROOT, "BASELINE.json")) as f:
+            return json.load(f)["published"].get(key)
+    except Exception:
+        return None
+
+
+def _dollars(out: dict, *, inf2_value: float | None = None) -> dict:
+    """Attach the cost basis + work-per-dollar fields to a bench line.
+
+    ``per_dollar`` is work units per dollar of chip time; when the reference
+    publishes a comparable inf2 number, ``per_dollar_vs_inf2`` is the
+    throughput/$ ratio (the BASELINE.md north star: >= 2.0).
+    """
+    out["chip_cost_per_hr"] = V5E_COST_HR
+    out["per_dollar"] = round(out["value"] * 3600.0 / V5E_COST_HR, 2)
+    if inf2_value is not None:
+        out["per_dollar_vs_inf2"] = round(
+            (out["value"] / V5E_COST_HR) / (inf2_value / INF2_COST_HR), 3)
+    return out
 
 
 def bench_sd(tiny: bool) -> dict:
@@ -83,21 +116,58 @@ def bench_sd(tiny: bool) -> dict:
     pipe = sd_mod.StableDiffusion(variant, unet_params, vae_params, text_encode)
     ids = jnp.zeros((1, seq), jnp.int32)
 
-    pipe.txt2img(ids, ids, rng=rng, height=size, width=size, steps=steps)  # warm
+    stepwise = os.environ.get("SHAI_SD_STEPWISE", "") == "1"
+
+    if not tiny:
+        # staged warm: give the tunnel SMALL compiles first — the stepwise
+        # single-step executable, then the VAE decode — before the
+        # full-pipeline compile that wedged the r3 tunnel (VERDICT r3 weak
+        # #7). Both are the REAL executables of stepwise mode, so this also
+        # pre-banks the fallback path in the persistent XLA cache: if the
+        # pipeline compile wedges the tunnel, the next attempt escalates to
+        # SHAI_SD_STEPWISE=1 (see main()) and resumes these stages instantly.
+        import numpy as np
+
+        step = pipe._build_step(1)
+        ts, a_t, a_p = (np.asarray(x) for x in pipe.scheduler.tables(steps))
+        out = step(unet_params,
+                   jnp.zeros((1, lat, lat, variant.unet.in_channels),
+                             jnp.float32),
+                   ts[0], a_t[0], a_p[0],
+                   jnp.zeros((2, seq, D), jnp.bfloat16), jnp.float32(7.5))
+        np.asarray(out).sum()
+        print("warm stage 1/3 done (denoise step)", file=sys.stderr)
+        np.asarray(pipe._decode(
+            vae_params, jnp.zeros((1, lat, lat, variant.vae.latent_channels),
+                                  jnp.float32))).sum()
+        print("warm stage 2/3 done (vae decode)", file=sys.stderr)
+
+    def run(key):
+        if stepwise:
+            # fallback for a tunnel that cannot survive the one-executable
+            # pipeline compile: jitted single step in a host loop + jitted
+            # decode. Async dispatch overlaps the per-step enqueues, so the
+            # measured number stays comparable (mode is labeled).
+            return pipe.txt2img_stepwise(ids, ids, rng=key, height=size,
+                                         width=size, steps=steps)
+        return pipe.txt2img(ids, ids, rng=key, height=size, width=size,
+                            steps=steps)
+
+    img = run(rng)  # warm stage 3/3: the full pipeline
     runs = 3
     t0 = time.perf_counter()
     for i in range(runs):
-        img = pipe.txt2img(ids, ids, rng=jax.random.PRNGKey(i), height=size,
-                           width=size, steps=steps)
+        img = run(jax.random.PRNGKey(i))
     dt = (time.perf_counter() - t0) / runs
     assert img.shape[1] == size
-    return {
-        "metric": f"sd21-{size}px {steps}-step txt2img img/s "
+    mode = " stepwise" if stepwise else ""
+    return _dollars({
+        "metric": f"sd21-{size}px {steps}-step{mode} txt2img img/s "
                   f"({jax.devices()[0].platform})",
         "value": round(1.0 / dt, 4),
         "unit": "images/sec",
         "vs_baseline": round((1.0 / dt) / SD_BASELINE_IMG_S, 3),
-    }
+    }, inf2_value=SD_BASELINE_IMG_S)
 
 
 def bench_llama(tiny: bool) -> dict:
@@ -169,30 +239,125 @@ def bench_llama(tiny: bool) -> dict:
            "llama3.2-3b-geometry": "llama3b_decode_tok_s",
            "llama3.2-1b-geometry-int8": "llama1b_int8_decode_tok_s",
            "llama3.2-3b-geometry-int8": "llama3b_int8_decode_tok_s"}.get(name)
-    try:
-        published = json.load(open("BASELINE.json"))["published"]
-        base = published.get(key)
-    except Exception:
-        base = None
-    return {
+    base = _published(key)
+    return _dollars({
         "metric": f"{name} decode tok/s (bs={batch}, "
                   f"{jax.devices()[0].platform})",
         "value": round(toks, 2),
         "unit": "tokens/sec",
         "vs_baseline": round(toks / base, 3) if base else 1.0,
-    }
+    })
+
+
+def bench_flux(tiny: bool) -> dict:
+    """Flux (rectified-flow DiT) txt2img on ONE chip.
+
+    The real flux-schnell is ~12B params — 24 GiB bf16, beyond one v5e chip's
+    16 GiB — so this benches a clearly-labeled SCALED geometry (same hidden
+    width/heads/patching as flux, depth cut to 6 double + 12 single blocks,
+    ~3.8B params) at 256x256, 4 steps, schnell-style (no guidance embedding,
+    guidance=0). Self-baselined via BASELINE.json.published like the llama
+    benches; the reference's comparable stage is the cova image stage
+    (flux-dev 512^2 inf2 TP=8, 5.61 s — ``cova/README.md:98``), recorded in
+    BASELINE.md but not directly comparable to a scaled single-chip geometry.
+    """
+    import dataclasses as _dc
+
+    from scalable_hw_agnostic_inference_tpu.core.aot import (
+        host_init,
+        to_default_device,
+    )
+    from scalable_hw_agnostic_inference_tpu.models import flux as flux_mod
+    from scalable_hw_agnostic_inference_tpu.models.convert import cast_f32_to_bf16
+    from scalable_hw_agnostic_inference_tpu.models.flux_pipeline import FluxPipeline
+    from scalable_hw_agnostic_inference_tpu.models.vae import VAEConfig
+
+    if tiny:
+        fcfg, vcfg = flux_mod.FluxConfig.tiny(), VAEConfig.tiny()
+        size, steps, t5_len = 32, 2, 8
+        name = "flux-tiny"
+    else:
+        fcfg = _dc.replace(flux_mod.FluxConfig.flux_dev(), n_double=6,
+                           n_single=12, guidance_embed=False)
+        vcfg = VAEConfig(latent_channels=16)
+        size, steps, t5_len = 256, 4, 256
+        name = "flux-schnell-scaled-4b-geometry"
+
+    model = flux_mod.FluxTransformer(fcfg, dtype=jnp.bfloat16)
+    f = 2 ** (len(vcfg.block_out) - 1)
+    h = w = size // f
+    ids = flux_mod.make_ids(1, t5_len, h, w)  # h,w are LATENT dims
+    params = host_init(
+        model.init, lambda: jax.random.PRNGKey(0),
+        lambda: jnp.zeros((1, (h // 2) * (w // 2), fcfg.in_channels)),
+        lambda: jnp.zeros((1, t5_len, fcfg.t5_dim)),
+        lambda: jnp.zeros((1, fcfg.clip_dim)),
+        lambda: jnp.zeros((1,)),
+        lambda: jnp.zeros((1,)),
+        lambda: ids,
+    )
+    params = to_default_device(cast_f32_to_bf16(params))
+    from scalable_hw_agnostic_inference_tpu.models.vae import AutoencoderKL
+
+    vae = AutoencoderKL(vcfg)
+    vae_params = to_default_device(host_init(
+        vae.init, lambda: jax.random.PRNGKey(1),
+        lambda: jnp.zeros((1, h, w, vcfg.latent_channels))))
+
+    D_t5, D_clip = fcfg.t5_dim, fcfg.clip_dim
+
+    @jax.jit  # stub conditioning (not benched; cost negligible vs the DiT)
+    def t5_encode(tok):
+        return jax.nn.one_hot(tok % D_t5, D_t5, dtype=jnp.bfloat16)
+
+    @jax.jit
+    def clip_pooled(tok):
+        return jax.nn.one_hot(tok[:, 0] % D_clip, D_clip, dtype=jnp.bfloat16)
+
+    pipe = FluxPipeline(fcfg, params, vcfg, vae_params, t5_encode, clip_pooled)
+    t5_ids = jnp.zeros((1, t5_len), jnp.int32)
+    clip_ids = jnp.zeros((1, 8), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+
+    def run(key):
+        return pipe.txt2img(t5_ids, clip_ids, rng=key, height=size,
+                            width=size, steps=steps, guidance=0.0)
+
+    img = run(rng)  # warm
+    runs = 3
+    t0 = time.perf_counter()
+    for i in range(runs):
+        img = run(jax.random.PRNGKey(i))
+    dt = (time.perf_counter() - t0) / runs
+    assert img.shape[1] == size
+    base = _published("flux_scaled_img_s")
+    val = round(1.0 / dt, 4)
+    return _dollars({
+        "metric": f"{name} {size}px {steps}-step txt2img img/s "
+                  f"({jax.devices()[0].platform})",
+        "value": val,
+        "unit": "images/sec",
+        "vs_baseline": round(val / base, 3) if base else 1.0,
+    })
 
 
 def inner_main() -> None:
     if "--probe" in sys.argv:
         # liveness: a real device round-trip (completion signals can lie
-        # over the tunnel — only a host transfer proves execution)
+        # over the tunnel — only a host transfer proves execution). A
+        # silent JAX CPU fallback must read as DOWN, not alive — a probe
+        # that passes on CPU lets the watcher bank cpu-tiny numbers as
+        # on-chip measurements (ADVICE r3 medium).
         import numpy as np
 
+        if jax.devices()[0].platform == "cpu":
+            print("probe refused: backend fell back to cpu", file=sys.stderr)
+            sys.exit(3)
         x = jnp.ones((128, 128), jnp.bfloat16)
         np.asarray(x @ x)
         print(json.dumps({"metric": "probe", "value": 1.0, "unit": "ok",
-                          "vs_baseline": 1.0}))
+                          "vs_baseline": 1.0,
+                          "platform": jax.devices()[0].platform}))
         return
     tiny = jax.devices()[0].platform == "cpu"
     if not tiny:
@@ -202,8 +367,15 @@ def inner_main() -> None:
         )
 
         enable_persistent_cache_from_env()
-    which = "llama" if any(a.startswith("llama") for a in sys.argv) else "sd"
-    out = bench_llama(tiny) if which == "llama" else bench_sd(tiny)
+    if any(a.startswith("llama") for a in sys.argv):
+        out = bench_llama(tiny)
+    elif "flux" in sys.argv:
+        out = bench_flux(tiny)
+    else:
+        out = bench_sd(tiny)
+    # structured platform provenance: is_real() keys off this, never off
+    # metric-string formatting (ADVICE r3 medium)
+    out["platform"] = jax.devices()[0].platform
     print(json.dumps(out))
 
 
@@ -222,16 +394,19 @@ def _clear_stale_locks() -> None:
             pass
 
 
-def _run_child(which: str, cpu: bool, timeout: float) -> tuple[dict | None, str]:
+def _run_child(which: str, cpu: bool, timeout: float,
+               env: dict | None = None) -> tuple[dict | None, str]:
     """Run one measurement attempt in a child; return (result, error_tail)."""
     args = [sys.executable, os.path.abspath(__file__), "--inner", which]
-    for tok in ("llama3b", "int8"):
+    for tok in ("llama3b", "int8", "flux"):
         if tok in sys.argv and tok not in args:
             args.append(tok)
     if cpu:
         args.append("--cpu")
     try:
-        r = subprocess.run(args, capture_output=True, text=True, timeout=timeout)
+        r = subprocess.run(args, capture_output=True, text=True,
+                           timeout=timeout,
+                           env={**os.environ, **(env or {})})
     except subprocess.TimeoutExpired:
         return None, f"attempt timed out after {timeout:.0f}s"
     for line in reversed(r.stdout.strip().splitlines()):
@@ -251,6 +426,8 @@ def _banked_result() -> dict | None:
         key = "llama3b" if "llama3b" in sys.argv else "llama"
         if "int8" in sys.argv:
             key += "_int8"
+    elif "flux" in sys.argv:
+        key = "flux"
     else:
         key = "sd"
     root = os.path.dirname(os.path.abspath(__file__))
@@ -269,7 +446,12 @@ def _banked_result() -> dict | None:
 
 
 def main() -> None:
-    which = "llama" if any(a.startswith("llama") for a in sys.argv) else "sd"
+    if any(a.startswith("llama") for a in sys.argv):
+        which = "llama"
+    elif "flux" in sys.argv:
+        which = "flux"
+    else:
+        which = "sd"
     unit = "tokens/sec" if which == "llama" else "images/sec"
     force_cpu = "--cpu" in sys.argv
 
@@ -287,8 +469,23 @@ def main() -> None:
                 if i + 1 < attempts:
                     time.sleep(20 * (i + 1))
                 continue
-        out, last_err = _run_child(which, force_cpu, timeout=2400)
+        # last-attempt escalation for sd: the fused-pipeline mega-compile is
+        # the known tunnel-wedger; stepwise mode compiles only the (already
+        # cache-banked) single-step + decode executables
+        env = ({"SHAI_SD_STEPWISE": "1"}
+               if which == "sd" and not force_cpu and i == attempts - 1
+               else None)
+        out, last_err = _run_child(which, force_cpu, timeout=2400, env=env)
         if out is not None:
+            # a measurement child whose backend silently fell back to CPU is
+            # a FAILED attempt, not a result: banking it would block the
+            # real on-chip number for the rest of the round (the probe
+            # passing does not guarantee the next child's init succeeds)
+            if not force_cpu and out.get("platform") == "cpu":
+                last_err = "measurement child fell back to cpu platform"
+                if i + 1 < attempts:
+                    time.sleep(20 * (i + 1))
+                continue
             print(json.dumps(out))
             return
         if i + 1 < attempts:

