@@ -90,6 +90,22 @@ class LlamaConfig:
         return cls()  # defaults are Llama-3-8B
 
     @classmethod
+    def llama3_70b(cls) -> "LlamaConfig":
+        """Llama-3-70B / DeepSeek-R1-Distill-Llama-70B geometry — the
+        reference's biggest deployment (TP=32,
+        ``compile-vllm-job.yaml:49-55``)."""
+        return cls(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                   mlp_dim=28672)
+
+    @classmethod
+    def mllama_11b_text(cls) -> "LlamaConfig":
+        """Llama-3.2-11B-Vision text tower: 40 layers, 8 of them gated
+        cross-attention (``cova/mllama-32-11b-vllm-trn1-config.yaml``)."""
+        return cls(dim=4096, n_layers=40, n_heads=32, n_kv_heads=8,
+                   mlp_dim=14336, max_seq_len=131072,
+                   cross_attention_layers=(3, 8, 13, 18, 23, 28, 33, 38))
+
+    @classmethod
     def from_hf(cls, hf) -> "LlamaConfig":
         return cls(
             vocab_size=hf.vocab_size,
