@@ -1,0 +1,101 @@
+"""Engine request/response dataclasses (split from engine.py, r4 weak #5)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .config import EngineConfig
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    max_new_tokens: int = 128
+    eos_id: int = -1            # -1: never stop on a token
+    # report per-token logprobs with this many top alternatives (0 = off,
+    # capped at runner.K_LOGPROBS — the OpenAI `logprobs` field)
+    logprobs: int = 0
+
+    def clamp(self, ecfg: EngineConfig) -> "SamplingParams":
+        from .runner import K_LOGPROBS
+
+        # global_topk == 0 means "cap disabled": leave a user-set top_k alone
+        if self.top_k and ecfg.global_topk:
+            top_k = min(self.top_k, ecfg.global_topk)
+        else:
+            top_k = self.top_k or ecfg.global_topk
+        return dataclasses.replace(
+            self,
+            max_new_tokens=min(self.max_new_tokens, ecfg.max_new_tokens),
+            top_k=top_k,
+            logprobs=min(max(int(self.logprobs), 0), K_LOGPROBS),
+        )
+
+
+@dataclasses.dataclass
+class Request:
+    req_id: int
+    prompt_ids: List[int]
+    params: SamplingParams
+    # soft-prefix embeddings [P, dim] (vision tokens — multimodal requests,
+    # reference ``vllm_model_api_m.py:42-66``); occupy the first P positions
+    prefix: Optional[np.ndarray] = None
+    # mllama cross-attention states [Lv, dim] (projected vision features);
+    # attended by the gated cross layers, never part of the token sequence.
+    # cross_len: valid rows (multi-tile images fill a tile-count-dependent
+    # prefix of the static buffer; 0/None = all rows valid)
+    cross_states: Optional[np.ndarray] = None
+    cross_len: int = 0
+    # tokens generated before a recompute-preemption (they re-enter the
+    # cache as prompt suffix but remain part of the client-visible output)
+    already_generated: List[int] = dataclasses.field(default_factory=list)
+    orig_n_prompt: int = -1
+    # streaming: called (engine-loop thread, must be cheap — a queue put)
+    # exactly once per token that will appear in Finished.token_ids, in order
+    on_token: Optional[Any] = None
+    # submission time (monotonic) for TTFT accounting; survives preemption
+    t_submit: float = 0.0
+    # logprob entries for tokens emitted before a preemption (mirrors
+    # already_generated)
+    already_lp: List = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        if self.orig_n_prompt < 0:
+            self.orig_n_prompt = len(self.prompt_ids)
+
+    @property
+    def prefix_len(self) -> int:
+        return 0 if self.prefix is None else int(self.prefix.shape[0])
+
+
+@dataclasses.dataclass
+class Finished:
+    req_id: int
+    token_ids: List[int]        # generated tokens, EOS excluded
+    n_prompt: int
+    stop_reason: str            # "eos" | "length" | "rejected" | "cancelled"
+    # one entry per token_ids element when the request asked for logprobs:
+    # {"token", "logprob", "top_ids", "top_logprobs"}
+    logprobs: Optional[List[Dict[str, Any]]] = None
+
+
+@dataclasses.dataclass
+class _Running:
+    req: Request
+    slot: int
+    generated: List[int]
+    pending_token: int          # sampled but not yet written to the cache
+    # chunked prefill: prompt position of the next chunk, or None when the
+    # prompt is fully encoded (mid-prefill slots don't join the decode batch)
+    prefill_cursor: Optional[int] = None
+    t_first: float = 0.0        # first-token time (TPOT accounting)
+    # logprob entries in sample order (== append order); only populated
+    # when the request asked for logprobs
+    lps: List = dataclasses.field(default_factory=list)
+
+

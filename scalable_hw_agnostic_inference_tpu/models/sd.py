@@ -184,6 +184,66 @@ class StableDiffusion:
             self._denoise_cache[key] = self._build_pipeline(B, h, w, steps)
         return self._denoise_cache[key]
 
+    def _build_pipeline_from_latents(self, B: int, h: int, w: int,
+                                     steps: int) -> Callable:
+        """The fused pipeline with LATENTS AS AN ARGUMENT.
+
+        The serving coalescer batches concurrent requests into one denoise
+        call; each request keeps its own seed by materializing its [1,h,w,C]
+        init noise host-side (identical math to the in-graph init: same key,
+        same shape) and stacking — so a request's image is a function of its
+        own (seed, prompt), independent of which batch it landed in.
+        """
+        sch = self.scheduler
+        tables = sch.tables(steps)
+        one = self._make_step(B)
+        vae = self.vae
+
+        def full(unet_params, vae_params, ctx2, latents, guidance):
+            def body(lat, xs):
+                t, a, a2 = xs
+                return one(unet_params, lat, t, a, a2, ctx2, guidance), None
+
+            lat, _ = jax.lax.scan(body, latents, tables)
+            img = vae.apply(vae_params, lat, method=AutoencoderKL.decode)
+            img = jnp.clip(img * 127.5 + 127.5, 0.0, 255.0)
+            return jnp.round(img).astype(jnp.uint8)
+
+        return jax.jit(full)
+
+    def init_latents(self, seed: int, h: int, w: int, steps: int) -> jax.Array:
+        """One request's [1,h,w,C] init noise — the exact tensor the
+        in-graph path draws from ``PRNGKey(seed)``."""
+        lat = jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (1, h, w, self.variant.unet.in_channels), jnp.float32)
+        return lat * self._init_scale(steps)
+
+    def txt2img_batch(
+        self,
+        prompt_ids: jax.Array,    # [B, L]
+        uncond_ids: jax.Array,    # [B, L]
+        latents: jax.Array,       # [B, h, w, C] (stacked init_latents)
+        *,
+        height: int,
+        width: int,
+        steps: int = 25,
+        guidance_scale: float = 7.5,
+    ) -> np.ndarray:
+        """Batched :meth:`txt2img` over pre-drawn latents (the coalescer
+        path). Returns uint8 [B, H, W, 3]."""
+        f = self.vae_scale
+        B = prompt_ids.shape[0]
+        key = ("batch", B, height // f, width // f, steps)
+        if key not in self._denoise_cache:
+            self._denoise_cache[key] = self._build_pipeline_from_latents(
+                B, height // f, width // f, steps)
+        ctx2 = self.text_encode(jnp.concatenate([uncond_ids, prompt_ids], axis=0))
+        img = self._denoise_cache[key](
+            self.unet_params, self.vae_params, ctx2, latents,
+            jnp.float32(guidance_scale))
+        return np.asarray(img)
+
     def _build_step(self, B: int) -> Callable:
         """ONE denoise step as its own executable (stepwise mode).
 

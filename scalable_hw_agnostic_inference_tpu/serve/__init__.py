@@ -1,5 +1,28 @@
-from .latency import LatencyCollector, BenchmarkReport  # noqa: F401
-from .metrics import MetricsPublisher  # noqa: F401
-from .asgi import App, Request, Response, HTTPError  # noqa: F401
-from .app import ModelService, create_app, serve_forever  # noqa: F401
-from .httpd import Server  # noqa: F401
+"""Serving runtime. Exports resolve lazily (PEP 562): the ASGI framework and
+HTTP server are stdlib-only, and the thin assets image (build/
+Dockerfile.assets) runs controllers/simulators against them WITHOUT jax —
+an eager ``from .app import ...`` here would pull the whole model stack into
+every consumer (tests/test_assets_image.py pins the light-import set)."""
+
+_EXPORTS = {
+    "LatencyCollector": "latency", "BenchmarkReport": "latency",
+    "MetricsPublisher": "metrics",
+    "App": "asgi", "Request": "asgi", "Response": "asgi", "HTTPError": "asgi",
+    "ModelService": "app", "create_app": "app", "serve_forever": "app",
+    "Server": "httpd",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(
+            importlib.import_module(f".{_EXPORTS[name]}", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -104,6 +104,21 @@ class StreamingResponse(Response):
 _SEGMENT = re.compile(r"\{(\w+)(?::(int|float|path))?\}")
 _CASTS = {"int": int, "float": float, None: str, "path": str}
 
+_STREAM_POOL = None
+
+
+def _stream_pool():
+    """Executor reserved for StreamingResponse chunk pulls (see usage)."""
+    global _STREAM_POOL
+    if _STREAM_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        # one thread per concurrently-live stream; 64 covers every engine's
+        # max_num_seqs with slack, and idle threads cost only stack pages
+        _STREAM_POOL = ThreadPoolExecutor(max_workers=64,
+                                          thread_name_prefix="sse-stream")
+    return _STREAM_POOL
+
 
 def _compile_pattern(pattern: str) -> Tuple[re.Pattern, Dict[str, Callable]]:
     casts: Dict[str, Callable] = {}
@@ -277,7 +292,12 @@ class App:
                     return _END
 
             while True:
-                chunk = await loop.run_in_executor(None, _next)
+                # dedicated pool: each live SSE stream parks one thread in
+                # _next (possibly for minutes on a queued request); the
+                # default executor is capped at min(32, cpus+4) and shared
+                # with asyncio internals (getaddrinfo), so saturating it
+                # stalls every OTHER stream and DNS lookup (ADVICE r3)
+                chunk = await loop.run_in_executor(_stream_pool(), _next)
                 if chunk is _END:
                     break
                 if isinstance(chunk, str):

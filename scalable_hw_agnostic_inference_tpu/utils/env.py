@@ -77,6 +77,10 @@ class ServeConfig:
     batch_size: int = 1
     scheduler: str = "ddim"              # diffusion sampler: ddim | euler
     steps_buckets: str = ""              # extra allowed steps values, csv
+    # diffusion request coalescing: concurrent /genimage requests sharing
+    # (steps, guidance) batch into ONE denoise call, pow2 batch buckets up
+    # to this cap (1 = off; each bucket costs one compiled executable)
+    sd_batch_max: int = 1
     vllm_config: str = "/vllm_config.yaml"  # engine ConfigMap mount path
     # mesh / parallelism
     mesh_spec: str = ""                  # e.g. "tp=4" or "dp=2,tp=4"; "" = single device
@@ -109,6 +113,7 @@ class ServeConfig:
             batch_size=env_int("BATCH_SIZE", 1),
             scheduler=env_str("SCHEDULER", "ddim"),
             steps_buckets=env_str("STEPS_BUCKETS", ""),
+            sd_batch_max=env_int("SD_BATCH_MAX", 1),
             vllm_config=env_str("VLLM_CONFIG", "/vllm_config.yaml"),
             mesh_spec=env_str("MESH_SPEC", ""),
             submesh=env_str("SUBMESH", ""),

@@ -279,6 +279,55 @@ def test_httpd_over_real_socket():
         server.stop()
 
 
+def test_httpd_http10_gets_unframed_body():
+    """An HTTP/1.0 client cannot parse chunked framing: a response without
+    content-length must arrive unframed, delimited by connection close
+    (ADVICE r3 — previously chunked framing went out regardless)."""
+    import socket
+
+    import socket as _socket
+
+    from scalable_hw_agnostic_inference_tpu.serve.asgi import (
+        App as AsgiApp,
+        StreamingResponse,
+    )
+
+    app = AsgiApp()
+
+    @app.get("/stream")
+    def stream(request):
+        return StreamingResponse(iter(["hello ", "world"]),
+                                 media_type="text/plain")
+
+    server = Server(app, host="127.0.0.1", port=0)
+    host, port = server.start_background()
+    try:
+        with socket.create_connection((host, port), timeout=10) as s:
+            s.sendall(b"GET /stream HTTP/1.0\r\nhost: x\r\n\r\n")
+            raw = b""
+            while True:
+                b_ = s.recv(65536)
+                if not b_:
+                    break      # server closed: the HTTP/1.0 delimiter
+                raw += b_
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert b"200" in head.split(b"\r\n")[0]
+        assert b"transfer-encoding" not in head.lower()
+        assert b"connection: close" in head.lower()
+        assert body == b"hello world"      # unframed, no chunk artifacts
+        # HTTP/1.1 on the same route still gets chunked keep-alive framing
+        with _socket.create_connection((host, port), timeout=10) as s:
+            s.sendall(b"GET /stream HTTP/1.1\r\nhost: x\r\n\r\n")
+            raw = b""
+            while b"0\r\n\r\n" not in raw:
+                raw += s.recv(65536)
+        head = raw.lower().partition(b"\r\n\r\n")[0]
+        assert b"transfer-encoding: chunked" in head
+        assert b"connection: keep-alive" in head
+    finally:
+        server.stop()
+
+
 def test_httpd_parallel_probes_during_inference():
     """Health probes answer while the single model lane is busy."""
     cfg = make_cfg()

@@ -3,6 +3,8 @@
 import base64
 import io
 
+import numpy as np
+
 import httpx
 import pytest
 
@@ -43,3 +45,106 @@ async def test_sd_service_genimage_roundtrip():
 
         r = await c.post("/genimage", json={"prompt": "x", "steps": 0})
         assert r.status_code == 400
+
+
+@pytest.mark.asyncio
+async def test_sd_request_coalescing_serves_concurrent_requests():
+    """SD_BATCH_MAX>1: concurrent /genimage requests are coalesced into
+    batched denoise calls and all succeed with valid images."""
+    import asyncio
+    import base64
+
+    cfg = ServeConfig(app="sd21", model_id="tiny", device="cpu",
+                      num_inference_steps=2, batch_size=1, sd_batch_max=4)
+    service = get_model("sd")(cfg)
+    assert service.concurrency == 4
+    app = create_app(cfg, service)
+    async with make_client(app) as c:
+        assert (await wait_ready(c, timeout=240.0)).status_code == 200
+        payloads = [{"prompt": f"a cat #{i}", "seed": i} for i in range(4)]
+        outs = await asyncio.gather(
+            *[c.post("/genimage", json=p) for p in payloads])
+        for o in outs:
+            assert o.status_code == 200
+            assert base64.b64decode(o.json()["image_b64"])[:4] == b"\x89PNG"
+
+
+def test_sd_coalescer_follower_membership_is_identity_based():
+    """Entries hold numpy arrays; a follower probing the pending list must
+    use identity, never equality (ndarray __eq__ raises in `in`). Staggered
+    arrivals force the follower-wakes-while-peers-pend path
+    deterministically."""
+    import threading
+
+    cfg = ServeConfig(app="sd21", model_id="tiny", device="cpu",
+                      num_inference_steps=2, batch_size=1, sd_batch_max=2)
+    s = get_model("sd")(cfg)
+    s._coalesce_window_s = 0.15
+    ran = []
+
+    def fake_run_batch(items, steps, guidance):
+        ran.append(len(items))
+        return np.zeros((len(items), 4, 4, 3), np.uint8)
+
+    s._run_batch = fake_run_batch
+    results, errors = [], []
+
+    def one(i, delay):
+        import time as t
+        t.sleep(delay)
+        try:
+            results.append(s._coalesced(
+                {"ids": np.zeros((1, 8), np.int32),
+                 "uncond": np.zeros((1, 8), np.int32), "seed": i}, 2, 7.5))
+        except Exception as e:   # the old equality probe raised ValueError
+            errors.append(e)
+
+    # 3 same-key requests with cap 2: one pair batches, the straggler
+    # leads its own batch — every membership probe sees live peers
+    ts = [threading.Thread(target=one, args=(i, d))
+          for i, d in enumerate((0.0, 0.05, 0.1))]
+    for t_ in ts:
+        t_.start()
+    for t_ in ts:
+        t_.join(timeout=30)
+    assert not errors, errors
+    assert len(results) == 3
+    assert sum(ran) == 3 and max(ran) <= 2
+
+
+def test_sd_batch_max_clamps_to_pow2():
+    """A non-pow2 cap would let a rounded-up batch land in a bucket warmup
+    never compiled (post-ready XLA compile); the cap clamps down instead."""
+    cfg = ServeConfig(app="sd21", model_id="tiny", device="cpu",
+                      num_inference_steps=2, batch_size=1, sd_batch_max=6)
+    s = get_model("sd")(cfg)
+    assert s._batch_max == 4 and s.concurrency == 4
+
+
+def test_sd_batch_output_is_composition_invariant():
+    """A request's image depends on (seed, prompt, batch bucket) only —
+    NEVER on which other requests share its batch (each sample's init noise
+    comes from its own seed; the batched executable computes all rows
+    identically). Cross-bucket bit-exactness is NOT promised: XLA fuses
+    differently per batch shape, the usual batching-server tradeoff."""
+    cfg = ServeConfig(app="sd21", model_id="tiny", device="cpu",
+                      num_inference_steps=2, batch_size=1, sd_batch_max=4)
+    s = get_model("sd")(cfg)
+    s.load()
+
+    def it(i, prompt=None):
+        return {"ids": s._tokenize(prompt or f"a cat #{i}"),
+                "uncond": s._tokenize(""), "seed": i}
+
+    a = s._run_batch([it(1), it(0), it(2), it(3)], 2, 7.5)
+    b = s._run_batch([it(3), it(2), it(0), it(1)], 2, 7.5)
+    np.testing.assert_array_equal(a[0], b[3])   # item 1
+    np.testing.assert_array_equal(a[1], b[2])   # item 0
+    np.testing.assert_array_equal(a[3], b[0])   # item 3
+    # different co-batched PROMPTS must not bleed into a row either
+    c = s._run_batch([it(1), it(7, "a dog"), it(8, "x y z"), it(9, "?")],
+                     2, 7.5)
+    np.testing.assert_array_equal(a[0], c[0])
+    # padded partial batch (3 -> bucket 4) keeps rows independent too
+    d = s._run_batch([it(1), it(0), it(2)], 2, 7.5)
+    np.testing.assert_array_equal(a[0], d[0])

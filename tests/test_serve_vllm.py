@@ -66,6 +66,30 @@ def test_sse_assembler_compacts_on_newline():
     assert asm.push(ord("z")) == "z"
 
 
+def test_sse_assembler_forced_compaction_preserves_seam_spaces():
+    """Long unbroken generations force mid-line compaction; the streamed
+    concatenation must still equal the full decode (ADVICE r3: a fresh
+    window's sentencepiece-style leading-space normalization used to drop
+    the space at the seam — the one-token overlap prevents it)."""
+    from scalable_hw_agnostic_inference_tpu.serve.services import (
+        SseTextAssembler,
+    )
+
+    words = {i: f" w{i}" for i in range(400)}
+
+    def sp_decode(ids):
+        # sentencepiece semantics: a word-initial token decodes WITHOUT its
+        # leading space at the start of the window
+        return "".join(words[i] for i in ids).lstrip(" ")
+
+    asm = SseTextAssembler(sp_decode, [])
+    toks = list(range(400))  # > 2x COMPACT_AT, no newlines anywhere
+    streamed = "".join(asm.push(t) for t in toks) + asm.finish()
+    assert streamed == sp_decode(toks)
+    # compaction actually engaged (window stayed bounded)
+    assert len(asm.held) <= asm.COMPACT_AT
+
+
 def make_service(tmp_path=None, **env_over):
     cfg = ServeConfig(app="llm", model_id="tiny", device="cpu",
                       max_new_tokens=8, vllm_config="/nonexistent.yaml",
