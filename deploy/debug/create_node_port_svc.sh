@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Per-pod NodePort debug exposer — parity with the reference operator tool
+# (app/create_node_port_svc.sh + node_port_svc_template.yaml): label ONE
+# serving pod and surface it on its node's IP, bypassing the gateway, so an
+# operator can curl a specific replica (per-pod /stats, /profile, latency).
+#
+# Usage: POD_NAME=sd21-tpu-abc123 bash deploy/debug/create_node_port_svc.sh
+# Cleanup: kubectl delete svc "$POD_NAME-debug"; kubectl label pod \
+#          "$POD_NAME" inferencepod-
+set -euo pipefail
+
+: "${POD_NAME:?set POD_NAME to the pod to expose}"
+
+kubectl label pod "$POD_NAME" "inferencepod=$POD_NAME" --overwrite
+
+# node this pod landed on + that node's reachable IP (external if the pool
+# has one, internal otherwise — GKE TPU pools are usually internal-only)
+NODE=$(kubectl get pod "$POD_NAME" -o jsonpath='{.spec.nodeName}')
+NODE_IP=$(kubectl get node "$NODE" \
+  -o jsonpath='{.status.addresses[?(@.type=="ExternalIP")].address}')
+[ -n "$NODE_IP" ] || NODE_IP=$(kubectl get node "$NODE" \
+  -o jsonpath='{.status.addresses[?(@.type=="InternalIP")].address}')
+
+export POD_NAME SVC_NAME="$POD_NAME-debug"
+envsubst < "$(dirname "$0")/node-port-svc-template.yaml" | kubectl apply -f -
+
+PORT=$(kubectl get svc "$SVC_NAME" -o jsonpath='{.spec.ports[0].nodePort}')
+echo "pod $POD_NAME exposed at http://$NODE_IP:$PORT (node $NODE)"

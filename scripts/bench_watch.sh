@@ -29,7 +29,17 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     have "$w" || missing="$missing $w"
   done
   if [ -z "$missing" ]; then
-    note "all benches done — running perf breakdowns"
+    note "all benches done — serving-level breaking point (VERDICT r3 #2)"
+    # real sd unit over HTTP on the chip: replaces the projected
+    # sd21-tpu row in deploy/breakpoints.json with a measured ramp
+    # SD_BATCH_MAX=4: measure the unit as deployed (request coalescing on)
+    SD_BATCH_MAX=4 PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 3600 python \
+      scripts/breaking_point.py --spawn sd --full --levels 1,2,4,8 \
+      --duration 30 --platform tpu-v5e-1 --bank sd21-tpu \
+      2>&1 | grep -v WARNING | tee -a "$LOG"
+    python scripts/derive_weights.py 2>&1 | tee -a "$LOG"
+    python deploy/gen_units.py >/dev/null 2>&1 && note "manifests rederived"
+    note "running perf breakdowns"
     PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 2400 python scripts/perf_sd.py \
       2>&1 | grep -v WARNING | tee -a "$LOG"
     PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 2400 python scripts/perf_paged.py \

@@ -1,0 +1,82 @@
+"""Thin assets image + debug exposer consistency (VERDICT r3 missing #4/#5).
+
+The assets image (build/Dockerfile.assets) carries only the control plane:
+orchestrate/, the stdlib-only serve modules (asgi/httpd), loadgen, and the
+measurement scripts — no jax/torch/model stack. These tests pin (a) the
+light-import property the image depends on, hermetically, and (b) that the
+Dockerfile's COPY set and the debug exposer's label contract stay coherent.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BLOCKER = r"""
+import sys
+
+FORBIDDEN = {"jax", "jaxlib", "flax", "torch", "transformers", "numpy",
+             "optax", "orbax"}
+
+class Block:
+    def find_spec(self, name, path=None, target=None):
+        if name.split(".")[0] in FORBIDDEN:
+            raise ImportError(f"assets image has no {name!r}")
+        return None
+
+sys.meta_path.insert(0, Block())
+
+# exactly what the assets image runs (Dockerfile.assets COPY set)
+from scalable_hw_agnostic_inference_tpu.orchestrate import (  # noqa: F401
+    capacity_checker,
+    cova,
+    load_sim,
+)
+from scalable_hw_agnostic_inference_tpu.serve import asgi, httpd  # noqa: F401
+from scalable_hw_agnostic_inference_tpu.serve.asgi import App     # noqa: F401
+from scalable_hw_agnostic_inference_tpu.serve.httpd import Server  # noqa: F401
+print("light-import ok")
+"""
+
+
+def test_control_plane_imports_without_model_stack():
+    r = subprocess.run(
+        [sys.executable, "-c", BLOCKER], capture_output=True, text=True,
+        cwd=ROOT, timeout=120,
+        env={**os.environ, "PYTHONPATH": ROOT, "PALLAS_AXON_POOL_IPS": "",
+             "PYTHONNOUSERSITE": "1"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "light-import ok" in r.stdout
+
+
+def test_dockerfile_assets_copies_only_the_control_plane():
+    text = open(os.path.join(ROOT, "build", "Dockerfile.assets")).read()
+    for needed in ("orchestrate/", "serve/asgi.py", "serve/httpd.py",
+                   "native/loadgen", "breaking_point.py", "kubectl"):
+        assert needed in text, f"Dockerfile.assets must ship {needed}"
+    # instructions only (comments may NAME the excluded trees)
+    instructions = "\n".join(
+        ln for ln in text.splitlines()
+        if ln.strip().startswith(("COPY", "RUN", "ADD")))
+    for heavy in ("models/", "engine/", "compilectl", "jax", "torch",
+                  "transformers", "flax"):
+        assert heavy not in instructions, (
+            f"Dockerfile.assets must NOT ship {heavy}")
+
+
+def test_debug_exposer_label_contract():
+    sh = open(os.path.join(ROOT, "deploy", "debug",
+                           "create_node_port_svc.sh")).read()
+    tmpl = open(os.path.join(ROOT, "deploy", "debug",
+                             "node-port-svc-template.yaml")).read()
+    # the label key the script writes is the one the template selects on
+    assert 'inferencepod=$POD_NAME' in sh
+    assert "inferencepod: $POD_NAME" in tmpl
+    assert "type: NodePort" in tmpl
+    assert "envsubst" in sh
+    # debug services must never join routing (no albapp label); the
+    # template's comment may explain this, so scan yaml lines only
+    yaml_lines = [ln for ln in tmpl.splitlines()
+                  if not ln.lstrip().startswith("#")]
+    assert not any("albapp" in ln for ln in yaml_lines)

