@@ -6,6 +6,19 @@ set -euo pipefail
 IMAGE_REPO="${IMAGE_REPO:-ghcr.io/example/shai-tpu}"
 IMAGE_TAG="${IMAGE_TAG:-latest}"
 BASE_IMAGE="${BASE_IMAGE:-python:3.12-slim}"
+MIRROR_REPO="${MIRROR_REPO:-us-docker.pkg.dev/example/shai/base}"
+
+# digest pinning (reference build-assets.sh DLC mirroring, GCP-shaped):
+# when build/base-images.lock records a digest for BASE_IMAGE, build from
+# the mirrored, pinned copy instead of the mutable upstream tag
+LOCK="$(dirname "$0")/base-images.lock"
+if [ -f "$LOCK" ]; then
+  digest=$(awk -v img="$BASE_IMAGE" '$1 == img {print $2}' "$LOCK")
+  if [ -n "${digest:-}" ]; then
+    BASE_IMAGE="$MIRROR_REPO/$(echo "$BASE_IMAGE" | tr ':/' '--')@$digest"
+    echo "base image pinned: $BASE_IMAGE"
+  fi
+fi
 
 cd "$(dirname "$0")/.."
 docker build \

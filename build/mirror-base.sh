@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Mirror + pin the build's base images into the project registry — parity
+# with the reference's DLC mirroring (app/build-assets.sh:2-42, which copies
+# the AWS deep-learning containers into the account's ECR), GCP-shaped:
+# upstream registry -> Artifact Registry, pinned by DIGEST so every build is
+# byte-reproducible and survives upstream tag mutation or registry outages.
+#
+# The lock records the digest THE MIRROR serves after the push — a push
+# re-digests single-platform manifests, so recording the upstream (often
+# multi-arch index) digest would 404 against the mirror. Entries that
+# already carry a digest are skipped unless --refresh.
+#
+# build/base-images.lock holds one "name digest" pair per line; build.sh
+# and cloudbuild.yaml resolve BASE_IMAGE through it when a digest is
+# recorded.
+#
+# Usage (network-connected build host):
+#   bash build/mirror-base.sh            # mirror any not-yet-pinned image
+#   bash build/mirror-base.sh --refresh  # re-mirror everything, re-pin
+set -euo pipefail
+
+REPO="${MIRROR_REPO:-us-docker.pkg.dev/example/shai/base}"
+LOCK="$(cd "$(dirname "$0")" && pwd)/base-images.lock"
+MODE="${1:-}"
+
+mirror_name() {  # python:3.12-slim -> python-3.12-slim (one repo per image)
+  echo "${1//[:\/]/-}"
+}
+
+tmp="$LOCK.new.$$"
+: > "$tmp"
+while IFS= read -r line; do
+  case "$line" in
+    ''|'#'*) printf '%s\n' "$line" >> "$tmp"; continue ;;
+  esac
+  # shellcheck disable=SC2086
+  set -- $line
+  name=$1
+  digest=${2:-}
+  tgt="$REPO/$(mirror_name "$name")"
+  if [ -n "$digest" ] && [ "$MODE" != "--refresh" ]; then
+    printf '%s %s\n' "$name" "$digest" >> "$tmp"
+    echo "already pinned: $name ($digest) — --refresh to re-resolve"
+    continue
+  fi
+  docker pull "$name"
+  docker tag "$name" "$tgt:pinned"
+  docker push "$tgt:pinned"
+  digest=$(docker inspect \
+    --format='{{range .RepoDigests}}{{println .}}{{end}}' "$tgt:pinned" \
+    | awk -F@ -v repo="$tgt" '$1 == repo {print $2; exit}')
+  if [ -z "$digest" ]; then
+    echo "could not resolve the mirror's digest for $name" >&2
+    rm -f "$tmp"
+    exit 1
+  fi
+  printf '%s %s\n' "$name" "$digest" >> "$tmp"
+  echo "mirrored $name -> $tgt@$digest"
+done < "$LOCK"
+mv "$tmp" "$LOCK"
