@@ -347,17 +347,30 @@ def inner_main() -> None:
         # over the tunnel — only a host transfer proves execution). A
         # silent JAX CPU fallback must read as DOWN, not alive — a probe
         # that passes on CPU lets the watcher bank cpu-tiny numbers as
-        # on-chip measurements (ADVICE r3 medium).
+        # on-chip measurements (ADVICE r3 medium). Stage markers go to
+        # stderr UNBUFFERED so a timed-out probe still tells the parent
+        # WHERE the tunnel wedged (r3 postmortems only had "timed out").
         import numpy as np
 
-        if jax.devices()[0].platform == "cpu":
+        def stage(msg):
+            print(f"probe-stage: {msg}", file=sys.stderr, flush=True)
+
+        stage("backend init (jax.devices)")
+        devs = jax.devices()
+        stage(f"backend up: {devs[0].platform} x{len(devs)} "
+              f"[{getattr(devs[0], 'device_kind', '?')}]")
+        if devs[0].platform == "cpu":
             print("probe refused: backend fell back to cpu", file=sys.stderr)
             sys.exit(3)
+        stage("compile+enqueue 128x128 bf16 matmul")
         x = jnp.ones((128, 128), jnp.bfloat16)
-        np.asarray(x @ x)
+        y = x @ x
+        stage("device->host transfer")
+        np.asarray(y)
+        stage("round-trip complete")
         print(json.dumps({"metric": "probe", "value": 1.0, "unit": "ok",
                           "vs_baseline": 1.0,
-                          "platform": jax.devices()[0].platform}))
+                          "platform": devs[0].platform}))
         return
     tiny = jax.devices()[0].platform == "cpu"
     if not tiny:
@@ -407,8 +420,13 @@ def _run_child(which: str, cpu: bool, timeout: float,
         r = subprocess.run(args, capture_output=True, text=True,
                            timeout=timeout,
                            env={**os.environ, **(env or {})})
-    except subprocess.TimeoutExpired:
-        return None, f"attempt timed out after {timeout:.0f}s"
+    except subprocess.TimeoutExpired as te:
+        # surface the child's partial stderr: the probe/warm stage markers
+        # say exactly WHERE the tunnel wedged (r3's postmortem had only
+        # "timed out" to go on)
+        tail = _stderr_tail(te.stderr, te.output)
+        suffix = f"; last output: {tail}" if tail else ""
+        return None, f"attempt timed out after {timeout:.0f}s{suffix}"
     for line in reversed(r.stdout.strip().splitlines()):
         try:
             obj = json.loads(line)
@@ -416,8 +434,24 @@ def _run_child(which: str, cpu: bool, timeout: float,
             continue
         if isinstance(obj, dict) and "metric" in obj:
             return obj, ""
-    tail = (r.stderr or r.stdout or "").strip().splitlines()
-    return None, " | ".join(tail[-4:])[-500:] or f"rc={r.returncode}, no output"
+    tail = _stderr_tail(r.stderr, r.stdout, lines=4, chars=500)
+    return None, tail or f"rc={r.returncode}, no output"
+
+
+def _stderr_tail(*chunks, lines: int = 3, chars: int = 300) -> str:
+    """Last few non-WARNING lines of the first non-empty chunk — ONE
+    summarizer for both the timeout and the failed-exit paths."""
+    for chunk in chunks:
+        if not chunk:
+            continue
+        if isinstance(chunk, bytes):
+            chunk = chunk.decode(errors="replace")
+        keep = [ln for ln in chunk.strip().splitlines()
+                if "WARNING" not in ln]
+        tail = " | ".join(keep[-lines:])[-chars:]
+        if tail:
+            return tail
+    return ""
 
 
 def _banked_result() -> dict | None:
