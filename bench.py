@@ -40,6 +40,9 @@ if INNER:
 
 # inf2.xlarge SD2.1 breaking point: 0.67 s/img p50 (reference README.md:261)
 SD_BASELINE_IMG_S = 1.0 / 0.67
+#: one unit mapping for the measurement AND crash paths
+UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
+                  "sd": "images/sec", "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
 # (reference README.md:192). The north star is throughput per DOLLAR, so
 # every bench line carries the cost basis it was computed with.
@@ -341,6 +344,56 @@ def bench_flux(tiny: bool) -> dict:
     })
 
 
+def bench_t5(tiny: bool) -> dict:
+    """T5 embedding throughput on ONE chip (the cova chain's embed stage,
+    reference ``t5_model_api.py`` / ``cova/README.md:98``): batched encode +
+    mean-pool, sequences/sec. Self-baselined like llama/flux."""
+    from scalable_hw_agnostic_inference_tpu.core.aot import (
+        host_init,
+        to_default_device,
+    )
+    from scalable_hw_agnostic_inference_tpu.models import t5 as t5_mod
+    from scalable_hw_agnostic_inference_tpu.models.convert import cast_f32_to_bf16
+
+    if tiny:
+        cfg, batch, seq = t5_mod.T5Config.tiny(), 4, 16
+        name = "t5-tiny"
+    else:
+        cfg, batch, seq = t5_mod.T5Config.t5_v1_1_large(), 32, 128
+        name = "t5-v1.1-large-geometry"
+
+    model = t5_mod.T5Encoder(cfg, dtype=jnp.bfloat16)
+    params = host_init(
+        model.init, lambda: jax.random.PRNGKey(0),
+        lambda: jnp.zeros((1, 8), jnp.int32),
+        lambda: jnp.ones((1, 8), jnp.int32))
+    params = to_default_device(cast_f32_to_bf16(params))
+
+    @jax.jit
+    def embed(p, ids, mask):
+        return t5_mod.mean_pool(model.apply(p, ids, mask), mask)
+
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (batch, seq), 3, cfg.vocab_size, jnp.int32)
+    mask = jnp.ones((batch, seq), jnp.int32)
+    embed(params, ids, mask).block_until_ready()   # warm
+    runs = 5
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = embed(params, ids, mask)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / runs
+    val = round(batch / dt, 2)
+    base = _published("t5_embed_seq_s")
+    return _dollars({
+        "metric": f"{name} embed seq/s (bs={batch}, len={seq}, "
+                  f"{jax.devices()[0].platform})",
+        "value": val,
+        "unit": "sequences/sec",
+        "vs_baseline": round(val / base, 3) if base else 1.0,
+    })
+
+
 def inner_main() -> None:
     if "--probe" in sys.argv:
         # liveness: a real device round-trip (completion signals can lie
@@ -355,6 +408,7 @@ def inner_main() -> None:
         def stage(msg):
             print(f"probe-stage: {msg}", file=sys.stderr, flush=True)
 
+        _clear_stale_locks()   # the watcher probes without the parent harness
         stage("backend init (jax.devices)")
         devs = jax.devices()
         stage(f"backend up: {devs[0].platform} x{len(devs)} "
@@ -384,6 +438,8 @@ def inner_main() -> None:
         out = bench_llama(tiny)
     elif "flux" in sys.argv:
         out = bench_flux(tiny)
+    elif "t5" in sys.argv:
+        out = bench_t5(tiny)
     else:
         out = bench_sd(tiny)
     # structured platform provenance: is_real() keys off this, never off
@@ -411,7 +467,7 @@ def _run_child(which: str, cpu: bool, timeout: float,
                env: dict | None = None) -> tuple[dict | None, str]:
     """Run one measurement attempt in a child; return (result, error_tail)."""
     args = [sys.executable, os.path.abspath(__file__), "--inner", which]
-    for tok in ("llama3b", "int8", "flux"):
+    for tok in ("llama3b", "int8", "flux", "t5"):
         if tok in sys.argv and tok not in args:
             args.append(tok)
     if cpu:
@@ -462,6 +518,8 @@ def _banked_result() -> dict | None:
             key += "_int8"
     elif "flux" in sys.argv:
         key = "flux"
+    elif "t5" in sys.argv:
+        key = "t5"
     else:
         key = "sd"
     root = os.path.dirname(os.path.abspath(__file__))
@@ -484,9 +542,11 @@ def main() -> None:
         which = "llama"
     elif "flux" in sys.argv:
         which = "flux"
+    elif "t5" in sys.argv:
+        which = "t5"
     else:
         which = "sd"
-    unit = "tokens/sec" if which == "llama" else "images/sec"
+    unit = UNITS_BY_BENCH.get(which, "images/sec")
     force_cpu = "--cpu" in sys.argv
 
     last_err = ""
@@ -574,9 +634,11 @@ if __name__ == "__main__":
             print(json.dumps({
                 "metric": "bench harness crashed",
                 "value": 0.0,
-                "unit": ("tokens/sec"
-                         if any(a.startswith("llama") for a in sys.argv)
-                         else "images/sec"),
+                "unit": UNITS_BY_BENCH.get(
+                    "llama" if any(a.startswith("llama") for a in sys.argv)
+                    else ("t5" if "t5" in sys.argv else
+                          ("flux" if "flux" in sys.argv else "sd")),
+                    "images/sec"),
                 "vs_baseline": 0.0,
                 "error": f"{type(e).__name__}: {e}"[:700],
             }))

@@ -25,7 +25,7 @@ have() {  # have <key>: does RES already hold a real on-device result?
 note "watcher start (deadline in $(( (DEADLINE - $(date +%s)) / 60 )) min)"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   missing=""
-  for w in sd flux llama llama3b llama_int8 llama3b_int8; do
+  for w in sd flux t5 llama llama3b llama_int8 llama3b_int8; do
     have "$w" || missing="$missing $w"
   done
   if [ -z "$missing" ]; then
@@ -47,9 +47,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     break
   fi
 
-  probe=$(timeout 200 python bench.py --inner --probe 2>/dev/null | tail -1)
+  probe=$(timeout 200 python bench.py --inner --probe 2>scripts/.probe_err | tail -1)
   if ! echo "$probe" | grep -q '"probe"'; then
-    note "tunnel down (missing:$missing) — sleeping 300s"
+    why=$(grep -v WARNING scripts/.probe_err 2>/dev/null | tail -1)
+    note "tunnel down [${why:-no output}] (missing:$missing) — sleeping 300s"
     sleep 300
     continue
   fi

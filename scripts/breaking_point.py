@@ -52,11 +52,16 @@ SPAWN_REQUESTS = {
 
 
 def ensure_loadgen() -> str:
-    if not os.path.exists(LOADGEN):
-        if shutil.which("g++") is None:
-            raise SystemExit("native/loadgen missing and no g++ to build it")
-        subprocess.run(["make", "-C", os.path.join(ROOT, "native")],
-                       check=True, capture_output=True)
+    if os.path.exists(LOADGEN):
+        return LOADGEN
+    on_path = shutil.which("loadgen")   # the assets image installs it there
+    if on_path:
+        return on_path
+    if shutil.which("g++") is None:
+        raise SystemExit("no loadgen binary (native/loadgen or PATH) and "
+                         "no g++ to build it")
+    subprocess.run(["make", "-C", os.path.join(ROOT, "native")],
+                   check=True, capture_output=True)
     return LOADGEN
 
 
