@@ -42,6 +42,7 @@ if INNER:
 SD_BASELINE_IMG_S = 1.0 / 0.67
 #: one unit mapping for the measurement AND crash paths
 UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
+                  "mllama": "tokens/sec",
                   "sd": "images/sec", "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
 # (reference README.md:192). The north star is throughput per DOLLAR, so
@@ -51,6 +52,20 @@ INF2_COST_HR = 0.7582
 
 
 _ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+
+def _which_from_argv(argv) -> str:
+    """THE argv->bench-key dispatch — one definition for the inner runner,
+    the child arg forwarding, the banked-result lookup, main(), and the
+    crash handler (five call sites that previously each hand-rolled it and
+    drifted)."""
+    if any(a.startswith("llama") for a in argv):
+        return "llama"
+    for k in ("flux", "t5", "mllama"):
+        if k in argv:
+            return k
+    return "sd"
 
 
 def _published(key: str):
@@ -394,6 +409,80 @@ def bench_t5(tiny: bool) -> dict:
     })
 
 
+def bench_mllama(tiny: bool) -> dict:
+    """Mllama (Llama-3.2-Vision) CAPTION-path decode on ONE chip: the paged
+    engine with gated cross-attention layers attending a full vision-state
+    buffer (4 tiles), int8 weights — the cova caption stage's compute
+    (reference ``vllm_model_api_m.py`` / ``cova/README.md:98``). 11B text
+    geometry born-int8 device-side (models.llama.geometry_params), so it
+    fits the chip at every instant; the HBM budget gate validates on boot.
+    Self-baselined; end-to-end tok/s for prompt 128 -> 64 new, bs=1.
+    """
+    import numpy as np
+
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+    from scalable_hw_agnostic_inference_tpu.models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig(
+            vocab_size=512, dim=64, n_layers=4, n_heads=4, n_kv_heads=2,
+            mlp_dim=128, max_seq_len=256, rope_theta=10000.0,
+            tie_embeddings=True, cross_attention_layers=(1, 3))
+        Lv, prompt_len, new = 34, 16, 8
+        ecfg = EngineConfig(max_model_len=64, max_num_seqs=1, block_size=8,
+                            context_encoding_buckets=(16,),
+                            max_new_tokens=16)
+        quant = False
+        name = "mllama-tiny"
+    else:
+        cfg = llama_mod.LlamaConfig.mllama_11b_text()
+        Lv = 4 * (1 + (560 // 14) ** 2)        # 4 tiles x (patches+1)
+        prompt_len, new = 128, 64
+        ecfg = EngineConfig(
+            model="meta-llama/Llama-3.2-11B-Vision-Instruct-geometry",
+            max_model_len=1024, max_num_seqs=1, block_size=128,
+            context_encoding_buckets=(128,), quantization="int8",
+            max_new_tokens=128)
+        quant = True
+        name = "mllama-11b-int8-geometry"
+
+    params = llama_mod.geometry_params(cfg, quant=quant)
+    eng = LLMEngine(cfg, params, ecfg, cross_seq_len=Lv)
+    states = np.zeros((Lv, cfg.dim), np.float32)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(3, cfg.vocab_size, prompt_len).tolist()
+
+    def run(n_new):
+        eng.add_request(prompt,
+                        SamplingParams(temperature=0.0, max_new_tokens=n_new),
+                        cross_states=states, cross_len=Lv)
+        fins = []
+        while eng.has_work:
+            fins += eng.step()
+        assert len(fins) == 1 and len(fins[0].token_ids) == n_new
+        return fins
+
+    run(2)   # warm: prefill + decode executables + cross projection
+    runs = 3
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        run(new)
+    dt = (time.perf_counter() - t0) / runs
+    val = round(new / dt, 2)
+    base = _published("mllama_caption_tok_s")
+    return _dollars({
+        "metric": f"{name} caption tok/s (prompt {prompt_len}, Lv={Lv}, "
+                  f"bs=1, {jax.devices()[0].platform})",
+        "value": val,
+        "unit": "tokens/sec",
+        "vs_baseline": round(val / base, 3) if base else 1.0,
+    })
+
+
 def inner_main() -> None:
     if "--probe" in sys.argv:
         # liveness: a real device round-trip (completion signals can lie
@@ -434,14 +523,9 @@ def inner_main() -> None:
         )
 
         enable_persistent_cache_from_env()
-    if any(a.startswith("llama") for a in sys.argv):
-        out = bench_llama(tiny)
-    elif "flux" in sys.argv:
-        out = bench_flux(tiny)
-    elif "t5" in sys.argv:
-        out = bench_t5(tiny)
-    else:
-        out = bench_sd(tiny)
+    out = {"llama": bench_llama, "flux": bench_flux, "t5": bench_t5,
+           "mllama": bench_mllama, "sd": bench_sd}[
+        _which_from_argv(sys.argv)](tiny)
     # structured platform provenance: is_real() keys off this, never off
     # metric-string formatting (ADVICE r3 medium)
     out["platform"] = jax.devices()[0].platform
@@ -467,7 +551,7 @@ def _run_child(which: str, cpu: bool, timeout: float,
                env: dict | None = None) -> tuple[dict | None, str]:
     """Run one measurement attempt in a child; return (result, error_tail)."""
     args = [sys.executable, os.path.abspath(__file__), "--inner", which]
-    for tok in ("llama3b", "int8", "flux", "t5"):
+    for tok in ("llama3b", "int8", "flux", "t5", "mllama"):
         if tok in sys.argv and tok not in args:
             args.append(tok)
     if cpu:
@@ -512,16 +596,11 @@ def _stderr_tail(*chunks, lines: int = 3, chars: int = 300) -> str:
 
 def _banked_result() -> dict | None:
     """On-chip result banked by the watcher for THIS bench variant, if any."""
-    if any(a.startswith("llama") for a in sys.argv):
+    key = _which_from_argv(sys.argv)
+    if key == "llama":
         key = "llama3b" if "llama3b" in sys.argv else "llama"
         if "int8" in sys.argv:
             key += "_int8"
-    elif "flux" in sys.argv:
-        key = "flux"
-    elif "t5" in sys.argv:
-        key = "t5"
-    else:
-        key = "sd"
     root = os.path.dirname(os.path.abspath(__file__))
     try:
         with open(os.path.join(root, "scripts", "bench_results.json")) as f:
@@ -538,14 +617,7 @@ def _banked_result() -> dict | None:
 
 
 def main() -> None:
-    if any(a.startswith("llama") for a in sys.argv):
-        which = "llama"
-    elif "flux" in sys.argv:
-        which = "flux"
-    elif "t5" in sys.argv:
-        which = "t5"
-    else:
-        which = "sd"
+    which = _which_from_argv(sys.argv)
     unit = UNITS_BY_BENCH.get(which, "images/sec")
     force_cpu = "--cpu" in sys.argv
 
@@ -634,11 +706,8 @@ if __name__ == "__main__":
             print(json.dumps({
                 "metric": "bench harness crashed",
                 "value": 0.0,
-                "unit": UNITS_BY_BENCH.get(
-                    "llama" if any(a.startswith("llama") for a in sys.argv)
-                    else ("t5" if "t5" in sys.argv else
-                          ("flux" if "flux" in sys.argv else "sd")),
-                    "images/sec"),
+                "unit": UNITS_BY_BENCH.get(_which_from_argv(sys.argv),
+                                            "images/sec"),
                 "vs_baseline": 0.0,
                 "error": f"{type(e).__name__}: {e}"[:700],
             }))

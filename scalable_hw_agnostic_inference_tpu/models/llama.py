@@ -403,3 +403,56 @@ def params_from_torch(model_or_sd, cfg: LlamaConfig) -> Dict[str, Any]:
     if not cfg.tie_embeddings:
         tree["lm_head"] = convert.linear(sd, "lm_head")
     return {"params": tree}
+
+
+def geometry_params(cfg: LlamaConfig, dtype=jnp.bfloat16,
+                    quant: bool = False) -> Dict[str, Any]:
+    """Shape-exact zero-weight param tree for GEOMETRY benches.
+
+    Mirrors :func:`params_from_torch`'s tree (incl. mllama cross layers),
+    but materializes device-side zeros — no host copy of N billion floats,
+    and with ``quant`` the kernels are BORN int8 (+unit scales), so an 11B
+    geometry stays under one chip's HBM at every instant. Decode cost is
+    weight-value-independent, so throughput numbers are real; outputs are
+    (deterministically) meaningless.
+    """
+    D, HD = cfg.dim, cfg.head_dim
+    q_out, kv_out = cfg.n_heads * HD, cfg.n_kv_heads * HD
+
+    def lin(i, o):
+        if quant:
+            return {"kernel_q": jnp.zeros((i, o), jnp.int8),
+                    "scale": jnp.ones((o,), jnp.float32)}
+        return {"kernel": jnp.zeros((i, o), dtype)}
+
+    def norm(n=D):
+        return {"scale": jnp.ones((n,), dtype)}
+
+    tree: Dict[str, Any] = {
+        "embed": {"embedding": jnp.zeros((cfg.vocab_size, D), dtype)},
+        "final_norm": norm(),
+    }
+    for i in range(cfg.n_layers):
+        layer: Dict[str, Any] = {
+            "mlp": {"gate": lin(D, cfg.mlp_dim), "up": lin(D, cfg.mlp_dim),
+                    "down": lin(cfg.mlp_dim, D)},
+            "attn_norm": norm(),
+            "mlp_norm": norm(),
+        }
+        if i in cfg.cross_attention_layers:
+            layer["cross_attn"] = {
+                "q": lin(D, q_out), "k": lin(D, kv_out), "v": lin(D, kv_out),
+                "o": lin(q_out, D),
+                "q_norm": norm(HD), "k_norm": norm(HD),
+            }
+            layer["gate_attn"] = jnp.zeros((1,), dtype)
+            layer["gate_mlp"] = jnp.zeros((1,), dtype)
+        else:
+            layer["attn"] = {
+                "q": lin(D, q_out), "k": lin(D, kv_out), "v": lin(D, kv_out),
+                "o": lin(q_out, D),
+            }
+        tree[f"layer_{i}"] = layer
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = lin(D, cfg.vocab_size)
+    return {"params": tree}

@@ -25,7 +25,7 @@ have() {  # have <key>: does RES already hold a real on-device result?
 note "watcher start (deadline in $(( (DEADLINE - $(date +%s)) / 60 )) min)"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   missing=""
-  for w in sd flux t5 llama llama3b llama_int8 llama3b_int8; do
+  for w in sd flux t5 mllama llama llama3b llama_int8 llama3b_int8; do
     have "$w" || missing="$missing $w"
   done
   if [ -z "$missing" ]; then

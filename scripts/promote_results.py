@@ -19,6 +19,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 KEYS = {"sd": "sd21_img_s",
         "flux": "flux_scaled_img_s",
         "t5": "t5_embed_seq_s",
+        "mllama": "mllama_caption_tok_s",
         "llama": "llama1b_decode_tok_s", "llama3b": "llama3b_decode_tok_s",
         "llama_int8": "llama1b_int8_decode_tok_s",
         "llama3b_int8": "llama3b_int8_decode_tok_s"}
