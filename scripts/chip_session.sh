@@ -30,6 +30,15 @@ timeout 2700 python bench.py llama 2>&1 | tail -1 | tee -a "$LOG"
 note "bench llama (3B geometry)"
 timeout 2700 python bench.py llama3b 2>&1 | tail -1 | tee -a "$LOG"
 
+note "bench flux (scaled schnell geometry)"
+timeout 2700 python bench.py flux 2>&1 | tail -1 | tee -a "$LOG"
+
+note "bench t5 (v1.1-large embed)"
+timeout 2700 python bench.py t5 2>&1 | tail -1 | tee -a "$LOG"
+
+note "bench mllama (11B int8 caption path)"
+timeout 2700 python bench.py mllama 2>&1 | tail -1 | tee -a "$LOG"
+
 note "paged vs dense decode attention"
 PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 2400 python scripts/perf_paged.py 2>&1 | grep -v WARNING | tee -a "$LOG"
 

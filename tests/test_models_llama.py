@@ -217,3 +217,77 @@ def test_llama_in_registry():
 
     models = list_models()
     assert {"llama", "mistral", "deepseek"} <= set(models)
+
+
+def test_geometry_params_mirror_converter_tree():
+    """geometry_params (the device-side zero-weight bench tree) must stay
+    structurally identical to params_from_torch's output — the engine
+    consumes both interchangeably, so drift would break geometry benches
+    silently. Checked for a cross-attention (mllama) config via a synthetic
+    HF state dict."""
+    import numpy as np
+
+    import jax
+
+    cfg = llama.LlamaConfig(
+        vocab_size=64, dim=16, n_layers=3, n_heads=4, n_kv_heads=2,
+        mlp_dim=32, max_seq_len=32, rope_theta=10000.0,
+        tie_embeddings=False, cross_attention_layers=(1,))
+    D, HD = cfg.dim, cfg.head_dim
+    q_out, kv_out = cfg.n_heads * HD, cfg.n_kv_heads * HD
+
+    class T:  # minimal torch-tensor stand-in for convert.t2j
+        def __init__(self, a):
+            self._a = np.asarray(a, np.float32)
+
+        def detach(self):
+            return self
+
+        def cpu(self):
+            return self
+
+        def float(self):
+            return self
+
+        def numpy(self):
+            return self._a
+
+        @property
+        def T(self):
+            return T(self._a.T)
+
+    sd = {"model.embed_tokens.weight": T(np.zeros((cfg.vocab_size, D))),
+          "model.norm.weight": T(np.ones(D)),
+          "lm_head.weight": T(np.zeros((cfg.vocab_size, D)))}
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}"
+        sd[f"{p}.input_layernorm.weight"] = T(np.ones(D))
+        sd[f"{p}.post_attention_layernorm.weight"] = T(np.ones(D))
+        for n, o in (("gate_proj", cfg.mlp_dim), ("up_proj", cfg.mlp_dim)):
+            sd[f"{p}.mlp.{n}.weight"] = T(np.zeros((o, D)))
+        sd[f"{p}.mlp.down_proj.weight"] = T(np.zeros((D, cfg.mlp_dim)))
+        attn = "cross_attn" if i in cfg.cross_attention_layers else "self_attn"
+        sd[f"{p}.{attn}.q_proj.weight"] = T(np.zeros((q_out, D)))
+        sd[f"{p}.{attn}.k_proj.weight"] = T(np.zeros((kv_out, D)))
+        sd[f"{p}.{attn}.v_proj.weight"] = T(np.zeros((kv_out, D)))
+        sd[f"{p}.{attn}.o_proj.weight"] = T(np.zeros((D, q_out)))
+        if attn == "cross_attn":
+            sd[f"{p}.cross_attn.q_norm.weight"] = T(np.ones(HD))
+            sd[f"{p}.cross_attn.k_norm.weight"] = T(np.ones(HD))
+            sd[f"{p}.cross_attn_attn_gate"] = T(np.zeros(1))
+            sd[f"{p}.cross_attn_mlp_gate"] = T(np.zeros(1))
+
+    converted = llama.params_from_torch(sd, cfg)
+    geometry = llama.geometry_params(cfg)
+
+    def shape_tree(t):
+        return jax.tree_util.tree_map(lambda a: tuple(a.shape), t)
+
+    assert shape_tree(converted) == shape_tree(geometry)
+    # quantized variant keeps the same structure modulo the QuantDense
+    # kernel_q/scale expansion the engine's _proj understands
+    q = llama.geometry_params(cfg, quant=True)
+    flat = {"/".join(str(getattr(k, "key", k)) for k in path)
+            for path, _ in jax.tree_util.tree_flatten_with_path(q)[0]}
+    assert any(p.endswith("attn/q/kernel_q") for p in flat)
+    assert any(p.endswith("attn/q/scale") for p in flat)
