@@ -56,6 +56,10 @@ class SDService(ModelService):
         self._pending: list = []   # (key, item, Future)
         self._tok_lock = threading.Lock()  # HF tokenizers aren't thread-safe
         self._coalesce_window_s = 0.02     # ~2% of a 1 s denoise
+        # coalescing observability: /stats + Prometheus gauges (scaling and
+        # breaking-point analysis read batch occupancy, not just RPS)
+        self._n_batches = 0
+        self._n_coalesced = 0
 
     def load(self) -> None:
         from ...models import clip, sd
@@ -289,6 +293,24 @@ class SDService(ModelService):
                         e[2].set_exception(exc)
         return fut.result(timeout=1800)
 
+    def extra_stats(self) -> Dict[str, float]:
+        if self._batch_max == 1:
+            return {}
+        with self._pend_lock:
+            waiting = len(self._pending)
+            # same lock as _run_batch's increments: no torn (n_b, n_r) pair
+            n_b, n_r = self._n_batches, self._n_coalesced
+        return {
+            "coalesce_batch_max": float(self._batch_max),
+            "coalesce_waiting": float(waiting),
+            "coalesced_batches": float(n_b),
+            "coalesced_requests": float(n_r),
+            # mean requests per denoise call: the utilization the weighted
+            # KEDA target assumes; near 1.0 under load means the window is
+            # too short or traffic too serialized to batch
+            "coalesce_occupancy": round(n_r / n_b, 3) if n_b else 0.0,
+        }
+
     def _run_batch(self, items, steps: int, guidance: float) -> np.ndarray:
         f = self.pipe.vae_scale
         h, w = self.height // f, self.width // f
@@ -304,6 +326,9 @@ class SDService(ModelService):
         imgs = self.pipe.txt2img_batch(
             ids, unc, lat, height=self.height, width=self.width,
             steps=steps, guidance_scale=guidance)
+        with self._pend_lock:
+            self._n_batches += 1
+            self._n_coalesced += n
         if n > 1:
             log.info("sd coalesced %d requests into one batch-%d denoise",
                      n, b)

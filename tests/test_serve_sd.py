@@ -67,6 +67,10 @@ async def test_sd_request_coalescing_serves_concurrent_requests():
         for o in outs:
             assert o.status_code == 200
             assert base64.b64decode(o.json()["image_b64"])[:4] == b"\x89PNG"
+        stats = (await c.get("/stats")).json()["service"]
+        assert stats["coalesce_batch_max"] == 4.0
+        assert stats["coalesced_requests"] >= 4   # warmup calls don't count
+        assert stats["coalesce_occupancy"] >= 1.0
 
 
 def test_sd_coalescer_follower_membership_is_identity_based():
