@@ -60,6 +60,11 @@ class SDService(ModelService):
         # breaking-point analysis read batch occupancy, not just RPS)
         self._n_batches = 0
         self._n_coalesced = 0
+        from collections import deque
+
+        # recent batch sizes: the CURRENT-utilization signal (a lifetime
+        # mean converges and stops responding to overload)
+        self._recent_batches: deque = deque(maxlen=32)
 
     def load(self) -> None:
         from ...models import clip, sd
@@ -300,15 +305,20 @@ class SDService(ModelService):
             waiting = len(self._pending)
             # same lock as _run_batch's increments: no torn (n_b, n_r) pair
             n_b, n_r = self._n_batches, self._n_coalesced
+            recent = list(self._recent_batches)
         return {
             "coalesce_batch_max": float(self._batch_max),
             "coalesce_waiting": float(waiting),
+            # since-boot totals (for rate math off scraped deltas)
             "coalesced_batches": float(n_b),
             "coalesced_requests": float(n_r),
-            # mean requests per denoise call: the utilization the weighted
-            # KEDA target assumes; near 1.0 under load means the window is
-            # too short or traffic too serialized to batch
-            "coalesce_occupancy": round(n_r / n_b, 3) if n_b else 0.0,
+            "coalesce_occupancy_lifetime": round(n_r / n_b, 3) if n_b else 0.0,
+            # mean requests per denoise over the last 32 batches: the
+            # CURRENT utilization the weighted KEDA target assumes; near
+            # 1.0 under load means the window is too short or traffic too
+            # serialized to batch
+            "coalesce_occupancy": (round(sum(recent) / len(recent), 3)
+                                   if recent else 0.0),
         }
 
     def _run_batch(self, items, steps: int, guidance: float) -> np.ndarray:
@@ -329,6 +339,7 @@ class SDService(ModelService):
         with self._pend_lock:
             self._n_batches += 1
             self._n_coalesced += n
+            self._recent_batches.append(n)
         if n > 1:
             log.info("sd coalesced %d requests into one batch-%d denoise",
                      n, b)

@@ -136,6 +136,15 @@ def test_declared_production_geometries_fit():
     g.dryrun_production_geometries()
 
 
+def test_mllama_tp8_prefill_lowers_at_full_shape():
+    """The caption unit's sharded prefill partitions legally at FULL
+    production shape (11B params abstract, TP=8, 1024-token bucket) — the
+    SPMD-level leg beyond byte-math budgets."""
+    import __graft_entry__ as g
+
+    g.dryrun_lower_mllama_tp8(jax.devices()[:8])
+
+
 def test_engine_enforces_budget_when_opted_in(monkeypatch):
     monkeypatch.setenv("SHAI_ENFORCE_HBM", "1")
     from scalable_hw_agnostic_inference_tpu.engine.engine import LLMEngine
