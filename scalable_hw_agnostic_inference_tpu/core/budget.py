@@ -109,23 +109,43 @@ class HbmBudget:
         return self
 
 
-def _dtype_bytes(dtype: str, quantization: Optional[str]) -> float:
-    if quantization == "int8":
-        # 1 byte/elem kernels + per-out-channel fp32 scales (~0.1-2% of the
-        # kernel for the geometries served here); 2% covers every config
-        return 1.02
+def _dtype_bytes(dtype: str) -> float:
     return jnp.dtype(jnp.bfloat16 if dtype == "bfloat16" else dtype).itemsize
 
 
+def _leaf_bytes_fn(dtype: str, quantization: Optional[str], shapes):
+    """Per-leaf bytes/elem over an ``eval_shape`` tree: int8 quantization
+    converts ONLY the leaves ``ops.quant.quantize_params_tree`` converts
+    (shared predicate via ``quantized_kernel_paths`` — attn/mlp/lm_head
+    2-D kernels); embeddings, norms, and gates stay at the serving dtype.
+    A uniform 1.02 bytes/elem under-counted the 11B mllama embed by
+    ~0.5 GiB at tp=1, which could wave an over-budget config past the
+    boot gate."""
+    full = _dtype_bytes(dtype)
+    if quantization != "int8":
+        return lambda name, leaf: full
+    from ..ops.quant import quantized_kernel_paths
+
+    qpaths = quantized_kernel_paths(shapes)
+    # 1 byte/elem int8 kernel + per-out-channel fp32 scale (~0.1-2% of
+    # the kernel for the geometries served here)
+    return lambda name, leaf: 1.02 if name in qpaths else full
+
+
 def params_bytes_per_chip(shapes, rules, axis_sizes: dict,
-                          bytes_per_elem: float) -> float:
+                          bytes_per_elem) -> float:
     """Per-chip parameter bytes from an ``eval_shape`` tree + TP rules.
+
+    ``bytes_per_elem`` is a float, or a callable ``(name, leaf) -> float``
+    for mixed-precision trees (int8 kernels + full-precision embeds/norms).
 
     Also the sharding LEGALITY check: a rule that splits a dim an axis does
     not divide raises here — the same condition that would fail at
     ``device_put`` time on real chips.
     """
     flat = jax.tree_util.tree_flatten_with_path(shapes)[0]
+    per_leaf = (bytes_per_elem if callable(bytes_per_elem)
+                else lambda name, leaf: bytes_per_elem)
     total = 0.0
     for path, leaf in flat:
         name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
@@ -145,7 +165,7 @@ def params_bytes_per_chip(shapes, rules, axis_sizes: dict,
         n_elems = 1
         for d in leaf.shape:
             n_elems *= d
-        total += n_elems * bytes_per_elem / div
+        total += n_elems * per_leaf(name, leaf) / div
     return total
 
 
@@ -210,7 +230,6 @@ def causal_lm_budget(cfg, ecfg, *, hbm_gib_per_chip: float = HBM_GIB["v5e"],
     from ..models.llama import LlamaForCausalLM, tp_rules
 
     tp = max(int(ecfg.tensor_parallel_size), 1)
-    bpe = _dtype_bytes(ecfg.dtype, ecfg.quantization)
 
     # cross-attention (mllama) trees come from the checkpoint converter, not
     # flax init — count bytes via a plain clone: a gated cross layer's
@@ -220,6 +239,7 @@ def causal_lm_budget(cfg, ecfg, *, hbm_gib_per_chip: float = HBM_GIB["v5e"],
     model = LlamaForCausalLM(plain, dtype=jnp.float32)
     shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0),
                             jnp.zeros((1, 8), jnp.int32))
+    bpe = _leaf_bytes_fn(ecfg.dtype, ecfg.quantization, shapes)
     p_bytes = params_bytes_per_chip(shapes, tp_rules("tp"), {"tp": tp}, bpe)
 
     # paged KV pool (engine.runner allocation): self-attn layers only —

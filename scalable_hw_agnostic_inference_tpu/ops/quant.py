@@ -41,20 +41,47 @@ def dequantize_weight(q: jax.Array, scale: jax.Array, dtype=jnp.float32):
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
+def _is_quant_node(node: Dict[str, Any], path: str) -> bool:
+    """THE conversion predicate: a dict holding a single 2-D ``kernel``
+    under a matmul parent path. Shared by the quantizer itself and by
+    :func:`quantized_kernel_paths` (HBM budget math) so the two can never
+    disagree about which leaves shrink to int8."""
+    return bool(set(node) == {"kernel"} and _QUANT_PARENT.search(path)
+                and getattr(node["kernel"], "ndim", 0) == 2)
+
+
 def quantize_params_tree(params: Dict[str, Any]) -> Dict[str, Any]:
     """Replace every quantizable ``{"kernel": W}`` with
     ``{"kernel_q": int8, "scale": f32}`` (host-side, one pass at boot)."""
 
     def rec(node, path):
         if isinstance(node, dict):
-            if (set(node) == {"kernel"} and _QUANT_PARENT.search(path)
-                    and getattr(node["kernel"], "ndim", 0) == 2):
+            if _is_quant_node(node, path):
                 q, s = quantize_weight(node["kernel"])
                 return {"kernel_q": q, "scale": s}
             return {k: rec(v, f"{path}/{k}") for k, v in node.items()}
         return node
 
     return rec(params, "")
+
+
+def quantized_kernel_paths(params: Dict[str, Any]) -> set:
+    """'/'-joined leaf paths (no leading slash) that
+    :func:`quantize_params_tree` would convert. Works on real arrays or an
+    ``eval_shape`` tree (``ShapeDtypeStruct`` has ``ndim``) — the budget
+    validator prices exactly these leaves at int8 width."""
+    out: set = set()
+
+    def rec(node, path):
+        if isinstance(node, dict):
+            if _is_quant_node(node, path):
+                out.add(f"{path}/kernel".lstrip("/"))
+                return
+            for k, v in node.items():
+                rec(v, f"{path}/{k}")
+
+    rec(params, "")
+    return out
 
 
 def quant_matmul(x: jax.Array, p: Dict[str, jax.Array]) -> jax.Array:

@@ -152,67 +152,106 @@ class SDService(ModelService):
 
             cache = AotCache(aot_dir)
             by_name = {m["name"]: k for k, m in cache.keys().items()}
-            f = self.pipe.vae_scale
+            # install artifacts under the keys serving TRAFFIC actually
+            # hits: the latents-as-argument ('batch', b, ...) executables in
+            # coalescing mode, the in-graph single path otherwise — a
+            # single-path artifact on a coalescing unit would load but
+            # never serve a request (dead weight masquerading as coverage)
             for steps in sorted(self.steps_allowed):
-                key = by_name.get(self._aot_name(steps))
-                if not key:
-                    continue
-                try:
-                    fn = cache.load(key)
-                except Exception as e:  # platform mismatch, stale artifact
-                    log.warning("AOT artifact %s unusable (%s); jit instead",
-                                key, e)
-                    continue
-                shape_key = (1, self.height // f, self.width // f, steps)
-                self.pipe._denoise_cache[shape_key] = fn
-                self.aot_loaded += 1
+                for shape_key, name in self._aot_keys(steps):
+                    key = by_name.get(name)
+                    if not key:
+                        continue
+                    try:
+                        fn = cache.load(key)
+                    except Exception as e:  # platform mismatch, stale
+                        log.warning("AOT artifact %s unusable (%s); jit "
+                                    "instead", key, e)
+                        continue
+                    self.pipe._denoise_cache[shape_key] = fn
+                    self.aot_loaded += 1
             if self.aot_loaded:
                 log.info("sd: %d pipeline executable(s) from AOT artifacts",
                          self.aot_loaded)
 
-    def _aot_name(self, steps: int) -> str:
-        return (f"sd-{self.variant.name}-{self.height}x{self.width}"
-                f"-s{steps}")
+    def _aot_keys(self, steps: int):
+        """(denoise-cache key, artifact name) pairs for one steps value —
+        the single source of truth shared by export (compile Job) and boot
+        load, so the executables exported are exactly the ones served."""
+        f = self.pipe.vae_scale
+        h, w = self.height // f, self.width // f
+        stem = f"sd-{self.variant.name}-{self.height}x{self.width}-s{steps}"
+        if self._batch_max == 1:
+            return [((1, h, w, steps), stem)]
+        pairs = []
+        b = 1
+        while b <= self._batch_max:
+            pairs.append((("batch", b, h, w, steps), f"{stem}-b{b}"))
+            b *= 2
+        return pairs
 
     def export_artifacts(self, artifact_root: str) -> int:
-        """Export the fused txt2img pipeline per compiled steps value as
-        StableHLO (``AotCache``) — wire-or-cut resolution for VERDICT r2
-        missing #7: compilectl writes these, serve boot loads them."""
+        """Export the fused txt2img pipeline executables as StableHLO
+        (``AotCache``) — wire-or-cut resolution for VERDICT r2 missing #7:
+        compilectl writes these, serve boot loads them. The exported set
+        follows :meth:`_aot_keys`, so a coalescing unit (SD_BATCH_MAX>1)
+        exports the latents-as-argument batch-bucket executables its
+        traffic actually runs, not the unused in-graph single path."""
         import os
 
         from ...core.aot import AotCache
 
         cache = AotCache(os.path.join(artifact_root, "aot"))
         f = self.pipe.vae_scale
+        h, w = self.height // f, self.width // f
         n = 0
         for steps in sorted(self.steps_allowed):
-            fn = self.pipe._denoise_for(
-                1, self.height // f, self.width // f, steps)
-            ids = jnp.zeros((2, self.seq_len), jnp.int32)
-            ctx2 = self.pipe.text_encode(ids)
-            args = (self.pipe.unet_params, self.pipe.vae_params, ctx2,
-                    jax.random.PRNGKey(0), jnp.float32(7.5))
-            cache.export(self._aot_name(steps), fn, args)
-            n += 1
+            for shape_key, name in self._aot_keys(steps):
+                if shape_key[0] == "batch":
+                    b = shape_key[1]
+                    fn = (self.pipe._denoise_cache.get(shape_key)
+                          or self.pipe._build_pipeline_from_latents(
+                              b, h, w, steps))
+                    ctx2 = self.pipe.text_encode(
+                        jnp.zeros((2 * b, self.seq_len), jnp.int32))
+                    args = (self.pipe.unet_params, self.pipe.vae_params,
+                            ctx2,
+                            jnp.zeros((b, h, w,
+                                       self.variant.unet.in_channels),
+                                      jnp.float32),
+                            jnp.float32(7.5))
+                else:
+                    fn = self.pipe._denoise_for(1, h, w, steps)
+                    ctx2 = self.pipe.text_encode(
+                        jnp.zeros((2, self.seq_len), jnp.int32))
+                    args = (self.pipe.unet_params, self.pipe.vae_params,
+                            ctx2, jax.random.PRNGKey(0), jnp.float32(7.5))
+                cache.export(name, fn, args)
+                n += 1
         return n
 
     def warmup(self) -> None:
-        # warm at batch 1 — the shape infer() actually runs
         for steps in sorted(self.steps_allowed):
-            self.pipe.warm(1, self.height, self.width, steps, self.seq_len)
-            # coalescer batch buckets (pow2 up to the cap): compile now so
-            # no post-ready batch composition can trigger a compile
-            b = 2
-            while b <= self._batch_max:
+            if self._batch_max == 1:
+                # warm at batch 1 — the in-graph-latents shape infer() runs
+                self.pipe.warm(1, self.height, self.width, steps, self.seq_len)
+                continue
+            # Coalescer batch buckets (the _aot_keys ladder, starting at
+            # b=1): with SD_BATCH_MAX>1 every request — including a solo
+            # one — goes through _run_batch → txt2img_batch, whose cache
+            # key ('batch', B, ...) names a latents-as-argument executable
+            # the single-path pipe.warm() does not build. Warming b=1 here
+            # is what makes readiness imply "no post-ready compile"; the
+            # in-graph single path is unused in this mode and not warmed.
+            for shape_key, _name in self._aot_keys(steps):
+                _, b, h, w, _steps = shape_key
                 ids = jnp.zeros((b, self.seq_len), jnp.int32)
                 lat = jnp.concatenate(
-                    [self.pipe.init_latents(i, self.height // self.pipe.vae_scale,
-                                            self.width // self.pipe.vae_scale,
-                                            steps) for i in range(b)])
+                    [self.pipe.init_latents(i, h, w, steps)
+                     for i in range(b)])
                 self.pipe.txt2img_batch(ids, ids, lat, height=self.height,
                                         width=self.width, steps=steps,
                                         guidance_scale=self.cfg.guidance_scale)
-                b *= 2
 
     def _tokenize(self, text: str) -> np.ndarray:
         with self._tok_lock:
@@ -280,8 +319,13 @@ class SDService(ModelService):
             # is elementwise — `entry in list` would raise on the first
             # comparison against a same-key peer
             if any(e is entry for e in self._pending):  # not grabbed: I lead
-                batch = [e for e in self._pending
-                         if e[0] == key][: self._batch_max]
+                # the leader ALWAYS takes its own entry: if pending ever
+                # exceeds the cap (serving lane drift, direct infer() use),
+                # a batch sliced purely by arrival order could exclude the
+                # leader, stranding its future with no owning thread
+                others = [e for e in self._pending
+                          if e[0] == key and e is not entry]
+                batch = [entry] + others[: self._batch_max - 1]
                 grabbed = {id(e) for e in batch}
                 self._pending = [e for e in self._pending
                                  if id(e) not in grabbed]

@@ -88,12 +88,34 @@ def test_70b_needs_multichip():
     assert tp32.fits
 
 
-def test_int8_halves_param_bytes():
+def test_int8_counts_per_leaf_not_uniform():
+    """ADVICE r4: int8 quantizes ONLY the matmul kernels — embeddings and
+    norms stay bf16, so the budget must count them at full width (a uniform
+    1.02 bytes/elem under-counted the 11B mllama embed by ~0.5 GiB)."""
+    from scalable_hw_agnostic_inference_tpu.ops.quant import (
+        quantized_kernel_paths,
+    )
+
     cfg = LlamaConfig.llama3_8b()
     bf16 = causal_lm_budget(cfg, _ecfg())
     int8 = causal_lm_budget(cfg, _ecfg(quantization="int8"))
-    assert int8.params_gib == pytest.approx(bf16.params_gib * 1.02 / 2,
-                                            rel=1e-3)
+    # strictly above the old uniform under-count, strictly below bf16
+    assert bf16.params_gib * 1.02 / 2 < int8.params_gib < bf16.params_gib
+
+    # exact cross-check against the quantizer's own conversion predicate
+    # (quantized_kernel_paths shares _is_quant_node with the converter)
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+    qpaths = quantized_kernel_paths(shapes)
+    assert qpaths and all(p.endswith("/kernel") for p in qpaths)
+    assert not any("embed" in p or "norm" in p for p in qpaths)
+    expected = 0.0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(shapes)[0]:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        expected += int(np.prod(leaf.shape)) * (1.02 if name in qpaths
+                                                else 2.0)
+    assert int8.params_gib == pytest.approx(expected / GIB, rel=1e-6)
     # KV pool is NOT quantized (weight-only)
     assert int8.kv_gib == pytest.approx(bf16.kv_gib)
 

@@ -64,6 +64,36 @@ def test_sd_aot_export_then_boot_from_artifacts(tmp_path):
     assert out["image_b64"]
 
 
+def test_sd_coalescing_aot_export_covers_batch_buckets(tmp_path):
+    """With SD_BATCH_MAX>1 serving traffic runs the latents-as-argument
+    ('batch', b, ...) executables — the compile Job must export THOSE (one
+    per pow2 bucket), and a fresh coalescing boot must install them under
+    the batch keys so warmup executes loaded artifacts instead of
+    re-tracing (code-review r5: single-path artifacts on a coalescing unit
+    were dead weight)."""
+    from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+
+    cfg = ServeConfig(app="sd21", model_id="tiny", device="cpu",
+                      artifact_root=str(tmp_path), num_inference_steps=2,
+                      sd_batch_max=2)
+    report = compile_model("sd", cfg, self_test=False)
+    assert report["aot_exported"] == 2          # buckets b=1 and b=2
+    manifest = json.loads((tmp_path / "aot" / "manifest.json").read_text())
+    names = {m["name"] for m in manifest.values()}
+    assert any(n.endswith("-b1") for n in names), names
+    assert any(n.endswith("-b2") for n in names), names
+
+    svc = get_model("sd")(cfg)
+    svc.load()
+    assert svc.aot_loaded == 2
+    f = svc.pipe.vae_scale
+    h, w = svc.height // f, svc.width // f
+    assert ("batch", 1, h, w, 2) in svc.pipe._denoise_cache
+    assert ("batch", 2, h, w, 2) in svc.pipe._denoise_cache
+    svc._coalesce_window_s = 0.0
+    assert svc.infer(svc.example_payload())["image_b64"]
+
+
 def test_sd_boot_without_artifacts_still_works(tmp_path):
     from scalable_hw_agnostic_inference_tpu.models.registry import get_model
 

@@ -116,6 +116,64 @@ def test_sd_coalescer_follower_membership_is_identity_based():
     assert sum(ran) == 3 and max(ran) <= 2
 
 
+def test_sd_coalesced_warmup_compiles_batch1_executable():
+    """ADVICE r4 (high): with SD_BATCH_MAX>1 every request — including a
+    solo one — runs txt2img_batch, so warmup must build the
+    ('batch', 1, ...) latents-as-argument executable; a solo request after
+    readiness must add NO new cache keys (no post-ready compile)."""
+    cfg = ServeConfig(app="sd21", model_id="tiny", device="cpu",
+                      num_inference_steps=2, batch_size=1, sd_batch_max=2)
+    s = get_model("sd")(cfg)
+    s.load()
+    s.warmup()
+    f = s.pipe.vae_scale
+    h, w = s.height // f, s.width // f
+    assert ("batch", 1, h, w, 2) in s.pipe._denoise_cache
+    assert ("batch", 2, h, w, 2) in s.pipe._denoise_cache
+    keys_before = set(s.pipe._denoise_cache)
+    s._coalesce_window_s = 0.0
+    s.infer({"prompt": "a solo request", "seed": 3})
+    assert set(s.pipe._denoise_cache) == keys_before
+
+
+def test_sd_coalescer_leader_always_takes_own_entry():
+    """ADVICE r4 (low): if pending ever exceeds the cap, a leader slicing
+    purely by arrival order could grab a full batch that EXCLUDES itself,
+    stranding its future. The leader must always include its own entry."""
+    import concurrent.futures
+
+    cfg = ServeConfig(app="sd21", model_id="tiny", device="cpu",
+                      num_inference_steps=2, batch_size=1, sd_batch_max=2)
+    s = get_model("sd")(cfg)
+    s._coalesce_window_s = 0.0
+    ran = []
+
+    def fake_run_batch(items, steps, guidance):
+        ran.append([i["seed"] for i in items])
+        return np.zeros((len(items), 4, 4, 3), np.uint8)
+
+    s._run_batch = fake_run_batch
+    # two foreign same-key entries already pending (beyond what this
+    # leader's lane should ever see) — arrival-order slicing would pick
+    # exactly these two and strand the leader
+    foreign = []
+    for i in (100, 101):
+        f_ = concurrent.futures.Future()
+        s._pending.append(((2, 7.5),
+                           {"ids": np.zeros((1, 8), np.int32),
+                            "uncond": np.zeros((1, 8), np.int32),
+                            "seed": i}, f_))
+        foreign.append(f_)
+    out = s._coalesced({"ids": np.zeros((1, 8), np.int32),
+                        "uncond": np.zeros((1, 8), np.int32), "seed": 7},
+                       2, 7.5)
+    assert out is not None
+    assert any(7 in batch for batch in ran)   # leader served itself
+    # exactly one foreign rode along (cap 2); the other is still pending
+    assert sum(f_.done() for f_ in foreign) == 1
+    assert len(s._pending) == 1
+
+
 def test_sd_batch_max_clamps_to_pow2():
     """A non-pow2 cap would let a rounded-up batch land in a bucket warmup
     never compiled (post-ready XLA compile); the cap clamps down instead."""
