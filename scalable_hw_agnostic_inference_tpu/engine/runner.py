@@ -50,9 +50,11 @@ class EngineShardings:
         if cfg.n_kv_heads % tp or cfg.n_heads % tp:
             raise ValueError(
                 f"tensor_parallel_size={tp} must divide both n_heads="
-                f"{cfg.n_heads} and n_kv_heads={cfg.n_kv_heads} — pick a tp "
-                f"that divides the GQA head counts (reference vLLM has the "
-                f"same constraint)")
+                f"{cfg.n_heads} and n_kv_heads={cfg.n_kv_heads}. For GQA "
+                f"models with tp > n_kv_heads (the reference's 70B TP=32 "
+                f"tier), widen the kv heads first with "
+                f"models.llama.replicate_kv_heads(params, cfg, tp) — the "
+                f"serve layer does this automatically (units/vllm.py)")
         self.mesh = mesh
         self.rep = NamedSharding(mesh, P())
         specs = tp_rules().tree_specs(params)
@@ -168,9 +170,49 @@ def make_cross_slot_write(cfg: LlamaConfig):
     return jax.jit(write, donate_argnums=(0,))
 
 
+def _tp_attention(shardings: Optional["EngineShardings"], q, k, v, *,
+                  kv_lengths=None, causal=False):
+    """Self/cross attention, head-split over ``tp`` via shard_map under TP.
+
+    The flash kernel behind ``dot_product_attention`` (``ops.pallas``) is a
+    raw Mosaic call — XLA's SPMD partitioner refuses to split it
+    automatically ("Mosaic kernels cannot be automatically partitioned"), so
+    a TP-sharded prefill would fail to COMPILE on the first multi-chip boot.
+    Attention is head-local, so under TP the call is explicitly shard_map'd
+    on the head axes; contiguous head splits keep every GQA group on its
+    rank (``EngineShardings`` enforces tp | n_heads and tp | n_kv_heads,
+    widening GQA kv heads by replication when tp is larger —
+    ``models.llama.replicate_kv_heads``). Single-device engines call
+    straight through. Caught by the tp=32 abstract lowering leg
+    (``__graft_entry__.dryrun_lower_llama70b_tp32``).
+    """
+    if shardings is None:
+        return dot_product_attention(q, k, v, kv_lengths=kv_lengths,
+                                     causal=causal)
+    from jax.experimental.shard_map import shard_map
+
+    heads = P(None, None, "tp", None)
+    if kv_lengths is None:
+        return shard_map(
+            lambda q_, k_, v_: dot_product_attention(q_, k_, v_,
+                                                     causal=causal),
+            mesh=shardings.mesh, in_specs=(heads,) * 3, out_specs=heads,
+            check_rep=False,
+        )(q, k, v)
+    return shard_map(
+        lambda q_, k_, v_, n_: dot_product_attention(
+            q_, k_, v_, kv_lengths=n_, causal=causal),
+        mesh=shardings.mesh,
+        in_specs=(heads, heads, heads, P(None)),
+        out_specs=heads,
+        check_rep=False,
+    )(q, k, v, kv_lengths)
+
+
 def _cross_layer(lp: Dict, x: jax.Array, cross_k: jax.Array,
                  cross_v: jax.Array, has_image: jax.Array,
-                 cfg: LlamaConfig, cross_len=None) -> jax.Array:
+                 cfg: LlamaConfig, cross_len=None,
+                 shardings: Optional["EngineShardings"] = None) -> jax.Array:
     """One mllama gated cross-attention layer.
 
     ``x`` [B, T, dim]; ``cross_k/v`` [B, Lv, Hkv, Dh] (already k-normed);
@@ -185,8 +227,8 @@ def _cross_layer(lp: Dict, x: jax.Array, cross_k: jax.Array,
     h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
     q = _proj(h, ca["q"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
     q = _head_rmsnorm(q, ca["q_norm"]["scale"], cfg.rms_eps)
-    o = dot_product_attention(q, cross_k.astype(q.dtype),
-                              cross_v.astype(q.dtype), kv_lengths=cross_len)
+    o = _tp_attention(shardings, q, cross_k.astype(q.dtype),
+                      cross_v.astype(q.dtype), kv_lengths=cross_len)
     # gate in x's dtype: an f32 gate would promote the residual stream (and
     # every downstream layer) off bf16
     gate = has_image.astype(x.dtype)[:, None, None]
@@ -247,15 +289,18 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                 # gated cross-attention over vision states: no rope, no KV
                 # pool traffic — its keys are static per request
                 x = _cross_layer(lp, x, cross_kv[ci]["k"], cross_kv[ci]["v"],
-                                 has_image, cfg, cross_len=cross_len)
+                                 has_image, cfg, cross_len=cross_len,
+                                 shardings=shardings)
                 ci += 1
                 continue
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
             q, k, v = _qkv(lp, h, positions, cfg)
             # causal within the prompt; pad keys masked by the true length —
             # kv_lengths (not a mask) keeps the pallas flash kernel eligible
-            # for bucketed prefill shapes (VERDICT r1 #3)
-            o = dot_product_attention(q, k, v, kv_lengths=n, causal=True)
+            # for bucketed prefill shapes (VERDICT r1 #3); head-split
+            # shard_map under TP (the raw Mosaic kernel cannot be
+            # auto-partitioned)
+            o = _tp_attention(shardings, q, k, v, kv_lengths=n, causal=True)
             x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
             x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
             # scatter each row's k/v blocks into the pool ([B, m_used] index)
@@ -353,7 +398,8 @@ def make_prefill_cont(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
             lp = p[f"layer_{li}"]
             if li in cross_set:
                 x = _cross_layer(lp, x, cross_kv[ci]["k"], cross_kv[ci]["v"],
-                                 has_image, cfg, cross_len=cross_len)
+                                 has_image, cfg, cross_len=cross_len,
+                                 shardings=shardings)
                 ci += 1
                 continue
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
@@ -363,7 +409,8 @@ def make_prefill_cont(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
             kcat = jnp.concatenate(
                 [kflat[goff].astype(q.dtype), k], axis=1)  # [B, start+T, ...]
             vcat = jnp.concatenate([vflat[goff].astype(q.dtype), v], axis=1)
-            o = dot_product_attention(q, kcat, vcat, kv_lengths=n, causal=True)
+            o = _tp_attention(shardings, q, kcat, vcat, kv_lengths=n,
+                              causal=True)
             x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
             x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
             kdst = kv[pi]["k"].at[tbl_chunk].set(
@@ -440,7 +487,9 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
         if env:
             paged = env not in ("0", "false")
         else:
-            paged = jax.default_backend() in ("tpu", "axon")
+            from ..ops.attention import on_tpu_platform
+
+            paged = on_tpu_platform()
 
     def paged_attn(q1, kpool, vpool, tables, lengths):
         """q1 [B, H, D] over the pool; shard_map'd under TP (the kernel is
@@ -491,7 +540,7 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                 ck = cross_kv[ci]["k"][slot_idx]
                 cv = cross_kv[ci]["v"][slot_idx]
                 x = _cross_layer(lp, x, ck, cv, has_image, cfg,
-                                 cross_len=cross_len)
+                                 cross_len=cross_len, shardings=shardings)
                 ci += 1
                 continue
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)

@@ -90,6 +90,16 @@ class LlamaConfig:
         return cls()  # defaults are Llama-3-8B
 
     @classmethod
+    def mistral_7b(cls) -> "LlamaConfig":
+        """Mistral-7B-v0.3 geometry (reference serves Mistral through the
+        same causal-LM server, ``app/run-llama.py`` / ``mistral/``): llama
+        arch with a 32k vocab; v0.3 dropped the sliding window, so no
+        attention variant is needed."""
+        return cls(vocab_size=32768, dim=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, mlp_dim=14336, max_seq_len=32768,
+                   rope_theta=1000000.0)
+
+    @classmethod
     def llama3_70b(cls) -> "LlamaConfig":
         """Llama-3-70B / DeepSeek-R1-Distill-Llama-70B geometry — the
         reference's biggest deployment (TP=32,
@@ -456,3 +466,59 @@ def geometry_params(cfg: LlamaConfig, dtype=jnp.bfloat16,
     if not cfg.tie_embeddings:
         tree["lm_head"] = lin(D, cfg.vocab_size)
     return {"params": tree}
+
+
+def replicate_kv_heads(params: Dict[str, Any], cfg: LlamaConfig,
+                       tp: int) -> Tuple[Dict[str, Any], LlamaConfig]:
+    """Widen GQA kv heads to ``tp`` by weight-side replication.
+
+    The reference's biggest unit is TP=32 over a GQA model with 8 kv heads
+    (``compile-vllm-job.yaml:54-55``, DeepSeek-R1-Distill-Llama-70B) — more
+    ranks than kv heads. Head-local TP (the engine's shard_map'd paged
+    kernel, ``EngineShardings``) needs the kv-head axis to divide ``tp``, so
+    each kv head is duplicated ``tp // n_kv_heads`` times — the same
+    resolution vLLM applies when ``tp > num_kv_heads``. Numerics are
+    unchanged: query head ``h`` reads replica ``h // (n_heads/tp)`` which is
+    a copy of its original group head ``h // (n_heads/n_kv_heads)``
+    (``jnp.repeat`` preserves group order). HBM cost: kv weights and the KV
+    cache replicate across the extra ranks — exactly what
+    ``core.budget.causal_lm_budget`` charges (per-chip KV floors at one
+    head).
+
+    Works on real trees, geometry trees, and under ``jax.eval_shape`` (the
+    abstract lowering legs). Returns ``(new_params, new_cfg)`` with
+    ``n_kv_heads == tp``.
+    """
+    if tp <= cfg.n_kv_heads:
+        return params, cfg
+    if tp % cfg.n_kv_heads or cfg.n_heads % tp:
+        raise ValueError(
+            f"tp={tp} must be a multiple of n_kv_heads={cfg.n_kv_heads} and "
+            f"divide n_heads={cfg.n_heads} for replicated-GQA TP")
+    g, HD = tp // cfg.n_kv_heads, cfg.head_dim
+
+    def widen(mat):
+        # [..., kv*HD] -> [..., tp*HD]: repeat each head's HD-column group
+        lead = mat.shape[:-1]
+        m = mat.reshape(*lead, cfg.n_kv_heads, HD)
+        return jnp.repeat(m, g, axis=len(lead)).reshape(*lead, tp * HD)
+
+    tree = {"params": dict(params["params"])}
+    for i in range(cfg.n_layers):
+        name = f"layer_{i}"
+        layer = dict(tree["params"][name])
+        for attn_key in ("attn", "cross_attn"):
+            if attn_key not in layer:
+                continue
+            attn = dict(layer[attn_key])
+            for proj in ("k", "v"):
+                p = dict(attn[proj])
+                for leaf in ("kernel", "kernel_q"):
+                    if leaf in p:
+                        p[leaf] = widen(p[leaf])
+                if "scale" in p:  # int8 per-out-channel scale widens with out
+                    p["scale"] = widen(p["scale"])
+                attn[proj] = p
+            layer[attn_key] = attn
+        tree["params"][name] = layer
+    return tree, dataclasses.replace(cfg, n_kv_heads=tp)

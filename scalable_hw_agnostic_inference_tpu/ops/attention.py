@@ -18,6 +18,32 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# platforms whose default backend is the TPU chip (the axon tunnel's PJRT
+# platform registers as "tpu"; the name is kept for older plugin builds)
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def effective_platform() -> str:
+    """Platform the CURRENT computation will actually run on.
+
+    ``jax.default_backend()`` ignores a ``jax.default_device(...)`` override
+    — ``core.aot.host_init`` runs whole-model flax inits on the CPU device
+    while the global backend stays the TPU, and dispatching a Mosaic kernel
+    into that CPU-placed trace crashes with "Only interpret mode is
+    supported on CPU backend" (first observed on-chip in the round-5 SD
+    bench). Every TPU-or-not dispatch decision in the ops layer must go
+    through this helper, not ``jax.default_backend()``.
+    """
+    dd = jax.config.jax_default_device
+    if dd is not None:
+        # the option accepts a platform STRING too (JAX_DEFAULT_DEVICE=cpu)
+        return dd if isinstance(dd, str) else dd.platform
+    return jax.default_backend()
+
+
+def on_tpu_platform() -> bool:
+    return effective_platform() in TPU_PLATFORMS
+
 # Plain (non-causal, no-lengths) attention dispatch: measured on v5e
 # (scripts/perf_attn.py), XLA's fused softmax-attention beats the flash
 # kernel on every SD2.1 UNet shape — L0 self (T=S=4096, T*S=16.7M) runs
@@ -118,7 +144,7 @@ def dot_product_attention(
         if impl == "auto" and not causal and kv_lengths is None:
             if (_jax_flash_eligible(q, k, mask, bias, kv_lengths, causal)
                     and _JAX_FLASH_WINDOW[0] <= T * S < _JAX_FLASH_WINDOW[1]
-                    and jax.default_backend() in ("tpu", "axon")):
+                    and on_tpu_platform()):
                 impl = "jax-flash"
             elif T * S <= _XLA_SCORE_BUDGET:
                 impl = "xla"
@@ -128,7 +154,7 @@ def dot_product_attention(
         # a dispatch option for big self-attention shapes; needs a real TPU
         # (no interpreter mode)
         eligible = _jax_flash_eligible(q, k, mask, bias, kv_lengths, causal)
-        on_tpu = jax.default_backend() in ("tpu", "axon")
+        on_tpu = on_tpu_platform()
         if eligible and on_tpu:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention as jax_flash,
@@ -154,7 +180,7 @@ def dot_product_attention(
 
         want = impl == "pallas"
         if flash_eligible(q, k, v, mask=mask, bias=bias) and (
-            want or jax.default_backend() in ("tpu", "axon")
+            want or on_tpu_platform()
         ):
             return flash_attention(q, k, v, causal=causal, scale=scale,
                                    lengths=kv_lengths)

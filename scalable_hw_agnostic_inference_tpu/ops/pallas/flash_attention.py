@@ -155,7 +155,9 @@ def flash_attention(
     if scale is None:
         scale = 1.0 / (D ** 0.5)
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        from ..attention import on_tpu_platform
+
+        interpret = not on_tpu_platform()
     block_q = _pick_block(T, BLOCK_Q)
     if not block_q:
         raise ValueError(f"T={T} not tileable (min tile {_MIN_BLOCK})")

@@ -106,7 +106,9 @@ def paged_decode_attention(
     if scale is None:
         scale = 1.0 / (D ** 0.5)
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        from ..attention import on_tpu_platform
+
+        interpret = not on_tpu_platform()
 
     tables = tables.astype(jnp.int32)
     lengths = lengths.astype(jnp.int32)

@@ -293,6 +293,17 @@ class LlamaService(ModelService):
             cfg, cfg.model_id)
         self.mcfg = mcfg
 
+        if cfg.quantization == "int8":
+            # weight-only int8 at boot (the engine units' vllm_config knob,
+            # env-shaped for this service): halves decode HBM traffic and is
+            # what fits an 8B distill on one 16 GiB v5e chip
+            # (deploy/gen_units.py deepseek-tpu unit; core.budget accounting)
+            from ...ops.quant import quantize_params_tree
+
+            params = quantize_params_tree(params)
+            self.model = llama.LlamaForCausalLM(
+                mcfg, dtype=self.model.dtype, quant=True)
+
         if cfg.mesh_spec:
             from ...parallel.sharding import shard_pytree
 

@@ -84,6 +84,7 @@ class VllmService(ModelService):
             block_size=16,
             context_encoding_buckets=tuple(buckets),
             max_new_tokens=cfg.max_new_tokens,
+            quantization=cfg.quantization or None,
         )
 
     def load(self) -> None:
@@ -172,6 +173,12 @@ class VllmService(ModelService):
                     f"tensor_parallel_size={tp} exceeds the {len(devs)} local "
                     f"devices of this unit — match it to the nodepool's chip "
                     f"count (reference compile-vllm-job.yaml:54-55)")
+            if tp > mcfg.n_kv_heads:
+                # more ranks than GQA kv heads (the reference's 70B TP=32
+                # tier): widen kv heads by weight-side replication so the
+                # head-local engine shardings stay legal
+                # (models.llama.replicate_kv_heads; numerics unchanged)
+                params, mcfg = llama_mod.replicate_kv_heads(params, mcfg, tp)
             mesh = build_mesh(f"tp={tp}", devices=devs[:tp])
             params = shard_pytree(params, mesh, llama_mod.tp_rules())
         else:

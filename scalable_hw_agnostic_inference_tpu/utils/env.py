@@ -77,6 +77,12 @@ class ServeConfig:
     batch_size: int = 1
     scheduler: str = "ddim"              # diffusion sampler: ddim | euler
     steps_buckets: str = ""              # extra allowed steps values, csv
+    # weight-only quantization for causal-LM units: "" = bf16, "int8" =
+    # per-channel int8 matmul kernels (ops.quant) — what lets an 8B distill
+    # serve from ONE v5e chip (the engine units read the same knob from the
+    # vllm_config ConfigMap instead; this env form covers LlamaService and
+    # ConfigMap-less engine units)
+    quantization: str = ""
     # diffusion request coalescing: concurrent /genimage requests sharing
     # (steps, guidance) batch into ONE denoise call, pow2 batch buckets up
     # to this cap (1 = off; each bucket costs one compiled executable)
@@ -113,6 +119,7 @@ class ServeConfig:
             batch_size=env_int("BATCH_SIZE", 1),
             scheduler=env_str("SCHEDULER", "ddim"),
             steps_buckets=env_str("STEPS_BUCKETS", ""),
+            quantization=env_str("QUANTIZATION", ""),
             sd_batch_max=env_int("SD_BATCH_MAX", 1),
             vllm_config=env_str("VLLM_CONFIG", "/vllm_config.yaml"),
             mesh_spec=env_str("MESH_SPEC", ""),
@@ -135,6 +142,10 @@ class ServeConfig:
             raise ValueError("HEIGHT and WIDTH must be multiples of 8")
         if self.batch_size < 1:
             raise ValueError("BATCH_SIZE must be >= 1")
+        if self.quantization not in ("", "int8"):
+            raise ValueError(
+                f"QUANTIZATION={self.quantization!r} not supported; "
+                f"expected '' or 'int8'")
 
     def describe(self) -> Dict[str, Any]:
         """Redacted config for the self-describing ``GET /`` endpoint."""

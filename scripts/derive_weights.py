@@ -39,10 +39,11 @@ OUT = os.path.join(ROOT, "deploy", "derived_weights.json")
 CPU_COST_HR = 0.39
 EQUAL_UTILIZATION = 0.70
 
-# units that participate in an app's cost-optimized (weighted) route; the
-# cpu tier is the capacity-failover backstop and takes no steady-state
-# traffic (deploy/ingress/sd21-weighted-routing-ing.yaml rationale)
-WEIGHTED_ROUTE_TIERS = ("tpu",)
+# units that participate in an app's cost-optimized (weighted) route: every
+# tpu tier (gen_units._is_tpu — tpu, tpub8, ... are config flavors of the
+# same silicon, the reference's g5-cuda vs g5-triton pattern). The cpu tier
+# is the capacity-failover backstop and takes no steady-state traffic
+# (deploy/ingress/sd21-weighted-routing-ing.yaml rationale).
 
 
 def _load_units():
@@ -50,8 +51,15 @@ def _load_units():
         "gen_units", os.path.join(ROOT, "deploy", "gen_units.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    # ONE tpu-tier predicate (gen_units._is_tpu) for route membership,
+    # cost basis, and replica caps — a drifted copy would mis-price a unit
+    global _is_tpu
+    _is_tpu = mod._is_tpu
     return {f"{app}-{tier}": (app, tier, chips)
             for app, _model, tier, _env, chips in mod.UNITS}
+
+
+_is_tpu = None  # bound from gen_units by _load_units()
 
 
 def _chip_cost() -> float:
@@ -68,7 +76,7 @@ def derive(breakpoints: dict) -> dict:
             raise SystemExit(f"breakpoint key {key!r} is not a unit in "
                              f"deploy/gen_units.py UNITS")
         app, tier, chips = units[key]
-        cost = chips * chip_hr if tier == "tpu" else CPU_COST_HR
+        cost = chips * chip_hr if _is_tpu(tier) else CPU_COST_HR
         rps = float(bp["breakpoint"]["rps"])
         row = {
             "breakpoint_rps": round(rps, 4),
@@ -90,7 +98,7 @@ def derive(breakpoints: dict) -> dict:
 
     for app, data in apps.items():
         in_route = {k: r for k, r in data["units"].items()
-                    if units[k][1] in WEIGHTED_ROUTE_TIERS}
+                    if _is_tpu(units[k][1])}
         total = sum(r["rps_per_dollar_hr"] for r in in_route.values())
         acc = 0
         keys = sorted(in_route)

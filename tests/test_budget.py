@@ -150,6 +150,31 @@ def test_sd_batch4_fits_one_chip_but_batch64_does_not():
     assert not b64_.fits, b64_.describe()
 
 
+def test_deepseek_8b_single_chip_needs_int8():
+    """The deepseek-tpu unit (deploy/gen_units.py) serves an 8B distill
+    from ONE v5e chip: bf16 params alone (~15 GiB) bust the 14.72 usable,
+    int8 weight-only fits with headroom — the QUANTIZATION=int8 env is the
+    fit-enabler, not an optimization flourish."""
+    from scalable_hw_agnostic_inference_tpu.core.budget import (
+        causal_lm_budget,
+    )
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+
+    mcfg = LlamaConfig.llama3_8b()
+
+    def ecfg(q):
+        return EngineConfig(
+            model="deepseek-ai/DeepSeek-R1-Distill-Llama-8B",
+            max_model_len=640, max_num_seqs=4, block_size=16,
+            context_encoding_buckets=(128, 640), tensor_parallel_size=1,
+            quantization=q)
+
+    bf16 = causal_lm_budget(mcfg, ecfg(None))
+    assert not bf16.fits, bf16.describe()
+    int8 = causal_lm_budget(mcfg, ecfg("int8"))
+    assert int8.fits, int8.describe()
+
+
 def test_declared_production_geometries_fit():
     """The dryrun's shape-level legs, as a CI test: every committed
     geometry (units + cova ConfigMap) fits and shards legally."""

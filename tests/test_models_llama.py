@@ -212,11 +212,78 @@ async def test_llama_service_end_to_end():
         assert "sentiment" in r.json()
 
 
+@pytest.mark.asyncio
+async def test_llama_service_int8_quantized_end_to_end():
+    """QUANTIZATION=int8 (the deepseek-tpu unit's fit-enabler): the service
+    rebuilds the model with QuantDense and quantizes the param tree at boot,
+    and the quantized service still generates deterministically."""
+    import httpx
+
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+    from scalable_hw_agnostic_inference_tpu.serve.services import LlamaService
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+    from tests.test_serve_http import wait_ready
+
+    cfg = ServeConfig(app="deepseek", device="cpu", model_id="tiny",
+                      max_seq_len=64, max_new_tokens=4, quantization="int8")
+    svc = LlamaService(cfg)
+    app = create_app(cfg, svc)
+    transport = httpx.ASGITransport(app=app)
+    async with httpx.AsyncClient(transport=transport, base_url="http://t") as c:
+        r = await wait_ready(c, timeout=60.0)
+        assert r.status_code == 200, r.text
+        r = await c.post("/generate", json={"prompt": "hello",
+                                            "temperature": 0.0})
+        assert r.json()["n_tokens"] >= 1
+    # the loaded tree really is int8: attention kernels became kernel_q+scale
+    leaves = jax.tree_util.tree_leaves_with_path(svc.params)
+    assert any("kernel_q" in jax.tree_util.keystr(p) for p, _ in leaves)
+    assert svc.model.quant
+
+
 def test_llama_in_registry():
     from scalable_hw_agnostic_inference_tpu.models import list_models
 
     models = list_models()
     assert {"llama", "mistral", "deepseek"} <= set(models)
+
+
+def test_replicate_kv_heads_preserves_numerics():
+    """Weight-side GQA widening (tp > n_kv_heads, the 70B TP=32 case): the
+    widened model's logits must equal the original's bit-for-bit — each
+    query head reads an exact copy of its original group head."""
+    import dataclasses
+
+    import numpy as np
+
+    cfg = llama.LlamaConfig.tiny()  # 4 q heads, 2 kv heads
+    model = llama.LlamaForCausalLM(cfg, dtype=jnp.float32)
+    ids = jnp.asarray([[5, 9, 17, 3, 1, 8]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    ref, _ = model.apply(params, ids)
+
+    tp = 4
+    wide_params, wide_cfg = llama.replicate_kv_heads(params, cfg, tp)
+    assert wide_cfg.n_kv_heads == tp
+    wide_model = llama.LlamaForCausalLM(wide_cfg, dtype=jnp.float32)
+    out, _ = wide_model.apply(wide_params, ids)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+    # no-op below the threshold; bad factors fail loudly
+    same, same_cfg = llama.replicate_kv_heads(params, cfg, 2)
+    assert same is params and same_cfg is cfg
+    with pytest.raises(ValueError):
+        llama.replicate_kv_heads(params, cfg, 3)
+
+
+def test_llama70b_tp32_lowering_leg():
+    """The dsr70b-mh unit's decode + continuation prefill partition at FULL
+    shape on an abstract 32-way mesh (VERDICT r4 next #4) — catches illegal
+    engine shardings (incl. non-shard_map'd Mosaic attention) in CI instead
+    of on an 8-host boot."""
+    import __graft_entry__ as g
+
+    g.dryrun_lower_llama70b_tp32()
 
 
 def test_geometry_params_mirror_converter_tree():

@@ -374,6 +374,23 @@ class TestPagedDecodeAttention:
                                    rtol=1e-6, atol=1e-6)
 
 
+def test_effective_platform_respects_default_device(monkeypatch):
+    """The r5 on-chip SD bench crash: ``host_init`` places whole-model flax
+    inits on the CPU device while the global backend is the TPU — dispatch
+    decisions must follow the device CONTEXT or a Mosaic kernel lands in a
+    CPU-placed trace ("Only interpret mode is supported on CPU backend")."""
+    from scalable_hw_agnostic_inference_tpu.ops import attention as A
+
+    # simulate a TPU-default process (CI runs cpu-only)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert A.on_tpu_platform()          # no override: global backend rules
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        assert A.effective_platform() == "cpu"
+        assert not A.on_tpu_platform()  # host-placed trace: no Mosaic
+    assert A.on_tpu_platform()
+
+
 def test_llama3_rope_scaling_matches_hf():
     """Our llama3 frequency remap matches transformers' reference impl."""
     torch = pytest.importorskip("torch")
