@@ -200,20 +200,12 @@ def bench_llama(tiny: bool) -> dict:
         cfg, batch, prompt, new = LlamaConfig.tiny(), 2, 32, 16
         name = "tiny"
     elif "llama3b" in sys.argv:
-        # Llama-3.2-3B geometry (hidden 3072, 28 layers, 24 q / 8 kv heads)
-        # — the largest Llama that fits one v5e chip in bf16 with headroom
-        cfg = LlamaConfig(
-            vocab_size=128256, dim=3072, n_layers=28, n_heads=24, n_kv_heads=8,
-            mlp_dim=8192, max_seq_len=4096, rope_theta=500000.0,
-            tie_embeddings=True)
+        # the largest Llama that fits one v5e chip in bf16 with headroom
+        cfg = LlamaConfig.llama32_3b()
         batch, prompt, new = 8, 128, 128
         name = "llama3.2-3b-geometry"
     else:
-        # Llama-3.2-1B geometry (hidden 2048, 16 layers, 32 q / 8 kv heads)
-        cfg = LlamaConfig(
-            vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
-            mlp_dim=8192, max_seq_len=4096, rope_theta=500000.0,
-            tie_embeddings=True)
+        cfg = LlamaConfig.llama32_1b()
         batch, prompt, new = 8, 128, 128
         name = "llama3.2-1b-geometry"
 

@@ -67,8 +67,14 @@ static int dial(const Url &u) {
     return fd;
 }
 
-// one full request/response on a fresh connection; returns HTTP status or -1
-static int once(const Url &u, const std::string &req) {
+// one full request/response on a fresh connection; returns HTTP status or
+// -1. `ttfb` (seconds from request start) is set when the first BODY byte
+// arrives — for SSE responses that is the first token event, so under
+// --body '{"stream": true}' payloads the ttfb percentiles are the unit's
+// TTFT (the LLM serving SLO; breaking_point.py --slo ttfb gates on it).
+static int once(const Url &u, const std::string &req, double &ttfb) {
+    auto t0 = Clock::now();
+    ttfb = -1.0;
     int fd = dial(u);
     if (fd < 0) return -1;
     size_t off = 0;
@@ -81,14 +87,22 @@ static int once(const Url &u, const std::string &req) {
     char buf[8192];
     std::string head;
     int status = -1;
+    bool in_body = false;
     while (true) {
         ssize_t n = recv(fd, buf, sizeof buf, 0);
         if (n <= 0) break;
-        if (status < 0) {
+        if (!in_body) {
             head.append(buf, size_t(n));
-            auto sp = head.find(' ');
-            if (sp != std::string::npos && head.size() >= sp + 4)
-                status = std::atoi(head.c_str() + sp + 1);
+            if (status < 0) {
+                auto sp = head.find(' ');
+                if (sp != std::string::npos && head.size() >= sp + 4)
+                    status = std::atoi(head.c_str() + sp + 1);
+            }
+            auto he = head.find("\r\n\r\n");
+            if (he != std::string::npos && head.size() > he + 4) {
+                in_body = true;   // this recv carried the first body bytes
+                ttfb = std::chrono::duration<double>(Clock::now() - t0).count();
+            }
         }
     }
     close(fd);
@@ -123,7 +137,7 @@ int main(int argc, char **argv) {
     req += "\r\n" + body;
 
     std::mutex mu;
-    std::vector<double> lat;
+    std::vector<double> lat, lat_ttfb;
     std::atomic<long> ok{0}, errs{0}, non200{0};
     auto t_end = Clock::now() + std::chrono::seconds(duration + warmup);
     auto t_measure = Clock::now() + std::chrono::seconds(warmup);
@@ -133,7 +147,8 @@ int main(int argc, char **argv) {
         ts.emplace_back([&] {
             while (Clock::now() < t_end) {
                 auto t0 = Clock::now();
-                int status = once(u, req);
+                double ttfb = -1.0;
+                int status = once(u, req, ttfb);
                 double dt = std::chrono::duration<double>(Clock::now() - t0).count();
                 if (Clock::now() < t_measure) continue;  // warmup discard
                 if (status < 0) { errs++; continue; }
@@ -141,23 +156,29 @@ int main(int argc, char **argv) {
                 ok++;
                 std::lock_guard<std::mutex> g(mu);
                 lat.push_back(dt);
+                if (ttfb >= 0.0) lat_ttfb.push_back(ttfb);
             }
         });
     for (auto &t : ts) t.join();
 
     std::sort(lat.begin(), lat.end());
-    auto pct = [&](double p) -> double {
-        if (lat.empty()) return 0.0;
-        size_t i = size_t(p * double(lat.size() - 1) + 0.5);
-        return lat[std::min(i, lat.size() - 1)];
+    std::sort(lat_ttfb.begin(), lat_ttfb.end());
+    auto pct_of = [](const std::vector<double> &v, double p) -> double {
+        if (v.empty()) return 0.0;
+        size_t i = size_t(p * double(v.size() - 1) + 0.5);
+        return v[std::min(i, v.size() - 1)];
     };
+    auto pct = [&](double p) { return pct_of(lat, p); };
     double rps = double(ok.load()) / double(duration);
-    // same report shape as serve/latency.py's percentile report
+    // same report shape as serve/latency.py's percentile report, plus the
+    // first-body-byte percentiles (TTFT under SSE streaming bodies)
     std::printf(
         "{\"n_runs\": %ld, \"throughput_rps\": %.3f, \"errors\": %ld, "
         "\"non_200\": %ld, \"p0\": %.4f, \"p50\": %.4f, \"p90\": %.4f, "
-        "\"p95\": %.4f, \"p99\": %.4f, \"p100\": %.4f}\n",
+        "\"p95\": %.4f, \"p99\": %.4f, \"p100\": %.4f, "
+        "\"ttfb_p50\": %.4f, \"ttfb_p90\": %.4f, \"ttfb_p99\": %.4f}\n",
         ok.load(), rps, errs.load(), non200.load(), pct(0.0), pct(0.5),
-        pct(0.9), pct(0.95), pct(0.99), pct(1.0));
+        pct(0.9), pct(0.95), pct(0.99), pct(1.0), pct_of(lat_ttfb, 0.5),
+        pct_of(lat_ttfb, 0.9), pct_of(lat_ttfb, 0.99));
     return 0;
 }

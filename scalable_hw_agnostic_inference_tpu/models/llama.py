@@ -90,6 +90,22 @@ class LlamaConfig:
         return cls()  # defaults are Llama-3-8B
 
     @classmethod
+    def llama32_1b(cls) -> "LlamaConfig":
+        """Llama-3.2-1B geometry — the reference's vLLM default model
+        (``vllm_model_api.py`` ConfigMap)."""
+        return cls(vocab_size=128256, dim=2048, n_layers=16, n_heads=32,
+                   n_kv_heads=8, mlp_dim=8192, max_seq_len=4096,
+                   rope_theta=500000.0, tie_embeddings=True)
+
+    @classmethod
+    def llama32_3b(cls) -> "LlamaConfig":
+        """Llama-3.2-3B geometry — the largest Llama fitting one v5e chip
+        in bf16 with KV headroom."""
+        return cls(vocab_size=128256, dim=3072, n_layers=28, n_heads=24,
+                   n_kv_heads=8, mlp_dim=8192, max_seq_len=4096,
+                   rope_theta=500000.0, tie_embeddings=True)
+
+    @classmethod
     def mistral_7b(cls) -> "LlamaConfig":
         """Mistral-7B-v0.3 geometry (reference serves Mistral through the
         same causal-LM server, ``app/run-llama.py`` / ``mistral/``): llama

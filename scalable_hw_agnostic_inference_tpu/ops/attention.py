@@ -33,7 +33,19 @@ def effective_platform() -> str:
     supported on CPU backend" (first observed on-chip in the round-5 SD
     bench). Every TPU-or-not dispatch decision in the ops layer must go
     through this helper, not ``jax.default_backend()``.
+
+    ``SHAI_PLATFORM_OVERRIDE`` wins over everything: deviceless AOT
+    compilation (``perf.topo``) traces on a CPU-backed process while
+    targeting a TPU topology, so the dispatch must follow the compile
+    TARGET — and must not call ``jax.default_backend()`` at all, which
+    would initialize the (possibly wedged) device tunnel just to answer a
+    question about a device the computation will never run on.
     """
+    import os
+
+    ovr = os.environ.get("SHAI_PLATFORM_OVERRIDE", "")
+    if ovr:
+        return ovr
     dd = jax.config.jax_default_device
     if dd is not None:
         # the option accepts a platform STRING too (JAX_DEFAULT_DEVICE=cpu)

@@ -1,0 +1,23 @@
+"""Offline performance modeling against a deviceless TPU topology.
+
+The reference finds per-unit capacity empirically only: ramp clients against
+a live pod until latency crosses the SLO (reference
+``find-compute-breaking-point.yaml:20-59``, ``README.md:122-133``). That
+requires the accelerator to be attached. TPU-natively we can do better: XLA
+AOT-compiles real TPU executables against a *topology description* with no
+device attached (``jax.experimental.topologies``), and the compiled
+executable reports its own FLOP and memory-traffic accounting
+(``compiled.cost_analysis()``). :mod:`.topo` wraps that machinery;
+:mod:`.model` turns it into roofline-calibrated throughput projections for
+every serving family — the capacity-planning instrument that works while the
+chip is unreachable, and the cross-check once it is.
+"""
+
+from .topo import (  # noqa: F401
+    abstract_params,
+    bf16_leaves,
+    compile_workload,
+    device_mesh,
+    topology_devices,
+    with_sharding,
+)

@@ -212,6 +212,17 @@ def _is_vlm_checkpoint(cfg: ServeConfig, model_id: str) -> bool:
             and hasattr(hf_cfg, "text_config"))
 
 
+def _geometry_models():
+    from ...models.llama import LlamaConfig
+
+    return {
+        "llama-1b-geometry": LlamaConfig.llama32_1b,
+        "llama-3b-geometry": LlamaConfig.llama32_3b,
+        "llama-8b-geometry": LlamaConfig.llama3_8b,
+        "mistral-7b-geometry": LlamaConfig.mistral_7b,
+    }
+
+
 def _load_causal_lm(cfg: ServeConfig, model_id: str):
     """Shared causal-LM bootstrap for LlamaService and VllmService.
 
@@ -221,11 +232,27 @@ def _load_causal_lm(cfg: ServeConfig, model_id: str):
     from ...models import llama
     from ...models.generate import ByteTokenizer
 
+    GEOMETRY_MODELS = _geometry_models()
+
     if model_id in ("", "tiny"):
         mcfg = llama.LlamaConfig.tiny()
         model = llama.LlamaForCausalLM(mcfg, dtype=jnp.float32)
         params = model.init(
             jax.random.PRNGKey(cfg.seed), jnp.zeros((1, 8), jnp.int32))
+        return (mcfg, model, params, ByteTokenizer(),
+                ByteTokenizer.eos_id, ByteTokenizer.pad_id, True)
+
+    if model_id in GEOMETRY_MODELS:
+        # serving-GEOMETRY tier: full-size architecture, zero weights
+        # (models.llama.geometry_params) — boots with no hub/network access,
+        # so serving-level load ramps (scripts/breaking_point.py) and the
+        # watcher's on-chip sessions can measure the REAL engine/serving
+        # stack at real shapes. Throughput is weight-value-independent
+        # (bench.py uses the same basis); outputs are meaningless and the
+        # unit's model id says "geometry" honestly.
+        mcfg = GEOMETRY_MODELS[model_id]()
+        model = llama.LlamaForCausalLM(mcfg, dtype=jnp.bfloat16)
+        params = llama.geometry_params(mcfg)
         return (mcfg, model, params, ByteTokenizer(),
                 ByteTokenizer.eos_id, ByteTokenizer.pad_id, True)
 

@@ -104,7 +104,13 @@ class VllmService(ModelService):
         # architecture it is serving
         from ...core import weights as wstore
 
-        real_id = model_id not in ("", "tiny")
+        from .causal_lm import _geometry_models
+
+        # geometry ids are architecture names, not hub repos: the VLM
+        # autoconfig probe must not fire an HF lookup for them (the tier's
+        # whole point is booting with zero network access)
+        real_id = (model_id not in ("", "tiny")
+                   and model_id not in _geometry_models())
         has_mllama_artifact = real_id and wstore.has_params(
             cfg.artifact_root, f"mllama--{model_id}")
         has_vlm_artifact = real_id and wstore.has_params(
@@ -138,8 +144,11 @@ class VllmService(ModelService):
             (mcfg, _model, params, self.tokenizer,
              self.eos_id, self.pad_id, self._byte_tok) = _load_causal_lm(
                 cfg, model_id)
-        if self._byte_tok:
-            # tiny engine shapes: small blocks/buckets so CI exercises paging
+        if self._byte_tok and model_id in ("", "tiny"):
+            # tiny engine shapes: small blocks/buckets so CI exercises
+            # paging (geometry model ids also use the byte tokenizer but
+            # keep their REAL engine shapes — they exist to measure the
+            # real serving stack)
             ecfg = EngineConfig(
                 model="tiny", max_model_len=256, max_num_seqs=ecfg.max_num_seqs,
                 block_size=16, context_encoding_buckets=(32, 64, 128),
@@ -205,7 +214,7 @@ class VllmService(ModelService):
             vm = VisionProjector(vcfg, dtype=jnp.bfloat16)
             vparams = jax.device_put(vparams)
             self._vision = (vcfg, jax.jit(lambda px: vm.apply(vparams, px)))
-        elif self._byte_tok:
+        elif self._byte_tok and model_id in ("", "tiny"):
             from ...models.vlm import VisionProjector, VisionTowerConfig
 
             vcfg = VisionTowerConfig.tiny(lm_dim=mcfg.dim)

@@ -37,6 +37,12 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       scripts/breaking_point.py --spawn sd --full --levels 1,2,4,8 \
       --duration 30 --platform tpu-v5e-1 --bank sd21-tpu \
       2>&1 | grep -v WARNING | tee -a "$LOG"
+    # LLM tier TTFT/TPOT breaking point (VERDICT r4 #8): the engine unit
+    # serving the 1B geometry (real shapes, no hub), gated on TTFT
+    PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 3600 python \
+      scripts/breaking_point.py --spawn vllm --full --slo ttfb \
+      --levels 1,2,4,8,16 --duration 20 --platform tpu-v5e-1 \
+      --bank vllm-tpu 2>&1 | grep -v WARNING | tee -a "$LOG"
     python scripts/derive_weights.py 2>&1 | tee -a "$LOG"
     python deploy/gen_units.py >/dev/null 2>&1 && note "manifests rederived"
     note "running perf breakdowns"

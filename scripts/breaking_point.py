@@ -48,6 +48,16 @@ SPAWN_REQUESTS = {
     "bert": ("/predict", {"text": "breaking point probe"}),
     "vit": ("/classify", {}),
     "llama": ("/generate", {"prompt": "probe", "max_new_tokens": 8}),
+    # SSE stream: loadgen's ttfb percentiles are the unit's TTFT, so this
+    # request shape + --slo ttfb is the LLM breaking point (VERDICT r4 #8)
+    "vllm": ("/v1/completions", {"model": "default",
+                                 "prompt": "breaking point probe",
+                                 "max_tokens": 16, "stream": True}),
+}
+#: --full serving-geometry tier per unit: boots with zero network access
+#: (serve/units/causal_lm.py GEOMETRY_MODELS), real engine shapes
+FULL_ENV = {
+    "vllm": {"MODEL_ID": "llama-1b-geometry"},
 }
 
 
@@ -81,22 +91,43 @@ def run_level(url: str, method: str, body: str, concurrency: int,
 
 
 def ramp(url: str, method: str, body: str, levels, duration: int,
-         warmup: int, threshold: float) -> dict:
-    """Ramp concurrency; stop past the first level whose p50 > threshold."""
+         warmup: int, threshold: float, slo: str = "total",
+         gen_tokens: int = 0) -> dict:
+    """Ramp concurrency; stop past the first level whose SLO metric > the
+    threshold. ``slo='total'`` gates on whole-request p50 (the reference's
+    900 ms breaking point, README.md:125); ``slo='ttfb'`` gates on
+    first-body-byte p50 — TTFT for SSE-streaming LLM bodies. With
+    ``gen_tokens`` the level also records TPOT = (p50 - ttfb_p50) /
+    (gen_tokens - 1)."""
+    metric = "ttfb_p50" if slo == "ttfb" else "p50"
     out_levels = []
     for c in levels:
         rep = run_level(url, method, body, c, duration, warmup)
+        if metric not in rep:
+            # a silent fall-back to total-latency gating would bank a wrong
+            # breakpoint under slo=ttfb provenance (e.g. a stale loadgen
+            # binary predating the ttfb fields)
+            raise SystemExit(f"--slo {slo} requires {metric!r} in the "
+                             f"loadgen report; rebuild native/loadgen "
+                             f"(got keys: {sorted(rep)})")
         lvl = {"concurrency": c, "rps": rep["throughput_rps"],
                "p50": rep["p50"], "p90": rep["p90"],
                "errors": rep["errors"] + rep["non_200"]}
+        if "ttfb_p50" in rep:
+            lvl["ttfb_p50"] = rep["ttfb_p50"]
+            lvl["ttfb_p90"] = rep.get("ttfb_p90", 0.0)
+            if gen_tokens > 1:
+                lvl["tpot"] = max(0.0, (rep["p50"] - rep["ttfb_p50"])
+                                  / (gen_tokens - 1))
         out_levels.append(lvl)
-        print(f"c={c} rps={lvl['rps']:.3f} p50={lvl['p50']:.3f}s",
-              file=sys.stderr)
-        if rep["p50"] > threshold:
+        gate = lvl.get(metric, lvl["p50"])
+        print(f"c={c} rps={lvl['rps']:.3f} p50={lvl['p50']:.3f}s "
+              f"{metric}={gate:.3f}s", file=sys.stderr)
+        if gate > threshold:
             break
-    under = [l for l in out_levels if l["p50"] <= threshold
+    under = [l for l in out_levels if l.get(metric, l["p50"]) <= threshold
              and not l["errors"]]
-    res = {"threshold_s": threshold, "levels": out_levels}
+    res = {"threshold_s": threshold, "slo": slo, "levels": out_levels}
     if under:
         bp = max(under, key=lambda l: l["rps"])
         res["breakpoint"] = dict(bp)
@@ -137,7 +168,13 @@ def main() -> None:
     ap.add_argument("--duration", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--threshold", type=float, default=0.9,
-                    help="p50 seconds (reference README.md:125: 900 ms)")
+                    help="SLO seconds (reference README.md:125: 900 ms)")
+    ap.add_argument("--slo", choices=("total", "ttfb"), default="total",
+                    help="gate on whole-request p50 or first-body-byte p50 "
+                         "(TTFT for SSE bodies)")
+    ap.add_argument("--gen-tokens", type=int, default=0,
+                    help="tokens per generation request: levels also record "
+                         "TPOT = (p50 - ttfb_p50)/(gen_tokens - 1)")
     ap.add_argument("--platform", default="")
     ap.add_argument("--bank", help="merge result into deploy/breakpoints.json "
                                    "under this unit key")
@@ -152,7 +189,10 @@ def main() -> None:
             route, payload = SPAWN_REQUESTS[args.spawn]
             port = 8200 + os.getpid() % 1000
             env = {**os.environ, "APP": args.spawn, "PORT": str(port)}
-            if not args.full:
+            if args.full:
+                # serving-geometry tier where defined: real shapes, no hub
+                env.update(FULL_ENV.get(args.spawn, {}))
+            else:
                 env.update({"DEVICE": "cpu", "MODEL_ID": "tiny"})
             proc = subprocess.Popen(
                 [sys.executable, "-m",
@@ -165,8 +205,17 @@ def main() -> None:
         if not url:
             raise SystemExit("need --url or --spawn")
         levels = [int(x) for x in args.levels.split(",")]
+        gen_tokens = args.gen_tokens
+        if not gen_tokens and args.spawn in SPAWN_REQUESTS:
+            payload = SPAWN_REQUESTS[args.spawn][1]
+            # TPOT = (total - first_byte)/(tokens-1) is only meaningful for
+            # STREAMING responses; on a buffered JSON body ttfb ~ total and
+            # the derived per-token latency would be a banked ~0
+            if payload.get("stream"):
+                gen_tokens = int(payload.get("max_tokens",
+                                             payload.get("max_new_tokens", 0)))
         res = ramp(url, method, body, levels, args.duration, args.warmup,
-                   args.threshold)
+                   args.threshold, slo=args.slo, gen_tokens=gen_tokens)
     finally:
         if proc is not None:
             proc.terminate()

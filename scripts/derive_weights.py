@@ -47,19 +47,15 @@ EQUAL_UTILIZATION = 0.70
 
 
 def _load_units():
+    """(units dict, is_tpu predicate) from deploy/gen_units.py — ONE
+    tpu-tier predicate (gen_units._is_tpu) for route membership, cost
+    basis, and replica caps; a drifted copy would mis-price a unit."""
     spec = importlib.util.spec_from_file_location(
         "gen_units", os.path.join(ROOT, "deploy", "gen_units.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    # ONE tpu-tier predicate (gen_units._is_tpu) for route membership,
-    # cost basis, and replica caps — a drifted copy would mis-price a unit
-    global _is_tpu
-    _is_tpu = mod._is_tpu
-    return {f"{app}-{tier}": (app, tier, chips)
-            for app, _model, tier, _env, chips in mod.UNITS}
-
-
-_is_tpu = None  # bound from gen_units by _load_units()
+    return ({f"{app}-{tier}": (app, tier, chips)
+             for app, _model, tier, _env, chips in mod.UNITS}, mod._is_tpu)
 
 
 def _chip_cost() -> float:
@@ -68,7 +64,7 @@ def _chip_cost() -> float:
 
 
 def derive(breakpoints: dict) -> dict:
-    units = _load_units()
+    units, _is_tpu = _load_units()
     chip_hr = _chip_cost()
     apps: dict = {}
     for key, bp in sorted(breakpoints.items()):

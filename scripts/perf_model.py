@@ -1,0 +1,46 @@
+"""CLI for the offline perf model (VERDICT r4 #1): AOT-compile the hot
+executables against a deviceless v5e topology and write PERF_MODEL.{json,md}.
+
+Must run with the default backend pinned to CPU so host-side constants never
+initialize a possibly-wedged device tunnel — the topology compile path needs
+no attached device at all.
+
+    python scripts/perf_model.py                  # full ladder
+    python scripts/perf_model.py --workloads sd_step_b1,sd_vae_b1
+"""
+
+import argparse
+import os
+import sys
+
+# pin BEFORE jax import: the topology compile needs no backend, and the
+# axon tunnel backend can wedge for hours in jax.devices()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workloads", default="",
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--out-json", default="PERF_MODEL.json")
+    ap.add_argument("--out-md", default="PERF_MODEL.md")
+    args = ap.parse_args()
+
+    from scalable_hw_agnostic_inference_tpu.core.aot import (
+        enable_persistent_cache_from_env,
+    )
+    from scalable_hw_agnostic_inference_tpu.perf import model as pm
+
+    enable_persistent_cache_from_env()   # re-runs only pay changed compiles
+    names = [w for w in args.workloads.split(",") if w] or None
+    res = pm.run(names)
+    pm.save(res, args.out_json, args.out_md)
+    done = len(res["components"])
+    print(f"wrote {args.out_json} + {args.out_md} "
+          f"({done} executables, {len(res['errors'])} errors)")
+
+
+if __name__ == "__main__":
+    main()

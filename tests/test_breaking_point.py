@@ -31,7 +31,7 @@ gen_mod = _load("gen_units_t", os.path.join(ROOT, "deploy", "gen_units.py"))
 # ramp logic (no sockets: run_level stubbed)
 # ---------------------------------------------------------------------------
 
-def _ramp_with(levels_out):
+def _ramp_with(levels_out, **ramp_kw):
     calls = iter(levels_out)
 
     def fake_run_level(url, method, body, c, duration, warmup):
@@ -41,14 +41,19 @@ def _ramp_with(levels_out):
     bp_mod.run_level = fake_run_level
     try:
         return bp_mod.ramp("http://x/y", "POST", "{}",
-                           [1, 2, 4, 8], duration=1, warmup=0, threshold=0.9)
+                           [1, 2, 4, 8], duration=1, warmup=0, threshold=0.9,
+                           **ramp_kw)
     finally:
         bp_mod.run_level = orig
 
 
-def _rep(rps, p50, errors=0):
-    return {"throughput_rps": rps, "p50": p50, "p90": p50 * 1.2,
-            "errors": errors, "non_200": 0}
+def _rep(rps, p50, errors=0, ttfb=None):
+    rep = {"throughput_rps": rps, "p50": p50, "p90": p50 * 1.2,
+           "errors": errors, "non_200": 0}
+    if ttfb is not None:
+        rep["ttfb_p50"] = ttfb
+        rep["ttfb_p90"] = ttfb * 1.2
+    return rep
 
 
 def test_ramp_picks_last_level_under_threshold():
@@ -75,6 +80,27 @@ def test_ramp_excludes_errored_levels_from_breakpoint():
     res = _ramp_with([_rep(10, 0.1), _rep(50, 0.2, errors=3),
                       _rep(30, 0.4), _rep(31, 1.0)])
     assert res["breakpoint"]["rps"] == 30  # the 50-RPS level had failures
+
+
+def test_ramp_ttfb_slo_gates_on_first_byte():
+    """LLM TTFT mode (VERDICT r4 #8): whole-request latency may exceed the
+    threshold (long generations) while TTFT stays healthy — only the TTFT
+    crossing ends the ramp."""
+    res = _ramp_with([_rep(4, 2.0, ttfb=0.1), _rep(7, 2.2, ttfb=0.3),
+                      _rep(8, 2.5, ttfb=1.2)],
+                     slo="ttfb", gen_tokens=16)
+    assert res["slo"] == "ttfb"
+    assert len(res["levels"]) == 3          # stopped at ttfb 1.2 > 0.9
+    assert res["breakpoint"]["concurrency"] == 2
+    assert res["breakpoint"]["ttfb_p50"] == 0.3
+    # TPOT derived from (total - ttft) / (tokens - 1)
+    assert res["breakpoint"]["tpot"] == pytest.approx((2.2 - 0.3) / 15)
+
+
+def test_ramp_total_slo_ignores_ttfb():
+    res = _ramp_with([_rep(4, 0.2, ttfb=0.1), _rep(5, 1.5, ttfb=0.2)])
+    assert len(res["levels"]) == 2          # gated on p50, not ttfb
+    assert res["breakpoint"]["concurrency"] == 1
 
 
 # ---------------------------------------------------------------------------

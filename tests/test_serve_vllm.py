@@ -530,3 +530,20 @@ async def test_dead_engine_loop_fails_readiness():
         r = await c.post("/generate", json={"prompt": "hi",
                                             "max_new_tokens": 4})
         assert r.status_code == 503
+
+
+def test_geometry_serving_tier_registry():
+    """`MODEL_ID=llama-1b-geometry` boots the full-size architecture with
+    zero weights and no hub access (serve/units/causal_lm.py) so on-chip
+    serving-level ramps (scripts/breaking_point.py --spawn vllm --full) can
+    measure the real engine stack without a network path to checkpoints."""
+    from scalable_hw_agnostic_inference_tpu.serve.units.causal_lm import (
+        _geometry_models,
+    )
+
+    g = _geometry_models()
+    assert set(g) >= {"llama-1b-geometry", "llama-3b-geometry",
+                      "llama-8b-geometry", "mistral-7b-geometry"}
+    cfg = g["llama-1b-geometry"]()
+    assert (cfg.dim, cfg.n_layers, cfg.vocab_size) == (2048, 16, 128256)
+    assert g["mistral-7b-geometry"]().vocab_size == 32768
