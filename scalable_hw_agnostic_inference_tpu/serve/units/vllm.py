@@ -116,7 +116,9 @@ class VllmService(ModelService):
         has_vlm_artifact = real_id and wstore.has_params(
             cfg.artifact_root, f"vlm--{model_id}")
         offline = has_mllama_artifact or has_vlm_artifact
-        hf_cfg = None if offline else _autoconfig_of(cfg, model_id)
+        # tiny/geometry ids never consult the hub (no network on bench hosts)
+        hf_cfg = None if (offline or not real_id) else _autoconfig_of(
+            cfg, model_id)
         is_vlm = offline or (
             hf_cfg is not None and hasattr(hf_cfg, "vision_config")
             and hasattr(hf_cfg, "text_config"))

@@ -109,12 +109,12 @@ def test_flux_tp_sharding_parity(tiny_flux, devices):
     np.testing.assert_allclose(out, ref, atol=2e-5)
 
 
-def test_flux_converter_roundtrip(tiny_flux):
-    """Inverse-generate a BFL-layout torch state dict from our tree; the
-    converter must reproduce the tree exactly (naming + transposes)."""
+def bfl_sd_from_params(params, cfg) -> dict:
+    """Inverse of flux.params_from_torch: synthesize the BFL single-file
+    state-dict layout from our tree (module-level so the full-size
+    structural pin in test_weights_fullsize.py reuses it)."""
     import torch
 
-    cfg, model, params, _ = tiny_flux
     p = params["params"]
     sd = {}
 
@@ -131,7 +131,9 @@ def test_flux_converter_roundtrip(tiny_flux):
         bfl = {"final_mod": "final_layer.adaLN_modulation.1",
                "final_proj": "final_layer.linear"}.get(pre, pre)
         put_lin(bfl, p[pre])
-    for emb in ("time_in", "vector_in", "guidance_in"):
+    embs = ("time_in", "vector_in") + (
+        ("guidance_in",) if "guidance_in" in p else ())
+    for emb in embs:
         put_lin(f"{emb}.in_layer", p[emb]["in_layer"])
         put_lin(f"{emb}.out_layer", p[emb]["out_layer"])
     for i in range(cfg.n_double):
@@ -154,7 +156,14 @@ def test_flux_converter_roundtrip(tiny_flux):
         put_lin(f"{b}.linear1", fp["linear1"])
         put_lin(f"{b}.linear2", fp["linear2"])
         put_qk(f"{b}.norm", fp["qknorm"])
+    return sd
 
+
+def test_flux_converter_roundtrip(tiny_flux):
+    """Inverse-generate a BFL-layout torch state dict from our tree; the
+    converter must reproduce the tree exactly (naming + transposes)."""
+    cfg, model, params, _ = tiny_flux
+    sd = bfl_sd_from_params(params, cfg)
     conv = flux.params_from_torch(sd, cfg)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),

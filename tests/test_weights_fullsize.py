@@ -1,0 +1,285 @@
+"""FULL-config structural pins for the checkpoint converters (VERDICT r4 #5).
+
+This environment has no network path to real checkpoints (BASELINE.md), so
+the real-weights load smoke is impossible here. These tests are the
+prescribed offline substitute: run every family's converter over the REAL
+config's state-dict spec — full-size shapes, zero weight values — and pin
+the output tree against what the flax module actually consumes
+(``jax.eval_shape`` of ``init``). A drifted config constant (wrong width,
+missing block, renamed key) fails here instead of at a production boot.
+
+Spec sources, strongest first:
+
+- **t5 / clip**: the spec comes from the REAL ``transformers`` modules built
+  on the meta device (``accelerate.init_empty_weights``) at the checkpoint's
+  published config — the actual library layout, not our reading of it.
+- **unet / vae / flux**: ``diffusers`` is not installed here, so the spec is
+  inverse-generated from the flax tree via the same module-level generators
+  the tiny numeric roundtrips use (test_models_sd / test_models_flux) — the
+  pin then catches structural drift between converter, module, and config.
+
+Memory note: all synthetic tensors are zeros (calloc'd); peak is a few GB
+transient for the UNet. Flux runs the full-dev WIDTHS at reduced depth
+(2 double + 2 single blocks) — per-block structure is what drifts; depth is
+a trivially-structural repeat that would cost 48 GiB to materialize.
+"""
+
+import dataclasses
+import importlib.util
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_test_mod(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_fullsize_helper",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def shape_tree(t):
+    return jax.tree_util.tree_map(lambda a: tuple(a.shape), t)
+
+
+def zeros_like_avals(avals):
+    return jax.tree_util.tree_map(
+        lambda a: np.zeros(a.shape, np.float32), avals)
+
+
+class _Zero:
+    """Meta-tensor stand-in implementing exactly the convert.t2j protocol."""
+
+    def __init__(self, shape):
+        self.shape = tuple(shape)
+
+    def detach(self):
+        return self
+
+    def cpu(self):
+        return self
+
+    def float(self):
+        return self
+
+    def numpy(self):
+        return np.zeros(self.shape, np.float32)
+
+
+def _meta_state_dict(model) -> dict:
+    return {k: _Zero(v.shape) for k, v in model.state_dict().items()}
+
+
+# ---------------------------------------------------------------------------
+# t5-v1.1-large — REAL transformers layout at full size
+# ---------------------------------------------------------------------------
+
+def test_t5_v11_large_converter_matches_real_hf_layout():
+    from accelerate import init_empty_weights
+    from transformers import T5Config as HFT5Config
+    from transformers import T5EncoderModel
+
+    from scalable_hw_agnostic_inference_tpu.models import t5 as t5_mod
+
+    cfg = t5_mod.T5Config.t5_v1_1_large()
+    hf = HFT5Config(
+        vocab_size=cfg.vocab_size, d_model=cfg.dim, d_kv=cfg.d_kv,
+        d_ff=cfg.d_ff, num_layers=cfg.n_layers, num_heads=cfg.heads,
+        relative_attention_num_buckets=cfg.rel_buckets,
+        relative_attention_max_distance=cfg.rel_max_distance,
+        feed_forward_proj="gated-gelu")          # v1.1
+    with init_empty_weights():
+        tm = T5EncoderModel(hf)
+    conv = t5_mod.params_from_torch(_meta_state_dict(tm), cfg)
+    model = t5_mod.T5Encoder(cfg)
+    avals = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32),
+                           jnp.ones((1, 8), jnp.int32)))
+    assert shape_tree(conv) == shape_tree(avals)
+
+
+# ---------------------------------------------------------------------------
+# SD2.1 CLIP text encoder — REAL transformers layout at full size
+# ---------------------------------------------------------------------------
+
+def test_sd21_clip_converter_matches_real_hf_layout():
+    from accelerate import init_empty_weights
+    from transformers import CLIPTextConfig, CLIPTextModel
+
+    from scalable_hw_agnostic_inference_tpu.models import clip as clip_mod
+
+    cfg = clip_mod.ClipTextConfig()   # sd21 defaults (OpenCLIP-H, 23 layers)
+    hf = CLIPTextConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.dim,
+        intermediate_size=cfg.mlp_dim, num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.heads,
+        max_position_embeddings=cfg.max_position, hidden_act=cfg.act)
+    with init_empty_weights():
+        tm = CLIPTextModel(hf)
+    conv = clip_mod.params_from_torch(_meta_state_dict(tm), cfg)
+    model = clip_mod.ClipTextEncoder(cfg)
+    avals = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32)))
+    assert shape_tree(conv) == shape_tree(avals)
+
+
+# ---------------------------------------------------------------------------
+# SD2.1 UNet + VAE at the full serving config
+# ---------------------------------------------------------------------------
+
+def test_sd21_unet_converter_fullsize_tree():
+    from scalable_hw_agnostic_inference_tpu.models import sd as sd_mod
+    from scalable_hw_agnostic_inference_tpu.models import unet as unet_mod
+
+    variant = sd_mod.SDVariant.sd21_base()
+    cfg = variant.unet
+    model = unet_mod.UNet2DCondition(cfg)
+    avals = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, cfg.in_channels)),
+            jnp.zeros((1,), jnp.int32),
+            jnp.zeros((1, 77, cfg.cross_attention_dim))))
+    gen = _load_test_mod("test_models_sd")
+    tsd = gen._torch_sd_from_unet_params(zeros_like_avals(avals), cfg)
+    conv = unet_mod.params_from_torch(tsd, cfg)
+    assert shape_tree(conv) == shape_tree(avals)
+
+
+def _torch_sd_from_vae_params(params, cfg) -> dict:
+    """Inverse of vae.params_from_torch (diffusers AutoencoderKL layout)."""
+    import torch
+
+    sd = {}
+
+    def put_conv(name, fp):
+        sd[f"{name}.weight"] = torch.tensor(
+            np.asarray(fp["kernel"]).transpose(3, 2, 0, 1))
+        sd[f"{name}.bias"] = torch.tensor(np.asarray(fp["bias"]))
+
+    def put_norm(name, fp):
+        sd[f"{name}.weight"] = torch.tensor(np.asarray(fp["scale"]))
+        sd[f"{name}.bias"] = torch.tensor(np.asarray(fp["bias"]))
+
+    def put_resnet(name, fp):
+        put_norm(f"{name}.norm1", fp["norm1"])
+        put_conv(f"{name}.conv1", fp["conv1"])
+        put_norm(f"{name}.norm2", fp["norm2"])
+        put_conv(f"{name}.conv2", fp["conv2"])
+        if "shortcut" in fp:
+            put_conv(f"{name}.conv_shortcut", fp["shortcut"])
+
+    def put_mid(name, fp):
+        put_resnet(f"{name}.resnets.0", fp["res1"])
+        put_resnet(f"{name}.resnets.1", fp["res2"])
+        a = f"{name}.attentions.0"
+        put_norm(f"{a}.group_norm", fp["attn"]["norm"])
+        for ours, theirs in (("q", "to_q"), ("k", "to_k"), ("v", "to_v"),
+                             ("o", "to_out.0")):
+            sd[f"{a}.{theirs}.weight"] = torch.tensor(
+                np.asarray(fp["attn"][ours]["kernel"]).T)
+            sd[f"{a}.{theirs}.bias"] = torch.tensor(
+                np.asarray(fp["attn"][ours]["bias"]))
+
+    p = params["params"]
+    dec, enc = p["decoder"], p["encoder"]
+    put_conv("decoder.conv_in", dec["conv_in"])
+    put_mid("decoder.mid_block", dec["mid"])
+    put_norm("decoder.conv_norm_out", dec["norm_out"])
+    put_conv("decoder.conv_out", dec["conv_out"])
+    n = len(cfg.block_out)
+    for i in range(n):
+        for j in range(cfg.layers_per_block + 1):
+            put_resnet(f"decoder.up_blocks.{i}.resnets.{j}",
+                       dec[f"up_{i}_res_{j}"])
+        if i < n - 1:
+            put_conv(f"decoder.up_blocks.{i}.upsamplers.0.conv",
+                     dec[f"up_{i}_conv"])
+    put_conv("encoder.conv_in", enc["conv_in"])
+    put_mid("encoder.mid_block", enc["mid"])
+    put_norm("encoder.conv_norm_out", enc["norm_out"])
+    put_conv("encoder.conv_out", enc["conv_out"])
+    for i in range(n):
+        for j in range(cfg.layers_per_block):
+            put_resnet(f"encoder.down_blocks.{i}.resnets.{j}",
+                       enc[f"down_{i}_res_{j}"])
+        if i < n - 1:
+            put_conv(f"encoder.down_blocks.{i}.downsamplers.0.conv",
+                     enc[f"down_{i}_conv"])
+    if cfg.use_quant_conv:
+        for ours, theirs in (("post_quant", "post_quant_conv"),
+                             ("quant", "quant_conv")):
+            k = np.asarray(p[ours]["kernel"])       # [I, O] dense
+            sd[f"{theirs}.weight"] = torch.tensor(k.T[:, :, None, None])
+            sd[f"{theirs}.bias"] = torch.tensor(np.asarray(p[ours]["bias"]))
+    return sd
+
+
+def _vae_init_both(model, cfg, rng):
+    """init must touch BOTH paths: the default call is decode-only, but the
+    converter (and the checkpoint) carries encoder + quant convs too."""
+
+    def both(m, z, x):
+        return m.decode(z), m.encode(x)
+
+    return model.init(rng, jnp.zeros((1, 8, 8, cfg.latent_channels)),
+                      jnp.zeros((1, 64, 64, 3)), method=both)
+
+
+def test_sd_vae_converter_fullsize_tree():
+    from scalable_hw_agnostic_inference_tpu.models import vae as vae_mod
+
+    cfg = vae_mod.VAEConfig()       # the real SD VAE
+    model = vae_mod.AutoencoderKL(cfg)
+    avals = jax.eval_shape(
+        lambda: _vae_init_both(model, cfg, jax.random.PRNGKey(0)))
+    tsd = _torch_sd_from_vae_params(zeros_like_avals(avals), cfg)
+    conv = vae_mod.params_from_torch(tsd, cfg)
+    assert shape_tree(conv) == shape_tree(avals)
+
+
+def test_vae_converter_tiny_numeric_roundtrip():
+    """The VAE converter had no roundtrip at all: inverse(params) -> convert
+    must reproduce values exactly (transposes + naming), tiny tier."""
+    from scalable_hw_agnostic_inference_tpu.models import vae as vae_mod
+
+    cfg = vae_mod.VAEConfig.tiny()
+    model = vae_mod.AutoencoderKL(cfg)
+    params = _vae_init_both(model, cfg, jax.random.PRNGKey(3))
+    tsd = _torch_sd_from_vae_params(params, cfg)
+    conv = vae_mod.params_from_torch(tsd, cfg)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6), params, conv)
+
+
+# ---------------------------------------------------------------------------
+# flux-dev widths (depth reduced: structure per block, not repeats)
+# ---------------------------------------------------------------------------
+
+def test_flux_dev_width_converter_tree():
+    from scalable_hw_agnostic_inference_tpu.models import flux as flux_mod
+
+    cfg = dataclasses.replace(flux_mod.FluxConfig.flux_dev(),
+                              n_double=2, n_single=2)
+    model = flux_mod.FluxTransformer(cfg)
+    ids = flux_mod.make_ids(1, 16, 8, 8)
+    avals = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16, cfg.in_channels)),
+            jnp.zeros((1, 16, cfg.t5_dim)), jnp.zeros((1, cfg.clip_dim)),
+            jnp.zeros((1,)), jnp.zeros((1,)), ids))
+    gen = _load_test_mod("test_models_flux")
+    sd = gen.bfl_sd_from_params(zeros_like_avals(avals), cfg)
+    conv = flux_mod.params_from_torch(sd, cfg)
+    assert shape_tree(conv) == shape_tree(avals)
