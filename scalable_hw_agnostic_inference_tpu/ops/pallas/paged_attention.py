@@ -38,10 +38,18 @@ NEG_INF = -1e30
 def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
                   m_ref, l_ref, acc_ref, *, scale: float, block_size: int,
                   n_blocks: int):
-    # q_ref: [group, D]; k_ref/v_ref: [block_size, D] (the pool block this
-    # grid step streams); scratch m/l: [group, 128], acc: [group, D].
+    # q_ref: [Hkv, group, D]; k_ref/v_ref: [block_size, Hkv, D] — one WHOLE
+    # pool block per grid step, every kv head at once. The head axis must
+    # not be squeezed out of the K/V block shape: a squeezed-middle block
+    # leaves Mosaic's last-two-dims tiling at (1, D), which the TPU
+    # lowering rejects for every Hkv > 1 (caught by the deviceless AOT
+    # compile, perf/topo.py — the kernel had only ever run in interpret
+    # mode before). Streaming the full block also matches physical HBM
+    # layout: a pool block's heads are contiguous, so per-head fetches of
+    # the same block would not reduce traffic anyway.
+    # Scratch m/l: [Hkv, group, 128], acc: [Hkv, group, D].
     b = pl.program_id(0)
-    j = pl.program_id(2)
+    j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
@@ -50,33 +58,37 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     length = lens_ref[b]
-    q = q_ref[:].astype(jnp.float32) * scale          # [G, D]
-    k = k_ref[:].astype(jnp.float32)                  # [bs, D]
+    q = q_ref[:].astype(jnp.float32) * scale          # [Hkv, G, D]
+    k = k_ref[:].astype(jnp.float32)                  # [bs, Hkv, D]
     v = v_ref[:].astype(jnp.float32)
-    g = q.shape[0]
+    hkv, g, _ = q.shape
 
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [G, bs]
+    # batched over the kv-head axis (k/v batch dim sits at position 1)
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)           # [Hkv, G, bs]
     k_pos = j * block_size + jax.lax.broadcasted_iota(
-        jnp.int32, (g, block_size), 1)
+        jnp.int32, (hkv, g, block_size), 2)
     live = k_pos < length
     s = jnp.where(live, s, NEG_INF)
 
-    m_prev = m_ref[:, :1]                             # [G, 1]
+    m_prev = m_ref[:, :, :1]                          # [Hkv, G, 1]
     bm = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, bm)
     # a fully-masked block keeps m at NEG_INF: exp(NEG_INF - NEG_INF) = 1
     # would poison l/acc — zero the probabilities via the live mask instead
     p = jnp.where(live, jnp.exp(s - m_new), 0.0)
-    corr = jnp.exp(m_prev - m_new)                    # [G, 1]
-    l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * corr + jnp.dot(
-        p, v, preferred_element_type=jnp.float32)
+    corr = jnp.exp(m_prev - m_new)                    # [Hkv, G, 1]
+    l_new = l_ref[:, :, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+        p, v, (((2,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)           # [Hkv, G, D]
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(j == n_blocks - 1)
     def _finish():
-        o_ref[:] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-20)
+        o_ref[:] = (acc_ref[:] / jnp.maximum(l_ref[:, :, :1], 1e-20)
                     ).astype(o_ref.dtype)
 
 
@@ -117,12 +129,12 @@ def paged_decode_attention(
 
     # dead blocks (j beyond the row's live count) re-map to the row's first
     # block so consecutive grid steps see an unchanged index -> no re-fetch
-    def kv_index(b, h, j, tables, lens):
+    def kv_index(b, j, tables, lens):
         n_live = pl.cdiv(lens[b], block_size)
         jj = jnp.where(j < jnp.maximum(n_live, 1), j, 0)
-        return (tables[b, jj], 0, h, 0)
+        return (tables[b, jj], 0, 0, 0)
 
-    grid = (B, Hkv, M)
+    grid = (B, M)
     kernel = functools.partial(
         _paged_kernel, scale=scale, block_size=block_size, n_blocks=M)
     out = pl.pallas_call(
@@ -131,17 +143,17 @@ def paged_decode_attention(
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((None, None, group, D),
-                             lambda b, h, j, tables, lens: (b, h, 0, 0)),
-                pl.BlockSpec((None, block_size, None, D), kv_index),
-                pl.BlockSpec((None, block_size, None, D), kv_index),
+                pl.BlockSpec((None, Hkv, group, D),
+                             lambda b, j, tables, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((None, block_size, Hkv, D), kv_index),
+                pl.BlockSpec((None, block_size, Hkv, D), kv_index),
             ],
-            out_specs=pl.BlockSpec((None, None, group, D),
-                                   lambda b, h, j, tables, lens: (b, h, 0, 0)),
+            out_specs=pl.BlockSpec((None, Hkv, group, D),
+                                   lambda b, j, tables, lens: (b, 0, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((group, 128), jnp.float32),   # m
-                pltpu.VMEM((group, 128), jnp.float32),   # l
-                pltpu.VMEM((group, D), jnp.float32),     # acc
+                pltpu.VMEM((Hkv, group, 128), jnp.float32),   # m
+                pltpu.VMEM((Hkv, group, 128), jnp.float32),   # l
+                pltpu.VMEM((Hkv, group, D), jnp.float32),     # acc
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, group, D), q.dtype),

@@ -248,6 +248,95 @@ def wl_llama_decode(geometry: str, *, quant: bool = False, batch: int = 8,
                   f"(cache {n_slots} slots)"}
 
 
+#: tiny paged-decode geometry: still lowers the REAL Pallas paged kernel
+#: for the TPU target, so head_dim must satisfy Mosaic's 128-lane tiling
+_TINY_DECODE_KW = dict(vocab_size=512, dim=256, n_layers=2, n_heads=2,
+                       n_kv_heads=2, mlp_dim=128, max_seq_len=256,
+                       rope_theta=10000.0, tie_embeddings=True)
+
+
+def wl_mllama_decode(*, tiny: bool = False):
+    """The cova caption stage's decode step: gated cross-attention over the
+    full vision buffer, born-int8 11B geometry, bs=1 — constants fixed to
+    bench.py's mllama caption path (prompt shapes aside)."""
+    from ..models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig(cross_attention_layers=(1,),
+                                    **_TINY_DECODE_KW)
+        return _paged_decode(cfg, "mllama-tiny", quant=False, batch=1,
+                             ctx=32, block_size=8, lv=32)
+    cfg = llama_mod.LlamaConfig.mllama_11b_text()
+    return _paged_decode(cfg, "mllama-11b-int8", quant=True, batch=1,
+                         ctx=1024, block_size=128,
+                         lv=4 * (1 + (560 // 14) ** 2))
+
+
+def wl_vllm_decode(geometry: str = "1b", *, quant: bool = False,
+                   batch: int = 8, ctx: int = 1024, block_size: int = 16,
+                   tiny: bool = False):
+    """ONE paged-engine decode step (engine/runner.py make_decode, the
+    Pallas paged-attention path) — the TPOT executable of the vllm unit."""
+    from ..models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig(**_TINY_DECODE_KW)
+        return _paged_decode(cfg, "llama-tiny", quant=quant, batch=batch,
+                             ctx=32, block_size=block_size, lv=0)
+    cfg = _llama_cfg(geometry, tiny=False)
+    name = f"llama-{geometry}" + ("-int8" if quant else "")
+    return _paged_decode(cfg, name, quant=quant, batch=batch, ctx=ctx,
+                         block_size=block_size, lv=0)
+
+
+def _paged_decode(cfg, name: str, *, quant: bool, batch: int, ctx: int,
+                  block_size: int, lv: int):
+    """Shared paged-decode workload assembly.
+
+    The KV pool is sized to exactly the bucketed context in use
+    (1 null block + batch x ctx blocks): XLA's cost analysis counts a
+    Pallas custom call's whole pool operand as accessed, so an over-sized
+    pool would overstate HBM traffic; at full occupancy pool size == true
+    working set."""
+    from ..engine.runner import make_decode
+    from ..models import llama as llama_mod
+
+    m_ctx = max(1, ctx // block_size)
+    n_cross = len(cfg.cross_attention_layers)
+    n_self = cfg.n_layers - n_cross
+    fn = make_decode(cfg, block_size, m_ctx, batch, ctx_blocks=m_ctx,
+                     paged=True)
+    mesh = topo.device_mesh(1)
+    s = _repl(mesh)
+    params = topo.with_sharding(topo.abstract_params(
+        lambda: llama_mod.geometry_params(cfg, quant=quant)), s)
+    pool_blocks = 1 + batch * m_ctx
+    pool = jax.ShapeDtypeStruct(
+        (pool_blocks, block_size, cfg.n_kv_heads, cfg.head_dim),
+        jnp.bfloat16, sharding=s)
+    kv = [{"k": pool, "v": pool} for _ in range(n_self)]
+    vec = lambda dt: jax.ShapeDtypeStruct((batch,), dt, sharding=s)  # noqa: E731
+    args = (params, kv, vec(jnp.int32), vec(jnp.int32),
+            jax.ShapeDtypeStruct((batch, m_ctx), jnp.int32, sharding=s),
+            vec(jnp.bool_),
+            topo.with_sharding(topo.abstract_params(
+                lambda: jax.random.PRNGKey(0)), s),
+            vec(jnp.float32), vec(jnp.int32), vec(jnp.float32))
+    if n_cross:
+        cbuf = jax.ShapeDtypeStruct(
+            (batch, lv, cfg.n_kv_heads, cfg.head_dim), jnp.bfloat16,
+            sharding=s)
+        args += ([{"k": cbuf, "v": cbuf} for _ in range(n_cross)],
+                 vec(jnp.float32), vec(jnp.int32), vec(jnp.int32))
+    return fn, args, {
+        "family": "mllama" if n_cross else "llama",
+        "component": "paged_decode_step", "batch": batch,
+        "param_bytes": _tree_bytes(params),
+        "detail": f"{name} paged-engine decode step bs={batch} "
+                  f"ctx={m_ctx * block_size}"
+                  + (f" cross Lv={lv}" if n_cross else "")}
+
+
 def wl_t5(*, batch: int = 32, seq: int = 128, tiny: bool = False):
     from ..models import t5 as t5_mod
 
@@ -339,6 +428,8 @@ WORKLOADS: Dict[str, Callable[[], Tuple[Callable, Tuple, Dict]]] = {
     "llama3b_int8_decode": lambda: wl_llama_decode("3b", quant=True),
     "t5": lambda: wl_t5(),
     "flux_tp8_step": lambda: wl_flux_tp8(),
+    "vllm_decode_b8": lambda: wl_vllm_decode("1b"),
+    "mllama_decode_b1": lambda: wl_mllama_decode(),
 }
 
 
@@ -401,6 +492,17 @@ def compose(rows: Dict[str, Dict]) -> Dict[str, Dict]:
                     "ttft_roofline_s": rows[pre]["t_roofline_s"],
                     "tpot_roofline_s": rows[dec]["t_roofline_s"],
                 }
+    for nm in ("vllm_decode_b8", "mllama_decode_b1"):
+        if nm in rows:
+            row = rows[nm]
+            out[f"{nm}_tpot"] = {
+                "family": row["family"], "work": row["batch"],
+                "work_unit": "tokens", "parts": {nm: 1.0},
+                "t_roofline_s": row["t_roofline_s"],
+                "t_xla_optimal_s": row.get("optimal_seconds"),
+                "flops": row["flops"],
+                "bytes_accessed": row["bytes_accessed"],
+            }
     if "t5" in rows:
         row = rows["t5"]
         out["t5_embed"] = {

@@ -62,27 +62,24 @@ FULL_ENV = {
 
 
 def ensure_loadgen() -> str:
-    src = os.path.join(ROOT, "native", "loadgen.cpp")
-    fresh = (os.path.exists(LOADGEN)
-             and os.path.getmtime(LOADGEN) >= os.path.getmtime(src))
-    if fresh:
+    if shutil.which("g++") is not None:
+        # ALWAYS rebuild (-B): a pre-existing binary may predate report
+        # fields the caller gates on (e.g. the ttfb percentiles behind
+        # --slo ttfb), and mtimes are meaningless across a git checkout.
+        # The build is a one-second single-file compile; correctness of the
+        # measurement instrument beats saving it.
+        subprocess.run(["make", "-B", "-C", os.path.join(ROOT, "native")],
+                       check=True, capture_output=True)
         return LOADGEN
-    if shutil.which("g++") is None:
-        # a stale repo binary beats nothing, and the assets image installs
-        # one on PATH — but a binary predating the report fields the caller
-        # asked for will hard-fail in ramp(), which is the honest outcome
-        if os.path.exists(LOADGEN):
-            return LOADGEN
-        on_path = shutil.which("loadgen")
-        if on_path:
-            return on_path
-        raise SystemExit("no loadgen binary (native/loadgen or PATH) and "
-                         "no g++ to build it")
-    # build (or REbuild: a binary older than loadgen.cpp silently lacks the
-    # newer report fields, e.g. the ttfb percentiles --slo ttfb gates on)
-    subprocess.run(["make", "-C", os.path.join(ROOT, "native")],
-                   check=True, capture_output=True)
-    return LOADGEN
+    # no compiler: fall back to whatever binary exists — a report missing a
+    # requested metric then hard-fails in ramp(), which is the honest outcome
+    if os.path.exists(LOADGEN):
+        return LOADGEN
+    on_path = shutil.which("loadgen")   # the assets image installs it there
+    if on_path:
+        return on_path
+    raise SystemExit("no loadgen binary (native/loadgen or PATH) and "
+                     "no g++ to build it")
 
 
 def run_level(url: str, method: str, body: str, concurrency: int,
