@@ -43,7 +43,8 @@ SD_BASELINE_IMG_S = 1.0 / 0.67
 #: one unit mapping for the measurement AND crash paths
 UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
                   "mllama": "tokens/sec",
-                  "sd": "images/sec", "flux": "images/sec"}
+                  "sd": "images/sec", "sd8": "images/sec",
+                  "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
 # (reference README.md:192). The north star is throughput per DOLLAR, so
 # every bench line carries the cost basis it was computed with.
@@ -62,7 +63,7 @@ def _which_from_argv(argv) -> str:
     drifted)."""
     if any(a.startswith("llama") for a in argv):
         return "llama"
-    for k in ("flux", "t5", "mllama"):
+    for k in ("flux", "t5", "mllama", "sd8"):
         if k in argv:
             return k
     return "sd"
@@ -93,15 +94,31 @@ def _dollars(out: dict, *, inf2_value: float | None = None) -> dict:
     return out
 
 
-def bench_sd(tiny: bool) -> dict:
+def bench_sd8(tiny: bool) -> dict:
+    """Batch-8 flash-attention throughput bench — the sd21-tpub8 serving
+    tier's configuration (deploy/gen_units.py: SD_BATCH_MAX=8 +
+    SHAI_ATTN_IMPL=pallas), driven through the coalescer's own
+    txt2img_batch executable. This is the on-chip validation target for
+    PERF_MODEL.md's headline projection (batch-8 + flash is the modeled
+    path past 2x throughput/$ vs inf2)."""
+    return bench_sd(tiny, batch=8, attn="pallas")
+
+
+def bench_sd(tiny: bool, batch: int = 1, attn: str = "") -> dict:
     from scalable_hw_agnostic_inference_tpu.core.aot import (
         host_init,
         to_default_device,
     )
     from scalable_hw_agnostic_inference_tpu.models import sd as sd_mod
 
+    if attn:
+        # trace-time dispatch override (ops.attention): must be set before
+        # the first pipeline build
+        os.environ["SHAI_ATTN_IMPL"] = attn
     if tiny:
         variant, size, steps, seq = sd_mod.SDVariant.tiny(), 16, 2, 8
+        attn = ""  # pallas kernels need a real TPU; tiny tier is CPU
+        os.environ.pop("SHAI_ATTN_IMPL", None)
     else:
         variant, size, steps, seq = sd_mod.SDVariant.sd21_base(), 512, 25, 77
 
@@ -133,6 +150,33 @@ def bench_sd(tiny: bool) -> dict:
 
     pipe = sd_mod.StableDiffusion(variant, unet_params, vae_params, text_encode)
     ids = jnp.zeros((1, seq), jnp.int32)
+
+    if batch > 1:
+        # the coalescer's own latents-as-argument executable, exactly as the
+        # SD_BATCH_MAX serving tier runs it
+        bids = jnp.zeros((batch, seq), jnp.int32)
+        lats = jnp.concatenate(
+            [pipe.init_latents(i, lat, lat, steps) for i in range(batch)])
+
+        def run_batch():
+            return pipe.txt2img_batch(bids, bids, lats, height=size,
+                                      width=size, steps=steps)
+
+        img = run_batch()  # warm (compiles the ('batch', B, ...) pipeline)
+        runs = 3
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            img = run_batch()
+        dt = (time.perf_counter() - t0) / runs
+        assert img.shape[0] == batch and img.shape[1] == size
+        label = f" b{batch}" + (f"-{attn}" if attn else "")
+        return _dollars({
+            "metric": f"sd21-{size}px {steps}-step{label} txt2img img/s "
+                      f"({jax.devices()[0].platform})",
+            "value": round(batch / dt, 4),
+            "unit": "images/sec",
+            "vs_baseline": round((batch / dt) / SD_BASELINE_IMG_S, 3),
+        }, inf2_value=SD_BASELINE_IMG_S)
 
     stepwise = os.environ.get("SHAI_SD_STEPWISE", "") == "1"
 
@@ -516,7 +560,7 @@ def inner_main() -> None:
 
         enable_persistent_cache_from_env()
     out = {"llama": bench_llama, "flux": bench_flux, "t5": bench_t5,
-           "mllama": bench_mllama, "sd": bench_sd}[
+           "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)
     # structured platform provenance: is_real() keys off this, never off
     # metric-string formatting (ADVICE r3 medium)
@@ -543,7 +587,7 @@ def _run_child(which: str, cpu: bool, timeout: float,
                env: dict | None = None) -> tuple[dict | None, str]:
     """Run one measurement attempt in a child; return (result, error_tail)."""
     args = [sys.executable, os.path.abspath(__file__), "--inner", which]
-    for tok in ("llama3b", "int8", "flux", "t5", "mllama"):
+    for tok in ("llama3b", "int8", "flux", "t5", "mllama", "sd8"):
         if tok in sys.argv and tok not in args:
             args.append(tok)
     if cpu:

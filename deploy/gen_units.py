@@ -43,7 +43,14 @@ UNITS = [
                              "stabilityai/stable-diffusion-2-1-base",
                              "HEIGHT": "512", "WIDTH": "512",
                              "NUM_INFERENCE_STEPS": "25",
-                             "SD_BATCH_MAX": "8"}, 1),
+                             "SD_BATCH_MAX": "8",
+                             # throughput tier runs flash attention on every
+                             # UNet level: the offline perf model
+                             # (PERF_MODEL.md) shows XLA-attention batched
+                             # steps are HBM-bound on score traffic while
+                             # flash flips them MXU-bound (b4: 48.7 -> 21.7
+                             # GB/step); watcher re-validates on-chip
+                             "SHAI_ATTN_IMPL": "pallas"}, 1),
     ("bert", "bert", "tpu", {"MODEL_ID":
                              "distilbert-base-uncased-finetuned-sst-2-english"}, 1),
     ("bert", "bert", "cpu", {"MODEL_ID":

@@ -337,6 +337,49 @@ def _paged_decode(cfg, name: str, *, quant: bool, batch: int, ctx: int,
                   + (f" cross Lv={lv}" if n_cross else "")}
 
 
+def wl_vllm_decode_tp8(*, tiny: bool = False):
+    """The TP-sharded paged decode step AOT-compiled for the TPU target:
+    llama-70B int8 geometry over a tp=8 topology mesh — the deepest
+    validation the sharded engine path can get without chips. Catches what
+    neither the CPU lowering legs (no Mosaic) nor interpret mode can: the
+    shard_map'd Pallas kernel and the EngineShardings placement must
+    partition AND lower for real XLA:TPU."""
+    from ..engine.runner import EngineShardings, make_decode
+    from ..models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig(**_TINY_DECODE_KW)
+        tp, batch, ctx, block_size, quant = 2, 2, 32, 8, False
+    else:
+        cfg = llama_mod.LlamaConfig.llama3_70b()
+        tp, batch, ctx, block_size, quant = 8, 8, 1024, 128, True
+    mesh = topo.device_mesh(tp, axes=("tp",))
+    params = topo.abstract_params(
+        lambda: llama_mod.geometry_params(cfg, quant=quant))
+    sh = EngineShardings(mesh, params, cfg)
+    m_ctx = ctx // block_size
+    fn = make_decode(cfg, block_size, m_ctx, batch, ctx_blocks=m_ctx,
+                     shardings=sh, paged=True)
+    pool = jax.ShapeDtypeStruct(
+        (1 + batch * m_ctx, block_size, cfg.n_kv_heads, cfg.head_dim),
+        jnp.bfloat16)
+    kv = [{"k": pool, "v": pool} for _ in range(cfg.n_layers)]
+    vec = lambda dt: jax.ShapeDtypeStruct((batch,), dt)  # noqa: E731
+    # plain avals: placement comes from the jit's in_shardings (the REAL
+    # serving path), not per-aval annotations
+    args = (params, kv, vec(jnp.int32), vec(jnp.int32),
+            jax.ShapeDtypeStruct((batch, m_ctx), jnp.int32),
+            vec(jnp.bool_),
+            topo.abstract_params(lambda: jax.random.PRNGKey(0)),
+            vec(jnp.float32), vec(jnp.int32), vec(jnp.float32))
+    name = "llama-tiny" if tiny else "llama-70b-int8"
+    return fn, args, {
+        "family": "llama", "component": "paged_decode_step", "batch": batch,
+        "n_devices": tp, "param_bytes": _tree_bytes(params),
+        "detail": f"{name} paged decode step tp={tp} bs={batch} "
+                  f"ctx={m_ctx * block_size}; per-device numbers"}
+
+
 def wl_t5(*, batch: int = 32, seq: int = 128, tiny: bool = False):
     from ..models import t5 as t5_mod
 
@@ -376,7 +419,14 @@ def wl_flux_tp8(*, size: int = 512, t5_len: int = 512, tiny: bool = False):
     if tiny:
         t5_len = 8
     model = flux_mod.FluxTransformer(fcfg, dtype=jnp.bfloat16)
-    ids = flux_mod.make_ids(1, t5_len, lat, lat)
+
+    def _ids():
+        # ONLY ever traced (eval_shape): an eager make_ids would be this
+        # process's first eager op, and eager dispatch resolves the default
+        # device through the real backend registry — i.e. it initializes
+        # the possibly-wedged device tunnel this module exists to avoid
+        return flux_mod.make_ids(1, t5_len, lat, lat)
+
     n_img = (lat // 2) * (lat // 2)
     mesh = topo.device_mesh(8, axes=("tp",))
     repl = _repl(mesh)
@@ -385,7 +435,7 @@ def wl_flux_tp8(*, size: int = 512, t5_len: int = 512, tiny: bool = False):
             jax.random.PRNGKey(0), jnp.zeros((1, n_img, fcfg.in_channels)),
             jnp.zeros((1, t5_len, fcfg.t5_dim)),
             jnp.zeros((1, fcfg.clip_dim)), jnp.zeros((1,)), jnp.zeros((1,)),
-            ids)))
+            _ids())))
     specs = flux_mod.tp_rules().tree_specs(params_avals)
     params = jax.tree.map(
         lambda a, sp: jax.ShapeDtypeStruct(
@@ -404,7 +454,7 @@ def wl_flux_tp8(*, size: int = 512, t5_len: int = 512, tiny: bool = False):
                                  sharding=repl),
             jax.ShapeDtypeStruct((1,), jnp.float32, sharding=repl),
             jax.ShapeDtypeStruct((1,), jnp.float32, sharding=repl),
-            topo.with_sharding(topo.abstract_params(lambda: ids), repl))
+            topo.with_sharding(topo.abstract_params(_ids), repl))
     return step, args, {
         "family": "flux", "component": "denoise_step", "batch": 1,
         "n_devices": 8, "param_bytes": _tree_bytes(params_avals),
@@ -416,7 +466,7 @@ def wl_flux_tp8(*, size: int = 512, t5_len: int = 512, tiny: bool = False):
 WORKLOADS: Dict[str, Callable[[], Tuple[Callable, Tuple, Dict]]] = {
     **{f"sd_step_b{b}": (lambda b=b: wl_sd_step(b)) for b in (1, 2, 4, 8)},
     **{f"sd_step_b{b}_flash": (lambda b=b: wl_sd_step(b, attn="pallas"))
-       for b in (1, 4)},
+       for b in (1, 4, 8)},
     **{f"sd_vae_b{b}": (lambda b=b: wl_sd_vae(b)) for b in (1, 2, 4, 8)},
     "llama1b_prefill": lambda: wl_llama_prefill("1b"),
     "llama1b_decode": lambda: wl_llama_decode("1b"),
@@ -430,6 +480,7 @@ WORKLOADS: Dict[str, Callable[[], Tuple[Callable, Tuple, Dict]]] = {
     "flux_tp8_step": lambda: wl_flux_tp8(),
     "vllm_decode_b8": lambda: wl_vllm_decode("1b"),
     "mllama_decode_b1": lambda: wl_mllama_decode(),
+    "vllm_decode_70b_tp8": lambda: wl_vllm_decode_tp8(),
 }
 
 
@@ -492,7 +543,7 @@ def compose(rows: Dict[str, Dict]) -> Dict[str, Dict]:
                     "ttft_roofline_s": rows[pre]["t_roofline_s"],
                     "tpot_roofline_s": rows[dec]["t_roofline_s"],
                 }
-    for nm in ("vllm_decode_b8", "mllama_decode_b1"):
+    for nm in ("vllm_decode_b8", "mllama_decode_b1", "vllm_decode_70b_tp8"):
         if nm in rows:
             row = rows[nm]
             out[f"{nm}_tpot"] = {
@@ -719,14 +770,72 @@ def render_md(res: Dict[str, Any]) -> str:
               f"{INF2['sd_img_s']:.2f} img/s at {INF2['cost_hr']:.4f} $/hr, "
               f"scaled to the v5e's {hw['cost_hr']:.2f} $/hr).", ""]
     for b in (1, 2, 4, 8):
-        p = res["projections"].get(f"sd_b{b}")
-        if p:
-            lines.append(
-                f"- batch {b} coalesced: projected "
-                f"{_fmt(p.get('projected_per_s'))} img/s "
-                f"({_fmt(p.get('projected_per_dollar_vs_inf2'))}x per-$ "
-                f"vs inf2), roofline ceiling {_fmt(p['ceiling_per_s'])} "
-                f"img/s ({_fmt(p.get('ceiling_per_dollar_vs_inf2'))}x).")
+        for suffix, label in (("", "coalesced"), ("_flash", "+ flash")):
+            p = res["projections"].get(f"sd_b{b}{suffix}")
+            if p:
+                lines.append(
+                    f"- batch {b} {label}: projected "
+                    f"{_fmt(p.get('projected_per_s'))} img/s "
+                    f"({_fmt(p.get('projected_per_dollar_vs_inf2'))}x per-$ "
+                    f"vs inf2), roofline ceiling {_fmt(p['ceiling_per_s'])} "
+                    f"img/s ({_fmt(p.get('ceiling_per_dollar_vs_inf2'))}x).")
+    flux = res["projections"].get("flux_dev_tp8_28step")
+    if flux and flux.get("projected_s_per_call"):
+        lines += ["", "## Reference-stage comparison (flux)", "",
+                  f"The cova image stage serves Flux.1-dev 512^2 in 5.61 s "
+                  f"on an inf2.48xl TP=8 group (reference cova/README.md:98)."
+                  f" The modeled v5e-8 TP=8 28-step flux-dev render: "
+                  f"projected {_fmt(flux['projected_s_per_call'])} s "
+                  f"(ceiling {_fmt(1 / flux['ceiling_per_s'])} s) — "
+                  f"{_fmt(5.61 / flux['projected_s_per_call'], 1, 1)}x "
+                  f"faster at the projected eta.", ""]
+    # -- lever analysis, computed from the compiled evidence --------------
+    comp, cps = res["composed"], res["components"]
+    lines += ["", "## Levers (evidence-ranked)", ""]
+    b4, b4f = cps.get("sd_step_b4"), cps.get("sd_step_b4_flash")
+    if b4 and b4f:
+        lines.append(
+            f"- **Flash attention on every UNet level** (the sd21-tpub8 "
+            f"tier's `SHAI_ATTN_IMPL=pallas`): XLA-attention batched steps "
+            f"are HBM-bound on score traffic — flash cuts step bytes "
+            f"{b4['bytes_accessed'] / 1e9:.1f} -> "
+            f"{b4f['bytes_accessed'] / 1e9:.1f} GB at batch 4 and flips the "
+            f"bound to `{b4f['bound']}`. Largest single lever found; the "
+            f"round-3 on-chip micro-bench preferred XLA at batch 1-2, so "
+            f"the watcher re-measures in-situ (bench.py sd8) before this "
+            f"becomes the default below batch 4.")
+    best = None
+    for key in ("sd_b8_flash", "sd_b4_flash", "sd_b8"):
+        if key in comp and comp[key].get("t_roofline_s"):
+            best = key
+            break
+    if best and cal:
+        row = comp[best]
+        eta_needed = need_img_s * row["t_roofline_s"] / row["work"]
+        lines.append(
+            f"- **Coalescing depth**: throughput/image improves through the "
+            f"batch ladder (weight traffic amortizes; XLA fuses activations "
+            f"better at batch). Best modeled config `{best}`: ceiling "
+            f"{row['work'] / row['t_roofline_s']:.2f} img/s; reaching "
+            f"{need_img_s:.2f} img/s (2x/$) requires achieved-fraction "
+            f"eta >= **{eta_needed:.2f}** vs the {cal['eta_roofline']:.2f} "
+            f"measured at batch-1 — plausible for an MXU-bound batched "
+            f"executable, to be proven by the watcher's on-chip sd8 bench.")
+    b8 = cps.get("sd_step_b8") or b4
+    if b8:
+        share = b8.get("param_bytes", 0) / b8["bytes_accessed"]
+        lines.append(
+            f"- **int8 UNet: evaluated and rejected** — UNet weights are "
+            f"{b8.get('param_bytes', 0) / 1e9:.1f} GB of "
+            f"{b8['bytes_accessed'] / 1e9:.1f} GB accessed per batched step "
+            f"({share * 100:.0f}%); halving them moves the roofline by "
+            f"<{max(1, round(share * 50))}%. Decode LLMs are the opposite "
+            f"case (weights dominate): int8 already ships there, and the "
+            f"model shows it "
+            + (f"({cps['llama3b_decode']['t_roofline_s'] * 1e3:.0f} -> "
+               f"{cps['llama3b_int8_decode']['t_roofline_s'] * 1e3:.0f} "
+               f"ms/step on the 3B decode)."
+               if "llama3b_int8_decode" in cps else "."))
     if res.get("errors"):
         lines += ["", "## Errors", ""]
         lines += [f"- `{k}`: {v}" for k, v in res["errors"].items()]

@@ -161,11 +161,13 @@ def compile_workload(fn: Callable, args: Tuple, *,
                 mem[k] = int(v)
     except Exception:       # pragma: no cover - analysis is best-effort
         pass
+    # the deviceless TPU backend emits a meaningless negative sentinel for
+    # optimal_seconds — keep only physically-possible values
+    opt = float(ca.get("optimal_seconds", 0.0))
     return {
         "flops": float(ca.get("flops", 0.0)),
         "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
-        "optimal_seconds": float(ca["optimal_seconds"])
-        if "optimal_seconds" in ca else None,
+        "optimal_seconds": opt if opt > 0 else None,
         "utilization_operand0": ca.get("utilization operand 0 {}"),
         "memory": mem,
         "lower_s": round(t_lower, 2),

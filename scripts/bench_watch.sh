@@ -25,7 +25,7 @@ have() {  # have <key>: does RES already hold a real on-device result?
 note "watcher start (deadline in $(( (DEADLINE - $(date +%s)) / 60 )) min)"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   missing=""
-  for w in sd flux t5 mllama llama llama3b llama_int8 llama3b_int8; do
+  for w in sd sd8 flux t5 mllama llama llama3b llama3b_int8 llama_int8; do
     have "$w" || missing="$missing $w"
   done
   if [ -z "$missing" ]; then
@@ -36,6 +36,14 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     SD_BATCH_MAX=4 PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 3600 python \
       scripts/breaking_point.py --spawn sd --full --levels 1,2,4,8 \
       --duration 30 --platform tpu-v5e-1 --bank sd21-tpu \
+      2>&1 | grep -v WARNING | tee -a "$LOG"
+    # the batch-8 + flash throughput tier (69% of the weighted route): its
+    # projected row MUST be replaced by a measured ramp in the same session,
+    # or the rederived weights would mix measured and projected bases
+    SD_BATCH_MAX=8 SHAI_ATTN_IMPL=pallas PYTHONPATH=$PWD:${PYTHONPATH:-} \
+      timeout 3600 python \
+      scripts/breaking_point.py --spawn sd --full --levels 1,2,4,8,16 \
+      --duration 30 --platform tpu-v5e-1 --bank sd21-tpub8 \
       2>&1 | grep -v WARNING | tee -a "$LOG"
     # LLM tier TTFT/TPOT breaking point (VERDICT r4 #8): the engine unit
     # serving the 1B geometry (real shapes, no hub), gated on TTFT

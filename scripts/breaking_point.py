@@ -61,7 +61,20 @@ FULL_ENV = {
 }
 
 
+_LOADGEN_PATH = None
+
+
 def ensure_loadgen() -> str:
+    # memoized: run_level calls this per ramp level — rebuild once per
+    # process, not once per concurrency step
+    global _LOADGEN_PATH
+    if _LOADGEN_PATH is not None:
+        return _LOADGEN_PATH
+    _LOADGEN_PATH = _resolve_loadgen()
+    return _LOADGEN_PATH
+
+
+def _resolve_loadgen() -> str:
     if shutil.which("g++") is not None:
         # ALWAYS rebuild (-B): a pre-existing binary may predate report
         # fields the caller gates on (e.g. the ttfb percentiles behind

@@ -205,3 +205,48 @@ def test_no_invented_thresholds_left():
                 ctx = "\n".join(text.splitlines()[i - 2:i])
                 assert "breakpoint" in ctx, (
                     f"{name}: threshold without derivation comment")
+
+
+# ---------------------------------------------------------------------------
+# perf-model -> projected breakpoint rows (scripts/project_breakpoints.py)
+# ---------------------------------------------------------------------------
+
+pb_mod = _load("project_breakpoints",
+               os.path.join(ROOT, "scripts", "project_breakpoints.py"))
+
+
+def _perf_fixture():
+    return {
+        "calibration": {"eta_roofline": 0.5},
+        "composed": {
+            "sd_b4": {"t_roofline_s": 0.8, "work": 4},
+            "sd_b8_flash": {"t_roofline_s": 1.2, "work": 8},
+        },
+        "components": {
+            "vllm_decode_b8": {"t_roofline_s": 0.010, "batch": 8},
+            "llama1b_prefill": {"t_roofline_s": 0.020},
+        },
+    }
+
+
+def test_project_rows_math():
+    rows = pb_mod.project_rows(_perf_fixture())
+    # sd b4: t_call = 0.8/0.5 = 1.6s -> 2.5 RPS, over the 900ms SLO
+    sd = rows["sd21-tpu"]
+    assert sd["projected"] is True
+    assert sd["breakpoint"]["rps"] == pytest.approx(4 / 1.6)
+    assert sd["breakpoint"]["over_threshold_at_c1"] is True
+    # b8 flash tier
+    assert rows["sd21-tpub8"]["breakpoint"]["rps"] == pytest.approx(8 / 2.4, abs=1e-3)
+    # vllm: t_req = 0.04 + 16*0.02 = 0.36 -> 22.2 RPS; TTFT/TPOT recorded
+    v = rows["vllm-tpu"]
+    assert v["breakpoint"]["rps"] == pytest.approx(8 / 0.36, abs=0.01)
+    assert v["breakpoint"]["ttfb_p50"] == pytest.approx(0.04)
+    assert v["breakpoint"]["tpot"] == pytest.approx(0.02)
+    assert v["slo"] == "ttfb"
+
+
+def test_project_rows_require_calibration():
+    with pytest.raises(SystemExit):
+        pb_mod.project_rows({"calibration": None, "composed": {},
+                             "components": {}})

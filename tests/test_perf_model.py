@@ -146,3 +146,9 @@ def test_paged_decode_tiny_lowers_for_tpu():
                           lambda: pm.wl_mllama_decode(tiny=True),
                           verbose=False)
     assert row["family"] == "mllama" and row["bytes_accessed"] > 0
+    # the TP-sharded variant: shard_map'd paged kernel + EngineShardings
+    # must partition AND lower for the real XLA:TPU backend
+    row = pm.run_workload("tp_dec_tiny",
+                          lambda: pm.wl_vllm_decode_tp8(tiny=True),
+                          verbose=False)
+    assert row["n_devices"] == 2 and row["bytes_accessed"] > 0
