@@ -30,6 +30,11 @@ UNITS = [
     # (pow2 buckets, per-request seeds preserved) — the throughput/$ lever
     # the breaking-point ramp measures; batch-4 activations fit the chip
     # with the bf16 UNet (core.budget accounting)
+    # latency tier keeps the MEASURED on-chip dispatch policy (r3
+    # perf_attn: XLA attention won at batch 1-2, which is what this tier
+    # serves at low occupancy). The perf model says flash wins at batch 4
+    # (PERF_MODEL.md) — the watcher's measured ramp decides before flash
+    # becomes this tier's default; the batch-8 tier below already runs it.
     ("sd21", "sd", "tpu", {"MODEL_ID": "stabilityai/stable-diffusion-2-1-base",
                            "HEIGHT": "512", "WIDTH": "512",
                            "NUM_INFERENCE_STEPS": "25",

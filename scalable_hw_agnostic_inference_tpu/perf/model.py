@@ -290,51 +290,69 @@ def wl_vllm_decode(geometry: str = "1b", *, quant: bool = False,
 
 
 def _paged_decode(cfg, name: str, *, quant: bool, batch: int, ctx: int,
-                  block_size: int, lv: int):
-    """Shared paged-decode workload assembly.
+                  block_size: int, lv: int, tp: int = 1):
+    """Shared paged-decode workload assembly (single-device or TP-sharded).
 
     The KV pool is sized to exactly the bucketed context in use
     (1 null block + batch x ctx blocks): XLA's cost analysis counts a
     Pallas custom call's whole pool operand as accessed, so an over-sized
     pool would overstate HBM traffic; at full occupancy pool size == true
-    working set."""
-    from ..engine.runner import make_decode
+    working set.
+
+    ``tp > 1`` compiles the REAL sharded serving path: EngineShardings over
+    a tp-wide topology mesh, plain avals (placement comes from the jit's
+    in_shardings exactly as in serving), per-device cost numbers."""
+    from ..engine.runner import EngineShardings, make_decode
     from ..models import llama as llama_mod
 
     m_ctx = max(1, ctx // block_size)
     n_cross = len(cfg.cross_attention_layers)
     n_self = cfg.n_layers - n_cross
+    params_avals = topo.abstract_params(
+        lambda: llama_mod.geometry_params(cfg, quant=quant))
+    if tp > 1:
+        mesh = topo.device_mesh(tp, axes=("tp",))
+        sh = EngineShardings(mesh, params_avals, cfg)
+        s = None
+    else:
+        sh = None
+        s = _repl(topo.device_mesh(1))
     fn = make_decode(cfg, block_size, m_ctx, batch, ctx_blocks=m_ctx,
-                     paged=True)
-    mesh = topo.device_mesh(1)
-    s = _repl(mesh)
-    params = topo.with_sharding(topo.abstract_params(
-        lambda: llama_mod.geometry_params(cfg, quant=quant)), s)
-    pool_blocks = 1 + batch * m_ctx
-    pool = jax.ShapeDtypeStruct(
-        (pool_blocks, block_size, cfg.n_kv_heads, cfg.head_dim),
-        jnp.bfloat16, sharding=s)
+                     shardings=sh, paged=True)
+
+    def aval(shape, dtype):
+        if s is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=s)
+
+    def atree(build):
+        t = topo.abstract_params(build)
+        return t if s is None else topo.with_sharding(t, s)
+
+    params = atree(lambda: llama_mod.geometry_params(cfg, quant=quant))
+    pool = aval((1 + batch * m_ctx, block_size, cfg.n_kv_heads,
+                 cfg.head_dim), jnp.bfloat16)
     kv = [{"k": pool, "v": pool} for _ in range(n_self)]
-    vec = lambda dt: jax.ShapeDtypeStruct((batch,), dt, sharding=s)  # noqa: E731
+    vec = lambda dt: aval((batch,), dt)  # noqa: E731
     args = (params, kv, vec(jnp.int32), vec(jnp.int32),
-            jax.ShapeDtypeStruct((batch, m_ctx), jnp.int32, sharding=s),
-            vec(jnp.bool_),
-            topo.with_sharding(topo.abstract_params(
-                lambda: jax.random.PRNGKey(0)), s),
+            aval((batch, m_ctx), jnp.int32), vec(jnp.bool_),
+            atree(lambda: jax.random.PRNGKey(0)),
             vec(jnp.float32), vec(jnp.int32), vec(jnp.float32))
     if n_cross:
-        cbuf = jax.ShapeDtypeStruct(
-            (batch, lv, cfg.n_kv_heads, cfg.head_dim), jnp.bfloat16,
-            sharding=s)
+        cbuf = aval((batch, lv, cfg.n_kv_heads, cfg.head_dim), jnp.bfloat16)
         args += ([{"k": cbuf, "v": cbuf} for _ in range(n_cross)],
                  vec(jnp.float32), vec(jnp.int32), vec(jnp.int32))
-    return fn, args, {
+    meta = {
         "family": "mllama" if n_cross else "llama",
         "component": "paged_decode_step", "batch": batch,
-        "param_bytes": _tree_bytes(params),
+        "param_bytes": _tree_bytes(params_avals),
         "detail": f"{name} paged-engine decode step bs={batch} "
                   f"ctx={m_ctx * block_size}"
-                  + (f" cross Lv={lv}" if n_cross else "")}
+                  + (f" cross Lv={lv}" if n_cross else "")
+                  + (f" tp={tp}; per-device numbers" if tp > 1 else "")}
+    if tp > 1:
+        meta["n_devices"] = tp
+    return fn, args, meta
 
 
 def wl_vllm_decode_tp8(*, tiny: bool = False):
@@ -344,40 +362,15 @@ def wl_vllm_decode_tp8(*, tiny: bool = False):
     neither the CPU lowering legs (no Mosaic) nor interpret mode can: the
     shard_map'd Pallas kernel and the EngineShardings placement must
     partition AND lower for real XLA:TPU."""
-    from ..engine.runner import EngineShardings, make_decode
     from ..models import llama as llama_mod
 
     if tiny:
         cfg = llama_mod.LlamaConfig(**_TINY_DECODE_KW)
-        tp, batch, ctx, block_size, quant = 2, 2, 32, 8, False
-    else:
-        cfg = llama_mod.LlamaConfig.llama3_70b()
-        tp, batch, ctx, block_size, quant = 8, 8, 1024, 128, True
-    mesh = topo.device_mesh(tp, axes=("tp",))
-    params = topo.abstract_params(
-        lambda: llama_mod.geometry_params(cfg, quant=quant))
-    sh = EngineShardings(mesh, params, cfg)
-    m_ctx = ctx // block_size
-    fn = make_decode(cfg, block_size, m_ctx, batch, ctx_blocks=m_ctx,
-                     shardings=sh, paged=True)
-    pool = jax.ShapeDtypeStruct(
-        (1 + batch * m_ctx, block_size, cfg.n_kv_heads, cfg.head_dim),
-        jnp.bfloat16)
-    kv = [{"k": pool, "v": pool} for _ in range(cfg.n_layers)]
-    vec = lambda dt: jax.ShapeDtypeStruct((batch,), dt)  # noqa: E731
-    # plain avals: placement comes from the jit's in_shardings (the REAL
-    # serving path), not per-aval annotations
-    args = (params, kv, vec(jnp.int32), vec(jnp.int32),
-            jax.ShapeDtypeStruct((batch, m_ctx), jnp.int32),
-            vec(jnp.bool_),
-            topo.abstract_params(lambda: jax.random.PRNGKey(0)),
-            vec(jnp.float32), vec(jnp.int32), vec(jnp.float32))
-    name = "llama-tiny" if tiny else "llama-70b-int8"
-    return fn, args, {
-        "family": "llama", "component": "paged_decode_step", "batch": batch,
-        "n_devices": tp, "param_bytes": _tree_bytes(params),
-        "detail": f"{name} paged decode step tp={tp} bs={batch} "
-                  f"ctx={m_ctx * block_size}; per-device numbers"}
+        return _paged_decode(cfg, "llama-tiny", quant=False, batch=2,
+                             ctx=32, block_size=8, lv=0, tp=2)
+    cfg = llama_mod.LlamaConfig.llama3_70b()
+    return _paged_decode(cfg, "llama-70b-int8", quant=True, batch=8,
+                         ctx=1024, block_size=128, lv=0, tp=8)
 
 
 def wl_t5(*, batch: int = 32, seq: int = 128, tiny: bool = False):

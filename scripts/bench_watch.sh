@@ -37,9 +37,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       scripts/breaking_point.py --spawn sd --full --levels 1,2,4,8 \
       --duration 30 --platform tpu-v5e-1 --bank sd21-tpu \
       2>&1 | grep -v WARNING | tee -a "$LOG"
-    # the batch-8 + flash throughput tier (69% of the weighted route): its
-    # projected row MUST be replaced by a measured ramp in the same session,
-    # or the rederived weights would mix measured and projected bases
+    # the batch-8 + flash throughput tier (the majority share of the
+    # weighted route per derived_weights.json): its projected row MUST be
+    # replaced by a measured ramp in the same session, or the rederived
+    # weights would mix measured and projected bases
     SD_BATCH_MAX=8 SHAI_ATTN_IMPL=pallas PYTHONPATH=$PWD:${PYTHONPATH:-} \
       timeout 3600 python \
       scripts/breaking_point.py --spawn sd --full --levels 1,2,4,8,16 \

@@ -67,6 +67,8 @@ def project_rows(perf: dict) -> dict:
             r["breakpoint"]["over_threshold_at_c1"] = True
         return r
 
+    # the latency tier serves the measured (non-flash) dispatch, so its
+    # projection must use the matching executables
     r = sd_row("sd_b4", 4, "coalesced batch-4 denoise+VAE projection")
     if r:
         out["sd21-tpu"] = r

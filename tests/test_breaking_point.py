@@ -220,6 +220,7 @@ def _perf_fixture():
         "calibration": {"eta_roofline": 0.5},
         "composed": {
             "sd_b4": {"t_roofline_s": 0.8, "work": 4},
+            "sd_b4_flash": {"t_roofline_s": 0.6, "work": 4},
             "sd_b8_flash": {"t_roofline_s": 1.2, "work": 8},
         },
         "components": {
@@ -231,10 +232,12 @@ def _perf_fixture():
 
 def test_project_rows_math():
     rows = pb_mod.project_rows(_perf_fixture())
-    # sd b4: t_call = 0.8/0.5 = 1.6s -> 2.5 RPS, over the 900ms SLO
+    # sd21-tpu (latency tier, measured dispatch): uses the NON-flash b4
+    # executables; t_call = 0.8/0.5 = 1.6s -> 2.5 RPS, over the 900ms SLO
     sd = rows["sd21-tpu"]
     assert sd["projected"] is True
-    assert sd["breakpoint"]["rps"] == pytest.approx(4 / 1.6)
+    assert "flash" not in sd["basis"]
+    assert sd["breakpoint"]["rps"] == pytest.approx(4 / 1.6, abs=1e-3)
     assert sd["breakpoint"]["over_threshold_at_c1"] is True
     # b8 flash tier
     assert rows["sd21-tpub8"]["breakpoint"]["rps"] == pytest.approx(8 / 2.4, abs=1e-3)
