@@ -160,6 +160,29 @@ class StableDiffusion:
 
         return denoise
 
+    def _decode_body(self, vae_params, lat: jax.Array) -> jax.Array:
+        """VAE decode + uint8 quantize inside a pipeline trace.
+
+        On TPU, batches 2-4 decode per-image via ``lax.map``: XLA:TPU's
+        fused batch-2/4 VAE decode is HBM-pathological — the offline cost
+        model measured 115 GB accessed at batch 4 fused vs 35 GB as four
+        single-image decodes (PERF_MODEL.md, sd_vae_b4 vs sd_vae_b4_split;
+        batch 8 fuses fine at 30 GB). The split is platform-gated like the
+        attention dispatch (only measured on XLA:TPU); row independence is
+        exact either way (decode is per-image), covered by the
+        composition-invariance test.
+        """
+        from ..ops.attention import on_tpu_platform
+
+        def dec(z):
+            img = self.vae.apply(vae_params, z, method=AutoencoderKL.decode)
+            img = jnp.clip(img * 127.5 + 127.5, 0.0, 255.0)
+            return jnp.round(img).astype(jnp.uint8)
+
+        if 2 <= lat.shape[0] <= 4 and on_tpu_platform():
+            return jax.lax.map(lambda z: dec(z[None])[0], lat)
+        return dec(lat)
+
     def _build_pipeline(self, B: int, h: int, w: int, steps: int) -> Callable:
         """Denoise scan + VAE decode + uint8 quantize as ONE executable.
 
@@ -168,13 +191,10 @@ class StableDiffusion:
         chip sits behind a network tunnel).
         """
         denoise = self._denoise_body(B, h, w, steps)
-        vae = self.vae
 
         def full(unet_params, vae_params, ctx2, rng, guidance):
             lat = denoise(unet_params, ctx2, rng, guidance)
-            img = vae.apply(vae_params, lat, method=AutoencoderKL.decode)
-            img = jnp.clip(img * 127.5 + 127.5, 0.0, 255.0)
-            return jnp.round(img).astype(jnp.uint8)
+            return self._decode_body(vae_params, lat)
 
         return jax.jit(full)
 
@@ -197,7 +217,6 @@ class StableDiffusion:
         sch = self.scheduler
         tables = sch.tables(steps)
         one = self._make_step(B)
-        vae = self.vae
 
         def full(unet_params, vae_params, ctx2, latents, guidance):
             def body(lat, xs):
@@ -205,9 +224,7 @@ class StableDiffusion:
                 return one(unet_params, lat, t, a, a2, ctx2, guidance), None
 
             lat, _ = jax.lax.scan(body, latents, tables)
-            img = vae.apply(vae_params, lat, method=AutoencoderKL.decode)
-            img = jnp.clip(img * 127.5 + 127.5, 0.0, 255.0)
-            return jnp.round(img).astype(jnp.uint8)
+            return self._decode_body(vae_params, lat)
 
         return jax.jit(full)
 

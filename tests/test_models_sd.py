@@ -324,3 +324,37 @@ def test_variant_registry():
     assert v.unet.cross_attention_dim == 1024
     assert v.schedule.prediction_type == "epsilon"
     assert sd_mod.SDVariant.sd21().schedule.prediction_type == "v_prediction"
+
+
+def test_decode_body_split_path_matches_fused():
+    """On the TPU target, batches 2-4 VAE-decode per image via lax.map
+    (XLA:TPU's fused batch-2/4 decode is HBM-pathological — PERF_MODEL.md);
+    the split path must be BIT-EXACT vs decoding each image standalone
+    (identical per-image graphs), and match the fused batch to within a few
+    uint8 LSBs (fusion order changes float associativity)."""
+    import os
+
+    variant = sd_mod.SDVariant.tiny()
+    pipe = sd_mod.StableDiffusion(variant, None, None, None)
+    vae_params = pipe.vae.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4, 4, variant.vae.latent_channels)))
+    z = jax.random.normal(jax.random.PRNGKey(1),
+                          (3, 4, 4, variant.vae.latent_channels), jnp.float32)
+    fused = np.asarray(pipe._decode_body(vae_params, z))   # cpu: fused
+    old = os.environ.get("SHAI_PLATFORM_OVERRIDE")
+    os.environ["SHAI_PLATFORM_OVERRIDE"] = "tpu"           # forces the map path
+    try:
+        split = np.asarray(pipe._decode_body(vae_params, z))
+    finally:
+        if old is None:
+            os.environ.pop("SHAI_PLATFORM_OVERRIDE", None)
+        else:
+            os.environ["SHAI_PLATFORM_OVERRIDE"] = old
+    per_image = np.stack([
+        np.asarray(pipe._decode(vae_params, z[i:i + 1]))[0]
+        for i in range(z.shape[0])])
+    np.testing.assert_array_equal(split, per_image)
+    diff = np.abs(fused.astype(np.int16) - split.astype(np.int16))
+    # vs the fused batch: a few LSBs of reassociation drift, nothing
+    # structural (tiny random weights amplify it vs real checkpoints)
+    assert diff.max() <= 3, f"max pixel diff {diff.max()}"
