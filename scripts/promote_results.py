@@ -17,6 +17,7 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 KEYS = {"sd": "sd21_img_s",
+        "sd8": "sd8_flash_img_s",
         "flux": "flux_scaled_img_s",
         "t5": "t5_embed_seq_s",
         "mllama": "mllama_caption_tok_s",

@@ -51,7 +51,7 @@ def test_error_and_malformed_entries_are_not_real():
 
 def test_watched_keys_cover_all_bench_variants():
     # VERDICT r3 weak #2: a banked on-chip SD number must publish too
-    assert {"sd", "flux", "t5", "mllama", "llama", "llama3b",
+    assert {"sd", "sd8", "flux", "t5", "mllama", "llama", "llama3b",
             "llama_int8", "llama3b_int8"} <= set(promote.KEYS)
 
 

@@ -121,6 +121,16 @@ def test_tiny_workload_compiles_against_v5e_topology():
     assert row["t_roofline_s"] > 0
     # XLA:TPU's own latency estimate comes back with the executable
     assert row["optimal_seconds"] is None or row["optimal_seconds"] > 0
+    # the split-VAE variant: the lax.map body is counted once by XLA, so
+    # run_workload must scale by the declared trip count
+    fused = pm.run_workload("vae_tiny", lambda: pm.wl_sd_vae(2, tiny=True),
+                            verbose=False)
+    split = pm.run_workload("vae_tiny_split",
+                            lambda: pm.wl_sd_vae(2, tiny=True, split=True),
+                            verbose=False)
+    assert split["flops"] > 0
+    # trip-scaled: split ~ 2x the single-image body, same order as fused
+    assert 0.2 < split["flops"] / max(fused["flops"], 1) < 5
 
 
 @pytest.mark.skipif(not _topology_available(),
