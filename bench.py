@@ -42,7 +42,7 @@ if INNER:
 SD_BASELINE_IMG_S = 1.0 / 0.67
 #: one unit mapping for the measurement AND crash paths
 UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
-                  "mllama": "tokens/sec",
+                  "mllama": "tokens/sec", "llama_spec": "tokens/sec",
                   "sd": "images/sec", "sd8": "images/sec",
                   "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
@@ -61,6 +61,8 @@ def _which_from_argv(argv) -> str:
     the child arg forwarding, the banked-result lookup, main(), and the
     crash handler (five call sites that previously each hand-rolled it and
     drifted)."""
+    if "llama_spec" in argv:  # before the llama prefix match below
+        return "llama_spec"
     if any(a.startswith("llama") for a in argv):
         return "llama"
     for k in ("flux", "t5", "mllama", "sd8"):
@@ -301,6 +303,82 @@ def bench_llama(tiny: bool) -> dict:
         "unit": "tokens/sec",
         "vs_baseline": round(toks / base, 3) if base else 1.0,
     })
+
+
+def bench_llama_spec(tiny: bool) -> dict:
+    """Speculative decoding tokens/sec through the paged engine: prompt-
+    lookup ([ngram]) drafting with num_speculative_tokens=4, verified by the
+    multi-token executable (engine/runner.py make_verify) — the PR-1
+    tentpole's measured number. The workload is repetitive prompts (the
+    regime prompt lookup targets: extraction/summarization-style requests
+    whose output quotes the input); the line carries the realized
+    acceptance_rate and tokens_per_verify so the perf model's
+    acceptance-dependent projection (perf/model.py spec_decode_model) can be
+    checked against an on-chip measurement, not just the roofline.
+    """
+    import numpy as np
+
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+    from scalable_hw_agnostic_inference_tpu.models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig.tiny()
+        ecfg = EngineConfig(max_model_len=128, max_num_seqs=2, block_size=8,
+                            context_encoding_buckets=(32,),
+                            max_new_tokens=32,
+                            speculative_model="[ngram]",
+                            num_speculative_tokens=4)
+        batch, prompt_len, new = 2, 24, 24
+        name = "llama-tiny-spec"
+    else:
+        cfg = llama_mod.LlamaConfig.llama32_1b()
+        ecfg = EngineConfig(max_model_len=1024, max_num_seqs=4,
+                            block_size=16, context_encoding_buckets=(128,),
+                            max_new_tokens=128,
+                            speculative_model="[ngram]",
+                            num_speculative_tokens=4)
+        batch, prompt_len, new = 4, 128, 128
+        name = "llama3.2-1b-geometry-spec"
+
+    params = llama_mod.geometry_params(cfg, quant=False)
+    eng = LLMEngine(cfg, params, ecfg)
+    rng = np.random.default_rng(0)
+    base = rng.integers(3, cfg.vocab_size, 16).tolist()
+    prompt = (base * ((prompt_len // 16) + 1))[:prompt_len]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=new)
+
+    def run():
+        for _ in range(batch):
+            eng.add_request(prompt, sp)
+        fins = []
+        while eng.has_work:
+            fins += eng.step()
+        assert len(fins) == batch
+        assert all(len(f.token_ids) == new for f in fins)
+
+    run()   # warm: prefill + decode + verify executables
+    runs = 3
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        run()
+    dt = (time.perf_counter() - t0) / runs
+    val = round(batch * new / dt, 2)
+    base_v = _published("llama_spec_tps")
+    out = _dollars({
+        "metric": f"{name} spec-decode tok/s (bs={batch}, k=4, ngram, "
+                  f"{jax.devices()[0].platform})",
+        "value": val,
+        "unit": "tokens/sec",
+        "vs_baseline": round(val / base_v, 3) if base_v else 1.0,
+    })
+    out["acceptance_rate"] = round(eng.spec.acceptance_rate, 4)
+    out["tokens_per_verify"] = round(eng.spec.tokens_per_verify, 4)
+    out["spec_fallback_steps"] = eng.spec.fallback_steps
+    return out
 
 
 def bench_flux(tiny: bool) -> dict:
@@ -559,7 +637,8 @@ def inner_main() -> None:
         )
 
         enable_persistent_cache_from_env()
-    out = {"llama": bench_llama, "flux": bench_flux, "t5": bench_t5,
+    out = {"llama": bench_llama, "llama_spec": bench_llama_spec,
+           "flux": bench_flux, "t5": bench_t5,
            "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)
     # structured platform provenance: is_real() keys off this, never off

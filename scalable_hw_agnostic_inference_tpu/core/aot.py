@@ -36,6 +36,17 @@ def enable_persistent_cache(cache_dir: str) -> None:
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    try:
+        # any compile BEFORE the dir was set latches the cache module
+        # disabled for the whole process (observed on jax 0.4.x): an
+        # in-process compilectl would then warm NOTHING while reporting
+        # success. Reset so the next compile re-initializes against the
+        # directory just configured.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - private API moved
+        pass
 
 
 def enable_persistent_cache_from_env() -> None:

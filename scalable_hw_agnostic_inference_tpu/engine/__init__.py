@@ -11,3 +11,4 @@ sampling, and a continuous-batching scheduler — all static-shaped for XLA.
 
 from .cache import BlockAllocator, PagedKVCache  # noqa: F401
 from .config import EngineConfig  # noqa: F401
+from .speculative import PromptLookupDrafter, SpecStats  # noqa: F401

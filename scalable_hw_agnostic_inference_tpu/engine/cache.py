@@ -265,6 +265,31 @@ class PagedKVCache:
         alloc.n_tokens += n_new
         return alloc
 
+    def shrink(self, seq_id: int, n_remove: int) -> SeqAllocation:
+        """Roll back the last ``n_remove`` reserved tokens, freeing trailing
+        blocks the shorter sequence no longer needs.
+
+        Speculative decoding reserves ``1 + k`` tokens optimistically before
+        verification; rejected drafts give their reservation back here so a
+        partially-accepted step can't leak pool blocks. Only freshly
+        allocated decode-tail blocks are ever in the rollback range —
+        prefix-cache-shared blocks live at the FRONT of the allocation
+        (``admit`` places ``reuse + fresh``) and a sequence never shrinks
+        below its already-committed token count, so a shared block's
+        refcount is never touched from here.
+        """
+        alloc = self._seqs[seq_id]
+        if n_remove <= 0:
+            return alloc
+        assert n_remove <= alloc.n_tokens, "shrink below zero tokens"
+        alloc.n_tokens -= n_remove
+        keep = self._blocks_needed(alloc.n_tokens)
+        if keep < len(alloc.blocks):
+            tail = alloc.blocks[keep:]
+            del alloc.blocks[keep:]
+            self.allocator.free(tail)
+        return alloc
+
     def release(self, seq_id: int) -> None:
         alloc = self._seqs.pop(seq_id)
         self.allocator.free(alloc.blocks)  # cached blocks survive (cache ref)

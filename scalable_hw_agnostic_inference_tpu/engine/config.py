@@ -41,6 +41,15 @@ class EngineConfig:
     global_topk: int = 64
     max_new_tokens: int = 128
     seed: int = 0
+    # speculative decoding (the vLLM knobs): "[ngram]" enables model-free
+    # prompt-lookup drafting; each decode step then verifies up to
+    # num_speculative_tokens drafted tokens in ONE multi-position executable
+    # (engine/speculative.py). "" = off.
+    speculative_model: str = ""
+    num_speculative_tokens: int = 0
+    # n-gram window the drafter matches against prompt+generated history
+    ngram_prompt_lookup_max: int = 4
+    ngram_prompt_lookup_min: int = 1
 
     def __post_init__(self):
         if self.block_size < 1:
@@ -58,10 +67,48 @@ class EngineConfig:
             raise ValueError(
                 f"prefill buckets {misaligned} not multiples of "
                 f"block_size={self.block_size}")
+        # token_generation_buckets get the SAME shape discipline as the
+        # prefill buckets: a decode executable compiled past max_model_len
+        # (or off block alignment) would warm a window no sequence can
+        # reach — or worse, mis-size its block-table slice
+        bad = [b for b in self.token_generation_buckets
+               if b > self.max_model_len]
+        if bad:
+            raise ValueError(
+                f"token_generation_buckets {bad} exceed max_model_len")
+        misaligned = [b for b in self.token_generation_buckets
+                      if b < 1 or b % self.block_size]
+        if misaligned:
+            raise ValueError(
+                f"token_generation_buckets {misaligned} not positive "
+                f"multiples of block_size={self.block_size}")
         if self.quantization not in (None, "", "int8"):
             raise ValueError(
                 f"unsupported quantization {self.quantization!r} "
                 f"(supported: int8)")
+        if self.speculative_model not in ("", "[ngram]"):
+            raise ValueError(
+                f"unsupported speculative_model "
+                f"{self.speculative_model!r} (supported: \"[ngram]\")")
+        if self.num_speculative_tokens < 0:
+            raise ValueError("num_speculative_tokens must be >= 0")
+        if self.speculative_model and self.num_speculative_tokens:
+            if not (1 <= self.ngram_prompt_lookup_min
+                    <= self.ngram_prompt_lookup_max):
+                raise ValueError(
+                    f"need 1 <= ngram_prompt_lookup_min "
+                    f"({self.ngram_prompt_lookup_min}) <= "
+                    f"ngram_prompt_lookup_max "
+                    f"({self.ngram_prompt_lookup_max})")
+            if self.num_speculative_tokens >= self.max_model_len:
+                raise ValueError(
+                    "num_speculative_tokens must be < max_model_len")
+
+    @property
+    def speculative_enabled(self) -> bool:
+        """Speculative decoding is live: both vLLM knobs set (a drafter
+        named but k == 0 means vanilla decode, matching vLLM)."""
+        return bool(self.speculative_model) and self.num_speculative_tokens > 0
 
     @property
     def blocks_per_seq(self) -> int:

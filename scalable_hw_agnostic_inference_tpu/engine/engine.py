@@ -111,6 +111,23 @@ class LLMEngine:
               for t in ecfg.token_generation_buckets]
         self._ctx_buckets = sorted(set(tg) | {ecfg.blocks_per_seq})
         self._decode_fns: Dict[Tuple[int, int], Any] = {}
+        # speculative decoding: a host-side prompt-lookup drafter plus one
+        # multi-token verify executable per (ctx_bucket, batch_bucket) —
+        # same dispatch grid as decode, k+1 positions per call
+        self._verify_fns: Dict[Tuple[int, int], Any] = {}
+        self._drafter = None
+        self.spec = None
+        if ecfg.speculative_enabled:
+            from .speculative import PromptLookupDrafter, SpecStats
+
+            self._drafter = PromptLookupDrafter(
+                ecfg.num_speculative_tokens,
+                ecfg.ngram_prompt_lookup_max, ecfg.ngram_prompt_lookup_min)
+            self.spec = SpecStats()
+            # rejection-sampling uniforms (temperature > 0 acceptance):
+            # host-side, own stream — device rng folds stay byte-identical
+            # to vanilla decode
+            self._spec_rng = np.random.default_rng(ecfg.seed + 0x5EC)
         self._sample1 = jax.jit(sample_logits)
         from .runner import token_logprobs
 
@@ -718,9 +735,27 @@ class LLMEngine:
                 bb, ctx_blocks=m, shardings=self.shardings)
         return bb, self._decode_fns[key]
 
+    def _verify_for(self, m_blocks: int, n_active: int = -1):
+        """Speculative verify executable for the smallest (context, batch)
+        buckets covering the running set — the same dispatch rule as
+        ``_decode_for``, k+1 scored positions per sequence."""
+        from .runner import make_verify
+
+        m = next(b for b in self._ctx_buckets if b >= m_blocks)
+        bb = (self.ecfg.max_num_seqs if n_active < 0
+              else self._batch_bucket(n_active))
+        key = (m, bb)
+        if key not in self._verify_fns:
+            self._verify_fns[key] = make_verify(
+                self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
+                bb, self.ecfg.num_speculative_tokens, ctx_blocks=m,
+                shardings=self.shardings)
+        return bb, self._verify_fns[key]
+
     @property
     def n_executables(self) -> int:
-        return len(self._prefill) + len(self._decode_fns)
+        return (len(self._prefill) + len(self._decode_fns)
+                + len(self._verify_fns))
 
     def _preempt_lowest(self) -> None:
         """Recompute-preempt the most recently admitted sequence."""
@@ -783,16 +818,22 @@ class LLMEngine:
             already_lp=(victim.req.already_lp + victim.lps
                         if p.logprobs else [])))
 
-    def _decode_step(self) -> None:
-        M = self.ecfg.blocks_per_seq
-        # grow each running seq by one slot for the pending token; preempt on
-        # pool exhaustion (never preempt down to zero running sequences)
+    def _grow_running(self, n_ext_for) -> None:
+        """Reserve ``n_ext_for(slot)`` cache tokens for every decoding slot,
+        recompute-preempting on pool exhaustion (never down to zero running
+        sequences) — THE reservation step of both decode paths."""
         for s in list(self.slots):
             if s is None or s.prefill_cursor is not None:
                 continue  # mid-prefill slots neither grow nor decode yet
+            if self.slots[s.slot] is not s:
+                # an EARLIER iteration's pool pressure preempted this slot:
+                # its sequence is already released — extending it would
+                # KeyError and kill the whole engine step
+                continue
+            n_ext = n_ext_for(s)
             while True:
                 try:
-                    self.cache.extend(s.req.req_id, 1)
+                    self.cache.extend(s.req.req_id, n_ext)
                     break
                 except MemoryError:
                     if sum(x is not None for x in self.slots) <= 1:
@@ -800,53 +841,213 @@ class LLMEngine:
                     self._preempt_lowest()
                     if self.slots[s.slot] is not s:
                         break  # s itself was preempted
-            if self.slots[s.slot] is not s:
-                continue
 
-        running = [s for s in self.slots
-                   if s is not None and s.prefill_cursor is None]
-        if not running:
-            return
-        n_active = len(running)
+    def _running_slots(self) -> List["_Running"]:
+        return [s for s in self.slots
+                if s is not None and s.prefill_cursor is None]
+
+    def _max_ctx_blocks(self, running) -> int:
         m_blocks = 1
         for s in running:
             m_blocks = max(m_blocks, self.cache._blocks_needed(
                 self.cache.seq(s.req.req_id).n_tokens))
-        Bb, decode = self._decode_for(m_blocks, n_active)
+        return m_blocks
 
-        # compact the active slots into the first n_active batch rows; the
-        # pool is slot-agnostic (block tables are data), so only the batch
-        # view compacts — padding rows write harmlessly into null block 0
+    def _marshal_running(self, running, Bb: int) -> Dict[str, np.ndarray]:
+        """Compact the active slots into the first ``len(running)`` batch
+        rows — the pool is slot-agnostic (block tables are data), so only
+        the batch view compacts; padding rows carry null tables and write
+        harmlessly into reserved block 0. Shared by decode and verify;
+        callers add their own token/position arrays."""
+        M = self.ecfg.blocks_per_seq
+        a = {
+            "tables": np.zeros((Bb, M), np.int32),
+            "active": np.zeros((Bb,), bool),
+            "temp": np.ones((Bb,), np.float32),
+            "topk": np.zeros((Bb,), np.int32),
+            "topp": np.ones((Bb,), np.float32),
+            "slot_idx": np.zeros((Bb,), np.int32),
+            "has_image": np.zeros((Bb,), np.float32),
+            "cross_len": np.full((Bb,), max(self.cross_seq_len, 1),
+                                 np.int32),
+        }
+        for i, s in enumerate(running):
+            a["tables"][i] = self.cache.seq(s.req.req_id).table(M)
+            a["active"][i] = True
+            a["temp"][i] = s.req.params.temperature
+            a["topk"][i] = s.req.params.top_k
+            a["topp"][i] = s.req.params.top_p
+            a["slot_idx"][i] = s.slot
+            a["has_image"][i] = self._has_image[s.slot]
+            a["cross_len"][i] = self._cross_len[s.slot]
+        return a
+
+    def _spec_step(self) -> bool:
+        """One speculative decode step: draft per running slot, verify all
+        drafts (+ the bonus position) in one multi-token executable, commit
+        the longest model-agreed prefix, roll back the rest.
+
+        Returns False — without touching the cache — when no slot drafted
+        anything; the caller falls through to the vanilla single-token
+        decode executable (one dispatch, no k+1 overcompute).
+        """
+        k = self.ecfg.num_speculative_tokens
+        running = self._running_slots()
+        if not running:
+            return False
+        drafts: Dict[int, List[int]] = {}
+        for s in running:
+            p = s.req.params
+            # a draft must leave room for its own commit: stay inside the
+            # request's token budget AND the model-length budget (the cache
+            # reservation below must never trip the max_model_len guard)
+            cap = min(k, p.max_new_tokens - len(s.generated) - 1,
+                      self.ecfg.max_model_len
+                      - self.cache.seq(s.req.req_id).n_tokens - 1)
+            if cap <= 0:
+                drafts[s.slot] = []
+                continue
+            ctx = s.req.prompt_ids + s.generated + [s.pending_token]
+            drafts[s.slot] = self._drafter.draft(ctx)[:cap]
+        if not any(drafts.values()):
+            self.spec.fallback_steps += 1
+            return False
+        # reserve 1 + draft_len tokens per slot (pending + drafts) before
+        # the verify call; pool pressure preempts exactly as vanilla decode
+        self._grow_running(lambda s: 1 + len(drafts.get(s.slot, ())))
+        running = self._running_slots()
+        if not running:
+            return True  # everything preempted away; step is done
+        Bb, verify = self._verify_for(self._max_ctx_blocks(running),
+                                      len(running))
+
+        a = self._marshal_running(running, Bb)
+        tokens = np.zeros((Bb, k + 1), np.int32)
+        pos0 = np.zeros((Bb,), np.int32)
+        n_drafted = [len(drafts.get(s.slot, ())) for s in running]
+        for i, s in enumerate(running):
+            d = drafts.get(s.slot, [])
+            tokens[i, 0] = s.pending_token
+            tokens[i, 1:1 + len(d)] = d
+            pos0[i] = self.cache.seq(s.req.req_id).n_tokens - (1 + len(d))
+
+        # same device stream slot as the vanilla decode this step replaces
+        rng = jax.random.fold_in(self._rng, self._step_count * 2)
+        args = [self.params, self.cache.kv, jnp.asarray(tokens),
+                jnp.asarray(pos0), jnp.asarray(a["tables"]),
+                jnp.asarray(a["active"]), rng, jnp.asarray(a["temp"]),
+                jnp.asarray(a["topk"]), jnp.asarray(a["topp"])]
+        if self._cross_kv is not None:
+            args += [self._cross_kv, jnp.asarray(a["has_image"]),
+                     jnp.asarray(a["slot_idx"]), jnp.asarray(a["cross_len"])]
+        (self.cache.kv, o, oex, accept_p, o_lp, d_lp, oex_lp,
+         top_ids, top_lp) = verify(*args)
+        o = np.asarray(o)
+        oex = np.asarray(oex)
+        accept_p = np.asarray(accept_p)
+        want_lp = any(s.req.params.logprobs for s in running)
+        if want_lp:
+            o_lp = np.asarray(o_lp)
+            d_lp = np.asarray(d_lp)
+            oex_lp = np.asarray(oex_lp)
+            top_ids = np.asarray(top_ids)
+            top_lp = np.asarray(top_lp)
+
+        from .speculative import accept_drafts
+
+        self.spec.verify_steps += 1
+        for i, s in enumerate(running):
+            if self.slots[s.slot] is not s:
+                continue  # defensive: slot changed mid-step
+            d = drafts.get(s.slot, [])
+            nd = n_drafted[i]
+            p = s.req.params
+            j, next_tok = accept_drafts(
+                d, o[i], oex[i], accept_p[i], p.temperature,
+                self._spec_rng.random(nd) if p.temperature > 0.0
+                else np.zeros(nd))
+            # give back what verification rejected: the cache reservation
+            # shrinks to exactly the committed tokens (atomic commit)
+            self.cache.shrink(s.req.req_id, nd - j)
+            committed = [s.pending_token] + [int(t) for t in d[:j]]
+            n_processed = 0  # tokens the commit walk actually reaches: an
+            # EOS/length finish mid-run must not inflate tokens_per_verify
+            finished = False
+            for m, c in enumerate(committed):
+                n_processed += 1
+                s.generated.append(c)
+                hit_eos = c == p.eos_id
+                if hit_eos:
+                    s.generated.pop()  # exclude EOS from the emitted text
+                    if p.logprobs and s.lps:
+                        s.lps.pop()    # its lp entry goes with it
+                elif s.req.on_token is not None:
+                    s.req.on_token(c)  # stream the committed token
+                full = len(s.generated) >= p.max_new_tokens
+                out_of_len = pos0[i] + m + 1 >= self.ecfg.max_model_len
+                if hit_eos or full or out_of_len:
+                    self._record_tpot(s)
+                    self._finish(Finished(
+                        s.req.req_id, s.req.already_generated + s.generated,
+                        s.req.orig_n_prompt, "eos" if hit_eos else "length",
+                        logprobs=((s.req.already_lp + s.lps)
+                                  if p.logprobs else None)))
+                    self.cache.release(s.req.req_id)
+                    self.slots[s.slot] = None
+                    self._has_image[s.slot] = 0.0
+                    finished = True
+                    break
+                if p.logprobs:
+                    # entry for this token's successor, exactly when vanilla
+                    # would record it (at sample time): the next accepted
+                    # draft, or the verify sample that ends the chain
+                    if m < j:
+                        s.lps.append(self._lp_entry(
+                            p.logprobs, committed[m + 1], d_lp[i, m],
+                            top_ids[i, m], top_lp[i, m]))
+                    else:
+                        tok_lp = (o_lp[i, j] if (j == nd
+                                                 or p.temperature <= 0.0)
+                                  else oex_lp[i, j])
+                        s.lps.append(self._lp_entry(
+                            p.logprobs, next_tok, tok_lp,
+                            top_ids[i, j], top_lp[i, j]))
+            # drafted/accepted record VERIFICATION outcomes (the drafter-
+            # quality signal); committed records tokens actually walked in
+            self.spec.drafted += nd
+            self.spec.accepted += j
+            self.spec.committed += n_processed
+            if not finished:
+                s.pending_token = next_tok
+        return True
+
+    def _decode_step(self) -> None:
+        if self._drafter is not None and self._spec_step():
+            return
+        # grow each running seq by one slot for the pending token; preempt
+        # on pool exhaustion (never preempt down to zero running sequences)
+        self._grow_running(lambda s: 1)
+        running = self._running_slots()
+        if not running:
+            return
+        Bb, decode = self._decode_for(self._max_ctx_blocks(running),
+                                      len(running))
+
+        a = self._marshal_running(running, Bb)
         tokens = np.zeros((Bb,), np.int32)
         pos = np.zeros((Bb,), np.int32)
-        tables = np.zeros((Bb, M), np.int32)
-        active = np.zeros((Bb,), bool)
-        temp = np.ones((Bb,), np.float32)
-        topk = np.zeros((Bb,), np.int32)
-        topp = np.ones((Bb,), np.float32)
-        slot_idx = np.zeros((Bb,), np.int32)
-        has_image = np.zeros((Bb,), np.float32)
-        cross_len = np.full((Bb,), max(self.cross_seq_len, 1), np.int32)
         for i, s in enumerate(running):
-            alloc = self.cache.seq(s.req.req_id)
             tokens[i] = s.pending_token
-            pos[i] = alloc.n_tokens - 1
-            tables[i] = alloc.table(M)
-            active[i] = True
-            temp[i] = s.req.params.temperature
-            topk[i] = s.req.params.top_k
-            topp[i] = s.req.params.top_p
-            slot_idx[i] = s.slot
-            has_image[i] = self._has_image[s.slot]
-            cross_len[i] = self._cross_len[s.slot]
+            pos[i] = self.cache.seq(s.req.req_id).n_tokens - 1
 
         rng = jax.random.fold_in(self._rng, self._step_count * 2)
         args = [self.params, self.cache.kv, jnp.asarray(tokens),
-                jnp.asarray(pos), jnp.asarray(tables), jnp.asarray(active),
-                rng, jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp)]
+                jnp.asarray(pos), jnp.asarray(a["tables"]),
+                jnp.asarray(a["active"]), rng, jnp.asarray(a["temp"]),
+                jnp.asarray(a["topk"]), jnp.asarray(a["topp"])]
         if self._cross_kv is not None:
-            args += [self._cross_kv, jnp.asarray(has_image),
-                     jnp.asarray(slot_idx), jnp.asarray(cross_len)]
+            args += [self._cross_kv, jnp.asarray(a["has_image"]),
+                     jnp.asarray(a["slot_idx"]), jnp.asarray(a["cross_len"])]
         self.cache.kv, nxt, top_ids_d, top_lp_d, tok_lp_d = decode(*args)
         nxt = np.asarray(nxt)
         if any(s.req.params.logprobs for s in running):

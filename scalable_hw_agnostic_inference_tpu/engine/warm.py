@@ -70,6 +70,13 @@ def warm_executables(eng, prefix_lens: Sequence[int] = (0,)) -> int:
         for bb in batch_buckets:
             eng._decode_for(m, bb)
             n += 1
+            if eng._drafter is not None:
+                # the speculative verify ladder mirrors decode's (ctx,
+                # batch) grid: a post-ready verify dispatch must never
+                # compile (vanilla decode stays in the set too — the
+                # engine falls back to it whenever drafting comes up empty)
+                eng._verify_for(m, bb)
+                n += 1
     # force compilation (jit is lazy until first call) with null args
     eng._run_warm_calls()
     eng._warmed = True  # cached admission now refuses cold compiles
@@ -115,6 +122,20 @@ def _run_warm_calls(eng) -> None:
                      jnp.full((bb,), max(eng.cross_seq_len, 1), jnp.int32)]
         eng.cache.kv, nxt, *_lp = fn(*args)
         nxt.block_until_ready()
+    K = eng.ecfg.num_speculative_tokens
+    for (m, bb), fn in list(eng._verify_fns.items()):
+        args = [eng.params, eng.cache.kv,
+                jnp.zeros((bb, K + 1), jnp.int32),
+                jnp.zeros((bb,), jnp.int32), jnp.zeros((bb, M), jnp.int32),
+                jnp.zeros((bb,), bool), jax.random.PRNGKey(0),
+                jnp.ones((bb,), jnp.float32), jnp.zeros((bb,), jnp.int32),
+                jnp.ones((bb,), jnp.float32)]
+        if eng._cross_kv is not None:
+            args += [eng._cross_kv, jnp.zeros((bb,), jnp.float32),
+                     jnp.zeros((bb,), jnp.int32),
+                     jnp.full((bb,), max(eng.cross_seq_len, 1), jnp.int32)]
+        eng.cache.kv, o, *_rest = fn(*args)
+        o.block_until_ready()
     if eng._cross_embed is not None:  # the admission-time projector
         per_layer = eng._cross_embed(
             eng.params,

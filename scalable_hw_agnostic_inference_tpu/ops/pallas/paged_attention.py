@@ -63,10 +63,14 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
     v = v_ref[:].astype(jnp.float32)
     hkv, g, _ = q.shape
 
-    # batched over the kv-head axis (k/v batch dim sits at position 1)
-    s = jax.lax.dot_general(
-        q, k, (((2,), (2,)), ((0,), (1,))),
-        preferred_element_type=jnp.float32)           # [Hkv, G, bs]
+    # per-kv-head 2D dots, unrolled over the static head count: Mosaic's
+    # older lowerings reject batched (3D) dot_general in-kernel ("Only 2D
+    # tensors supported in dot"), and Hkv here is the per-shard head count
+    # (1-8), so the unroll is tiny and each dot is a clean MXU tile
+    s = jnp.stack([
+        jax.lax.dot_general(q[h], k[:, h, :], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        for h in range(hkv)])                         # [Hkv, G, bs]
     k_pos = j * block_size + jax.lax.broadcasted_iota(
         jnp.int32, (hkv, g, block_size), 2)
     live = k_pos < length
@@ -80,9 +84,10 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
     p = jnp.where(live, jnp.exp(s - m_new), 0.0)
     corr = jnp.exp(m_prev - m_new)                    # [Hkv, G, 1]
     l_new = l_ref[:, :, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-        p, v, (((2,), (0,)), ((0,), (1,))),
-        preferred_element_type=jnp.float32)           # [Hkv, G, D]
+    acc_ref[:] = acc_ref[:] * corr + jnp.stack([
+        jax.lax.dot_general(p[h], v[:, h, :], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        for h in range(hkv)])                         # [Hkv, G, D]
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 

@@ -45,6 +45,78 @@ def _mask_top_p(logits: jax.Array, p: jax.Array) -> jax.Array:
     return jnp.where((p >= 1.0)[..., None], logits, masked)
 
 
+def _broadcast_knobs(logits, temperature, top_k, top_p):
+    batch_shape = logits.shape[:-1]
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), batch_shape)
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), batch_shape)
+    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), batch_shape)
+    return t, k, p
+
+
+def masked_scaled_logits(
+    logits: jax.Array,
+    temperature: float | jax.Array = 1.0,
+    top_k: int | jax.Array = 0,
+    top_p: float | jax.Array = 1.0,
+) -> jax.Array:
+    """The post-temperature/top-k/top-p logits ``sample_logits`` draws from
+    (categorical over these == the actual sampling distribution)."""
+    t, k, p = _broadcast_knobs(logits, temperature, top_k, top_p)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(t, 1e-6)[..., None]
+    return _mask_top_p(_mask_top_k(scaled, k), p)
+
+
+def sample_excluding(
+    logits: jax.Array,
+    rng: jax.Array,
+    exclude: jax.Array,
+    temperature: float | jax.Array = 1.0,
+    top_k: int | jax.Array = 0,
+    top_p: float | jax.Array = 1.0,
+) -> jax.Array:
+    """Sample from the :func:`sample_logits` distribution with token
+    ``exclude`` ``[...]`` removed — speculative decoding's rejection
+    resample (the residual of a delta proposal is the target distribution
+    with the rejected token zeroed, renormalized over the ORIGINAL
+    support). The top-k/top-p masks are computed BEFORE the exclusion:
+    recomputing them after would let a rank-(k+1) token into the support,
+    emitting tokens vanilla sampling can never produce.
+    """
+    t, _, _ = _broadcast_knobs(logits, temperature, top_k, top_p)
+    hole = exclude[..., None] == jnp.arange(logits.shape[-1])[None]
+    masked = jnp.where(hole, NEG_INF,
+                       masked_scaled_logits(logits, temperature, top_k, top_p))
+    sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
+    # temperature 0: the argmax with the excluded token removed (raw logits
+    # — greedy has full support minus the hole)
+    return jnp.where(t <= 0.0, greedy(jnp.where(hole, NEG_INF, logits)),
+                     sampled)
+
+
+def sampling_probs(
+    logits: jax.Array,
+    temperature: float | jax.Array = 1.0,
+    top_k: int | jax.Array = 0,
+    top_p: float | jax.Array = 1.0,
+) -> jax.Array:
+    """The ACTUAL sampling distribution ``[..., V]`` — post temperature,
+    top-k and top-p, the distribution :func:`sample_logits` draws from
+    (a point mass on the argmax at ``temperature == 0``).
+
+    Speculative decoding's rejection rule needs this exactly: a draft token
+    is accepted with its probability under the real sampling distribution,
+    not under the raw softmax — a draft outside the nucleus must always be
+    rejected, or verification would commit tokens vanilla decode can never
+    emit.
+    """
+    t, _, _ = _broadcast_knobs(logits, temperature, top_k, top_p)
+    probs = jax.nn.softmax(
+        masked_scaled_logits(logits, temperature, top_k, top_p), axis=-1)
+    point = jax.nn.one_hot(greedy(logits), logits.shape[-1],
+                           dtype=jnp.float32)
+    return jnp.where((t <= 0.0)[..., None], point, probs)
+
+
 def sample_logits(
     logits: jax.Array,
     rng: jax.Array,
@@ -57,12 +129,7 @@ def sample_logits(
     ``temperature == 0`` selects greedy decoding (per-row when the knob is a
     per-request array in a continuous batch).
     """
-    batch_shape = logits.shape[:-1]
-    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), batch_shape)
-    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), batch_shape)
-    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), batch_shape)
-
-    scaled = logits.astype(jnp.float32) / jnp.maximum(t, 1e-6)[..., None]
-    masked = _mask_top_p(_mask_top_k(scaled, k), p)
+    t, _, _ = _broadcast_knobs(logits, temperature, top_k, top_p)
+    masked = masked_scaled_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
     return jnp.where(t <= 0.0, greedy(logits), sampled)

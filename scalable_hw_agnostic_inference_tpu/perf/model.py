@@ -369,6 +369,49 @@ def _paged_decode(cfg, name: str, *, quant: bool, batch: int, ctx: int,
     return fn, args, meta
 
 
+def wl_vllm_verify(geometry: str = "1b", *, k: int = 4, quant: bool = False,
+                   batch: int = 8, ctx: int = 1024, block_size: int = 16,
+                   tiny: bool = False):
+    """ONE speculative VERIFY step (engine/runner.py make_verify): k+1
+    scored positions per sequence through the paged pool — the executable
+    whose cost, divided by the expected committed tokens per step
+    (:func:`spec_decode_model`), is the speculative decode ms/token."""
+    from ..engine.runner import make_verify
+    from ..models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig(**_TINY_DECODE_KW)
+        ctx, block_size = 32, 8
+    else:
+        cfg = _llama_cfg(geometry, tiny=False)
+    name = f"llama-{geometry}" + ("-int8" if quant else "")
+    m_ctx = max(1, ctx // block_size)
+    params_avals = topo.abstract_params(
+        lambda: llama_mod.geometry_params(cfg, quant=quant))
+    s = _repl(topo.device_mesh(1))
+    fn = make_verify(cfg, block_size, m_ctx, batch, k, ctx_blocks=m_ctx,
+                     paged=True)
+
+    def aval(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=s)
+
+    params = topo.with_sharding(params_avals, s)
+    pool = aval((1 + batch * m_ctx, block_size, cfg.n_kv_heads,
+                 cfg.head_dim), jnp.bfloat16)
+    kv = [{"k": pool, "v": pool} for _ in range(cfg.n_layers)]
+    vec = lambda dt: aval((batch,), dt)  # noqa: E731
+    args = (params, kv, aval((batch, k + 1), jnp.int32), vec(jnp.int32),
+            aval((batch, m_ctx), jnp.int32), vec(jnp.bool_),
+            topo.with_sharding(topo.abstract_params(
+                lambda: jax.random.PRNGKey(0)), s),
+            vec(jnp.float32), vec(jnp.int32), vec(jnp.float32))
+    return fn, args, {
+        "family": "llama", "component": "spec_verify_step", "batch": batch,
+        "param_bytes": _tree_bytes(params_avals),
+        "detail": f"{name} speculative verify step k={k} bs={batch} "
+                  f"ctx={m_ctx * block_size}"}
+
+
 def wl_vllm_decode_tp8(*, tiny: bool = False):
     """The TP-sharded paged decode step AOT-compiled for the TPU target:
     llama-70B int8 geometry over a tp=8 topology mesh — the deepest
@@ -488,9 +531,42 @@ WORKLOADS: Dict[str, Callable[[], Tuple[Callable, Tuple, Dict]]] = {
     "t5": lambda: wl_t5(),
     "flux_tp8_step": lambda: wl_flux_tp8(),
     "vllm_decode_b8": lambda: wl_vllm_decode("1b"),
+    "vllm_verify_b8_k4": lambda: wl_vllm_verify("1b", k=4),
     "mllama_decode_b1": lambda: wl_mllama_decode(),
     "vllm_decode_70b_tp8": lambda: wl_vllm_decode_tp8(),
 }
+
+
+# acceptance rates the speculative projection is tabulated at: 0 (pure
+# overhead — every draft rejected), the mid regime, and the
+# quote-heavy/self-repetitive regime prompt lookup is built for
+SPEC_ALPHAS = (0.0, 0.3, 0.5, 0.7, 0.9)
+
+
+def spec_decode_model(t_decode_s: float, t_verify_s: float,
+                      accept_rate: float, k: int) -> Dict[str, float]:
+    """Speculative decode cost as a function of acceptance rate.
+
+    With i.i.d. per-draft acceptance probability ``a`` and ``k`` drafts,
+    the accepted prefix length J has ``P(J >= j) = a^j``, so a verify step
+    commits ``E[1 + J] = 1 + a(1 - a^k)/(1 - a)`` tokens (the +1 is the
+    bonus/correction sample — a verify step NEVER commits fewer tokens than
+    a vanilla decode step). Modeled decode seconds per token is then
+    ``t_verify / E[1+J]``; ``speedup_vs_decode`` compares against the
+    vanilla single-token roofline. The break-even acceptance rate solves
+    ``E[1+J] = t_verify / t_decode``.
+    """
+    a = min(max(float(accept_rate), 0.0), 1.0)
+    if a >= 1.0:
+        committed = float(k + 1)
+    else:
+        committed = 1.0 + a * (1.0 - a ** k) / (1.0 - a)
+    return {
+        "accept_rate": a,
+        "tokens_per_verify": committed,
+        "s_per_token": t_verify_s / committed,
+        "speedup_vs_decode": t_decode_s * committed / t_verify_s,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -556,6 +632,22 @@ def compose(rows: Dict[str, Dict]) -> Dict[str, Dict]:
                     "ttft_roofline_s": rows[pre]["t_roofline_s"],
                     "tpot_roofline_s": rows[dec]["t_roofline_s"],
                 }
+    if "vllm_decode_b8" in rows and "vllm_verify_b8_k4" in rows:
+        dec, ver = rows["vllm_decode_b8"], rows["vllm_verify_b8_k4"]
+        out["vllm_spec_decode_b8_k4"] = {
+            "family": "llama", "work": ver["batch"], "work_unit": "tokens",
+            "parts": {"vllm_verify_b8_k4": 1.0},
+            "t_roofline_s": ver["t_roofline_s"],
+            "t_xla_optimal_s": ver.get("optimal_seconds"),
+            "flops": ver["flops"],
+            "bytes_accessed": ver["bytes_accessed"],
+            # decode ms/token as a function of acceptance rate: the compiled
+            # verify cost divided by expected committed tokens per step
+            "spec_model": {
+                f"{a:.1f}": spec_decode_model(
+                    dec["t_roofline_s"], ver["t_roofline_s"], a, 4)
+                for a in SPEC_ALPHAS},
+        }
     for nm in ("vllm_decode_b8", "mllama_decode_b1", "vllm_decode_70b_tp8"):
         if nm in rows:
             row = rows[nm]
@@ -875,6 +967,26 @@ def render_md(res: Dict[str, Any]) -> str:
                f"{cps['llama3b_int8_decode']['t_roofline_s'] * 1e3:.0f} "
                f"ms/step on the 3B decode)."
                if "llama3b_int8_decode" in cps else "."))
+    spec = comp.get("vllm_spec_decode_b8_k4")
+    dec_row = cps.get("vllm_decode_b8")
+    if spec and dec_row and spec.get("spec_model"):
+        lines += ["", "## Speculative decoding (prompt-lookup k=4, "
+                  "modeled vs acceptance rate)", "",
+                  f"Vanilla decode roofline: "
+                  f"{dec_row['t_roofline_s'] * 1e3:.2f} ms/token; verify "
+                  f"(k+1 positions, one dispatch): "
+                  f"{spec['t_roofline_s'] * 1e3:.2f} ms/step. A verify step "
+                  f"commits `1 + a(1-a^k)/(1-a)` tokens at per-draft "
+                  f"acceptance `a` — measured live as "
+                  f"`spec_acceptance_rate` (serve /stats, bench.py "
+                  f"llama_spec).", "",
+                  "| accept rate | tokens/verify | modeled ms/token | "
+                  "speedup vs decode |", "|---|---|---|---|"]
+        for a, m in spec["spec_model"].items():
+            lines.append(
+                f"| {a} | {m['tokens_per_verify']:.2f} | "
+                f"{m['s_per_token'] * 1e3:.2f} | "
+                f"{m['speedup_vs_decode']:.2f}x |")
     if res.get("errors"):
         lines += ["", "## Errors", ""]
         lines += [f"- `{k}`: {v}" for k, v in res["errors"].items()]

@@ -100,6 +100,14 @@ class ModelService:
         occupancy here."""
         return {}
 
+    def spec_counters(self) -> Optional[Dict[str, int]]:
+        """Cumulative speculative-decoding counters
+        (``{"drafted", "accepted", "committed"}``) for
+        :meth:`MetricsPublisher.publish_spec`, or None when the service has
+        no speculative engine. The request path forwards these after each
+        served inference so acceptance rate reaches the autoscaling plane."""
+        return None
+
     def export_artifacts(self, artifact_root: str) -> int:
         """Export portable AOT artifacts (StableHLO via ``core.aot.AotCache``)
         under the artifact root; returns how many were written.
@@ -230,6 +238,9 @@ def create_app(
         dt = time.perf_counter() - t0
         collector.record(dt)
         pub.publish(dt)
+        sc = service.spec_counters()
+        if sc is not None:
+            pub.publish_spec(**sc)
         if isinstance(out, dict):
             out.setdefault("latency_s", round(dt, 4))
         return out
@@ -331,9 +342,12 @@ def create_app(
         if seconds < 1 or seconds > 300:
             raise HTTPError(400, "seconds must be in [1, 300]")
         now = time.time()
-        if now < profile_state["until"]:
+        # still-running = countdown not elapsed OR the stop task hasn't
+        # completed yet (on a loaded box the window can expire before the
+        # event loop runs _stop_later — start_trace would then raise)
+        if now < profile_state["until"] or profile_state.get("task"):
             raise HTTPError(409, f"trace already running "
-                                 f"({profile_state['until'] - now:.0f}s left)")
+                                 f"({max(0.0, profile_state['until'] - now):.0f}s left)")
         trace_dir = os.path.join(cfg.artifact_root, "traces", cfg.app,
                                  time.strftime("%Y%m%d-%H%M%S"))
         os.makedirs(trace_dir, exist_ok=True)
@@ -341,7 +355,12 @@ def create_app(
 
         # arm the lockout only after the trace actually starts — a failed
         # start must not 409-block the endpoint with nothing running
-        jax.profiler.start_trace(trace_dir)
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except RuntimeError as e:
+            # profiler held by an out-of-band trace (e.g. a jax.profiler
+            # user in-process): same client semantics as our own lockout
+            raise HTTPError(409, f"trace already running: {e}")
         profile_state.update(until=now + seconds, dir=trace_dir)
 
         async def _stop_later():

@@ -79,6 +79,20 @@ class MetricsPublisher:
                 buckets=_LATENCY_BUCKETS,
                 registry=self.registry,
             )
+            # speculative decoding counters (drafted/accepted/committed):
+            # the KEDA-visible signal pair behind acceptance rate — a tier
+            # whose acceptance collapses decodes at vanilla pace and needs
+            # MORE replicas per token served, so the autoscaler must see it
+            self._prom_spec = {
+                kind: Counter(
+                    f"shai_spec_{kind}_total",
+                    f"Speculative decoding: {kind} tokens",
+                    ["app", "nodepool", "pod"],
+                    registry=self.registry,
+                )
+                for kind in ("drafted", "accepted", "committed")
+            }
+        self._spec_last = {"drafted": 0, "accepted": 0, "committed": 0}
 
     @property
     def served(self) -> int:
@@ -108,6 +122,40 @@ class MetricsPublisher:
                 }
             )
             print(line, file=self._stream, flush=True)
+
+    def publish_spec(self, drafted: int, accepted: int,
+                     committed: int) -> None:
+        """Record CUMULATIVE speculative-decoding counters (the engine's
+        ``SpecStats`` totals); Prometheus counters advance by the delta
+        since the last call, and the JSON push path emits the cumulative
+        snapshot plus the derived acceptance rate. Idempotent per snapshot —
+        callers just forward the engine's current totals after each request.
+        """
+        # delta AND emission both under the lock: a concurrent publisher
+        # finishing between them would print cumulative snapshots out of
+        # order (totals going backwards on the push stream)
+        with self._lock:
+            cur = {"drafted": drafted, "accepted": accepted,
+                   "committed": committed}
+            delta = {k: max(0, cur[k] - self._spec_last[k]) for k in cur}
+            self._spec_last = cur
+            if not any(delta.values()):
+                return
+            if _HAVE_PROM and self.registry is not None:
+                for kind, d in delta.items():
+                    if d:
+                        self._prom_spec[kind].labels(
+                            self.app, self.nodepool, self.pod_name).inc(d)
+            if self.emit_json:
+                data = {f"{self.app}-spec-{k}": v for k, v in cur.items()}
+                data[f"{self.app}-spec-acceptance"] = (
+                    round(accepted / drafted, 4) if drafted else 0.0)
+                print(json.dumps({
+                    "ns": METRIC_NAMESPACE,
+                    "ts": round(time.time(), 3),
+                    "pod": self.pod_name,
+                    "data": data,
+                }), file=self._stream, flush=True)
 
     def start_exporter(self, port: int) -> bool:
         """Start the Prometheus scrape endpoint; returns False if unavailable."""

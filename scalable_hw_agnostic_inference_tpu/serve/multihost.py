@@ -14,9 +14,14 @@ one pod alone would hang the whole slice. So the multi-host unit
 Determinism contract: a service's ``infer`` must reach the device only
 through the payload (services derive rngs from ``payload["seed"]``), which
 the serving layer already guarantees for the generate paths. The broadcast
-is two ``multihost_utils.broadcast_one_to_all`` rounds (fixed-shape header,
-then the pickled payload), serialized by a lock so every host observes the
-same request order.
+rides the cluster's coordination-service KV store (the same service
+``jax.distributed`` heartbeats and gloo rendezvous run through): the leader
+publishes each pickled request under a monotonically increasing sequence
+key and every follower long-polls its own cursor, so all hosts observe the
+same request order — with no device collective in the control path (a
+collective here would compile one executable per payload LENGTH, and
+jaxlib's CPU backend mis-replicates multi-element broadcast results, which
+is how this surfaced), and no shape coupling between hosts.
 
 Failure semantics are fail-together: the coordination service heartbeat
 kills every process when a peer dies (jax.distributed's behavior), the
@@ -27,12 +32,11 @@ vLLM rank.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import pickle
 import threading
 from typing import Any, Dict
-
-import numpy as np
 
 log = logging.getLogger(__name__)
 
@@ -40,20 +44,45 @@ _OP_SHUTDOWN = 0
 _OP_INFER = 1
 
 
-def _broadcast_bytes(payload: bytes | None) -> bytes:
-    """Two-round fixed-shape broadcast of a variable-length byte string."""
-    import jax
-    from jax.experimental import multihost_utils
+_KEY_PREFIX = "shai/mh/bcast"
+#: leader deletes key (seq - LAG) after publishing seq: a follower that far
+#: behind is already dead to the heartbeat, and the coordinator's KV memory
+#: stays bounded over a pod's lifetime
+_GC_LAG = 1024
+_seq = itertools.count()
 
-    leader = jax.process_index() == 0
-    hdr = np.array([len(payload) if leader else 0], np.int32)
-    hdr = np.asarray(multihost_utils.broadcast_one_to_all(hdr))
-    n = int(hdr[0])
-    buf = np.zeros((n,), np.uint8)
-    if leader:
-        buf[:n] = np.frombuffer(payload, np.uint8)
-    buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
-    return buf.tobytes()
+
+def _broadcast_bytes(payload: bytes | None) -> bytes:
+    """Deliver one variable-length byte string from the leader to all hosts
+    via the coordination-service KV store, in publication order.
+
+    The leader (``payload is not None``) publishes under sequence key i;
+    followers long-poll their own cursor — each process's ``_seq`` counter
+    advances once per delivered message, so cursors stay aligned without
+    any cross-host shape agreement. A follower poll timeout just means the
+    slice is idle between requests; any OTHER coordinator error re-raises
+    so the process dies with its peers (fail-together).
+    """
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    seq = next(_seq)
+    key = f"{_KEY_PREFIX}/{seq}"
+    if payload is not None:  # leader
+        client.key_value_set_bytes(key, payload)
+        if seq >= _GC_LAG:
+            try:
+                client.key_value_delete(f"{_KEY_PREFIX}/{seq - _GC_LAG}")
+            except Exception:  # pragma: no cover - GC is best-effort
+                pass
+        return payload
+    while True:
+        try:
+            return client.blocking_key_value_get_bytes(key, 10_000)
+        except Exception as e:
+            if "DEADLINE_EXCEEDED" not in str(e):
+                raise  # coordinator gone / real error: die with the slice
+            # idle long-poll timeout: keep waiting for the next request
 
 
 class MultihostDriver:

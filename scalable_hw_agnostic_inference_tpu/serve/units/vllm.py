@@ -158,7 +158,13 @@ class VllmService(ModelService):
                 tensor_parallel_size=ecfg.tensor_parallel_size,
                 quantization=ecfg.quantization,
                 enable_prefix_caching=ecfg.enable_prefix_caching,
-                max_new_tokens=min(ecfg.max_new_tokens, 64))
+                max_new_tokens=min(ecfg.max_new_tokens, 64),
+                # speculative knobs ride through: the tiny tier is how CI
+                # and serving smokes exercise the verify executables
+                speculative_model=ecfg.speculative_model,
+                num_speculative_tokens=ecfg.num_speculative_tokens,
+                ngram_prompt_lookup_max=ecfg.ngram_prompt_lookup_max,
+                ngram_prompt_lookup_min=ecfg.ngram_prompt_lookup_min)
 
         self.ecfg = ecfg
         if ecfg.quantization == "int8":
@@ -397,7 +403,19 @@ class VllmService(ModelService):
             out["ttft_p99_ms"] = round(rep["p99"] * 1e3, 2)
         if eng.tpot.count:
             out["tpot_p50_ms"] = round(eng.tpot.report()["p50"] * 1e3, 2)
+        if eng.spec is not None:
+            # speculative decoding counters: acceptance rate and realized
+            # tokens-per-verify become shai_service_* gauges, next to the
+            # shai_spec_*_total counters the request path publishes
+            out.update(eng.spec.as_dict())
         return out
+
+    def spec_counters(self):
+        eng = getattr(self, "_engine", None)
+        if eng is None or eng.spec is None:
+            return None
+        return {"drafted": eng.spec.drafted, "accepted": eng.spec.accepted,
+                "committed": eng.spec.committed}
 
     # -- OpenAI-compatible surface ------------------------------------------
     # The industry-standard serving API on the same engine: /v1/models,

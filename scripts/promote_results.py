@@ -23,7 +23,10 @@ KEYS = {"sd": "sd21_img_s",
         "mllama": "mllama_caption_tok_s",
         "llama": "llama1b_decode_tok_s", "llama3b": "llama3b_decode_tok_s",
         "llama_int8": "llama1b_int8_decode_tok_s",
-        "llama3b_int8": "llama3b_int8_decode_tok_s"}
+        "llama3b_int8": "llama3b_int8_decode_tok_s",
+        # speculative decoding (prompt-lookup k=4): tokens/s plus the
+        # acceptance_rate/tokens_per_verify fields the bench line carries
+        "llama_spec": "llama_spec_tps"}
 
 
 def _load_results() -> dict:

@@ -23,7 +23,12 @@ import pytest  # noqa: E402
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # JAX >= 0.5: the supported way to get virtual CPU devices. Older JAX
+    # (0.4.x) has no such config knob — the XLA_FLAGS path above covers it.
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 
 def pytest_configure(config):

@@ -55,6 +55,24 @@ def test_watched_keys_cover_all_bench_variants():
             "llama_int8", "llama3b_int8"} <= set(promote.KEYS)
 
 
+def test_llama_spec_key_promotes_tokens_per_second():
+    # PR-1 tentpole: the speculative-decode bench publishes under its own
+    # key, and its bench.py dispatch resolves BEFORE the "llama" prefix
+    # match (a llama_spec run must never bank as a vanilla llama number)
+    assert promote.KEYS["llama_spec"] == "llama_spec_tps"
+    bench_dir = os.path.join(ROOT)
+    bspec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(bench_dir, "bench.py"))
+    bench = importlib.util.module_from_spec(bspec)
+    bspec.loader.exec_module(bench)
+    assert bench._which_from_argv(["bench.py", "llama_spec"]) == "llama_spec"
+    assert bench._which_from_argv(["bench.py", "llama"]) == "llama"
+    assert bench.UNITS_BY_BENCH["llama_spec"] == "tokens/sec"
+    # the spec entry passes the same is_real gate as every other key
+    assert promote.is_real(_entry(metric="llama spec tok/s (tpu)",
+                                  acceptance_rate=0.7))
+
+
 def test_check_mode_subprocess_contract(tmp_path):
     # --check <key> is the watcher's done-predicate: exit 0 only for a
     # banked REAL entry; malformed invocation must not read as done

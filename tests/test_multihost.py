@@ -23,7 +23,17 @@ import numpy as np
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:  # JAX 0.4.x: pre-init XLA_FLAGS does the same
+    import os as _os
+    _os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+
+try:  # JAX 0.4.x: CPU cross-process collectives need explicit gloo opt-in
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass  # newer JAX: gloo is the default
 
 from scalable_hw_agnostic_inference_tpu.core.device import maybe_distributed_init
 
@@ -52,7 +62,17 @@ _MIRROR_WORKER = r"""
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:  # JAX 0.4.x: pre-init XLA_FLAGS does the same
+    import os as _os
+    _os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+
+try:  # JAX 0.4.x: CPU cross-process collectives need explicit gloo opt-in
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass  # newer JAX: gloo is the default
 
 from scalable_hw_agnostic_inference_tpu.core.device import maybe_distributed_init
 
@@ -103,7 +123,17 @@ import numpy as np
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:  # JAX 0.4.x: pre-init XLA_FLAGS does the same
+    import os as _os
+    _os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+
+try:  # JAX 0.4.x: CPU cross-process collectives need explicit gloo opt-in
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass  # newer JAX: gloo is the default
 
 from scalable_hw_agnostic_inference_tpu.core.device import maybe_distributed_init
 
