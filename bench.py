@@ -81,6 +81,22 @@ def _published(key: str):
         return None
 
 
+def _phases_of(fins) -> dict:
+    """Per-phase medians (seconds) across a batch of engine ``Finished``
+    results — the queue/prefill/decode split from the obs timeline, attached
+    to engine bench lines so a BENCH_*.json regression says WHERE the time
+    went (queue wait vs prefill vs decode), not just that tok/s moved."""
+    import statistics
+
+    out = {}
+    for k in ("queue_s", "prefill_s", "decode_s", "total_s"):
+        vals = [f.timing[k] for f in fins
+                if f.timing is not None and k in f.timing]
+        if vals:
+            out[k.replace("_s", "_s_p50")] = round(statistics.median(vals), 4)
+    return out
+
+
 def _dollars(out: dict, *, inf2_value: float | None = None) -> dict:
     """Attach the cost basis + work-per-dollar fields to a bench line.
 
@@ -359,12 +375,14 @@ def bench_llama_spec(tiny: bool) -> dict:
             fins += eng.step()
         assert len(fins) == batch
         assert all(len(f.token_ids) == new for f in fins)
+        return fins
 
     run()   # warm: prefill + decode + verify executables
     runs = 3
+    fins = []
     t0 = time.perf_counter()
     for _ in range(runs):
-        run()
+        fins = run()
     dt = (time.perf_counter() - t0) / runs
     val = round(batch * new / dt, 2)
     base_v = _published("llama_spec_tps")
@@ -378,6 +396,7 @@ def bench_llama_spec(tiny: bool) -> dict:
     out["acceptance_rate"] = round(eng.spec.acceptance_rate, 4)
     out["tokens_per_verify"] = round(eng.spec.tokens_per_verify, 4)
     out["spec_fallback_steps"] = eng.spec.fallback_steps
+    out["phases"] = _phases_of(fins)  # last measured batch, warm steady-state
     return out
 
 
@@ -582,19 +601,22 @@ def bench_mllama(tiny: bool) -> dict:
 
     run(2)   # warm: prefill + decode executables + cross projection
     runs = 3
+    fins = []
     t0 = time.perf_counter()
     for _ in range(runs):
-        run(new)
+        fins = run(new)
     dt = (time.perf_counter() - t0) / runs
     val = round(new / dt, 2)
     base = _published("mllama_caption_tok_s")
-    return _dollars({
+    out = _dollars({
         "metric": f"{name} caption tok/s (prompt {prompt_len}, Lv={Lv}, "
                   f"bs=1, {jax.devices()[0].platform})",
         "value": val,
         "unit": "tokens/sec",
         "vs_baseline": round(val / base, 3) if base else 1.0,
     })
+    out["phases"] = _phases_of(fins)  # last measured request, warm state
+    return out
 
 
 def inner_main() -> None:

@@ -28,6 +28,19 @@ log = logging.getLogger(__name__)
 
 MANIFEST = "manifest.json"
 
+# process-wide AOT event counters (obs): how many artifact traces/exports
+# and deserialize-loads this process performed, and the wall time traced.
+# A serving pod whose export count moves AFTER readiness is compiling
+# post-warm — the same bucket-miss signal the engine's telemetry counts,
+# visible here for the artifact tier. Exposed through ``/stats`` (serve.app).
+_COMPILE_STATS = {"exports": 0, "export_s": 0.0, "loads": 0,
+                  "cache_hits": 0}
+
+
+def compile_stats() -> Dict[str, float]:
+    """Snapshot of this process's AOT compile/export/load counters."""
+    return dict(_COMPILE_STATS)
+
 
 def enable_persistent_cache(cache_dir: str) -> None:
     """Point JAX's persistent compilation cache at the artifact root."""
@@ -171,9 +184,13 @@ class AotCache:
         key = aot_key(name, args, mesh=mesh, extra=extra)
         path = os.path.join(self.root, key + ".shlo")
         if key in self._manifest and os.path.exists(path):
+            _COMPILE_STATS["cache_hits"] += 1
             return key
         jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        t0 = time.perf_counter()
         exported = jexport.export(jitted)(*args)
+        _COMPILE_STATS["exports"] += 1
+        _COMPILE_STATS["export_s"] += time.perf_counter() - t0
         self._live[key] = exported.call
         data = exported.serialize()
         with open(path, "wb") as f:
@@ -199,6 +216,7 @@ class AotCache:
             raise KeyError(f"no AOT artifact {key} under {self.root}")
         with open(path, "rb") as f:
             exported = jexport.deserialize(f.read())
+        _COMPILE_STATS["loads"] += 1
         return exported.call
 
     def get_or_export(self, name: str, fn: Callable, args: Sequence, mesh=None, extra: str = ""):

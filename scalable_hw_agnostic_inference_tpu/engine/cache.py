@@ -128,6 +128,12 @@ class PagedKVCache:
 
         self.kv = [{"k": zeros("k"), "v": zeros("v")} for _ in range(n_layers)]
         self._seqs: Dict[int, SeqAllocation] = {}
+        # telemetry counters (obs.steploop reads them through the engine):
+        # speculative rollbacks give reserved tokens/blocks back via shrink —
+        # a high rollback rate is the "drafter wasting pool headroom" signal
+        self.rollback_tokens = 0
+        self.rollback_calls = 0
+        self.rollback_blocks = 0
 
     # -- prefix cache -------------------------------------------------------
 
@@ -283,11 +289,14 @@ class PagedKVCache:
             return alloc
         assert n_remove <= alloc.n_tokens, "shrink below zero tokens"
         alloc.n_tokens -= n_remove
+        self.rollback_tokens += n_remove
+        self.rollback_calls += 1
         keep = self._blocks_needed(alloc.n_tokens)
         if keep < len(alloc.blocks):
             tail = alloc.blocks[keep:]
             del alloc.blocks[keep:]
             self.allocator.free(tail)
+            self.rollback_blocks += len(tail)
         return alloc
 
     def release(self, seq_id: int) -> None:

@@ -25,6 +25,8 @@ import numpy as np
 
 from ..core.bucketing import BucketRegistry
 from ..models.llama import LlamaConfig
+from ..obs.steploop import StepTelemetry
+from ..obs.trace import annotate
 from ..ops.sampling import sample_logits
 from .cache import PagedKVCache
 from .config import EngineConfig
@@ -168,6 +170,12 @@ class LLMEngine:
 
         self.ttft = LatencyCollector()
         self.tpot = LatencyCollector()
+        # step telemetry (obs): per-step occupancy/KV/preemption records +
+        # TTFT/TPOT/queue-wait histograms, exported by the serving layer as
+        # Prometheus histograms and flight-recorder step records
+        self.obs = StepTelemetry(total_blocks=ecfg.total_blocks)
+        self._last_rollback_tokens = 0
+        self._step_kind = "idle"
         self._ids = itertools.count()
         self._step_count = 0
         self._rng = jax.random.PRNGKey(ecfg.seed)
@@ -235,7 +243,8 @@ class LLMEngine:
                 return Finished(req_id, list(r.already_generated),
                                 r.orig_n_prompt, "cancelled",
                                 logprobs=(list(r.already_lp)
-                                          if r.params.logprobs else None))
+                                          if r.params.logprobs else None),
+                                timing=self._timing_of(r))
         for s in self.slots:
             if s is not None and s.req.req_id == req_id:
                 self._record_tpot(s)
@@ -246,7 +255,8 @@ class LLMEngine:
                     req_id, s.req.already_generated + s.generated,
                     s.req.orig_n_prompt, "cancelled",
                     logprobs=((s.req.already_lp + s.lps[:len(s.generated)])
-                              if s.req.params.logprobs else None))
+                              if s.req.params.logprobs else None),
+                    timing=self._timing_of(s.req, s.t_first))
         return None
 
     @property
@@ -282,8 +292,10 @@ class LLMEngine:
         Returns every request that finished during this step, whatever the
         path (decode EOS/length, admission rejection, preemption close-out).
         """
+        t0 = time.monotonic()
         self._step_count += 1
         self._done_this_step = []
+        self._step_kind = "idle"
         chunking = [s for s in self.slots
                     if s is not None and s.prefill_cursor is not None]
         if chunking:
@@ -308,7 +320,24 @@ class LLMEngine:
             self._admit_batch()
         if any(s is not None for s in self.slots):
             self._decode_step()
+        self._record_step(time.monotonic() - t0)
         return self._done_this_step
+
+    def _record_step(self, duration_s: float) -> None:
+        """One obs step record per engine step — occupancy, KV pressure,
+        rollback delta, speculative counters at step end."""
+        rb = self.cache.rollback_tokens
+        self.obs.record_step(
+            kind=self._step_kind, duration_s=duration_s,
+            n_running=self.n_running, n_waiting=self.n_waiting,
+            n_chunking=self.n_chunking,
+            blocks_free=self.cache.allocator.n_free,
+            blocks_evictable=(self.cache.n_evictable
+                              if self.cache.prefix_caching else 0),
+            finished=len(self._done_this_step),
+            rollback_tokens=rb - self._last_rollback_tokens,
+            spec=self.spec.as_dict() if self.spec is not None else None)
+        self._last_rollback_tokens = rb
 
     def _finish(self, fin: Finished) -> None:
         self.finished.append(fin)
@@ -319,15 +348,54 @@ class LLMEngine:
         not a new first token); returns the timestamp for TPOT's t_first."""
         now = time.monotonic()
         if not req.already_generated and req.t_submit:
-            self.ttft.record(now - req.t_submit)
+            ttft = now - req.t_submit
+            self.ttft.record(ttft)
+            self.obs.ttft.observe(ttft)
+        if not req.t_first:
+            req.t_first = now
         return now
 
     def _record_tpot(self, s: "_Running") -> None:
         """Per-token decode pace: elapsed spans sample-of-token-1 through
         commit-of-token-n — n decode steps — so divide by n, not n-1."""
         if s.t_first and s.generated:
-            self.tpot.record((time.monotonic() - s.t_first)
-                             / len(s.generated))
+            tpot = (time.monotonic() - s.t_first) / len(s.generated)
+            self.tpot.record(tpot)
+            self.obs.tpot.observe(tpot)
+
+    def _note_admitted(self, req: Request) -> None:
+        """Queue-wait record point, at the first admission only (THE hook
+        every admission path calls right after taking the request off the
+        waiting queue; a preemption resume keeps its original t_admit)."""
+        if not req.t_admit:
+            req.t_admit = time.monotonic()
+            if req.t_submit:
+                self.obs.queue_wait.observe(req.t_admit - req.t_submit)
+
+    def _timing_of(self, req: Request, t_first: float = 0.0
+                   ) -> Dict[str, float]:
+        """Per-phase timeline for a Finished: monotonic stamps plus derived
+        queue/prefill/decode durations. Missing stamps fall FORWARD to now,
+        collapsing the phases that never ran to zero — a request rejected
+        straight from the queue spent its whole life in ``queue_s``, not in
+        a decode phase it never reached."""
+        now = time.monotonic()
+        t_sub = req.t_submit or now
+        t_adm = min(req.t_admit or now, now)
+        # prefer the request-persisted stamp: a preemption resume's slot
+        # t_first is the RESUMED segment's, which would book the first
+        # decode segment (and the re-queue wait) under prefill_s
+        t_f = min(req.t_first or t_first or now, now)
+        t_adm = max(t_sub, t_adm)
+        t_f = max(t_adm, t_f)
+        return {
+            "t_submit": t_sub, "t_admit": t_adm, "t_first": t_f,
+            "t_done": now,
+            "queue_s": round(max(0.0, t_adm - t_sub), 6),
+            "prefill_s": round(max(0.0, t_f - t_adm), 6),
+            "decode_s": round(max(0.0, now - t_f), 6),
+            "total_s": round(max(0.0, now - t_sub), 6),
+        }
 
     def _start_slot(self, slot: int, req: Request, tok: int) -> None:
         """Seat a fully-prefilled request with its sampled first token."""
@@ -377,7 +445,8 @@ class LLMEngine:
                 req.req_id, list(req.already_generated),
                 req.orig_n_prompt, "rejected",
                 logprobs=(list(req.already_lp)
-                          if req.params.logprobs else None)))
+                          if req.params.logprobs else None),
+                timing=self._timing_of(req)))
         return False
 
     def _admit_one(self) -> None:
@@ -396,6 +465,7 @@ class LLMEngine:
         if not self._try_reserve(req, n):
             return
         self.waiting.popleft()
+        self._note_admitted(req)
         P = req.prefix_len
         n_text = len(req.prompt_ids)
         bucket = self.buckets.bucket_for(n)
@@ -410,7 +480,8 @@ class LLMEngine:
             args.append(jnp.asarray(req.prefix)[None])
         if self._cross_kv is not None:
             args += list(self._set_slot_cross(slot, req))
-        self.cache.kv, logits = fn(*args)
+        with annotate("engine.prefill"):
+            self.cache.kv, logits = fn(*args)
         # no register_prefix here: this path only ever admits prefix/cross
         # (vision-conditioned) requests, whose blocks must NOT
         # content-address by tokens alone — and cross engines disable the
@@ -488,6 +559,7 @@ class LLMEngine:
                 continue   # rejected-and-finished; consider the next head
             bucket = b
             self.waiting.popleft()
+            self._note_admitted(req)
             self.cache.admit(req.req_id, n)
             group.append(req)
         if not group:
@@ -514,7 +586,8 @@ class LLMEngine:
         if self._cross_kv is not None:  # text-only rows through a cross model
             args += [self._cross_zeros(Kp), jnp.zeros((Kp,), jnp.float32),
                      jnp.full((Kp,), max(self.cross_seq_len, 1), jnp.int32)]
-        self.cache.kv, logits = fn(*args)
+        with annotate("engine.prefill"):
+            self.cache.kv, logits = fn(*args)
         for req in group:  # batch rows are always plain text
             self.cache.register_prefix(req.prompt_ids,
                                        self.cache.seq(req.req_id).blocks)
@@ -569,14 +642,16 @@ class LLMEngine:
         except MemoryError:
             self.waiting.appendleft(req)
             return False  # let the normal paths wait-or-reject
+        self._note_admitted(req)
         table = jnp.asarray(alloc.table(self.ecfg.blocks_per_seq))[None]
         n = n_total - start
         ids = np.zeros((1, chunk_bucket), np.int32)
         ids[0, :n] = req.prompt_ids[start:]
         fn = self._cont_for(sb, chunk_bucket)
-        self.cache.kv, logits = fn(self.params, self.cache.kv,
-                                   jnp.asarray(ids),
-                                   jnp.asarray([n], jnp.int32), table)
+        with annotate("engine.prefill"):
+            self.cache.kv, logits = fn(self.params, self.cache.kv,
+                                       jnp.asarray(ids),
+                                       jnp.asarray([n], jnp.int32), table)
         self.cache.register_prefix(req.prompt_ids, alloc.blocks)
         rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
         tok = int(self._sample1(
@@ -617,6 +692,7 @@ class LLMEngine:
         if not self._try_reserve(req, n_total):
             return
         self.waiting.popleft()
+        self._note_admitted(req)
         self.cache.admit(req.req_id, n_total)
         table = jnp.asarray(
             self.cache.seq(req.req_id).table(self.ecfg.blocks_per_seq))[None]
@@ -629,7 +705,8 @@ class LLMEngine:
             # seat the vision states (or the text-only gate-off) in the slot
             # buffers once; every chunk and decode step reads them from there
             args += list(self._set_slot_cross(slot, req))
-        self.cache.kv, _ = fn(*args)
+        with annotate("engine.prefill"):
+            self.cache.kv, _ = fn(*args)
         self.slots[slot] = _Running(req, slot, [], pending_token=-1,
                                     prefill_cursor=C)
 
@@ -650,7 +727,8 @@ class LLMEngine:
                 jnp.asarray([n], jnp.int32), table]
         if self._cross_kv is not None:
             args += list(self._slot_cross_args(s.slot))
-        self.cache.kv, logits = fn(*args)
+        with annotate("engine.prefill"):
+            self.cache.kv, logits = fn(*args)
         if start + n >= len(req.prompt_ids):
             self.cache.register_prefix(
                 req.prompt_ids, self.cache.seq(req.req_id).blocks)
@@ -676,6 +754,10 @@ class LLMEngine:
         bucket = self.buckets.max if bucket is None else bucket
         key = ("cont", start_blocks, bucket)
         if key not in self._prefill:
+            if self._warmed:
+                # post-warm compile == a shape escaped the warmed closed
+                # set (the cold-graph-behind-the-LB signal)
+                self.obs.count_recompile("prefill_cont")
             self._prefill[key] = make_prefill_cont(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
                 bucket, start_blocks, shardings=self.shardings)
@@ -707,6 +789,8 @@ class LLMEngine:
     def _prefill_for(self, bucket: int, prefix_len: int = 0, n_seqs: int = 1):
         key = (bucket, prefix_len, n_seqs)
         if key not in self._prefill:
+            if self._warmed:
+                self.obs.count_recompile("prefill")
             self._prefill[key] = make_prefill(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
                 bucket, prefix_len=prefix_len, n_seqs=n_seqs,
@@ -730,6 +814,8 @@ class LLMEngine:
               else self._batch_bucket(n_active))
         key = (m, bb)
         if key not in self._decode_fns:
+            if self._warmed:
+                self.obs.count_recompile("decode")
             self._decode_fns[key] = make_decode(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
                 bb, ctx_blocks=m, shardings=self.shardings)
@@ -746,6 +832,8 @@ class LLMEngine:
               else self._batch_bucket(n_active))
         key = (m, bb)
         if key not in self._verify_fns:
+            if self._warmed:
+                self.obs.count_recompile("verify")
             self._verify_fns[key] = make_verify(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
                 bb, self.ecfg.num_speculative_tokens, ctx_blocks=m,
@@ -762,6 +850,7 @@ class LLMEngine:
         victims = [s for s in self.slots if s is not None]
         victim = max(victims, key=lambda s: s.req.req_id)
         log.warning("preempting seq %d (block pool exhausted)", victim.req.req_id)
+        self.obs.count_preemption()
         self.cache.release(victim.req.req_id)
         self.slots[victim.slot] = None
         self._has_image[victim.slot] = 0.0
@@ -797,7 +886,8 @@ class LLMEngine:
                 reason = "length"
             self._finish(Finished(
                 victim.req.req_id, emitted, victim.req.orig_n_prompt, reason,
-                logprobs=lps))
+                logprobs=lps, timing=self._timing_of(victim.req,
+                                                     victim.t_first)))
             return
         # record this decode segment's pace before the slot state is lost —
         # preemption happens at peak load, exactly what TPOT must show
@@ -815,6 +905,8 @@ class LLMEngine:
             orig_n_prompt=victim.req.orig_n_prompt,
             on_token=victim.req.on_token,
             t_submit=victim.req.t_submit,
+            t_admit=victim.req.t_admit,
+            t_first=victim.req.t_first,
             already_lp=(victim.req.already_lp + victim.lps
                         if p.logprobs else [])))
 
@@ -940,8 +1032,9 @@ class LLMEngine:
         if self._cross_kv is not None:
             args += [self._cross_kv, jnp.asarray(a["has_image"]),
                      jnp.asarray(a["slot_idx"]), jnp.asarray(a["cross_len"])]
-        (self.cache.kv, o, oex, accept_p, o_lp, d_lp, oex_lp,
-         top_ids, top_lp) = verify(*args)
+        with annotate("engine.verify"):
+            (self.cache.kv, o, oex, accept_p, o_lp, d_lp, oex_lp,
+             top_ids, top_lp) = verify(*args)
         o = np.asarray(o)
         oex = np.asarray(oex)
         accept_p = np.asarray(accept_p)
@@ -991,7 +1084,8 @@ class LLMEngine:
                         s.req.req_id, s.req.already_generated + s.generated,
                         s.req.orig_n_prompt, "eos" if hit_eos else "length",
                         logprobs=((s.req.already_lp + s.lps)
-                                  if p.logprobs else None)))
+                                  if p.logprobs else None),
+                        timing=self._timing_of(s.req, s.t_first)))
                     self.cache.release(s.req.req_id)
                     self.slots[s.slot] = None
                     self._has_image[s.slot] = 0.0
@@ -1012,18 +1106,16 @@ class LLMEngine:
                         s.lps.append(self._lp_entry(
                             p.logprobs, next_tok, tok_lp,
                             top_ids[i, j], top_lp[i, j]))
-            # drafted/accepted record VERIFICATION outcomes (the drafter-
-            # quality signal); committed records tokens actually walked in
-            self.spec.drafted += nd
-            self.spec.accepted += j
-            self.spec.committed += n_processed
+            self.spec.record_verify(nd, j, n_processed)
             if not finished:
                 s.pending_token = next_tok
         return True
 
     def _decode_step(self) -> None:
         if self._drafter is not None and self._spec_step():
+            self._step_kind = "spec"
             return
+        self._step_kind = "decode"
         # grow each running seq by one slot for the pending token; preempt
         # on pool exhaustion (never preempt down to zero running sequences)
         self._grow_running(lambda s: 1)
@@ -1048,7 +1140,8 @@ class LLMEngine:
         if self._cross_kv is not None:
             args += [self._cross_kv, jnp.asarray(a["has_image"]),
                      jnp.asarray(a["slot_idx"]), jnp.asarray(a["cross_len"])]
-        self.cache.kv, nxt, top_ids_d, top_lp_d, tok_lp_d = decode(*args)
+        with annotate("engine.decode"):
+            self.cache.kv, nxt, top_ids_d, top_lp_d, tok_lp_d = decode(*args)
         nxt = np.asarray(nxt)
         if any(s.req.params.logprobs for s in running):
             top_ids_d = np.asarray(top_ids_d)
@@ -1076,7 +1169,8 @@ class LLMEngine:
                     s.req.req_id, s.req.already_generated + s.generated,
                     s.req.orig_n_prompt, "eos" if hit_eos else "length",
                     logprobs=((s.req.already_lp + s.lps)
-                              if p.logprobs else None)))
+                              if p.logprobs else None),
+                    timing=self._timing_of(s.req, s.t_first)))
                 self.cache.release(s.req.req_id)
                 self.slots[s.slot] = None
                 self._has_image[s.slot] = 0.0

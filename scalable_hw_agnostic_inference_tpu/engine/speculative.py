@@ -48,6 +48,16 @@ class SpecStats:
     verify_steps: int = 0   # multi-token verify dispatches
     fallback_steps: int = 0  # steps that fell back to vanilla decode
 
+    def record_verify(self, n_drafted: int, n_accepted: int,
+                      n_processed: int) -> None:
+        """One sequence's verification outcome: drafted/accepted count the
+        VERIFICATION result (drafter-quality signal); ``n_processed`` the
+        tokens the commit walk actually reached (an EOS/length finish
+        mid-run must not inflate tokens_per_verify)."""
+        self.drafted += n_drafted
+        self.accepted += n_accepted
+        self.committed += n_processed
+
     @property
     def acceptance_rate(self) -> float:
         return self.accepted / self.drafted if self.drafted else 0.0

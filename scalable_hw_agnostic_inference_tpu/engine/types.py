@@ -60,6 +60,14 @@ class Request:
     on_token: Optional[Any] = None
     # submission time (monotonic) for TTFT accounting; survives preemption
     t_submit: float = 0.0
+    # first-admission time (monotonic): queue-wait accounting. Survives
+    # preemption like t_submit — a resume is not a second queue wait.
+    t_admit: float = 0.0
+    # true first-token time (monotonic): the prefill/decode boundary in
+    # Finished.timing. Survives preemption — a resume's re-prefill belongs
+    # to the decode phase it interrupted, not to prefill (the slot-level
+    # t_first, which resets per segment, keeps TPOT per-segment-accurate)
+    t_first: float = 0.0
     # logprob entries for tokens emitted before a preemption (mirrors
     # already_generated)
     already_lp: List = dataclasses.field(default_factory=list)
@@ -82,6 +90,11 @@ class Finished:
     # one entry per token_ids element when the request asked for logprobs:
     # {"token", "logprob", "top_ids", "top_logprobs"}
     logprobs: Optional[List[Dict[str, Any]]] = None
+    # per-phase timeline (obs): monotonic stamps t_submit/t_admit/t_first/
+    # t_done plus derived queue_s/prefill_s/decode_s/total_s — the serving
+    # layer turns these into request-trace spans and bench.py aggregates
+    # them into per-phase report fields
+    timing: Optional[Dict[str, float]] = None
 
 
 @dataclasses.dataclass

@@ -80,6 +80,9 @@ def warm_executables(eng, prefix_lens: Sequence[int] = (0,)) -> int:
     # force compilation (jit is lazy until first call) with null args
     eng._run_warm_calls()
     eng._warmed = True  # cached admission now refuses cold compiles
+    # telemetry baseline: every executable built from here on is a
+    # bucket-miss recompile (obs counts them; /metrics exposes the total)
+    eng.obs.warmed_executables = eng.n_executables
     return n
 
 def _run_warm_calls(eng) -> None:

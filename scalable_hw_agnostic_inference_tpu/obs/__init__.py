@@ -1,0 +1,37 @@
+"""Observability subsystem: request-scoped tracing, engine step telemetry,
+and the flight recorder.
+
+- ``obs.trace``     dependency-free spans, contextvar propagation, W3C
+                    ``traceparent`` ingest/emit, jax.profiler annotations
+- ``obs.steploop``  per-engine-step gauges/counters + TTFT/TPOT/queue-wait
+                    histograms with explicit buckets (stdlib-only; the
+                    serve layer adapts them to Prometheus/JSON lines)
+- ``obs.flight``    bounded ring buffers of recent request timelines and
+                    engine-step records, dumped by ``GET /debug/flight``
+
+Layering: ``obs`` imports nothing from the rest of the package (and no
+third-party deps), so engine AND serve may both depend on it.
+"""
+
+from .flight import FlightRecorder  # noqa: F401
+from .steploop import (  # noqa: F401
+    BucketHistogram,
+    QUEUE_WAIT_BUCKETS,
+    StepTelemetry,
+    TPOT_BUCKETS,
+    TTFT_BUCKETS,
+)
+from .trace import (  # noqa: F401
+    Trace,
+    annotate,
+    begin_request_trace,
+    configure,
+    current_trace,
+    current_traceparent,
+    enabled,
+    format_traceparent,
+    parse_traceparent,
+    span,
+    use_trace,
+    well_formed_problems,
+)

@@ -1,0 +1,73 @@
+"""Flight recorder: bounded in-memory postmortem buffer per serving pod.
+
+When a pod degrades in production, the Prometheus history says *that*
+latency moved; the flight recorder says *what the last N requests actually
+did*: every completed request's span timeline (``obs.trace``) plus the last
+M engine-step records (``obs.steploop``) ride in two ring buffers, dumpable
+as JSON via ``GET /debug/flight`` (``serve.app``). Memory is strictly
+bounded — the rings never grow past their configured sizes — so the
+recorder is always-on, like an aircraft FDR, not a debug mode someone has
+to remember to enable before the incident.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+
+class FlightRecorder:
+    """Ring of the last N completed request timelines (+ an optional
+    engine-step feed provided at dump time). Thread-safe."""
+
+    def __init__(self, max_requests: Optional[int] = None,
+                 max_steps: int = 256):
+        if max_requests is None:
+            max_requests = int(os.environ.get("SHAI_FLIGHT_REQUESTS", "128"))
+        self.max_requests = max_requests
+        self.max_steps = max_steps
+        self._lock = threading.Lock()
+        self._requests: deque = deque(maxlen=max_requests)
+        self._seq = 0
+
+    def record_request(self, trace_dict: Dict[str, Any]) -> None:
+        """Ring-append one completed request's trace (the asgi layer's
+        trace sink). Cheap: one lock + one deque append."""
+        with self._lock:
+            self._seq += 1
+            self._requests.append({"seq": self._seq,
+                                   "recorded_at": round(time.time(), 4),
+                                   "trace": trace_dict})
+
+    @property
+    def n_recorded(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def dump(self, step_source: Optional[Callable[[int],
+                                                  List[Dict]]] = None,
+             n_requests: Optional[int] = None) -> Dict[str, Any]:
+        """The ``/debug/flight`` payload: newest-last request timelines and
+        (when an engine feed exists) the recent step records."""
+        with self._lock:
+            reqs = list(self._requests)
+        if n_requests is not None:
+            # explicit zero-guard: reqs[-0:] would be the WHOLE list
+            reqs = reqs[max(0, len(reqs) - n_requests):] \
+                if n_requests > 0 else []
+        out: Dict[str, Any] = {
+            "recorded_total": self._seq,
+            "capacity": {"requests": self.max_requests,
+                         "steps": self.max_steps},
+            "requests": reqs,
+            "engine_steps": [],
+        }
+        if step_source is not None:
+            try:
+                out["engine_steps"] = step_source(self.max_steps)
+            except Exception as e:  # a dead engine must not break the dump
+                out["engine_steps_error"] = f"{type(e).__name__}: {e}"
+        return out

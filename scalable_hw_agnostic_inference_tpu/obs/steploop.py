@@ -1,0 +1,184 @@
+"""Engine step telemetry: the per-step signals behind the control plane.
+
+The serving layer's request counter tells KEDA *how much* traffic arrived;
+it says nothing about *why* latency moved. The engine records, every
+``step()``, the numbers that explain it — running/waiting occupancy,
+KV-page utilization, preemptions, speculative acceptance, post-warm
+(bucket-miss) recompiles — plus dependency-free TTFT/TPOT/queue-wait
+histograms with explicit buckets. ``serve.metrics`` exports all of it as
+real Prometheus histograms/gauges on ``/metrics`` and as JSON lines, so the
+autoscaler and the cova failover controller scale on queue depth and KV
+pressure instead of raw request rate (SURVEY.md §5: "metrics ARE the
+control plane", now with engine-grade signals).
+
+Layering: the engine must not import the serve package, so everything here
+is stdlib-only; the serve layer adapts these snapshots into exposition
+formats.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: explicit histogram bounds (seconds). TTFT includes queue time, so its
+#: range reaches minutes; TPOT is per-token decode pace (milliseconds).
+TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                30.0, 60.0)
+TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0)
+QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                      5.0, 10.0, 30.0, 60.0)
+
+
+class BucketHistogram:
+    """Thread-safe fixed-bucket histogram (Prometheus-shaped: cumulative
+    bucket counts + sum + count), dependency-free so the engine can own it."""
+
+    def __init__(self, bounds: Sequence[float]):
+        self.bounds: Tuple[float, ...] = tuple(sorted(float(b)
+                                                      for b in bounds))
+        if not self.bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for b in self.bounds:
+            if v <= b:
+                break
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def snapshot(self) -> Dict[str, Any]:
+        """``{"buckets": [(le, cumulative_count), ..., ("+Inf", n)],
+        "sum": float, "count": int}`` — one locked copy."""
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._n
+        out, cum = [], 0
+        for b, c in zip(self.bounds, counts):
+            cum += c
+            out.append((b, cum))
+        return {"buckets": out + [("+Inf", n)], "sum": total, "count": n}
+
+
+class StepTelemetry:
+    """One engine's step-loop instruments: cumulative counters, request
+    latency histograms, and a bounded ring of per-step records (the flight
+    recorder's engine-side feed). All methods are thread-safe; the engine
+    loop thread writes, scrape/dump threads read."""
+
+    def __init__(self, total_blocks: int = 0, max_steps: int = 256):
+        self._lock = threading.Lock()
+        self.total_blocks = total_blocks
+        self._steps: deque = deque(maxlen=max_steps)
+        self.ttft = BucketHistogram(TTFT_BUCKETS)
+        self.tpot = BucketHistogram(TPOT_BUCKETS)
+        self.queue_wait = BucketHistogram(QUEUE_WAIT_BUCKETS)
+        # cumulative counters
+        self.steps = 0
+        self.preemptions = 0
+        self.recompiles = 0          # post-warm (bucket-miss) executables
+        self.requests_finished = 0
+        self.warmed_executables = 0  # closed-set size at readiness
+        # last-step gauges (scraped between steps)
+        self._gauges: Dict[str, float] = {}
+
+    # -- counter hooks (called from the engine) ----------------------------
+
+    def count_preemption(self) -> None:
+        with self._lock:
+            self.preemptions += 1
+
+    def count_recompile(self, kind: str = "") -> None:
+        with self._lock:
+            self.recompiles += 1
+
+    def record_step(self, *, kind: str, duration_s: float, n_running: int,
+                    n_waiting: int, n_chunking: int, blocks_free: int,
+                    blocks_evictable: int = 0, finished: int = 0,
+                    rollback_tokens: int = 0,
+                    spec: Optional[Dict[str, Any]] = None) -> None:
+        """One engine ``step()`` completed; ``kind`` names the decode path
+        taken (``"decode"``, ``"spec"``, ``"idle"``)."""
+        total = self.total_blocks or 1
+        used = max(0, total - blocks_free)
+        rec = {
+            "ts": round(time.time(), 4),
+            "step": 0,  # filled under the lock below
+            "kind": kind,
+            "duration_s": round(duration_s, 6),
+            "running": n_running,
+            "waiting": n_waiting,
+            "chunking": n_chunking,
+            "finished": finished,
+            "kv_blocks_free": blocks_free,
+            "kv_blocks_evictable": blocks_evictable,
+            "kv_utilization": round(used / total, 4),
+            "rollback_tokens": rollback_tokens,
+        }
+        if spec:
+            rec["spec"] = dict(spec)
+        with self._lock:
+            self.steps += 1
+            self.requests_finished += finished
+            rec["step"] = self.steps
+            rec["preemptions_total"] = self.preemptions
+            rec["recompiles_total"] = self.recompiles
+            self._steps.append(rec)
+            self._gauges = {
+                "running": float(n_running),
+                "waiting": float(n_waiting),
+                "chunking": float(n_chunking),
+                "kv_utilization": rec["kv_utilization"],
+                "kv_blocks_free": float(blocks_free),
+                "last_step_duration_s": rec["duration_s"],
+            }
+            if spec and "spec_acceptance_rate" in spec:
+                self._gauges["spec_acceptance_rate"] = float(
+                    spec["spec_acceptance_rate"])
+
+    # -- readouts ----------------------------------------------------------
+
+    def recent_steps(self, n: int = 256) -> List[Dict[str, Any]]:
+        with self._lock:
+            steps = list(self._steps)
+        return steps[-n:]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Flat cumulative snapshot: the JSON-line payload and the source of
+        the ``/stats`` + Prometheus gauge exports."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                "steps": self.steps,
+                "preemptions": self.preemptions,
+                "recompiles": self.recompiles,
+                "requests_finished": self.requests_finished,
+                "warmed_executables": self.warmed_executables,
+                "kv_blocks_total": self.total_blocks,
+            }
+            out.update(self._gauges)
+        for name, h in (("ttft", self.ttft), ("tpot", self.tpot),
+                        ("queue_wait", self.queue_wait)):
+            out[f"{name}_count"] = h.count
+        return out
+
+    def histograms(self) -> Dict[str, Dict[str, Any]]:
+        """Named histogram snapshots for the Prometheus adapter."""
+        return {"ttft_seconds": self.ttft.snapshot(),
+                "tpot_seconds": self.tpot.snapshot(),
+                "queue_wait_seconds": self.queue_wait.snapshot()}

@@ -51,6 +51,29 @@ class ControllerState:
     last_trigger: str = ""
 
 
+@dataclasses.dataclass(frozen=True)
+class OverloadThresholds:
+    """When a pod's engine telemetry (serve ``/stats`` → ``engine`` section,
+    the obs.steploop snapshot) reads saturated: a sustained admission queue
+    OR a KV pool at the preemption edge. These are leading indicators —
+    they move minutes before the request-rate trigger sees refused work."""
+
+    max_queue_depth: float = 8.0       # waiting requests on one pod
+    max_kv_utilization: float = 0.95   # page pool fraction in use
+
+
+def is_overloaded(stats: Optional[dict],
+                  th: OverloadThresholds = OverloadThresholds()) -> bool:
+    """One pod's engine snapshot → saturated? Missing/partial snapshots
+    (pod loading, old image) read as healthy — absence of telemetry must
+    not flap the routing mode."""
+    if not isinstance(stats, dict):
+        return False
+    if stats.get("waiting", 0) > th.max_queue_depth:
+        return True
+    return stats.get("kv_utilization", 0.0) > th.max_kv_utilization
+
+
 def is_capacity_failure(ev: Event, nodepool_substrings: Sequence[str]) -> bool:
     text = f"{ev.reason} {ev.message}"
     if not any(m.lower() in text.lower() for m in INSUFFICIENT_MARKERS):
@@ -64,7 +87,9 @@ def is_capacity_failure(ev: Event, nodepool_substrings: Sequence[str]) -> bool:
 def decide(state: ControllerState, events: List[Event],
            load_ready_replicas: Optional[int],
            nodepool_substrings: Sequence[str] = (),
-           fresh_cycle: range = range(1, 6)) -> str:
+           fresh_cycle: range = range(1, 6),
+           engine_stats: Optional[Sequence[Optional[dict]]] = None,
+           thresholds: OverloadThresholds = OverloadThresholds()) -> str:
     """Pure decision → action: "failover" | "fallback" | "hold".
 
     Mirrors the reference's two rules exactly (``capacity-checker-deploy.
@@ -72,11 +97,24 @@ def decide(state: ControllerState, events: List[Event],
     cycle while failed-over → fallback. Does NOT mutate ``state`` — callers
     :func:`commit` only after the cluster apply succeeds, so a failed apply
     retries next poll instead of desyncing controller from cluster.
+
+    ``engine_stats`` (optional, one obs snapshot per serving pod — see
+    :func:`fetch_engine_stats`) adds a third, leading trigger: a majority of
+    pods saturated (queue depth / KV utilization past ``thresholds``) while
+    cost-optimized fails over BEFORE provisioning events appear — the
+    raw-request-rate signal the reference scales on cannot see a pool that
+    is full but not yet refusing.
     """
     failures = [e for e in events if is_capacity_failure(e, nodepool_substrings)]
     if state.mode == "weighted" and failures:
         state.last_trigger = failures[0].message[:200]
         return "failover"
+    if state.mode == "weighted" and engine_stats:
+        hot = sum(1 for s in engine_stats if is_overloaded(s, thresholds))
+        if hot * 2 > len(engine_stats):  # strict majority: one hot pod is
+            state.last_trigger = (       # a scheduling blip, not capacity
+                f"engine overload on {hot}/{len(engine_stats)} pods")
+            return "failover"
     if state.mode == "equal" and load_ready_replicas is not None \
             and load_ready_replicas in fresh_cycle:
         state.last_trigger = f"load readyReplicas={load_ready_replicas}"
@@ -120,6 +158,31 @@ def fetch_load_ready(deployment: str, namespace: str = "load") -> Optional[int]:
         return None
 
 
+def fetch_engine_stats(urls: Sequence[str],
+                       timeout: float = 5.0) -> List[Optional[dict]]:
+    """Poll each serving pod's ``/stats`` for its engine telemetry snapshot
+    (``serve.app`` exposes the obs.steploop snapshot under ``"engine"``).
+    Returns ONE entry per url: unreachable pods and engine-less services
+    yield ``None`` — which :func:`is_overloaded` reads as healthy — so the
+    overload-majority denominator in :func:`decide` stays the fleet size.
+    (Dropping them instead would let a single hot pod constitute a "strict
+    majority" during a rolling restart.)"""
+    import httpx
+
+    out: List[Optional[dict]] = []
+    for u in urls:
+        eng = None
+        try:
+            r = httpx.get(f"{u.rstrip('/')}/stats", timeout=timeout)
+            got = r.json().get("engine")
+            if isinstance(got, dict):
+                eng = got
+        except Exception:
+            log.debug("stats poll failed for %s", u, exc_info=True)
+        out.append(eng)
+    return out
+
+
 def apply_mode(mode: str, manifest_dir: str, app: str) -> None:
     """Apply the ingress + scaledobjects for the target mode (the
     reference's kubectl-apply pair, ``capacity-checker-deploy.yaml:30-36``)."""
@@ -130,12 +193,15 @@ def apply_mode(mode: str, manifest_dir: str, app: str) -> None:
 
 def main_loop(app: str = "sd21", manifest_dir: str = "/deploy",
               nodepools: Sequence[str] = ("tpu", "v5e"),
-              load_deploy: str = "load", interval_s: int = 300) -> None:
+              load_deploy: str = "load", interval_s: int = 300,
+              stats_urls: Sequence[str] = ()) -> None:
     state = ControllerState()
     while True:
         try:
             action = decide(state, fetch_events(), fetch_load_ready(load_deploy),
-                            nodepool_substrings=nodepools)
+                            nodepool_substrings=nodepools,
+                            engine_stats=(fetch_engine_stats(stats_urls)
+                                          if stats_urls else None))
             if action in ("failover", "fallback"):
                 mode = "equal" if action == "failover" else "weighted"
                 log.warning("%s -> applying %s routing (%s)", action, mode,
@@ -159,4 +225,8 @@ if __name__ == "__main__":
         nodepools=tuple(os.environ.get("NODEPOOLS", "tpu,v5e").split(",")),
         load_deploy=os.environ.get("LOAD_DEPLOY", "load"),
         interval_s=int(os.environ.get("INTERVAL_S", "300")),
+        # comma-separated pod /stats base URLs: enables the engine-overload
+        # failover trigger (queue depth / KV pressure from obs telemetry)
+        stats_urls=tuple(u for u in
+                         os.environ.get("STATS_URLS", "").split(",") if u),
     )

@@ -81,6 +81,36 @@ class CovaClient:
                                      f"{r.text[:200]}")
             return r.json()
 
+    async def fleet(self) -> Dict[str, Any]:
+        """Every configured model's ``/stats`` in one fan-out: served
+        counts, latency percentiles, and (engine-backed units) the obs
+        step-telemetry snapshot — queue depth, KV utilization, preemptions.
+        The orchestrator-level view the failover controller and a human
+        debugging the chain both want (an unreachable model reports its
+        error instead of failing the whole dump)."""
+        import httpx
+
+        async def one(c, name):
+            try:
+                r = await c.get(f"{self.url_of(name)}/stats")
+                if r.status_code != 200:
+                    return name, {"error": f"/stats -> {r.status_code}"}
+                return name, r.json()
+            except Exception as e:
+                return name, {"error": str(e)[:200]}
+
+        from .capacity_checker import is_overloaded  # ONE threshold owner
+
+        async with httpx.AsyncClient(timeout=10.0) as c:
+            results = dict(await asyncio.gather(
+                *[one(c, n) for n in self.models]))
+        # a mis-pointed URL can 200 with non-dict JSON; keep it in the dump
+        # but never let it break the aggregation
+        overloaded = sorted(n for n, st in results.items()
+                            if isinstance(st, dict)
+                            and is_overloaded(st.get("engine")))
+        return {"models": results, "overloaded": overloaded}
+
     async def chain(self, prompt: str, image_b64: str = "") -> Dict[str, Any]:
         """The full cova chain: prompt → image → caption → embeddings.
 
@@ -185,6 +215,10 @@ def create_cova_app(models_path: str) -> App:
         body = request.json()
         return await client.chain(str(body.get("prompt", "")),
                                   str(body.get("image_b64", "")))
+
+    @app.get("/fleet")
+    async def fleet(request: Request):
+        return await client.fleet()
 
     @app.post("/compare")
     async def compare(request: Request):

@@ -25,10 +25,13 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextvars
 import logging
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..obs import FlightRecorder
+from ..obs import trace as obs_trace
 from ..utils.env import ServeConfig
 from .asgi import App, HTTPError, Request, Response
 from .latency import LatencyCollector, run_benchmark
@@ -108,6 +111,18 @@ class ModelService:
         served inference so acceptance rate reaches the autoscaling plane."""
         return None
 
+    def engine_telemetry(self):
+        """The engine's ``obs.steploop.StepTelemetry`` (None for services
+        without an engine). Resolved lazily — the app factory registers the
+        Prometheus collector before ``load()`` built the engine — and read
+        at every scrape, ``/stats`` call, and ``/debug/flight`` dump."""
+        return None
+
+    def step_records(self, n: int = 256) -> List[Dict[str, Any]]:
+        """The last ``n`` engine step records for the flight recorder."""
+        tele = self.engine_telemetry()
+        return tele.recent_steps(n) if tele is not None else []
+
     def export_artifacts(self, artifact_root: str) -> int:
         """Export portable AOT artifacts (StableHLO via ``core.aot.AotCache``)
         under the artifact root; returns how many were written.
@@ -156,13 +171,21 @@ def create_app(
     collector = LatencyCollector()
     pub = publisher or MetricsPublisher(cfg.app, cfg.nodepool, cfg.pod_name)
     state = {"loaded": False, "warm": False, "load_error": None}
+    # flight recorder: every completed request's span timeline rings here
+    # (the asgi layer closes each trace and sinks it), joined at dump time
+    # by the engine's step records — GET /debug/flight
+    flight = FlightRecorder()
+    app.trace_sink = flight.record_request
+    # engine telemetry → /metrics: TTFT/TPOT/queue-wait histograms + step
+    # gauges/counters, resolved lazily at scrape time
+    pub.attach_engine_telemetry(service.engine_telemetry)
     # the model lane: probes never queue behind it. Width 1 serializes device
     # access; engine-backed services widen it (their infer only enqueues).
     lane = concurrent.futures.ThreadPoolExecutor(
         max_workers=max(1, service.concurrency), thread_name_prefix="model")
 
     app.state.update(cfg=cfg, service=service, collector=collector, publisher=pub,
-                     status=state)
+                     status=state, flight=flight)
 
     def _do_load_and_warm():
         t0 = time.perf_counter()
@@ -190,7 +213,11 @@ def create_app(
 
     async def _run_model(fn: Callable, *args):
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(lane, fn, *args)
+        # run under a COPY of the caller's context: run_in_executor does not
+        # propagate contextvars, and the request trace must follow the model
+        # call onto the lane thread so spans opened there nest correctly
+        ctx = contextvars.copy_context()
+        return await loop.run_in_executor(lane, lambda: ctx.run(fn, *args))
 
     def _require_ready():
         if state["load_error"]:
@@ -234,13 +261,20 @@ def create_app(
         _require_ready()
         payload = request.json()
         t0 = time.perf_counter()
-        out = await _run_model(service.infer, payload)
+        # annotation=False: this span is held across an await on the event
+        # loop; the device-trace view comes from the engine's own
+        # prefill/decode annotations on the lane thread
+        with obs_trace.span("model_infer", annotation=False):
+            out = await _run_model(service.infer, payload)
         dt = time.perf_counter() - t0
         collector.record(dt)
         pub.publish(dt)
         sc = service.spec_counters()
         if sc is not None:
             pub.publish_spec(**sc)
+        tele = service.engine_telemetry()
+        if tele is not None:
+            pub.publish_engine(tele)
         if isinstance(out, dict):
             out.setdefault("latency_s", round(dt, 4))
         return out
@@ -301,7 +335,27 @@ def create_app(
             svc = {}
         if svc:
             out["service"] = svc
+        tele = service.engine_telemetry()
+        if tele is not None:
+            out["engine"] = tele.snapshot()
+        from ..core.aot import compile_stats
+
+        out["aot"] = compile_stats()
         return out
+
+    @app.get("/debug/flight")
+    def debug_flight(request: Request):
+        """Postmortem dump: the last-N completed request timelines (span
+        trees, W3C trace ids) + the last-M engine step records. Bounded
+        rings — safe to curl on a degraded pod at any time."""
+        n_req = None
+        if "requests" in request.query:
+            try:
+                n_req = max(0, int(request.query["requests"]))
+            except ValueError:
+                raise HTTPError(400, "requests must be an integer")
+        return flight.dump(step_source=service.step_records,
+                           n_requests=n_req)
 
     if pub.registry is not None:
         # service gauges read at scrape time — queue depth / pool occupancy
@@ -401,7 +455,8 @@ def create_app(
             async def _handler(request: Request, **params):
                 _require_ready()
                 t0 = time.perf_counter()
-                out = await _run_model(lambda: h(request, **params))
+                with obs_trace.span("model_infer", annotation=False):
+                    out = await _run_model(lambda: h(request, **params))
                 if isinstance(out, StreamingResponse):
                     # record when the stream DRAINS, not when the handler
                     # returns (that's just the submission)
@@ -421,6 +476,9 @@ def create_app(
                 dt = time.perf_counter() - t0
                 collector.record(dt)
                 pub.publish(dt)
+                tele = service.engine_telemetry()
+                if tele is not None:
+                    pub.publish_engine(tele)
                 return out
             return _handler
         app.route(pattern, tuple(methods))(_wrap(handler))

@@ -22,6 +22,8 @@ import traceback
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qsl
 
+from ..obs import trace as obs_trace
+
 log = logging.getLogger(__name__)
 
 
@@ -49,6 +51,10 @@ class Request:
         )
         self.path_params: Dict[str, Any] = {}
         self.body: bytes = body
+        self.route_matched = False  # set by dispatch when a handler runs
+        # request-scoped trace (obs.trace), set by the app when tracing is
+        # on; handlers may open child spans through the contextvar API
+        self.trace: Optional["obs_trace.Trace"] = None
 
     def json(self) -> Any:
         if not self.body:
@@ -165,6 +171,14 @@ class App:
         self.on_shutdown: List[Callable[[], Any]] = []
         self.state: Dict[str, Any] = {}
         self._started = False
+        # completed request traces go here (serve.app points it at the
+        # flight recorder); None = drop them after the response
+        self.trace_sink: Optional[Callable[[Dict[str, Any]], None]] = None
+        # probe/scrape surfaces stay untraced: a kubelet polling /readiness
+        # at 2 Hz (or the capacity checker / cova /fleet polling /stats)
+        # would evict every real request from the flight ring
+        self.trace_exclude = {"/health", "/readiness", "/metrics", "/stats",
+                              "/debug/flight"}
 
     # -- registration ------------------------------------------------------
     def route(self, pattern: str, methods: Tuple[str, ...] = ("GET",)):
@@ -216,6 +230,7 @@ class App:
                 allowed.append(route.method)
                 continue
             request.path_params = params
+            request.route_matched = True
             result = route.handler(request, **params)
             if inspect.isawaitable(result):
                 result = await result
@@ -259,52 +274,97 @@ class App:
                 return
 
         request = Request(scope, body)
-        try:
-            response = await self._dispatch(request)
-        except HTTPError as e:
-            response = Response({"detail": e.detail}, status=e.status)
-        except Exception:
-            log.error("handler error on %s %s\n%s", request.method, request.path,
-                      traceback.format_exc())
-            response = Response({"detail": "internal server error"}, status=500)
+        # W3C trace-context ingest: a valid upstream traceparent continues
+        # the caller's trace id; otherwise (or with tracing off → None) a
+        # fresh trace roots here. The whole request — dispatch, model call,
+        # stream drain — lives under ONE root span.
+        tr = None
+        if request.path not in self.trace_exclude:
+            tr = obs_trace.begin_request_trace(
+                f"{request.method} {request.path}",
+                request.headers.get("traceparent"),
+                method=request.method, path=request.path)
+        request.trace = tr
 
-        await send(
-            {
-                "type": "http.response.start",
-                "status": response.status,
-                "headers": [
-                    (k.encode("latin-1"), v.encode("latin-1"))
-                    for k, v in response.headers.items()
-                ],
-            }
-        )
-        if isinstance(response, StreamingResponse):
-            import asyncio
-
-            loop = asyncio.get_event_loop()
-            it = iter(response.iterator)
-            _END = object()
-
-            def _next():
+        def _finish_trace(status: int) -> None:
+            if tr is None or tr.root.closed:
+                return
+            tr.root.attrs["status"] = status
+            tr.close()
+            # unrouted traffic (scanner 404s, misconfigured probes at 2 Hz)
+            # must not turn over the flight ring: the trace still closes
+            # (traceparent header, annotations) but only requests a real
+            # handler served are sunk for postmortems
+            if not getattr(request, "route_matched", False):
+                return
+            sink = self.trace_sink
+            if sink is not None:
                 try:
-                    return next(it)
-                except StopIteration:
-                    return _END
+                    sink(tr.to_dict())
+                except Exception:  # recorder trouble must not fail requests
+                    log.exception("trace sink failed")
 
-            while True:
-                # dedicated pool: each live SSE stream parks one thread in
-                # _next (possibly for minutes on a queued request); the
-                # default executor is capped at min(32, cpus+4) and shared
-                # with asyncio internals (getaddrinfo), so saturating it
-                # stalls every OTHER stream and DNS lookup (ADVICE r3)
-                chunk = await loop.run_in_executor(_stream_pool(), _next)
-                if chunk is _END:
-                    break
-                if isinstance(chunk, str):
-                    chunk = chunk.encode()
-                if chunk:
-                    await send({"type": "http.response.body", "body": chunk,
-                                "more_body": True})
-            await send({"type": "http.response.body", "body": b""})
-            return
-        await send({"type": "http.response.body", "body": response.body})
+        with obs_trace.use_trace(tr):
+            try:
+                response = await self._dispatch(request)
+            except HTTPError as e:
+                response = Response({"detail": e.detail}, status=e.status)
+            except Exception:
+                log.error("handler error on %s %s\n%s", request.method,
+                          request.path, traceback.format_exc())
+                response = Response({"detail": "internal server error"},
+                                    status=500)
+        if tr is not None:
+            # traceparent emit: downstream hops (and the client) can join
+            # their spans to this request's trace id
+            response.headers.setdefault("traceparent", tr.traceparent)
+
+        # try/finally: an aborted request (client disconnect mid-stream, a
+        # generator raising after headers went out) must STILL close and
+        # sink its trace — failed requests are the ones postmortems need
+        try:
+            await send(
+                {
+                    "type": "http.response.start",
+                    "status": response.status,
+                    "headers": [
+                        (k.encode("latin-1"), v.encode("latin-1"))
+                        for k, v in response.headers.items()
+                    ],
+                }
+            )
+            if isinstance(response, StreamingResponse):
+                import asyncio
+
+                loop = asyncio.get_event_loop()
+                it = iter(response.iterator)
+                _END = object()
+
+                def _next():
+                    try:
+                        return next(it)
+                    except StopIteration:
+                        return _END
+
+                while True:
+                    # dedicated pool: each live SSE stream parks one thread
+                    # in _next (possibly for minutes on a queued request);
+                    # the default executor is capped at min(32, cpus+4) and
+                    # shared with asyncio internals (getaddrinfo), so
+                    # saturating it stalls every OTHER stream and DNS
+                    # lookup (ADVICE r3)
+                    chunk = await loop.run_in_executor(_stream_pool(), _next)
+                    if chunk is _END:
+                        break
+                    if isinstance(chunk, str):
+                        chunk = chunk.encode()
+                    if chunk:
+                        await send({"type": "http.response.body",
+                                    "body": chunk, "more_body": True})
+                await send({"type": "http.response.body", "body": b""})
+                return
+            await send({"type": "http.response.body", "body": response.body})
+        finally:
+            # the root span covers the DRAIN, not just the handler return —
+            # an SSE token stream's trace ends with its last token
+            _finish_trace(response.status)

@@ -23,7 +23,7 @@ import json
 import sys
 import threading
 import time
-from typing import Optional
+from typing import Any, Callable, Dict, Optional
 
 from .. import METRIC_NAMESPACE
 
@@ -42,6 +42,85 @@ except Exception:  # pragma: no cover
 _LATENCY_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 0.9, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
+
+#: engine-phase histogram metric names on /metrics ← obs.steploop snapshot
+#: keys (buckets live with the histograms: obs.steploop.TTFT_BUCKETS etc.)
+ENGINE_HISTOGRAMS = {
+    "ttft_seconds": ("shai_ttft_seconds",
+                     "Time to first token (queue wait included)"),
+    "tpot_seconds": ("shai_tpot_seconds",
+                     "Per-output-token decode pace after the first token"),
+    "queue_wait_seconds": ("shai_queue_wait_seconds",
+                           "Submit-to-admission wait in the engine queue"),
+}
+_ENGINE_GAUGES = {
+    "running": ("shai_engine_running", "Sequences decoding right now"),
+    "waiting": ("shai_engine_waiting", "Requests in the admission queue"),
+    "chunking": ("shai_engine_chunking", "Slots mid chunked-prefill"),
+    "kv_utilization": ("shai_engine_kv_utilization",
+                       "KV page pool fraction in use"),
+    "kv_blocks_free": ("shai_engine_kv_blocks_free", "Free KV pool blocks"),
+    "spec_acceptance_rate": ("shai_spec_acceptance_rate",
+                             "Speculative draft acceptance rate"),
+}
+_ENGINE_COUNTERS = {
+    "steps": ("shai_engine_steps", "Engine steps executed"),
+    "preemptions": ("shai_engine_preemptions",
+                    "Recompute-preemptions (KV pool pressure)"),
+    "recompiles": ("shai_engine_recompiles",
+                   "Post-warm bucket-miss executable compiles"),
+    "requests_finished": ("shai_engine_requests_finished",
+                          "Requests finished by the engine"),
+}
+
+
+class EngineTelemetryCollector:
+    """Prometheus custom collector over an ``obs.steploop.StepTelemetry``.
+
+    ``provider`` is a zero-arg callable returning the telemetry (or None
+    before the engine loads) — resolved at scrape time, so registration can
+    happen before ``service.load()`` built the engine.
+    """
+
+    def __init__(self, provider: Callable[[], Any], app: str):
+        self.provider = provider
+        self.app = app
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+            HistogramMetricFamily,
+        )
+
+        try:
+            tele = self.provider()
+        except Exception:
+            return
+        if tele is None:
+            return
+        snap = tele.snapshot()
+        for key, (name, doc) in _ENGINE_GAUGES.items():
+            if key in snap:
+                g = GaugeMetricFamily(name, doc, labels=["app"])
+                g.add_metric([self.app], float(snap[key]))
+                yield g
+        for key, (name, doc) in _ENGINE_COUNTERS.items():
+            c = CounterMetricFamily(name, doc, labels=["app"])
+            c.add_metric([self.app], float(snap.get(key, 0)))
+            yield c
+        hists = tele.histograms()
+        for key, (name, doc) in ENGINE_HISTOGRAMS.items():
+            hs = hists.get(key)
+            if hs is None:
+                continue
+            h = HistogramMetricFamily(name, doc, labels=["app"])
+            h.add_metric(
+                [self.app],
+                [(str(le) if le != "+Inf" else "+Inf", float(c))
+                 for le, c in hs["buckets"]],
+                sum_value=float(hs["sum"]))
+            yield h
 
 
 class MetricsPublisher:
@@ -93,6 +172,7 @@ class MetricsPublisher:
                 for kind in ("drafted", "accepted", "committed")
             }
         self._spec_last = {"drafted": 0, "accepted": 0, "committed": 0}
+        self._engine_last_steps = -1
 
     @property
     def served(self) -> int:
@@ -156,6 +236,46 @@ class MetricsPublisher:
                     "pod": self.pod_name,
                     "data": data,
                 }), file=self._stream, flush=True)
+
+    def attach_engine_telemetry(self, provider: Callable[[], Any]) -> bool:
+        """Register the engine's step telemetry on this publisher's
+        Prometheus registry (TTFT/TPOT/queue-wait histograms + step gauges
+        and counters). ``provider`` resolves lazily at scrape time so the
+        app factory can attach before the engine exists. Returns False when
+        prometheus_client is unavailable (the JSON-line path —
+        :meth:`publish_engine` — still works there)."""
+        if not (_HAVE_PROM and self.registry is not None):
+            return False
+        self.registry.register(EngineTelemetryCollector(provider, self.app))
+        return True
+
+    def publish_engine(self, tele: Any) -> None:
+        """Emit one JSON line of engine step telemetry (the push-model twin
+        of the Prometheus collector, for clusters scaling off a log
+        router). Deduped on the step counter: a snapshot identical in step
+        count to the last published one is dropped, so request bursts don't
+        multiply identical lines. Accepts either a snapshot dict or the
+        live telemetry object (``.steps`` / ``.snapshot()``); with the
+        object form, deduped hot-path calls pay one int compare instead of
+        building a snapshot that would be thrown away."""
+        if not self.emit_json:
+            return
+        with self._lock:
+            is_dict = isinstance(tele, dict)
+            steps = tele.get("steps", 0) if is_dict else tele.steps
+            if steps == self._engine_last_steps:
+                return
+            self._engine_last_steps = steps
+            snapshot = tele if is_dict else tele.snapshot()
+            data = {f"{self.app}-engine-{k}": v
+                    for k, v in snapshot.items()
+                    if isinstance(v, (int, float))}
+            print(json.dumps({
+                "ns": METRIC_NAMESPACE,
+                "ts": round(time.time(), 3),
+                "pod": self.pod_name,
+                "data": data,
+            }), file=self._stream, flush=True)
 
     def start_exporter(self, port: int) -> bool:
         """Start the Prometheus scrape endpoint; returns False if unavailable."""

@@ -38,6 +38,8 @@ import pickle
 import threading
 from typing import Any, Dict
 
+from ..obs import trace as obs_trace
+
 log = logging.getLogger(__name__)
 
 _OP_SHUTDOWN = 0
@@ -95,10 +97,14 @@ class MultihostDriver:
     leader-only and wedge the slice.
     """
 
-    def __init__(self, service):
+    def __init__(self, service, trace_sink=None):
         self.service = service
         self._lock = threading.Lock()
         self.methods = tuple(getattr(service, "mirror_methods", ("infer",)))
+        # completed follower-side mirror traces go here (None = drop):
+        # production followers have no flight recorder, but tests and
+        # debug builds can observe what the follower actually mirrored
+        self.trace_sink = trace_sink
 
     # -- leader side --------------------------------------------------------
     def wrap_leader(self) -> None:
@@ -108,8 +114,13 @@ class MultihostDriver:
 
             def wrapped(*args, _inner=inner, _name=name, **kwargs):
                 with self._lock:
-                    _broadcast_bytes(
-                        pickle.dumps((_OP_INFER, (_name, args, kwargs))))
+                    # W3C context rides the RPC: the follower's mirrored
+                    # work annotates under the LEADER's trace id, so one
+                    # request is one trace across the whole slice
+                    _broadcast_bytes(pickle.dumps(
+                        (_OP_INFER,
+                         (_name, args, kwargs,
+                          obs_trace.current_traceparent()))))
                     return _inner(*args, **kwargs)
 
             setattr(self.service, name, wrapped)
@@ -141,12 +152,24 @@ class MultihostDriver:
             if op == _OP_SHUTDOWN:
                 log.info("follower: shutdown broadcast received")
                 return
-            name, args, kwargs = msg
+            # 4-tuple since the tracing release; the 3-tuple branch is
+            # defensive only (a slice's hosts always run one image — JAX
+            # multihost requires identical code — so a version skew where
+            # an OLD follower sees the 4-tuple cannot occur intra-slice)
+            traceparent = None
+            if len(msg) == 4:
+                name, args, kwargs, traceparent = msg
+            else:
+                name, args, kwargs = msg
             if name not in self.methods:
                 log.error("follower: refusing unmirrored method %r", name)
                 raise ValueError(f"unmirrored method {name!r}")
+            tr = obs_trace.begin_request_trace(
+                f"mirror {name}", traceparent,
+                role="follower", method=name)
             try:
-                getattr(self.service, name)(*args, **kwargs)
+                with obs_trace.use_trace(tr):
+                    getattr(self.service, name)(*args, **kwargs)
             except HTTPError as e:
                 log.info("follower: mirrored %s rejected the payload "
                          "symmetrically (%s) — continuing", name, e)
@@ -154,6 +177,14 @@ class MultihostDriver:
                 log.exception("follower: mirrored %s diverged — dying so "
                               "the unit restarts together", name)
                 raise
+            finally:
+                if tr is not None:
+                    tr.close()
+                    if self.trace_sink is not None:
+                        try:
+                            self.trace_sink(tr.to_dict())
+                        except Exception:
+                            log.exception("mirror trace sink failed")
 
 
 def serve_multihost(cfg, service) -> None:

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.registry import register_model
+from ...obs import trace as obs_trace
 from ...utils.env import ServeConfig
 from ..app import ModelService
 from ..asgi import HTTPError
@@ -260,19 +261,24 @@ class VllmService(ModelService):
             return "engine loop is not running"
         return None
 
+    def engine_telemetry(self):
+        eng = getattr(self, "_engine", None)
+        return None if eng is None else eng.obs
+
     def _encode(self, text: str, add_special: bool = True):
         # the engine's true capacity, not the largest bucket — prompts past
         # the bucket chunk through the continuation-prefill ladder.
         # add_special=False: chat-template output already carries its own
         # special tokens (a default BOS would double it)
         cap = self._engine.max_prompt_len
-        if self._byte_tok:
-            ids, n = self.tokenizer.encode(text, cap)
-            return [int(i) for i in ids[:n]]
-        with self._tok_lock:
-            return [int(i) for i in self.tokenizer(
-                text, truncation=True, max_length=cap,
-                add_special_tokens=add_special)["input_ids"]]
+        with obs_trace.span("tokenize"):
+            if self._byte_tok:
+                ids, n = self.tokenizer.encode(text, cap)
+                return [int(i) for i in ids[:n]]
+            with self._tok_lock:
+                return [int(i) for i in self.tokenizer(
+                    text, truncation=True, max_length=cap,
+                    add_special_tokens=add_special)["input_ids"]]
 
     def _decode(self, ids) -> str:
         if self._byte_tok:
@@ -372,10 +378,18 @@ class VllmService(ModelService):
         from Finished to the serving dict (rejected → 503), shared by infer
         and the OpenAI n>1 fan-out."""
         fin = fut.result(timeout=600.0)
+        # graft the engine's per-phase timeline onto the request trace:
+        # queue/prefill/decode become spans of THIS request even though the
+        # engine loop ran them on its own thread
+        tr = obs_trace.current_trace()
+        if tr is not None and fin.timing:
+            tr.add_phase_spans(fin.timing)
         if fin.stop_reason == "rejected":
             raise HTTPError(503, "request rejected: prompt cannot fit the KV pool")
+        with obs_trace.span("detokenize"):
+            text = self._decode(fin.token_ids)
         out = {
-            "generated_text": self._decode(fin.token_ids),
+            "generated_text": text,
             "n_tokens": len(fin.token_ids),
             "n_prompt": fin.n_prompt,
             "stop_reason": fin.stop_reason,
@@ -580,6 +594,9 @@ class VllmService(ModelService):
         stops = [stop] if isinstance(stop, str) else list(stop)
         tokq: "_q.Queue[int]" = _q.Queue()
         fut = self.loop.submit(ids, params, on_token=tokq.put)
+        # captured HERE (handler context): the chunk generator drains on a
+        # stream-pool thread where the request contextvar is absent
+        req_trace = obs_trace.current_trace()
         rid = f"shai-{self._next_openai_id()}"
         created = int(_time.time())
         model = self.cfg.model_id or "tiny"
@@ -627,6 +644,8 @@ class VllmService(ModelService):
                         self.loop.cancel(fut)
                         break
                 fin = fut.result(timeout=600.0)
+                if req_trace is not None and fin.timing:
+                    req_trace.add_phase_spans(fin.timing)
                 if fin.stop_reason == "rejected":
                     # headers already went out as 200 — signal in-band
                     yield ("data: " + _json.dumps({"error": {

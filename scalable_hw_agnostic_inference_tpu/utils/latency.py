@@ -4,21 +4,34 @@ must not import the serve package: layering)."""
 
 from __future__ import annotations
 
+import random
 import threading
 import time
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 PERCENTILES = (0, 50, 90, 95, 99, 100)
 
 
 class LatencyCollector:
-    """Thread-safe reservoir of request latencies with percentile readout."""
+    """Thread-safe reservoir of request latencies with percentile readout.
 
-    def __init__(self, max_samples: int = 100_000):
+    Once the reservoir is full, Vitter's Algorithm R keeps every sample in
+    with probability ``max_samples / total`` — a uniform random subsample of
+    the whole stream. (The previous ``total % max_samples`` overwrite was
+    deterministic round-robin: it kept exactly the LAST ``max_samples``
+    observations, so long-tail samples older than one reservoir length
+    could never survive and percentiles silently became a sliding window.)
+    The RNG is seeded (private stream) so runs are reproducible and the
+    global ``random`` state is untouched.
+    """
+
+    def __init__(self, max_samples: int = 100_000,
+                 seed: Optional[int] = 0x5EED):
         self._lock = threading.Lock()
         self._samples: List[float] = []
         self._max_samples = max_samples
         self._total = 0
+        self._rng = random.Random(seed)
 
     def record(self, latency_s: float) -> None:
         with self._lock:
@@ -26,8 +39,11 @@ class LatencyCollector:
             if len(self._samples) < self._max_samples:
                 self._samples.append(latency_s)
             else:
-                # reservoir-style overwrite keeps memory bounded under load
-                self._samples[self._total % self._max_samples] = latency_s
+                # Algorithm R: admit with p = max/total, evicting a uniform
+                # victim — every observation ends up kept with equal chance
+                j = self._rng.randrange(self._total)
+                if j < self._max_samples:
+                    self._samples[j] = latency_s
 
     def timed(self, fn: Callable, *args, **kwargs):
         """Run ``fn`` and record its wall time; returns ``fn``'s result."""

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Tier-1 wall-clock budget gate.
+
+The driver runs ``pytest tests/ -m 'not slow'`` under a hard 870 s timeout
+(ROADMAP.md). This gate keeps the tier-1 SELECTION honest: it collects the
+current ``not slow`` test ids and prices them against a measured per-test
+duration snapshot (``tests/tier1_durations.json``, written by conftest's
+``SHAI_TEST_DURATIONS`` capture on a full run of this container). If the
+projected wall time exceeds the budget, it exits 1 and names the worst
+offenders — the tests to ``@pytest.mark.slow`` next.
+
+Usage::
+
+    python scripts/check_tier1_budget.py               # gate (budget 760 s)
+    python scripts/check_tier1_budget.py --budget 700
+    python scripts/check_tier1_budget.py --durations /tmp/fresh.json
+
+The budget defaults below the driver's 870 s timeout on purpose: the
+snapshot was measured on an idle container, and collection/import overhead
+plus CI jitter eat the difference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SNAPSHOT = os.path.join(ROOT, "tests", "tier1_durations.json")
+
+#: collection + import + fixture overhead not attributed to any test in the
+#: snapshot (measured: full-run wall minus summed test durations)
+DEFAULT_OVERHEAD_S = 120.0
+DEFAULT_BUDGET_S = 760.0
+#: priced per test that has no snapshot entry yet (new/renamed tests)
+UNKNOWN_TEST_ESTIMATE_S = 1.0
+
+
+def selected_tests() -> List[str]:
+    """Node ids the tier-1 selection currently runs (``-m 'not slow'``)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-m", "not slow",
+         "--collect-only", "-q", "-p", "no:cacheprovider"],
+        capture_output=True, text=True, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    ids = [ln.strip() for ln in r.stdout.splitlines()
+           if "::" in ln and not ln.startswith(("=", "~", " "))]
+    if not ids:
+        print("collection produced no tests; pytest said:\n"
+              + r.stdout[-2000:] + r.stderr[-2000:], file=sys.stderr)
+        sys.exit(2)
+    return ids
+
+
+def price(ids: List[str], durations: Dict[str, float]
+          ) -> Tuple[float, List[str], List[Tuple[float, str]]]:
+    """(projected test seconds, unknown ids, per-test costs desc)."""
+    costs: List[Tuple[float, str]] = []
+    unknown: List[str] = []
+    for nid in ids:
+        d = durations.get(nid)
+        if d is None:
+            unknown.append(nid)
+            d = UNKNOWN_TEST_ESTIMATE_S
+        costs.append((d, nid))
+    costs.sort(reverse=True)
+    return sum(c for c, _ in costs), unknown, costs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--durations", default=SNAPSHOT,
+                    help="per-test duration snapshot (JSON nodeid->seconds)")
+    ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S,
+                    help="projected wall-clock ceiling, seconds")
+    ap.add_argument("--overhead", type=float, default=DEFAULT_OVERHEAD_S,
+                    help="collection/import seconds added on top of tests")
+    ap.add_argument("--top", type=int, default=15,
+                    help="how many most-expensive tests to print")
+    args = ap.parse_args()
+
+    try:
+        with open(args.durations) as f:
+            durations = json.load(f)
+    except OSError as e:
+        print(f"cannot read durations snapshot {args.durations}: {e}\n"
+              f"regenerate with: SHAI_TEST_DURATIONS={SNAPSHOT} "
+              f"python -m pytest tests/ -q -m 'not slow'", file=sys.stderr)
+        return 2
+
+    ids = selected_tests()
+    total, unknown, costs = price(ids, durations)
+    projected = total + args.overhead
+    print(f"tier-1 selection: {len(ids)} tests "
+          f"({len(unknown)} not in snapshot, priced at "
+          f"{UNKNOWN_TEST_ESTIMATE_S}s each)")
+    print(f"projected wall: {total:.0f}s tests + {args.overhead:.0f}s "
+          f"overhead = {projected:.0f}s  (budget {args.budget:.0f}s)")
+    print(f"\ntop {args.top} most expensive in-selection tests:")
+    for d, nid in costs[:args.top]:
+        print(f"  {d:7.1f}s  {nid}")
+    if unknown:
+        print(f"\n{len(unknown)} tests missing from the snapshot "
+              f"(first 10): {unknown[:10]}")
+    if projected > args.budget:
+        print(f"\nOVER BUDGET by {projected - args.budget:.0f}s — mark the "
+              f"offenders above @pytest.mark.slow or regenerate the "
+              f"snapshot if timings changed", file=sys.stderr)
+        return 1
+    print(f"\nOK: {args.budget - projected:.0f}s of headroom")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

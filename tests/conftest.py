@@ -33,6 +33,36 @@ except AttributeError:
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: test runs under asyncio.run")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 selection (-m 'not slow') to keep "
+        "the suite inside the CI wall-clock budget; run explicitly with "
+        "-m slow or no marker filter")
+
+
+# -- per-test duration capture (scripts/check_tier1_budget.py's input) -------
+# Every run records setup+call+teardown seconds per test. Set
+# SHAI_TEST_DURATIONS=<path> to write the JSON snapshot at session end;
+# tests/tier1_durations.json is the committed snapshot the budget gate
+# reads (regenerate it with a full run on the CI container when timings
+# shift materially).
+
+_DURATIONS = {}
+
+
+def pytest_runtest_logreport(report):
+    _DURATIONS[report.nodeid] = (_DURATIONS.get(report.nodeid, 0.0)
+                                 + getattr(report, "duration", 0.0))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import json
+
+    path = os.environ.get("SHAI_TEST_DURATIONS", "")
+    if path and _DURATIONS:
+        with open(path, "w") as f:
+            json.dump({k: round(v, 3) for k, v in sorted(_DURATIONS.items())},
+                      f, indent=1)
 
 
 def pytest_pyfunc_call(pyfuncitem):

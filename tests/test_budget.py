@@ -77,6 +77,7 @@ def test_tiny_fits_and_absurd_window_does_not():
         b.check()
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_70b_needs_multichip():
     cfg = LlamaConfig.llama3_70b()
     one = causal_lm_budget(cfg, _ecfg(max_model_len=8192, max_num_seqs=1,
@@ -175,6 +176,7 @@ def test_deepseek_8b_single_chip_needs_int8():
     assert int8.fits, int8.describe()
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_declared_production_geometries_fit():
     """The dryrun's shape-level legs, as a CI test: every committed
     geometry (units + cova ConfigMap) fits and shards legally."""

@@ -6,12 +6,14 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from scalable_hw_agnostic_inference_tpu.compilectl import compile_model
 from scalable_hw_agnostic_inference_tpu.core.aot import AotCache, aot_key
 from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_compile_model_warms_cache_and_manifest(tmp_path):
     cfg = ServeConfig(app="bert", model_id="tiny", device="cpu",
                       artifact_root=str(tmp_path))
@@ -44,6 +46,7 @@ def test_aot_cache_export_load_roundtrip(tmp_path):
     assert aot_key("sin2", (x,)) != aot_key("sin2", (jnp.ones(4),))
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_sd_aot_export_then_boot_from_artifacts(tmp_path):
     """compilectl exports the SD pipeline as StableHLO; a fresh service boot
     with the same artifact root loads the exported executable instead of
@@ -64,6 +67,7 @@ def test_sd_aot_export_then_boot_from_artifacts(tmp_path):
     assert out["image_b64"]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_sd_coalescing_aot_export_covers_batch_buckets(tmp_path):
     """With SD_BATCH_MAX>1 serving traffic runs the latents-as-argument
     ('batch', b, ...) executables — the compile Job must export THOSE (one
@@ -94,6 +98,7 @@ def test_sd_coalescing_aot_export_covers_batch_buckets(tmp_path):
     assert svc.infer(svc.example_payload())["image_b64"]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_sd_boot_without_artifacts_still_works(tmp_path):
     from scalable_hw_agnostic_inference_tpu.models.registry import get_model
 

@@ -6,6 +6,7 @@ import json
 import pytest
 
 from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+    CovaClient,
     create_cova_app,
     load_models_config,
     resolve_service_url,
@@ -61,6 +62,7 @@ def upstream_services():
         s.stop()
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_chain_and_compare_end_to_end(upstream_services, tmp_path):
     urls = upstream_services
@@ -97,6 +99,72 @@ async def test_chain_and_compare_end_to_end(upstream_services, tmp_path):
         assert r.status_code == 200 and "cova" in r.text
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+@pytest.mark.asyncio
+async def test_fleet_aggregates_engine_telemetry(upstream_services, tmp_path):
+    """GET /fleet fans out to every model's /stats: engine-backed units
+    surface their obs step-telemetry snapshot (queue depth, KV utilization)
+    and a dead service reports its error without failing the dump."""
+    urls = upstream_services
+    models = {
+        "embed": {"url": urls["embed"], "task": "embeddings"},
+        "llm": {"url": urls["llm"], "task": "text-generation"},
+        "down": {"url": "http://127.0.0.1:9", "task": "text-generation"},
+    }
+    p = tmp_path / "models.json"
+    p.write_text(json.dumps({"models": models}))
+    app = create_cova_app(str(p))
+    async with make_client(app) as c:
+        # drive one generation so the llm engine has step records
+        r = await c.post("/compare", json={"prompt": "hello",
+                                           "temperature": 0.0,
+                                           "max_new_tokens": 4,
+                                           "models": ["llm"]})
+        assert r.status_code == 200, r.text
+        r = await c.get("/fleet")
+        assert r.status_code == 200, r.text
+        body = r.json()
+        llm = body["models"]["llm"]
+        assert llm["engine"]["steps"] > 0
+        assert "kv_utilization" in llm["engine"]
+        assert "served" in body["models"]["embed"]   # engine-less service
+        assert "engine" not in body["models"]["embed"]
+        assert "error" in body["models"]["down"]     # unreachable: isolated
+        assert body["overloaded"] == []              # idle fleet is healthy
+
+
+@pytest.mark.asyncio
+async def test_fleet_tolerates_non_dict_stats_json(monkeypatch):
+    """A mis-pointed service URL can 200 with non-dict JSON (array/string);
+    /fleet must keep it in the dump without crashing the aggregation."""
+    import httpx
+
+    class FakeResp:
+        status_code = 200
+
+        def json(self):
+            return ["not", "a", "dict"]
+
+    class FakeClient:
+        def __init__(self, *a, **kw):
+            pass
+
+        async def __aenter__(self):
+            return self
+
+        async def __aexit__(self, *a):
+            return False
+
+        async def get(self, url):
+            return FakeResp()
+
+    monkeypatch.setattr(httpx, "AsyncClient", FakeClient)
+    body = await CovaClient({"weird": {"url": "http://127.0.0.1:9"}}).fleet()
+    assert body["models"]["weird"] == ["not", "a", "dict"]
+    assert body["overloaded"] == []
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_full_chain_prompt_to_image_to_caption_to_embed(
         upstream_services, tmp_path):

@@ -172,6 +172,7 @@ def test_engine_rejects_never_admissible_request(tiny_model):
     assert fin.token_ids == []
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_engine_soft_prefix_conditions_output(tiny_model):
     """Multimodal path: a soft prefix must change generation, identical
     prefixes must reproduce it, and text-only requests must be unaffected."""
@@ -248,6 +249,8 @@ def _tp_engine(params, cfg, tp, **over):
     return LLMEngine(cfg, sharded, EngineConfig(**kw), mesh=mesh)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+# (tp sharding keeps tier-1 coverage via test_engine_tp_prefix_parity)
 @pytest.mark.parametrize("tp", [2, 8])
 def test_engine_tp_greedy_parity(tiny_model, tp):
     """tp=2 / tp=8 sharded engine matches the single-device engine greedily.
@@ -310,6 +313,7 @@ def test_engine_tp_prefix_parity(tiny_model):
     assert done[rid].token_ids == want
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_engine_warm_executables_closed_set(tiny_model):
     """warm_executables compiles the full closed set; a post-warm request mix
     spanning every bucket adds NO new executables (VERDICT r1 weak#2)."""
@@ -344,6 +348,7 @@ def test_engine_decode_ctx_bucket_dispatch(tiny_model):
     assert sorted(eng._decode_fns) == [(2, 1), (8, 1)]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_batched_prefill_parity_and_one_call(tiny_model):
     """Same-bucket concurrent prompts are admitted as ONE batched prefill
     call (VERDICT r2 weak #4) without changing greedy outputs."""
@@ -433,6 +438,7 @@ def test_engine_paged_kernel_decode_parity(tiny_model, monkeypatch):
     assert paged == dense
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_batched_prefill_stays_within_warmed_ladder(tiny_model):
     """max_num_seqs=3: the pow2 padding must cap at the warmed K=2
     executable, never compiling a K=4 one post-warm (closed-set invariant)."""
@@ -493,6 +499,7 @@ def test_chunked_prefill_greedy_parity(tiny_model):
         f"chunked prefill {fin.token_ids} != contiguous {expected}")
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_chunked_prefill_interleaves_with_decode(tiny_model):
     """A long prompt must not stall the running batch: short requests keep
     decoding between its chunks, and everyone's greedy output matches solo
@@ -536,6 +543,7 @@ def test_chunked_prefill_interleaves_with_decode(tiny_model):
         "decode made no progress while the long prompt was chunking")
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_chunked_prefill_within_warmed_set(tiny_model):
     """warm_executables builds the continuation ladder; a long request after
     warmup must not compile anything new."""

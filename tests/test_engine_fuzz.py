@@ -60,6 +60,7 @@ def _solo(tiny_model, prompt, mnt):
     return _SOLO_CACHE[key]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_engine_fuzz_invariants(tiny_model, seed):
     cfg, _, _ = tiny_model

@@ -32,6 +32,7 @@ class TestDistilBert:
             [jnp.ones((2, 8), jnp.int32), jnp.zeros((2, 8), jnp.int32)], axis=1))
         assert not np.allclose(full, half)
 
+    @pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
     def test_torch_parity(self):
         torch = pytest.importorskip("torch")
         from transformers import DistilBertConfig, DistilBertForSequenceClassification
@@ -63,6 +64,7 @@ class TestViT:
         logits = model.apply(params, px)
         assert logits.shape == (2, cfg.n_labels)
 
+    @pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
     def test_torch_parity(self):
         torch = pytest.importorskip("torch")
         from transformers import ViTConfig as HfViTConfig, ViTForImageClassification
@@ -106,6 +108,7 @@ class TestClipText:
         np.testing.assert_allclose(h1[:, :3], h2[:, :3], rtol=1e-5, atol=1e-5)
         assert not np.allclose(h1[:, 3], h2[:, 3])
 
+    @pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
     def test_torch_parity(self):
         torch = pytest.importorskip("torch")
         from transformers import CLIPTextConfig as HfClipConfig, CLIPTextModel
@@ -126,6 +129,7 @@ class TestClipText:
         got, _ = clip.ClipTextEncoder(cfg).apply(params, jnp.asarray(ids, jnp.int32))
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
     def test_penultimate_truncation(self):
         """n_layers-1 + final_ln reproduces diffusers' clip-skip conditioning."""
         torch = pytest.importorskip("torch")

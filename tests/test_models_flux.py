@@ -171,6 +171,7 @@ def test_flux_converter_roundtrip(tiny_flux):
         params, conv)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_flux_service_end_to_end():
     import base64

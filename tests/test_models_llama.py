@@ -162,6 +162,7 @@ def test_byte_tokenizer_roundtrip():
     assert tok.decode(ids[:n]) == "héllo wörld"
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_hf_parity_tiny_llama():
     """Our flax forward must match torch HF LlamaForCausalLM on random tiny
     weights (GQA + RoPE + SwiGLU + RMSNorm all covered)."""
@@ -276,6 +277,7 @@ def test_replicate_kv_heads_preserves_numerics():
         llama.replicate_kv_heads(params, cfg, 3)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_llama70b_tp32_lowering_leg():
     """The dsr70b-mh unit's decode + continuation prefill partition at FULL
     shape on an abstract 32-way mesh (VERDICT r4 next #4) — catches illegal

@@ -68,6 +68,7 @@ def _lm_state_dict(sd):
     return out
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_vision_model_matches_hf(hf_model):
     """Tiled two-stage vision encoder + projector: exact HF numerics,
     including a masked padding tile."""
@@ -110,6 +111,7 @@ def test_vision_model_matches_hf(hf_model):
     assert np.abs(np.asarray(feats_masked) - np.asarray(feats)).max() > 1e-6
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_cross_attention_prefill_logits_match_hf(hf_model):
     """Gated cross-attention text path: our paged-engine prefill's
     last-position logits equal HF's full forward given the same vision
@@ -159,6 +161,7 @@ def test_cross_attention_prefill_logits_match_hf(hf_model):
     assert int(np.argmax(np.asarray(logits)[0])) == int(np.argmax(want))
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_engine_serves_mllama_with_cross_states(hf_model):
     """End-to-end through LLMEngine: image conditions output, identical
     states reproduce it, text-only requests work and differ."""
@@ -212,6 +215,7 @@ def test_engine_serves_mllama_with_cross_states(hf_model):
     assert eng.n_executables == count
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_vllm_service_serves_mllama_checkpoint(hf_model, tmp_path):
     """The serving unit loads an actual mllama-layout checkpoint from disk
@@ -273,6 +277,7 @@ async def test_vllm_service_serves_mllama_checkpoint(hf_model, tmp_path):
                 == r_img.json()["generated_text"])
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_tiled_preprocessing_matches_hf_processor(hf_model):
     """Our tiling (canvas pick, fit-resize, normalize, pad, split) matches
     the HF MllamaImageProcessor output for a non-square image."""
@@ -337,6 +342,7 @@ def test_engine_cross_len_masks_padding_states(hf_model):
     assert run(base, valid) == run(garbage, valid)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_engine_cross_chunked_prefill_parity(hf_model):
     """A vision-conditioned prompt longer than the largest bucket encodes
     through the continuation ladder (cross layers attending the slot's
@@ -378,6 +384,7 @@ def test_engine_cross_chunked_prefill_parity(hf_model):
         f"cross chunked {chunked.token_ids} != whole {whole.token_ids}")
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_mllama_artifact_boot_skips_torch(hf_model, tmp_path,
                                                 monkeypatch):

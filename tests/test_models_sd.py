@@ -93,6 +93,7 @@ def tiny_unet():
     return cfg, model, params
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_unet_forward_shape_and_determinism(tiny_unet):
     cfg, model, params = tiny_unet
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, cfg.in_channels))
@@ -203,6 +204,7 @@ def _torch_sd_from_unet_params(params, cfg) -> dict:
     return sd
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_unet_converter_roundtrip(tiny_unet):
     """converter(inverse(params)) == params — transposes, naming, and tree
     structure all line up with the published layout."""
@@ -276,6 +278,7 @@ def test_txt2img_end_to_end_tiny():
     assert np.abs(img.astype(int) - img3.astype(int)).max() > 0
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_txt2img_stepwise_matches_scan():
     """Stepwise (per-step dispatch) and fused-scan modes are the same math:
     identical uint8 output for identical (seed, prompt). bench.py falls back
@@ -326,6 +329,7 @@ def test_variant_registry():
     assert sd_mod.SDVariant.sd21().schedule.prediction_type == "v_prediction"
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_decode_body_split_path_matches_fused():
     """On the TPU target, batches 2-4 VAE-decode per image via lax.map
     (XLA:TPU's fused batch-2/4 decode is HBM-pathological — PERF_MODEL.md);

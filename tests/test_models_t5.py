@@ -25,6 +25,7 @@ def hf_tiny():
     return T5EncoderModel(hf_cfg).eval(), hf_cfg
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_t5_torch_parity():
     import torch
 

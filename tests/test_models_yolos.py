@@ -24,6 +24,7 @@ def hf_tiny():
     return HFModel(hf_cfg).eval(), hf_cfg
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_yolos_torch_parity():
     import torch
 

@@ -42,6 +42,7 @@ def bert_server():
     srv.stop()
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_loadgen_get_and_post(loadgen_bin, bert_server):
     base = f"http://127.0.0.1:{bert_server.port}"
     out = subprocess.run(

@@ -1,0 +1,571 @@
+"""Observability subsystem: span trees, traceparent propagation, engine
+step telemetry, and the flight recorder — across the streaming,
+speculative, preemption, and multihost-mirror paths (ISSUE 3 acceptance:
+every dumped trace must be well-formed — single root, no orphan/unclosed
+spans — and tracing must be off the hot path when disabled)."""
+
+import queue
+import threading
+import time
+
+import httpx
+import pytest
+
+import jax  # noqa: F401  (platform pinned in conftest before backends init)
+
+from scalable_hw_agnostic_inference_tpu.obs import (
+    BucketHistogram,
+    FlightRecorder,
+    StepTelemetry,
+)
+from scalable_hw_agnostic_inference_tpu.obs import trace as obs_trace
+from scalable_hw_agnostic_inference_tpu.obs.trace import (
+    well_formed_problems,
+)
+
+from test_engine import make_engine, tiny_model  # noqa: F401 (fixture)
+from test_serve_http import EchoService, make_cfg, make_client, wait_ready
+
+
+# ---------------------------------------------------------------------------
+# trace primitives
+# ---------------------------------------------------------------------------
+
+def test_traceparent_parse_and_format():
+    tid, sid = "ab" * 16, "cd" * 8
+    hdr = obs_trace.format_traceparent(tid, sid)
+    assert obs_trace.parse_traceparent(hdr) == (tid, sid)
+    assert obs_trace.parse_traceparent(None) is None
+    assert obs_trace.parse_traceparent("garbage") is None
+    assert obs_trace.parse_traceparent("00-" + "0" * 32 + "-" + sid + "-01") \
+        is None  # all-zero trace id is invalid per spec
+    assert obs_trace.parse_traceparent(f"00-{tid}-{'0' * 16}-01") is None
+
+
+def test_span_nesting_builds_tree_via_contextvars():
+    tr = obs_trace.Trace("root-op")
+    with obs_trace.use_trace(tr):
+        with obs_trace.span("outer") as outer:
+            with obs_trace.span("inner", k=1) as inner:
+                pass
+    tr.close()
+    d = tr.to_dict()
+    assert not well_formed_problems(d), well_formed_problems(d)
+    by_name = {s["name"]: s for s in d["spans"]}
+    assert by_name["outer"]["parent_id"] == by_name["root-op"]["span_id"]
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["inner"]["attrs"]["k"] == 1
+    assert inner.span.closed and outer.span.closed
+
+
+def test_add_span_from_other_thread_is_safe():
+    tr = obs_trace.Trace("op")
+    t0 = time.monotonic()
+
+    def engine_side():
+        tr.add_span("decode", t0, t0 + 0.01, phase=True)
+
+    t = threading.Thread(target=engine_side)
+    t.start()
+    t.join()
+    tr.close()
+    d = tr.to_dict()
+    assert not well_formed_problems(d)
+    decode = next(s for s in d["spans"] if s["name"] == "decode")
+    assert decode["parent_id"] == tr.root.span_id
+    assert decode["duration_s"] == pytest.approx(0.01, abs=1e-3)
+
+
+def test_well_formed_detects_orphans_unclosed_and_multiroot():
+    assert well_formed_problems({"spans": []})
+    # orphan parent
+    bad = {"spans": [
+        {"name": "r", "span_id": "a", "parent_id": None, "duration_s": 0.1},
+        {"name": "x", "span_id": "b", "parent_id": "zz", "duration_s": 0.1},
+    ]}
+    assert any("orphan" in p for p in well_formed_problems(bad))
+    # unclosed
+    bad = {"spans": [
+        {"name": "r", "span_id": "a", "parent_id": None, "duration_s": -1.0},
+    ]}
+    assert any("unclosed" in p for p in well_formed_problems(bad))
+    # two roots
+    bad = {"spans": [
+        {"name": "r", "span_id": "a", "parent_id": None, "duration_s": 0.1},
+        {"name": "q", "span_id": "b", "parent_id": None, "duration_s": 0.1},
+    ]}
+    assert any("one root" in p for p in well_formed_problems(bad))
+    # a crashed handler's span is force-closed by Trace.close AND reported
+    tr = obs_trace.Trace("op")
+    live = tr.span("leaky")
+    live.__enter__()  # never exited
+    tr.close()
+    assert any("force-closed" in p
+               for p in well_formed_problems(tr.to_dict()))
+
+
+def test_span_tree_fuzz_always_well_formed():
+    """Randomized span workloads — nested context spans, handler
+    exceptions mid-span, concurrent engine-side add_span from worker
+    threads, random phase grafts — must ALWAYS dump a well-formed tree
+    (single root, no orphans, no unclosed spans)."""
+    import random
+
+    rng = random.Random(1337)
+    for trial in range(30):
+        tr = obs_trace.Trace(f"op-{trial}")
+
+        def nested(depth: int) -> None:
+            if depth <= 0 or rng.random() < 0.3:
+                return
+            try:
+                with obs_trace.span(f"d{depth}-{rng.randrange(4)}"):
+                    if rng.random() < 0.2:
+                        raise ValueError("handler blew up mid-span")
+                    nested(depth - 1)
+            except ValueError:
+                pass  # the span context must still have closed itself
+
+        def engine_side() -> None:
+            t0 = time.monotonic()
+            for i in range(rng.randrange(1, 4)):
+                tr.add_span(f"phase{i}", t0, t0 + rng.random() * 0.01)
+            if rng.random() < 0.5:
+                tr.add_phase_spans({"t_submit": t0, "t_admit": t0 + 0.001,
+                                    "t_first": t0 + 0.002,
+                                    "t_done": t0 + 0.003})
+
+        with obs_trace.use_trace(tr):
+            threads = [threading.Thread(target=engine_side)
+                       for _ in range(rng.randrange(0, 3))]
+            for t in threads:
+                t.start()
+            nested(rng.randrange(1, 6))
+            for t in threads:
+                t.join()
+        tr.close()
+        d = tr.to_dict()
+        assert not well_formed_problems(d), (trial, well_formed_problems(d))
+
+
+def test_tracing_disabled_is_off_the_hot_path():
+    obs_trace.configure(False)
+    try:
+        assert obs_trace.begin_request_trace("x") is None
+        s = obs_trace.span("y")
+        assert s is obs_trace.NOOP  # shared constant: zero allocation
+        with s:
+            pass
+        assert obs_trace.annotate("z") is obs_trace.NOOP
+        # and with no active trace (tracing on), span() is STILL the noop
+        obs_trace.configure(True)
+        assert obs_trace.current_trace() is None
+        assert obs_trace.span("y") is obs_trace.NOOP
+    finally:
+        obs_trace.configure(True)
+
+
+# ---------------------------------------------------------------------------
+# step telemetry + flight recorder primitives
+# ---------------------------------------------------------------------------
+
+def test_bucket_histogram_cumulative_shape():
+    h = BucketHistogram((0.1, 1.0))
+    for v in (0.05, 0.5, 0.7, 5.0):
+        h.observe(v)
+    s = h.snapshot()
+    assert s["count"] == 4
+    assert s["sum"] == pytest.approx(6.25)
+    assert s["buckets"] == [(0.1, 1), (1.0, 3), ("+Inf", 4)]
+
+
+def test_step_telemetry_ring_is_bounded():
+    t = StepTelemetry(total_blocks=10, max_steps=4)
+    for i in range(9):
+        t.record_step(kind="decode", duration_s=0.01, n_running=1,
+                      n_waiting=i, n_chunking=0, blocks_free=5)
+    recs = t.recent_steps()
+    assert len(recs) == 4
+    assert recs[-1]["step"] == 9 and recs[-1]["waiting"] == 8
+    assert recs[-1]["kv_utilization"] == 0.5
+    snap = t.snapshot()
+    assert snap["steps"] == 9 and snap["waiting"] == 8.0
+    t.count_preemption()
+    t.count_recompile("decode")
+    snap = t.snapshot()
+    assert snap["preemptions"] == 1 and snap["recompiles"] == 1
+
+
+def test_flight_recorder_ring_and_dump():
+    fr = FlightRecorder(max_requests=3, max_steps=2)
+    for i in range(5):
+        fr.record_request({"trace_id": f"t{i}", "spans": []})
+    d = fr.dump(step_source=lambda n: [{"step": 1}][:n])
+    assert d["recorded_total"] == 5
+    assert [r["trace"]["trace_id"] for r in d["requests"]] == \
+        ["t2", "t3", "t4"]
+    assert d["engine_steps"] == [{"step": 1}]
+
+    def boom(n):
+        raise RuntimeError("engine gone")
+
+    d = fr.dump(step_source=boom)
+    assert "engine gone" in d["engine_steps_error"]
+    assert d["requests"]  # the request ring still dumps
+    # n_requests edge cases: 0 means zero (reqs[-0:] would be ALL), and
+    # asking past the ring returns what exists
+    assert fr.dump(n_requests=0)["requests"] == []
+    assert len(fr.dump(n_requests=99)["requests"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# engine integration: speculative + preemption paths
+# ---------------------------------------------------------------------------
+
+def test_spec_engine_emits_timing_and_step_records(tiny_model):
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        SamplingParams,
+    )
+
+    eng = make_engine(tiny_model, speculative_model="[ngram]",
+                      num_speculative_tokens=3)
+    base = [1, 5, 9, 11, 7, 3, 2, 8]
+    prompt = (base * 3)[:20]  # repetitive: the n-gram drafter fires
+    fins = eng.generate([prompt, prompt],
+                        SamplingParams(temperature=0.0, max_new_tokens=10))
+    assert all(f.stop_reason == "length" for f in fins)
+    for f in fins:
+        t = f.timing
+        assert t is not None
+        assert t["queue_s"] >= 0 and t["prefill_s"] >= 0
+        assert t["decode_s"] >= 0
+        assert t["total_s"] == pytest.approx(
+            t["t_done"] - t["t_submit"], abs=1e-4)
+    recs = eng.obs.recent_steps()
+    assert recs, "no step records"
+    kinds = {r["kind"] for r in recs}
+    assert "spec" in kinds, kinds  # the speculative path actually ran
+    assert any("spec" in r for r in recs)  # spec counters ride the records
+    snap = eng.obs.snapshot()
+    assert snap["steps"] == len(recs) == eng._step_count
+    assert snap["ttft_count"] == 2 and snap["queue_wait_count"] == 2
+    assert snap["spec_acceptance_rate"] >= 0.0
+
+
+def test_preemption_path_counts_and_keeps_timing(tiny_model):
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        SamplingParams,
+    )
+
+    # 3 seqs x 3 blocks each at full length = 9 > the 6 usable blocks:
+    # growth MUST preempt at least once before all three finish
+    eng = make_engine(tiny_model, num_blocks=7)
+    prompts = [[1, 5, 9, 11], [1, 200, 300], [2, 7, 9, 13, 15]]
+    fins = eng.generate(prompts, SamplingParams(temperature=0.0,
+                                                max_new_tokens=16))
+    assert [f.stop_reason for f in fins] == ["length"] * 3
+    assert all(len(f.token_ids) == 16 for f in fins)
+    assert eng.obs.preemptions >= 1
+    assert eng.obs.recent_steps()[-1]["preemptions_total"] == \
+        eng.obs.preemptions
+    for f in fins:  # preempted-and-resumed requests keep ONE timeline
+        assert f.timing is not None
+        assert f.timing["t_done"] >= f.timing["t_first"] >= \
+            f.timing["t_admit"] >= f.timing["t_submit"]
+
+
+def test_resumed_request_timing_uses_original_first_token(tiny_model):
+    """A preemption resume carries the request-level t_first: the timeline
+    must book the pre-preemption decode segment (and the re-queue wait)
+    under decode_s, not prefill_s — the slot-level t_first passed by the
+    finish sites is the RESUMED segment's and would do exactly that."""
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        Request,
+        SamplingParams,
+    )
+
+    eng = make_engine(tiny_model)
+    now = time.monotonic()
+    req = Request(0, [1, 2, 3], SamplingParams(max_new_tokens=4),
+                  already_generated=[5, 6],  # marks a resume
+                  t_submit=now - 10.0, t_admit=now - 9.5, t_first=now - 9.0)
+    t = eng._timing_of(req, t_first=now - 1.0)  # resumed segment's stamp
+    assert t["t_first"] == req.t_first
+    assert t["prefill_s"] == pytest.approx(0.5, abs=0.1)
+    assert t["decode_s"] >= 8.9  # segment 1 + re-queue + segment 2
+
+
+def test_rejected_request_books_wait_as_queue_not_decode(tiny_model):
+    """A request finished straight from the waiting queue (never admitted)
+    spent its whole life in queue_s — missing stamps must fall FORWARD,
+    not book the wait into a decode phase that never ran."""
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        SamplingParams,
+    )
+
+    # pool of 4 blocks (3 usable) but a 32-token prompt needs 4 blocks
+    eng = make_engine(tiny_model, num_blocks=4, max_num_seqs=1)
+    [fin] = eng.generate([[1] * 32], SamplingParams(max_new_tokens=4))
+    assert fin.stop_reason == "rejected"
+    t = fin.timing
+    assert t is not None
+    assert t["prefill_s"] == 0.0 and t["decode_s"] == 0.0
+    assert t["queue_s"] == pytest.approx(t["total_s"], abs=1e-4)
+
+
+def test_post_warm_executable_build_counts_as_recompile(tiny_model):
+    eng = make_engine(tiny_model)
+    eng._decode_for(1, 1)
+    assert eng.obs.recompiles == 0  # pre-warm builds are the closed set
+    eng._warmed = True
+    eng._decode_for(1, 2)
+    eng._prefill_for(16, 0, 2)
+    assert eng.obs.recompiles == 2
+
+
+def test_cache_shrink_counts_rollback_tokens():
+    import jax.numpy as jnp
+
+    from scalable_hw_agnostic_inference_tpu.engine.cache import PagedKVCache
+
+    c = PagedKVCache(1, 1, 4, total_blocks=8, block_size=4,
+                     blocks_per_seq=4, dtype=jnp.float32)
+    c.admit(0, 10)  # 3 blocks
+    c.extend(0, 4)  # reserve like a spec step would
+    c.shrink(0, 3)  # reject 3 drafted tokens
+    assert c.rollback_tokens == 3
+    assert c.rollback_calls == 1
+    c.shrink(0, 0)  # no-op shrink does not count
+    assert c.rollback_calls == 1
+
+
+# ---------------------------------------------------------------------------
+# serving integration: vllm unit with speculative decoding
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec_app():
+    """Tiny engine-backed service with speculative decoding on — ONE
+    warmed service shared by every HTTP-level obs test in this module."""
+    import dataclasses
+
+    from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    cfg = ServeConfig(app="llm-obs", model_id="tiny", device="cpu",
+                      max_new_tokens=16, vllm_config="/nonexistent.yaml")
+    service = get_model("vllm")(cfg)
+    # smallest closed executable set that still exercises every obs path
+    # (2 slots batch the concurrent tests; serial prefill halves the warm
+    # ladder — this fixture is the costliest compile in the obs suite)
+    service.ecfg = dataclasses.replace(
+        service.ecfg, speculative_model="[ngram]", num_speculative_tokens=3,
+        max_num_seqs=2, max_prefill_batch=1)
+    return cfg, service, create_app(cfg, service)
+
+
+@pytest.mark.asyncio
+async def test_http_traceparent_ingest_emit_and_flight(spec_app):
+    cfg, service, app = spec_app
+    upstream = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    async with make_client(app) as c:
+        r = await wait_ready(c, timeout=600.0)
+        assert r.status_code == 200, r.text
+        r = await c.post("/generate",
+                         json={"prompt": "to be or not to be or not",
+                               "temperature": 0.0, "max_new_tokens": 6},
+                         headers={"traceparent": upstream})
+        assert r.status_code == 200, r.text
+        # W3C emit: same trace id, OUR root span id
+        tp = r.headers["traceparent"]
+        assert tp.split("-")[1] == "ab" * 16
+        assert tp.split("-")[2] != "cd" * 8
+
+        r = await c.get("/debug/flight")
+        d = r.json()
+        traces = [q["trace"] for q in d["requests"]
+                  if q["trace"]["name"] == "POST /generate"]
+        assert traces, "generate request missing from the flight ring"
+        tr = traces[-1]
+        assert tr["trace_id"] == "ab" * 16
+        assert tr["remote_parent"] == "cd" * 8
+        assert not well_formed_problems(tr), well_formed_problems(tr)
+        names = {s["name"] for s in tr["spans"]}
+        # the full timeline: http root, model lane, tokenize/detokenize,
+        # and the engine's queue/prefill/decode phase spans
+        assert {"POST /generate", "model_infer", "tokenize", "queue",
+                "prefill", "decode", "detokenize"} <= names
+        # engine step records ride the same dump
+        assert d["engine_steps"], "no engine step records"
+        last = d["engine_steps"][-1]
+        assert {"kind", "running", "waiting", "kv_utilization",
+                "preemptions_total", "recompiles_total"} <= set(last)
+        # probes are excluded from the ring (readiness polls above)
+        assert all(q["trace"]["name"] != "GET /readiness"
+                   for q in d["requests"])
+
+
+@pytest.mark.asyncio
+async def test_streaming_request_trace_is_well_formed(spec_app):
+    cfg, service, app = spec_app
+    async with make_client(app) as c:
+        await wait_ready(c, timeout=600.0)
+        async with c.stream(
+                "POST", "/v1/completions",
+                json={"prompt": "a b c a b c a b", "stream": True,
+                      "temperature": 0.0, "max_tokens": 5}) as r:
+            assert r.status_code == 200
+            body = ""
+            async for chunk in r.aiter_text():
+                body += chunk
+        assert "data: [DONE]" in body
+
+        d = (await c.get("/debug/flight")).json()
+        traces = [q["trace"] for q in d["requests"]
+                  if q["trace"]["name"] == "POST /v1/completions"]
+        assert traces, "streaming request missing from the flight ring"
+        tr = traces[-1]
+        assert not well_formed_problems(tr), well_formed_problems(tr)
+        names = {s["name"] for s in tr["spans"]}
+        assert {"queue", "prefill", "decode"} <= names
+        # the root span covers the stream DRAIN, so it must be at least as
+        # long as the engine's decode phase
+        root = next(s for s in tr["spans"] if s["parent_id"] is None)
+        decode = next(s for s in tr["spans"] if s["name"] == "decode")
+        assert root["duration_s"] >= decode["duration_s"] - 0.05
+
+
+@pytest.mark.asyncio
+async def test_metrics_exposes_engine_histograms_and_gauges(spec_app):
+    pytest.importorskip("prometheus_client")
+    cfg, service, app = spec_app
+    async with make_client(app) as c:
+        await wait_ready(c, timeout=600.0)
+        await c.post("/generate", json={"prompt": "x y z x y z",
+                                        "temperature": 0.0,
+                                        "max_new_tokens": 4})
+        r = await c.get("/metrics")
+        assert r.status_code == 200
+        for name in ("shai_ttft_seconds_bucket", "shai_ttft_seconds_sum",
+                     "shai_tpot_seconds_bucket",
+                     "shai_queue_wait_seconds_bucket",
+                     "shai_engine_running", "shai_engine_waiting",
+                     "shai_engine_kv_utilization",
+                     "shai_engine_preemptions_total",
+                     "shai_engine_recompiles_total",
+                     "shai_spec_acceptance_rate"):
+            assert name in r.text, f"{name} missing from /metrics"
+        # histogram actually observed something
+        assert 'shai_ttft_seconds_count{app="llm-obs"}' in r.text
+
+        st = (await c.get("/stats")).json()
+        assert st["engine"]["steps"] > 0
+        assert "kv_utilization" in st["engine"]
+        assert "exports" in st["aot"]
+
+
+@pytest.mark.asyncio
+async def test_disabled_tracing_serves_without_traces(spec_app):
+    cfg, service, app = spec_app
+    async with make_client(app) as c:
+        await wait_ready(c, timeout=600.0)
+        before = (await c.get("/debug/flight")).json()["recorded_total"]
+        obs_trace.configure(False)
+        try:
+            r = await c.post("/generate",
+                             json={"prompt": "hello hello hello",
+                                   "temperature": 0.0, "max_new_tokens": 4})
+            assert r.status_code == 200, r.text
+            assert "traceparent" not in r.headers
+        finally:
+            obs_trace.configure(True)
+        after = (await c.get("/debug/flight")).json()["recorded_total"]
+        assert after == before  # nothing recorded while disabled
+
+
+# ---------------------------------------------------------------------------
+# plain (engine-less) service still traces; multihost mirror propagation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_engineless_service_traces_and_empty_steps():
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+
+    cfg = make_cfg()
+    app = create_app(cfg, EchoService(cfg))
+    async with make_client(app) as c:
+        await wait_ready(c)
+        r = await c.post("/predict", json={"text": "hi"})
+        assert "traceparent" in r.headers
+        await c.get("/stats")  # scrape surface: must stay out of the ring
+        # unrouted traffic (scanner 404s) still gets a traceparent but must
+        # not turn over the postmortem ring
+        r = await c.get("/wp-login.php")
+        assert r.status_code == 404 and "traceparent" in r.headers
+        d = (await c.get("/debug/flight")).json()
+        assert d["engine_steps"] == []  # no engine, no step feed
+        assert all(q["trace"]["name"] != "GET /stats" for q in d["requests"])
+        assert all("/wp-login" not in q["trace"]["name"]
+                   for q in d["requests"])
+        tr = [q["trace"] for q in d["requests"]
+              if q["trace"]["name"] == "POST /predict"][-1]
+        assert not well_formed_problems(tr)
+        assert {"POST /predict", "model_infer"} <= \
+            {s["name"] for s in tr["spans"]}
+
+
+def test_mirror_rpc_propagates_traceparent(monkeypatch):
+    """Leader → follower over a faked coordination channel: the follower's
+    mirrored call runs under the LEADER's trace id, and the follower-side
+    trace is well-formed."""
+    from scalable_hw_agnostic_inference_tpu.serve import multihost
+
+    chan: "queue.Queue[bytes]" = queue.Queue()
+
+    def fake_broadcast(payload):
+        if payload is not None:
+            chan.put(payload)
+            return payload
+        return chan.get(timeout=30)
+
+    monkeypatch.setattr(multihost, "_broadcast_bytes", fake_broadcast)
+
+    class Svc:
+        mirror_methods = ("infer",)
+
+        def __init__(self):
+            self.seen = []
+
+        def infer(self, payload):
+            tr = obs_trace.current_trace()
+            self.seen.append((payload,
+                              None if tr is None else tr.trace_id))
+            return {"ok": True}
+
+    leader_svc, follower_svc = Svc(), Svc()
+    follower_traces = []
+    leader = multihost.MultihostDriver(leader_svc)
+    follower = multihost.MultihostDriver(
+        follower_svc, trace_sink=follower_traces.append)
+    leader.wrap_leader()
+    t = threading.Thread(target=follower.follower_loop, daemon=True)
+    t.start()
+
+    tr = obs_trace.Trace("POST /generate")
+    with obs_trace.use_trace(tr):
+        leader_svc.infer({"prompt": "x"})
+    leader_svc.infer({"prompt": "untraced"})  # no active trace: still works
+    leader.shutdown()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    tr.close()
+
+    assert [p["prompt"] for p, _ in follower_svc.seen] == ["x", "untraced"]
+    assert follower_svc.seen[0][1] == tr.trace_id  # leader's id, propagated
+    assert len(follower_traces) == 2
+    assert follower_traces[0]["trace_id"] == tr.trace_id
+    assert follower_traces[0]["remote_parent"] == tr.root.span_id
+    assert follower_traces[1]["trace_id"] != tr.trace_id  # fresh trace
+    for ft in follower_traces:
+        assert not well_formed_problems(ft), well_formed_problems(ft)

@@ -272,6 +272,7 @@ class TestFlashLengths:
                                        np.asarray(ref)[b, :n],
                                        rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
     def test_engine_prefill_shapes_select_pallas(self):
         """The LLM prefill call pattern (kv_lengths, causal, no mask) must be
         flash-eligible for real bucket/head geometries — impl='pallas' raises
@@ -391,6 +392,7 @@ def test_effective_platform_respects_default_device(monkeypatch):
     assert A.on_tpu_platform()
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_llama3_rope_scaling_matches_hf():
     """Our llama3 frequency remap matches transformers' reference impl."""
     torch = pytest.importorskip("torch")

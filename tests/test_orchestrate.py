@@ -12,9 +12,11 @@ import pytest
 from scalable_hw_agnostic_inference_tpu.orchestrate.capacity_checker import (
     ControllerState,
     Event,
+    OverloadThresholds,
     commit,
     decide,
     is_capacity_failure,
+    is_overloaded,
 )
 from scalable_hw_agnostic_inference_tpu.orchestrate.load_sim import (
     PhaseStore,
@@ -59,6 +61,75 @@ def test_failover_then_fallback_cycle():
     assert st.mode == "weighted"
     # replicas in fresh range but already weighted -> hold
     assert decide(st, [], 3, ("tpu",)) == "hold"
+
+
+def test_overload_predicate_reads_engine_snapshots():
+    """The obs step-telemetry snapshot (serve /stats "engine") drives the
+    saturation predicate; missing telemetry must read healthy."""
+    assert is_overloaded({"waiting": 20.0, "kv_utilization": 0.5})
+    assert is_overloaded({"kv_utilization": 0.99})
+    assert not is_overloaded({"waiting": 2.0, "kv_utilization": 0.5})
+    assert not is_overloaded({})      # partial snapshot: healthy
+    assert not is_overloaded(None)    # pod unreachable: healthy
+    th = OverloadThresholds(max_queue_depth=1.0)
+    assert is_overloaded({"waiting": 2.0}, th)
+
+
+def test_engine_overload_majority_triggers_failover():
+    """Queue-depth/KV-pressure is a LEADING failover trigger: a strict
+    majority of saturated pods fails over in cost mode before any
+    provisioning event appears; one hot pod holds."""
+    st = ControllerState()
+    hot = {"waiting": 20.0, "kv_utilization": 0.97}
+    cold = {"waiting": 0.0, "kv_utilization": 0.2}
+    assert decide(st, [], 10, ("tpu",),
+                  engine_stats=[hot, cold, cold]) == "hold"
+    assert decide(st, [], 10, ("tpu",),
+                  engine_stats=[hot, hot, cold]) == "failover"
+    assert "overload" in st.last_trigger
+    commit(st, "failover")
+    # already capacity-optimized: overload holds, fresh cycle falls back
+    assert decide(st, [], 10, ("tpu",), engine_stats=[hot, hot]) == "hold"
+    assert decide(st, [], 3, ("tpu",),
+                  engine_stats=[hot, hot]) == "fallback"
+    # no telemetry at all behaves exactly as before the feature
+    st2 = ControllerState()
+    assert decide(st2, [], 10, ("tpu",), engine_stats=None) == "hold"
+    assert decide(st2, [], 10, ("tpu",), engine_stats=[]) == "hold"
+
+
+def test_fetch_engine_stats_keeps_unreachable_pods_in_denominator(
+        monkeypatch):
+    """One entry per polled url: unreachable pods and engine-less services
+    come back as None (healthy), so a partial outage cannot shrink the
+    overload-majority denominator down to the one pod that answered."""
+    import httpx
+
+    from scalable_hw_agnostic_inference_tpu.orchestrate.capacity_checker \
+        import fetch_engine_stats
+
+    class _R:
+        def __init__(self, payload):
+            self._payload = payload
+
+        def json(self):
+            return self._payload
+
+    def fake_get(url, timeout=None):
+        if "down" in url:
+            raise OSError("connection refused")
+        if "noengine" in url:
+            return _R({"served": 3})
+        return _R({"engine": {"waiting": 9.0, "kv_utilization": 0.97}})
+
+    monkeypatch.setattr(httpx, "get", fake_get)
+    out = fetch_engine_stats(["http://hot", "http://down", "http://noengine"])
+    assert len(out) == 3
+    assert out[1] is None and out[2] is None
+    assert out[0]["waiting"] == 9.0
+    # 1 hot of 3 polled is NOT a strict majority -> hold, no flap
+    st = ControllerState()
+    assert decide(st, [], 10, ("tpu",), engine_stats=out) == "hold"
 
 
 def test_fallback_needs_fresh_cycle():

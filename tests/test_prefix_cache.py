@@ -90,6 +90,7 @@ def test_cached_admission_shares_blocks_and_matches(tiny_model):
     assert done[rid].token_ids == want      # shared-KV output identical
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_prefix_cache_differs_on_different_prefix(tiny_model):
     """Near-miss prompts (same length, different first block) must NOT
     share — outputs match their own solo runs."""
@@ -124,6 +125,7 @@ def test_prefix_cache_eviction_under_pressure(tiny_model):
     assert _greedy(off, prompts[-1]).token_ids == outs[-1]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_cached_admission_stays_in_warmed_set(tiny_model):
     eng = make_engine(tiny_model)
     eng.warm_executables()

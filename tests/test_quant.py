@@ -108,6 +108,7 @@ def test_flax_generate_parity_on_grid(quant_pair):
     assert np.asarray(out_q.tokens).tolist() == np.asarray(out_f.tokens).tolist()
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_engine_greedy_parity_on_grid(quant_pair):
     # tie-aware parity (tests/parity.py): `(x @ q) * scale` and the
     # dequantized `x @ (q * scale)` are equivalent but round differently

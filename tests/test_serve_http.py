@@ -365,6 +365,7 @@ def test_httpd_parallel_probes_during_inference():
         server.stop()
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_serve_ui_and_profile_endpoint(tmp_path):
     """/serve renders the interactive console (reference run-sd.py:203) and

@@ -15,6 +15,7 @@ from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
 from test_serve_http import make_client, wait_ready
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_sd_service_genimage_roundtrip():
     cfg = ServeConfig(app="sd21", model_id="tiny", device="cpu",
@@ -47,6 +48,7 @@ async def test_sd_service_genimage_roundtrip():
         assert r.status_code == 400
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_sd_request_coalescing_serves_concurrent_requests():
     """SD_BATCH_MAX>1: concurrent /genimage requests are coalesced into
@@ -183,6 +185,7 @@ def test_sd_batch_max_clamps_to_pow2():
     assert s._batch_max == 4 and s.concurrency == 4
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_sd_batch_output_is_composition_invariant():
     """A request's image depends on (seed, prompt, batch bucket) only —
     NEVER on which other requests share its batch (each sample's init noise

@@ -128,6 +128,7 @@ async def test_vllm_service_generate_and_batching():
         assert r.status_code == 400  # missing prompt field
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_vllm_openai_surface_and_stats():
     """OpenAI-compatible routes on the engine unit: /v1/models,
@@ -257,6 +258,7 @@ async def test_vllm_openai_surface_and_stats():
             assert "shai_service_queue_waiting" in r.text
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_stream_abandonment_cancels_engine_request():
     """A client disconnect abandons the SSE generator; the engine request
     must be cancelled (slot + blocks reclaimed), not decoded to
@@ -285,6 +287,7 @@ def test_stream_abandonment_cancels_engine_request():
         service.loop.stop()
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_vllm_streaming_over_real_socket():
     """SSE through the real asyncio server: chunked transfer-encoding frames
     the stream and the connection stays reusable afterwards."""
@@ -330,6 +333,7 @@ def test_vllm_streaming_over_real_socket():
     conn.close()
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_vllm_service_long_prompt_chunks():
     """A prompt past the largest prefill bucket must reach the engine
@@ -356,6 +360,7 @@ async def test_vllm_service_long_prompt_chunks():
         assert r1.json()["generated_text"] == r2.json()["generated_text"]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_vllm_service_int8_quantized(tmp_path):
     """`quantization: int8` in the mounted vllm_config.yaml boots the engine
@@ -384,6 +389,7 @@ async def test_vllm_service_int8_quantized(tmp_path):
         assert r1.json()["generated_text"] == r2.json()["generated_text"]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_vllm_service_multimodal_generate():
     """vllm_model_api_m parity: optional base64 image conditions generation."""
@@ -456,6 +462,7 @@ def _tiny_hf_llava():
     return LlavaForConditionalGeneration(cfg).eval(), cfg
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_vlm_vision_tower_parity_with_hf_llava():
     """Converter + flax tower must reproduce HF LLaVA's get_image_features
     (vision_feature_layer=-2, CLS dropped, 2-layer gelu projector)."""
@@ -482,6 +489,7 @@ def test_vlm_vision_tower_parity_with_hf_llava():
                                rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_vlm_language_model_conversion_roundtrip():
     """The llava-wrapped language model converts through the same llama
     mapping the text units use (prefix-stripped state dict)."""
@@ -511,6 +519,7 @@ def test_vlm_language_model_conversion_roundtrip():
     np.testing.assert_allclose(np.asarray(got), want, rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_dead_engine_loop_fails_readiness():
     """A crashed engine loop must drain the pod: /readiness 503, /generate

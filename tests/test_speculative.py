@@ -212,6 +212,7 @@ def _fuzz_prompts(seed, n):
     return prompts
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_spec_greedy_equivalence_fuzz(tiny_model):
     """THE speculative invariant: temperature-0 speculative output is
     bit-identical to vanilla greedy decode, prompt by prompt."""
@@ -225,6 +226,7 @@ def test_spec_greedy_equivalence_fuzz(tiny_model):
     assert es.spec.verify_steps > 0  # the last engine actually speculated
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_spec_greedy_equivalence_batched(tiny_model):
     """Continuous batching + speculation: staggered concurrent admissions
     must not change any sequence's greedy output."""
@@ -281,6 +283,7 @@ def test_spec_partial_acceptance_rolls_back_reservation(tiny_model):
     assert eng.spec.accepted <= eng.spec.drafted
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_spec_under_block_pressure_preempts_and_completes(tiny_model):
     """Speculative reservation (1+k tokens per step) under a tight pool:
     preemption must still drain every request with full-length output."""
@@ -292,6 +295,7 @@ def test_spec_under_block_pressure_preempts_and_completes(tiny_model):
     assert eng.cache.allocator.n_free == 12
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_grow_running_survives_later_slot_preemption(tiny_model):
     """Regression: while growing slot 0 under pool exhaustion, preemption
     may evict a LATER slot whose stale _Running the grow loop then visits —
@@ -365,6 +369,7 @@ def test_spec_disabled_keeps_vanilla_dispatch(tiny_model):
     assert not eng._verify_fns
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_spec_greedy_equivalence_cross_attention():
     """mllama path: the verify executable's cross-layer tail (slot-indexed
     encoder cache) must preserve greedy equivalence too."""
@@ -428,6 +433,7 @@ def test_metrics_publisher_spec_counters():
         assert got["shai_spec_committed_total"] == 25
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_spec_warm_builds_verify_ladder(tiny_model):
     eng = make_engine(tiny_model, spec=True)
     n = eng.warm_executables()

@@ -83,6 +83,7 @@ def _meta_state_dict(model) -> dict:
 # t5-v1.1-large — REAL transformers layout at full size
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_t5_v11_large_converter_matches_real_hf_layout():
     from accelerate import init_empty_weights
     from transformers import T5Config as HFT5Config
@@ -112,6 +113,7 @@ def test_t5_v11_large_converter_matches_real_hf_layout():
 # SD2.1 CLIP text encoder — REAL transformers layout at full size
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_sd21_clip_converter_matches_real_hf_layout():
     from accelerate import init_empty_weights
     from transformers import CLIPTextConfig, CLIPTextModel
@@ -138,6 +140,7 @@ def test_sd21_clip_converter_matches_real_hf_layout():
 # SD2.1 UNet + VAE at the full serving config
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_sd21_unet_converter_fullsize_tree():
     from scalable_hw_agnostic_inference_tpu.models import sd as sd_mod
     from scalable_hw_agnostic_inference_tpu.models import unet as unet_mod
@@ -267,6 +270,7 @@ def test_vae_converter_tiny_numeric_roundtrip():
 # flux-dev widths (depth reduced: structure per block, not repeats)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_flux_dev_width_converter_tree():
     from scalable_hw_agnostic_inference_tpu.models import flux as flux_mod
 
