@@ -27,6 +27,7 @@ from ..core.bucketing import BucketRegistry
 from ..models.llama import LlamaConfig
 from ..obs.steploop import StepTelemetry
 from ..obs.trace import annotate
+from ..resilience import faults as _faults
 from ..ops.sampling import sample_logits
 from .cache import PagedKVCache
 from .config import EngineConfig
@@ -188,7 +189,8 @@ class LLMEngine:
                     params: Optional[SamplingParams] = None,
                     prefix: Optional[np.ndarray] = None,
                     cross_states: Optional[np.ndarray] = None,
-                    cross_len: int = 0, on_token=None) -> int:
+                    cross_len: int = 0, on_token=None,
+                    deadline_at: float = 0.0) -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -228,6 +230,7 @@ class LLMEngine:
         self.waiting.append(Request(rid, list(prompt_ids), params,
                                     prefix=prefix, cross_states=cross_states,
                                     cross_len=cross_len, on_token=on_token,
+                                    deadline_at=deadline_at,
                                     t_submit=time.monotonic()))
         return rid
 
@@ -235,13 +238,20 @@ class LLMEngine:
         """Abort a request wherever it is (queue, mid-prefill, or decoding),
         reclaiming its slot and blocks. Returns the partial Finished (reason
         ``"cancelled"``), or None if the id is unknown/already finished.
-        Used by streamed requests that hit a client-side stop sequence — the
-        engine would otherwise decode to max_new_tokens for nobody."""
+        Used by streamed requests that hit a client-side stop sequence or
+        whose client disconnected — the engine would otherwise decode to
+        max_new_tokens for nobody."""
+        return self._abort(req_id, "cancelled")
+
+    def _abort(self, req_id: int, reason: str) -> Optional[Finished]:
+        """THE teardown for a request leaving early (``cancelled`` /
+        ``timeout``): remove it from the queue or its slot, release exactly
+        its cache blocks, and return the partial Finished."""
         for i, r in enumerate(self.waiting):
             if r.req_id == req_id:
                 del self.waiting[i]
                 return Finished(req_id, list(r.already_generated),
-                                r.orig_n_prompt, "cancelled",
+                                r.orig_n_prompt, reason,
                                 logprobs=(list(r.already_lp)
                                           if r.params.logprobs else None),
                                 timing=self._timing_of(r))
@@ -253,11 +263,29 @@ class LLMEngine:
                 self._has_image[s.slot] = 0.0
                 return Finished(
                     req_id, s.req.already_generated + s.generated,
-                    s.req.orig_n_prompt, "cancelled",
+                    s.req.orig_n_prompt, reason,
                     logprobs=((s.req.already_lp + s.lps[:len(s.generated)])
                               if s.req.params.logprobs else None),
                     timing=self._timing_of(s.req, s.t_first))
         return None
+
+    def _expire_deadlines(self) -> None:
+        """Finish every request whose deadline passed — queued, mid-chunk,
+        or decoding — with stop reason ``"timeout"``. Step-granular: a
+        request is at most one engine step late, and its blocks/slot free
+        the same step instead of decoding to max_new_tokens for a caller
+        that already gave up."""
+        now = time.monotonic()
+        expired = [r.req_id for r in self.waiting
+                   if 0.0 < r.deadline_at <= now]
+        expired += [s.req.req_id for s in self.slots
+                    if s is not None and 0.0 < s.req.deadline_at <= now]
+        for rid in expired:
+            fin = self._abort(rid, "timeout")
+            if fin is not None:
+                log.warning("req %d exceeded its deadline "
+                            "(%d tokens generated)", rid, len(fin.token_ids))
+                self._finish(fin)
 
     @property
     def max_prompt_len(self) -> int:
@@ -296,6 +324,15 @@ class LLMEngine:
         self._step_count += 1
         self._done_this_step = []
         self._step_kind = "idle"
+        inj = _faults.get()
+        if inj.active:
+            # chaos sites: step latency/stall (watchdog + deadline fodder)
+            # and step crash (the engine-loop-death path)
+            inj.sleep_at(_faults.ENGINE_STEP)
+            inj.raise_at(_faults.ENGINE_STEP)
+        # expire BEFORE admission: a queued request already past its
+        # deadline must not be admitted into a prefill nobody waits for
+        self._expire_deadlines()
         chunking = [s for s in self.slots
                     if s is not None and s.prefill_cursor is not None]
         if chunking:
@@ -435,7 +472,11 @@ class LLMEngine:
         ever get — the request is rejected-and-finished so the queue can't
         starve (and ``generate()`` can't spin forever)."""
         need = self._need_blocks(n_tokens)
-        if need <= self.cache.n_available:
+        # chaos site: an injected reservation failure reads as a dry pool,
+        # exercising exactly the wait-or-reject ladder a real one takes
+        available = (-1 if _faults.get().should_fail(_faults.KV_RESERVE)
+                     else self.cache.n_available)
+        if need <= available:
             return True
         if not any(s is not None for s in self.slots):
             self.waiting.popleft()
@@ -754,6 +795,7 @@ class LLMEngine:
         bucket = self.buckets.max if bucket is None else bucket
         key = ("cont", start_blocks, bucket)
         if key not in self._prefill:
+            _faults.get().raise_at(_faults.COMPILE)
             if self._warmed:
                 # post-warm compile == a shape escaped the warmed closed
                 # set (the cold-graph-behind-the-LB signal)
@@ -789,6 +831,8 @@ class LLMEngine:
     def _prefill_for(self, bucket: int, prefix_len: int = 0, n_seqs: int = 1):
         key = (bucket, prefix_len, n_seqs)
         if key not in self._prefill:
+            # chaos site: executable-factory compile failure
+            _faults.get().raise_at(_faults.COMPILE)
             if self._warmed:
                 self.obs.count_recompile("prefill")
             self._prefill[key] = make_prefill(
@@ -814,6 +858,7 @@ class LLMEngine:
               else self._batch_bucket(n_active))
         key = (m, bb)
         if key not in self._decode_fns:
+            _faults.get().raise_at(_faults.COMPILE)
             if self._warmed:
                 self.obs.count_recompile("decode")
             self._decode_fns[key] = make_decode(
@@ -832,6 +877,7 @@ class LLMEngine:
               else self._batch_bucket(n_active))
         key = (m, bb)
         if key not in self._verify_fns:
+            _faults.get().raise_at(_faults.COMPILE)
             if self._warmed:
                 self.obs.count_recompile("verify")
             self._verify_fns[key] = make_verify(
@@ -904,6 +950,7 @@ class LLMEngine:
             already_generated=emitted,
             orig_n_prompt=victim.req.orig_n_prompt,
             on_token=victim.req.on_token,
+            deadline_at=victim.req.deadline_at,
             t_submit=victim.req.t_submit,
             t_admit=victim.req.t_admit,
             t_first=victim.req.t_first,

@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import List, Optional, Sequence, Tuple
 
@@ -34,6 +35,7 @@ class EngineLoop:
         self._cancel_q: "queue.Queue[Future]" = queue.Queue()
         self._poll_s = poll_s
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._thread = threading.Thread(target=self._run, name="engine-loop",
                                         daemon=True)
 
@@ -58,24 +60,54 @@ class EngineLoop:
         self._stop.set()
         self._thread.join(timeout)
 
+    def drain(self, budget_s: float = 30.0) -> bool:
+        """Graceful shutdown: refuse new submissions, let in-flight requests
+        run to completion for up to ``budget_s`` seconds, then stop the
+        loop. Returns True when everything finished inside the budget;
+        False means the budget expired with work still in flight (those
+        futures fail with "engine loop is stopped" on the way out)."""
+        self._draining.set()
+        deadline = time.monotonic() + max(0.0, budget_s)
+        drained = False
+        while True:
+            with self._futures_lock:
+                outstanding = bool(self._futures)
+            if (not outstanding and self._submit_q.empty()
+                    and not self.engine.has_work):
+                drained = True
+                break
+            if time.monotonic() >= deadline:
+                log.warning("drain budget (%.1fs) expired with work in "
+                            "flight — stopping anyway", budget_s)
+                break
+            time.sleep(self._poll_s)
+        self.stop()
+        return drained
+
     def submit(self, prompt_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
                prefix=None, cross_states=None, cross_len: int = 0,
-               on_token=None) -> Future:
+               on_token=None, deadline_at: float = 0.0) -> Future:
         """Enqueue a request; the future resolves to a :class:`Finished`.
 
         ``prefix``: optional soft-prefix embeddings [P, dim] (vision tokens,
         LLaVA-style). ``cross_states``: optional mllama cross-attention
         states [Lv, dim] (gated cross layers attend them). ``on_token``:
         streaming callback — called from the loop thread, once per output
-        token, in order; must be cheap (a queue put).
+        token, in order; must be cheap (a queue put). ``deadline_at``:
+        absolute monotonic deadline (0 = none) — the engine expires the
+        request with stop reason ``"timeout"`` once passed.
         """
         if self._stop.is_set():
             raise RuntimeError("engine loop is stopped")
+        if self._draining.is_set():
+            # the admission gate sheds with a 503 before reaching here;
+            # this guards direct submitters during the drain window
+            raise RuntimeError("engine loop is draining")
         fut: Future = Future()
         self._submit_q.put(
             (list(prompt_ids), params or SamplingParams(),
-             (prefix, cross_states, cross_len, on_token), fut))
+             (prefix, cross_states, cross_len, on_token, deadline_at), fut))
         # close the put-after-drain window: if the loop died between our
         # _stop check and the put, nobody will ever drain this item
         if self._stop.is_set():
@@ -98,12 +130,15 @@ class EngineLoop:
         except queue.Empty:
             return
         while True:
-            ids, params, (prefix, cross_states, cross_len, on_token), fut = item
+            (ids, params,
+             (prefix, cross_states, cross_len, on_token, deadline_at),
+             fut) = item
             try:
                 rid = self.engine.add_request(ids, params, prefix=prefix,
                                               cross_states=cross_states,
                                               cross_len=cross_len,
-                                              on_token=on_token)
+                                              on_token=on_token,
+                                              deadline_at=deadline_at)
                 with self._futures_lock:
                     self._futures[rid] = fut
             except Exception as e:  # bad request (e.g. empty prompt)

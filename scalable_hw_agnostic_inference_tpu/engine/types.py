@@ -58,6 +58,12 @@ class Request:
     # streaming: called (engine-loop thread, must be cheap — a queue put)
     # exactly once per token that will appear in Finished.token_ids, in order
     on_token: Optional[Any] = None
+    # absolute monotonic deadline (0 = none): the engine expires the
+    # request at step granularity wherever it is — queued, mid-prefill, or
+    # decoding — finishing it with stop reason "timeout" so its KV blocks
+    # and slot free instead of decoding past a budget nobody is waiting on.
+    # Survives preemption (the budget is the request's, not the segment's).
+    deadline_at: float = 0.0
     # submission time (monotonic) for TTFT accounting; survives preemption
     t_submit: float = 0.0
     # first-admission time (monotonic): queue-wait accounting. Survives
@@ -86,7 +92,8 @@ class Finished:
     req_id: int
     token_ids: List[int]        # generated tokens, EOS excluded
     n_prompt: int
-    stop_reason: str            # "eos" | "length" | "rejected" | "cancelled"
+    # "eos" | "length" | "rejected" | "cancelled" | "timeout"
+    stop_reason: str
     # one entry per token_ids element when the request asked for logprobs:
     # {"token", "logprob", "top_ids", "top_logprobs"}
     logprobs: Optional[List[Dict[str, Any]]] = None

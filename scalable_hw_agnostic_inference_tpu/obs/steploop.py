@@ -97,6 +97,10 @@ class StepTelemetry:
         self.warmed_executables = 0  # closed-set size at readiness
         # last-step gauges (scraped between steps)
         self._gauges: Dict[str, float] = {}
+        # step-watchdog feed (resilience.drain.StepWatchdog): monotonic
+        # stamp of the last COMPLETED step. Initialized at construction so
+        # "busy since boot, never stepped" reads as an ever-growing age.
+        self._last_step_mono = time.monotonic()
 
     # -- counter hooks (called from the engine) ----------------------------
 
@@ -151,8 +155,26 @@ class StepTelemetry:
             if spec and "spec_acceptance_rate" in spec:
                 self._gauges["spec_acceptance_rate"] = float(
                     spec["spec_acceptance_rate"])
+            self._last_step_mono = time.monotonic()
 
     # -- readouts ----------------------------------------------------------
+
+    def last_step_age_s(self, now: Optional[float] = None) -> float:
+        """Seconds since the last completed engine step (since construction
+        when no step ran yet) — the watchdog's staleness signal."""
+        with self._lock:
+            last = self._last_step_mono
+        return max(0.0, (now if now is not None else time.monotonic()) - last)
+
+    def step_duration_p99(self) -> float:
+        """p99 of the recent step-duration ring (0.0 with no steps) — the
+        watchdog's scale for what a 'normal' step costs on this tier."""
+        with self._lock:
+            durations = sorted(r["duration_s"] for r in self._steps)
+        if not durations:
+            return 0.0
+        return durations[min(len(durations) - 1,
+                             int(0.99 * (len(durations) - 1)))]
 
     def recent_steps(self, n: int = 256) -> List[Dict[str, Any]]:
         with self._lock:

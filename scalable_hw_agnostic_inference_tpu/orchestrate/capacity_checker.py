@@ -130,6 +130,68 @@ def commit(state: ControllerState, action: str) -> None:
         state.mode = "weighted"
 
 
+# -- controller error accounting + retry pacing -----------------------------
+
+#: cumulative failed iterations (process-local); mirrored to the
+#: ``shai_controller_errors_total`` Prometheus counter when the client is
+#: available — a broken kubeconfig becomes a visible, alertable rate
+#: instead of a silent 5-minute crash loop
+_controller_errors = 0
+_prom_errors = None
+
+
+def controller_errors_total() -> int:
+    return _controller_errors
+
+
+def count_controller_error() -> None:
+    global _controller_errors, _prom_errors
+    _controller_errors += 1
+    if _prom_errors is None:
+        try:
+            from prometheus_client import Counter
+
+            _prom_errors = Counter(
+                "shai_controller_errors_total",
+                "capacity-checker iterations that raised")
+        except Exception:
+            _prom_errors = False  # unavailable (or duplicate): int only
+    if _prom_errors:
+        _prom_errors.inc()
+
+
+def failure_backoff_s(consecutive_failures: int, base_s: float = 2.0,
+                      cap_s: float = 300.0) -> float:
+    """Retry pacing while the control loop is broken: quick retries first
+    (a transient apiserver blip recovers in seconds, not a full poll
+    interval), doubling up to ``cap_s``. Pure — unit-tested directly."""
+    if consecutive_failures <= 0:
+        return 0.0
+    return min(cap_s, base_s * (2 ** (consecutive_failures - 1)))
+
+
+def start_metrics_exporter() -> bool:
+    """Serve prometheus_client's default registry (which holds
+    ``shai_controller_errors_total``) from the controller process — it
+    runs no MetricsPublisher, so without this the counter would increment
+    into a registry nobody scrapes. ``CONTROLLER_METRICS_PORT`` (default
+    9101, 0 disables). Returns True when the exporter is up."""
+    import os
+
+    port = int(os.environ.get("CONTROLLER_METRICS_PORT", "9101") or "0")
+    if not port:
+        return False
+    try:
+        from prometheus_client import start_http_server
+
+        start_http_server(port)
+        log.info("controller metrics on :%d", port)
+        return True
+    except Exception:
+        log.warning("controller metrics exporter unavailable", exc_info=True)
+        return False
+
+
 # -- k8s glue (shell-out, matching the reference's kubectl-apply loop) ------
 
 def kubectl(*args: str) -> str:
@@ -196,6 +258,8 @@ def main_loop(app: str = "sd21", manifest_dir: str = "/deploy",
               load_deploy: str = "load", interval_s: int = 300,
               stats_urls: Sequence[str] = ()) -> None:
     state = ControllerState()
+    consecutive_failures = 0
+    start_metrics_exporter()
     while True:
         try:
             action = decide(state, fetch_events(), fetch_load_ready(load_deploy),
@@ -210,9 +274,22 @@ def main_loop(app: str = "sd21", manifest_dir: str = "/deploy",
                 commit(state, action)  # only after the apply succeeded
             else:
                 log.info("hold (mode=%s)", state.mode)
+            consecutive_failures = 0
+            time.sleep(interval_s)
         except Exception:
-            log.exception("capacity-checker iteration failed")
-        time.sleep(interval_s)
+            consecutive_failures += 1
+            count_controller_error()
+            # retry fast at first (a transient blip recovers in seconds),
+            # doubling up to the normal poll interval — never slower than
+            # the healthy cadence, never a silent 5-minute crash loop
+            pause = min(interval_s,
+                        failure_backoff_s(consecutive_failures,
+                                          cap_s=interval_s))
+            log.exception(
+                "capacity-checker iteration failed (%d consecutive, "
+                "%d total) — retrying in %.0fs", consecutive_failures,
+                controller_errors_total(), pause)
+            time.sleep(pause)
 
 
 if __name__ == "__main__":

@@ -20,9 +20,12 @@ import asyncio
 import json
 import logging
 import os
+import random
 import time
 from typing import Any, Dict, List, Optional
 
+from ..resilience import faults as rz_faults
+from ..resilience.breaker import CircuitBreaker
 from ..serve.asgi import App, HTTPError, Request, Response
 
 log = logging.getLogger(__name__)
@@ -59,27 +62,131 @@ def load_models_config(path: str) -> Dict[str, Dict[str, Any]]:
 
 
 class CovaClient:
-    """Async fan-out client over the model services."""
+    """Async fan-out client over the model services.
 
-    def __init__(self, models: Dict[str, Dict[str, Any]], timeout: float = 300.0):
+    Transport hardening (the fan-out is the chain's availability
+    bottleneck — one dead backend used to cost a flat 300 s):
+
+    - ONE shared ``httpx.AsyncClient`` with split timeouts: connect fails
+      in ``connect_timeout`` seconds (a dead backend is known in ~5 s, not
+      minutes), while reads keep the long generation budget;
+    - per-backend :class:`CircuitBreaker`: consecutive CONNECT-PHASE
+      failures (the backend is unreachable) open the circuit and calls
+      fail fast with 503 + ``Retry-After`` until a jittered exponential
+      backoff admits a probe; read-phase timeouts/errors are surfaced but
+      never breaker-counted — a slow-but-alive backend stays reachable;
+    - bounded retries on CONNECT-PHASE errors only — the request never
+      reached the backend, so a retry cannot replay non-idempotent work; a
+      read-phase timeout or error is surfaced, never retried.
+    """
+
+    def __init__(self, models: Dict[str, Dict[str, Any]],
+                 timeout: float = 300.0, connect_timeout: float = 5.0,
+                 connect_retries: int = 2,
+                 breaker_factory=None, rng: Optional[random.Random] = None):
         self.models = models
-        self.timeout = timeout
+        self.timeout = timeout                # read budget (generation)
+        self.connect_timeout = connect_timeout
+        self.connect_retries = connect_retries
+        self._client = None
+        self._breaker_factory = breaker_factory or CircuitBreaker
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        # unseeded: each orchestrator replica must draw DIFFERENT jitter or
+        # N replicas re-probe a recovering backend in lockstep (tests that
+        # need determinism inject their own seeded rng)
+        self._rng = rng or random.Random()
 
     def url_of(self, name: str) -> str:
         if name not in self.models:
             raise KeyError(f"unknown model {name!r}; have {sorted(self.models)}")
         return resolve_service_url(name, self.models[name])
 
+    def _http(self):
+        """The shared client, built lazily (so tests can monkeypatch
+        ``httpx.AsyncClient`` before first use)."""
+        import httpx
+
+        if self._client is None:
+            self._client = httpx.AsyncClient(
+                timeout=httpx.Timeout(self.timeout,
+                                      connect=self.connect_timeout))
+        return self._client
+
+    async def aclose(self) -> None:
+        c, self._client = self._client, None
+        if c is not None:
+            await c.aclose()
+
+    def breaker_of(self, name: str) -> CircuitBreaker:
+        br = self._breakers.get(name)
+        if br is None:
+            br = self._breakers[name] = self._breaker_factory()
+        return br
+
+    def _retry_backoff_s(self, attempt: int) -> float:
+        """Jittered exponential pause between connect retries — 50 ms base
+        doubling, +0-50% jitter so N orchestrator replicas don't re-probe
+        a recovering backend in lockstep."""
+        return 0.05 * (2 ** attempt) * (1.0 + 0.5 * self._rng.random())
+
     async def post(self, name: str, route: str, payload: Dict) -> Dict:
         import httpx
 
+        br = self.breaker_of(name)
+        if not br.allow():
+            ra = br.retry_after_s
+            raise HTTPError(
+                503, f"{name}: circuit open after repeated failures; "
+                     f"retry in {ra:.1f}s",
+                headers={"retry-after": str(max(1, int(round(ra))))})
         url = f"{self.url_of(name)}{route}"
-        async with httpx.AsyncClient(timeout=self.timeout) as c:
-            r = await c.post(url, json=payload)
-            if r.status_code != 200:
-                raise HTTPError(502, f"{name}{route} -> {r.status_code}: "
-                                     f"{r.text[:200]}")
-            return r.json()
+        inj = rz_faults.get()
+        attempt = 0
+        try:
+            while True:
+                try:
+                    if inj.active:
+                        # chaos site: injected RPC latency / connect error
+                        await inj.asleep_at(rz_faults.COVA_RPC)
+                        if inj.should_fail(rz_faults.COVA_RPC):
+                            raise httpx.ConnectError("injected cova.rpc fault")
+                    r = await self._http().post(url, json=payload)
+                except (httpx.ConnectError, httpx.ConnectTimeout) as e:
+                    # connect phase: the backend never saw the request, so a
+                    # bounded retry is always safe
+                    br.record_failure()
+                    if attempt < self.connect_retries and br.allow():
+                        await asyncio.sleep(self._retry_backoff_s(attempt))
+                        attempt += 1
+                        continue
+                    raise HTTPError(502, f"{name}{route} unreachable: "
+                                         f"{type(e).__name__}: {e}")
+                except httpx.TimeoutException as e:
+                    # read phase: the request may be EXECUTING — never
+                    # retried, and NOT fed to the breaker: the backend is
+                    # reachable (it accepted the connect), just slow; a few
+                    # long generations must not open the circuit and
+                    # fail-fast a healthy backend. The breaker's contract
+                    # is connect-phase failures only.
+                    raise HTTPError(504, f"{name}{route} timed out: {e}")
+                except httpx.HTTPError as e:
+                    # reached the backend (protocol/read error mid-exchange):
+                    # surfaced, not breaker-counted, same as the read timeout
+                    raise HTTPError(502, f"{name}{route} failed: "
+                                         f"{type(e).__name__}: {e}")
+                br.record_success()
+                if r.status_code != 200:
+                    raise HTTPError(502, f"{name}{route} -> {r.status_code}: "
+                                         f"{r.text[:200]}")
+                return r.json()
+        except BaseException:
+            # A CancelledError (or anything the httpx clauses above don't
+            # catch) escaping while this call holds the half-open probe slot
+            # would wedge the breaker half-open forever. release_probe() is
+            # idempotent, so the record_success/record_failure paths that
+            # already cleared it are unaffected.
+            br.release_probe()
+            raise
 
     async def fleet(self) -> Dict[str, Any]:
         """Every configured model's ``/stats`` in one fan-out: served
@@ -88,11 +195,12 @@ class CovaClient:
         The orchestrator-level view the failover controller and a human
         debugging the chain both want (an unreachable model reports its
         error instead of failing the whole dump)."""
-        import httpx
 
         async def one(c, name):
             try:
-                r = await c.get(f"{self.url_of(name)}/stats")
+                # stats polls are cheap: a tight read timeout keeps a hung
+                # pod from stalling the whole fleet dump
+                r = await c.get(f"{self.url_of(name)}/stats", timeout=10.0)
                 if r.status_code != 200:
                     return name, {"error": f"/stats -> {r.status_code}"}
                 return name, r.json()
@@ -101,9 +209,9 @@ class CovaClient:
 
         from .capacity_checker import is_overloaded  # ONE threshold owner
 
-        async with httpx.AsyncClient(timeout=10.0) as c:
-            results = dict(await asyncio.gather(
-                *[one(c, n) for n in self.models]))
+        c = self._http()
+        results = dict(await asyncio.gather(
+            *[one(c, n) for n in self.models]))
         # a mis-pointed URL can 200 with non-dict JSON; keep it in the dump
         # but never let it break the aggregation
         overloaded = sorted(n for n, st in results.items()
@@ -200,6 +308,10 @@ def create_cova_app(models_path: str) -> App:
     models = load_models_config(models_path)
     client = CovaClient(models)
     app = App(title="cova")
+
+    @app.shutdown
+    async def _close_client():
+        await client.aclose()
 
     @app.get("/")
     def index(request: Request):

@@ -1,0 +1,35 @@
+"""Per-replica resilience: the pod-level half of the paper's availability
+story.
+
+The reference stays up by failing over BETWEEN tiers (capacity-checker
+events -> ALB re-weighting, reference ``README.md:157-321``); each pod is
+assumed healthy until the LB drops it. This package hardens the pod itself
+so a degraded replica degrades *gracefully* instead of hanging:
+
+- :mod:`deadline` — per-request deadlines (``X-SHAI-Deadline-Ms``) carried
+  on a contextvar from the HTTP layer down to the engine loop;
+- :mod:`admission` — bounded admission in front of ``add_request``: shed
+  with 429/503 + ``Retry-After`` instead of parking threads forever;
+- :mod:`breaker` — per-backend circuit breakers with jittered exponential
+  backoff for the cova fan-out client;
+- :mod:`drain` — SIGTERM graceful drain and the engine-step watchdog that
+  fails liveness on a stuck dispatch;
+- :mod:`faults` — a deterministic, env/endpoint-driven fault injector with
+  named sites threaded through the stack (the chaos suite's instrument).
+
+Layering: everything here is stdlib-only (plus ``orchestrate.
+capacity_checker``'s pure threshold types) so the engine may import it
+without pulling in the serve stack.
+"""
+
+from .admission import AdmissionGate, Shed  # noqa: F401
+from .breaker import CircuitBreaker  # noqa: F401
+from .deadline import (  # noqa: F401
+    DEADLINE_HEADER,
+    Deadline,
+    current_deadline,
+    deadline_from_headers,
+    set_current_deadline,
+)
+from .drain import DrainController, StepWatchdog  # noqa: F401
+from .faults import FaultError, FaultInjector  # noqa: F401

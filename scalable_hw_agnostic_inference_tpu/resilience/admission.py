@@ -1,0 +1,116 @@
+"""Bounded admission: shed load at the HTTP door instead of parking threads.
+
+The engine queue was previously unbounded — any number of requests could
+pile into ``add_request`` while the pool was saturated, each one parking a
+serving-lane thread on a future for up to 600 s. That converts overload
+into latency collapse (every queued request times out together) instead of
+the fast 429 a load balancer can act on.
+
+The gate prices admission with the SAME thresholds the failover controller
+uses (:class:`orchestrate.capacity_checker.OverloadThresholds`, via
+``is_overloaded``): sustained admission-queue depth or a KV pool at the
+preemption edge. One threshold owner means the pod starts shedding exactly
+where the fleet controller would call it saturated — the 429s a client
+sees and the failover the controller triggers describe the same line.
+
+Shed responses carry ``Retry-After``; counts are exported as
+``shai_shed_total{reason}`` on ``/metrics`` (see ``serve.metrics``) and
+under ``/stats`` → ``"shed"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional
+
+from ..orchestrate.capacity_checker import OverloadThresholds, is_overloaded
+
+
+@dataclasses.dataclass(frozen=True)
+class Shed:
+    """One shed decision: HTTP status + reason + client backoff hint."""
+
+    status: int          # 429 (overload) or 503 (draining)
+    reason: str          # "draining" | "queue_depth" | "kv_pressure" | ...
+    retry_after_s: float
+
+    @property
+    def detail(self) -> str:
+        return {
+            "draining": "pod is draining: shutting down, retry elsewhere",
+            "queue_depth": "admission queue is full, retry later",
+            "kv_pressure": "KV pool is at the preemption edge, retry later",
+            "inflight": "too many requests in flight, retry later",
+        }.get(self.reason, self.reason)
+
+    @property
+    def headers(self) -> Dict[str, str]:
+        return {"retry-after": str(max(1, int(round(self.retry_after_s))))}
+
+
+class AdmissionGate:
+    """Engine-aware load shedding in front of ``add_request``.
+
+    ``check`` reads the engine's obs telemetry snapshot (queue depth and KV
+    utilization gauges — the numbers the autoscaler already scrapes) plus
+    the drain flag, an optional in-flight cap, and the serving lane's width
+    (so blocking requests queued in the lane executor — invisible to the
+    engine's gauges — still count against the queue-depth threshold).
+    Returns a :class:`Shed` to refuse, None to admit. Thread-safe counters.
+    """
+
+    def __init__(self, thresholds: Optional[OverloadThresholds] = None,
+                 max_inflight: int = 0, retry_after_s: float = 1.0,
+                 drain_retry_after_s: float = 5.0):
+        self.thresholds = thresholds or OverloadThresholds()
+        self.max_inflight = max_inflight  # 0 = no cap
+        self.retry_after_s = retry_after_s
+        self.drain_retry_after_s = drain_retry_after_s
+        self._lock = threading.Lock()
+        self._shed: Dict[str, int] = {}
+
+    def check(self, engine_stats: Optional[dict] = None, inflight: int = 0,
+              draining: bool = False, lane_width: int = 0,
+              lane_pending: int = 0) -> Optional[Shed]:
+        shed = self._decide(engine_stats, inflight, draining, lane_width,
+                            lane_pending)
+        if shed is not None:
+            with self._lock:
+                self._shed[shed.reason] = self._shed.get(shed.reason, 0) + 1
+        return shed
+
+    def _decide(self, stats: Optional[dict], inflight: int,
+                draining: bool, lane_width: int,
+                lane_pending: int) -> Optional[Shed]:
+        if draining:
+            return Shed(503, "draining", self.drain_retry_after_s)
+        if self.max_inflight and inflight >= self.max_inflight:
+            return Shed(429, "inflight", self.retry_after_s)
+        # Lane backlog: blocking requests beyond the executor's width queue
+        # INVISIBLY to the engine's "waiting" gauge (only `lane_width`
+        # threads ever reach add_request at once), so price the app-level
+        # overflow with the same queue-depth threshold. Without this, a
+        # burst of blocking calls parks unboundedly in the lane queue and
+        # overload becomes latency collapse with zero 429s. ``lane_pending``
+        # counts only lane-bound requests — live SSE streams run on the
+        # stream pool and must not read as executor queue depth (they are
+        # still visible to ``inflight``/MAX_INFLIGHT above).
+        if (lane_width > 0
+                and lane_pending - lane_width > self.thresholds.max_queue_depth):
+            return Shed(429, "queue_depth", self.retry_after_s)
+        if isinstance(stats, dict) and is_overloaded(stats, self.thresholds):
+            reason = ("queue_depth"
+                      if stats.get("waiting", 0) > self.thresholds.max_queue_depth
+                      else "kv_pressure")
+            return Shed(429, reason, self.retry_after_s)
+        return None
+
+    @property
+    def shed_total(self) -> int:
+        with self._lock:
+            return sum(self._shed.values())
+
+    def shed_by_reason(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._shed)

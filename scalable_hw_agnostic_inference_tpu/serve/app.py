@@ -27,11 +27,16 @@ import asyncio
 import concurrent.futures
 import contextvars
 import logging
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..obs import FlightRecorder
 from ..obs import trace as obs_trace
+from ..resilience import deadline as rz_deadline
+from ..resilience import faults as rz_faults
+from ..resilience.admission import AdmissionGate
+from ..resilience.drain import DrainController
 from ..utils.env import ServeConfig
 from .asgi import App, HTTPError, Request, Response
 from .latency import LatencyCollector, run_benchmark
@@ -93,6 +98,21 @@ class ModelService:
         Engine-backed services report a dead engine loop here so the LB
         drains the pod instead of routing into guaranteed 500s.
         """
+        return None
+
+    def liveness_error(self) -> Optional[str]:
+        """Non-None fails ``/health`` (the LIVENESS probe) so Kubernetes
+        restarts the pod. Reserved for wedged-beyond-recovery states only —
+        engine-backed services report the step watchdog here (a stuck
+        dispatch: work pending, no step completing). Readiness-grade
+        trouble belongs in :meth:`ready_error`, which merely drains."""
+        return None
+
+    def drain(self, budget_s: float) -> None:
+        """Finish in-flight work within ``budget_s`` seconds and stop
+        accepting more (SIGTERM path). Engine-backed services drain their
+        engine loop here; the default is a no-op (plain services have no
+        queue beyond the in-flight requests the app already waits on)."""
         return None
 
     def extra_stats(self) -> Dict[str, float]:
@@ -170,7 +190,20 @@ def create_app(
     app = App(title=cfg.app)
     collector = LatencyCollector()
     pub = publisher or MetricsPublisher(cfg.app, cfg.nodepool, cfg.pod_name)
-    state = {"loaded": False, "warm": False, "load_error": None}
+    state = {"loaded": False, "warm": False, "load_error": None,
+             "inflight": 0, "lane_pending": 0}
+    inflight_lock = threading.Lock()
+    # request-lifecycle hardening (resilience): bounded admission in front
+    # of the model lane + the SIGTERM drain flag. One threshold owner: the
+    # gate prices saturation with the failover controller's numbers, so
+    # pod-level 429s and fleet-level failover describe the same line.
+    from ..orchestrate.capacity_checker import OverloadThresholds
+
+    gate = AdmissionGate(
+        OverloadThresholds(max_queue_depth=cfg.admit_max_queue,
+                           max_kv_utilization=cfg.admit_max_kv),
+        max_inflight=cfg.max_inflight)
+    drainer = DrainController(budget_s=cfg.drain_budget_s)
     # flight recorder: every completed request's span timeline rings here
     # (the asgi layer closes each trace and sinks it), joined at dump time
     # by the engine's step records — GET /debug/flight
@@ -185,7 +218,9 @@ def create_app(
         max_workers=max(1, service.concurrency), thread_name_prefix="model")
 
     app.state.update(cfg=cfg, service=service, collector=collector, publisher=pub,
-                     status=state, flight=flight)
+                     status=state, flight=flight, gate=gate, drainer=drainer)
+    # lifecycle probes must not ring the flight recorder
+    app.trace_exclude |= {"/health/ready", "/debug/faults"}
 
     def _do_load_and_warm():
         t0 = time.perf_counter()
@@ -228,6 +263,118 @@ def create_app(
         if err:
             raise HTTPError(503, f"model unhealthy: {err}")
 
+    # -- request lifecycle (resilience) ------------------------------------
+
+    def _engine_snapshot() -> Optional[Dict[str, Any]]:
+        try:
+            tele = service.engine_telemetry()
+            return None if tele is None else tele.snapshot()
+        except Exception:
+            return None
+
+    def _admit():
+        """Bounded admission: shed (429/503 + Retry-After) BEFORE the
+        request parks a lane thread or enters the engine queue."""
+        shed = gate.check(_engine_snapshot(), inflight=state["inflight"],
+                          draining=drainer.draining,
+                          lane_width=max(1, service.concurrency),
+                          lane_pending=state["lane_pending"])
+        if shed is not None:
+            pub.count_shed(shed.reason)
+            raise HTTPError(shed.status, shed.detail, headers=shed.headers)
+
+    def _deadline_of(request: Request) -> Optional[rz_deadline.Deadline]:
+        """The request's deadline: header wins, DEADLINE_MS default fills
+        in. Expired-on-arrival is a 504 before any model work."""
+        try:
+            dl = rz_deadline.deadline_from_headers(
+                request.headers, default_ms=float(cfg.deadline_ms))
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        if dl is not None and dl.expired:
+            raise HTTPError(504, "deadline exceeded before processing")
+        return dl
+
+    class _InferScope:
+        """Admission + deadline + in-flight accounting around one request.
+        The deadline rides a contextvar so ``_run_model``'s context copy
+        carries it onto the lane thread (and into the engine loop)."""
+
+        def __init__(self, request: Request):
+            self.request = request
+            self._token = None
+            self._handed_off = False
+
+        def __enter__(self):
+            _admit()
+            dl = _deadline_of(self.request)
+            self._token = rz_deadline.set_current_deadline(dl)
+            with inflight_lock:
+                state["inflight"] += 1
+                state["lane_pending"] += 1
+            return dl
+
+        def _dec_inflight(self):
+            with inflight_lock:
+                state["inflight"] -= 1
+
+        def hand_off_inflight(self):
+            """Streaming: the request is in flight until its stream DRAINS,
+            not until the handler returns the StreamingResponse — defer the
+            decrement to the returned callable (idempotent; called from the
+            stream iterator's finally, which runs on drain, disconnect
+            abort, and generator close alike). Keeps live SSE streams
+            visible to MAX_INFLIGHT and the drain's in-flight wait. The
+            lane-pending count drops NOW: the submission's lane thread is
+            already free and the stream's engine work runs on the stream
+            pool, so an open stream must not read as executor queue depth."""
+            self._handed_off = True
+            with inflight_lock:
+                state["lane_pending"] -= 1
+            released = {"v": False}
+
+            def release():
+                if not released["v"]:
+                    released["v"] = True
+                    self._dec_inflight()
+
+            return release
+
+        def __exit__(self, *exc):
+            if not self._handed_off:
+                with inflight_lock:
+                    state["inflight"] -= 1
+                    state["lane_pending"] -= 1
+            rz_deadline.reset_current_deadline(self._token)
+            return False
+
+    def _begin_drain(on_done: Optional[Callable[[], None]] = None) -> bool:
+        """SIGTERM semantics, callable without a signal (tests, /debug):
+        flip readiness, shed new work, let in-flight requests finish up to
+        the drain budget, drain the service (engine loop), then ``on_done``
+        (the server's shutdown). Idempotent — one drain per process."""
+        if not drainer.begin():
+            return False
+        log.warning("%s: draining (budget %.1fs) — readiness now 503",
+                    cfg.app, drainer.budget_s)
+
+        def _work():
+            clean = drainer.wait(lambda: state["inflight"] == 0)
+            if not clean:
+                log.warning("%s: drain budget expired with %d requests "
+                            "in flight", cfg.app, state["inflight"])
+            try:
+                service.drain(max(0.0, drainer.remaining_s))
+            except Exception:
+                log.exception("service drain failed")
+            if on_done is not None:
+                on_done()
+
+        threading.Thread(target=_work, daemon=True, name="drain").start()
+        return True
+
+    app.state["begin_drain"] = _begin_drain
+
     # -- uniform surface ---------------------------------------------------
     @app.get("/")
     def root(request: Request):
@@ -243,10 +390,21 @@ def create_app(
 
     @app.get("/health")
     def health(request: Request):
+        # LIVENESS: only wedged-beyond-recovery states fail it (the engine
+        # step watchdog) — Kubernetes restarts the pod. A draining pod is
+        # still live (it is finishing real work).
+        err = service.liveness_error()
+        if err:
+            return Response({"status": "stuck", "error": err}, status=503)
         return {"status": "ok"}
 
     @app.get("/readiness")
+    @app.get("/health/ready")
     def readiness(request: Request):
+        if drainer.draining:
+            # SIGTERM flips readiness first: the LB stops routing while
+            # in-flight requests finish inside the drain budget
+            return Response({"status": "draining"}, status=503)
         if state["load_error"]:
             return Response({"status": "failed", "error": state["load_error"]}, status=500)
         if not (state["loaded"] and state["warm"]):
@@ -261,11 +419,12 @@ def create_app(
         _require_ready()
         payload = request.json()
         t0 = time.perf_counter()
-        # annotation=False: this span is held across an await on the event
-        # loop; the device-trace view comes from the engine's own
-        # prefill/decode annotations on the lane thread
-        with obs_trace.span("model_infer", annotation=False):
-            out = await _run_model(service.infer, payload)
+        with _InferScope(request):
+            # annotation=False: this span is held across an await on the
+            # event loop; the device-trace view comes from the engine's own
+            # prefill/decode annotations on the lane thread
+            with obs_trace.span("model_infer", annotation=False):
+                out = await _run_model(service.infer, payload)
         dt = time.perf_counter() - t0
         collector.record(dt)
         pub.publish(dt)
@@ -328,7 +487,13 @@ def create_app(
             "served": pub.served,
             "latency": collector.report(),
             "count": collector.count,
+            "inflight": state["inflight"],
+            "lane_pending": state["lane_pending"],
+            "draining": drainer.draining,
         }
+        if gate.shed_total:
+            out["shed"] = {"total": gate.shed_total,
+                           **gate.shed_by_reason()}
         try:
             svc = service.extra_stats()
         except Exception:
@@ -342,6 +507,28 @@ def create_app(
 
         out["aot"] = compile_stats()
         return out
+
+    @app.get("/debug/faults")
+    def debug_faults(request: Request):
+        """The live fault-injection schedule (spec, seed, per-clause draw
+        and firing counts) — how a chaos run confirms what actually fired."""
+        return rz_faults.get().snapshot()
+
+    @app.post("/debug/faults")
+    def debug_faults_set(request: Request):
+        """Replace the fault schedule at runtime: ``{"spec": "...", "seed"
+        : 0}``. Armed only by the SHAI_FAULTS_ENDPOINT env opt-in — a
+        production pod must not take fault writes off its serving port."""
+        if not rz_faults.endpoint_enabled():
+            raise HTTPError(403, "fault injection endpoint is not enabled "
+                                 "(set SHAI_FAULTS_ENDPOINT=1)")
+        body = request.json()
+        try:
+            inj = rz_faults.configure(str(body.get("spec", "")),
+                                      int(body.get("seed", 0) or 0))
+        except (TypeError, ValueError) as e:
+            raise HTTPError(400, f"bad fault spec: {e}")
+        return inj.snapshot()
 
     @app.get("/debug/flight")
     def debug_flight(request: Request):
@@ -451,28 +638,49 @@ def create_app(
     from .asgi import StreamingResponse
 
     for pattern, methods, handler in service.extra_routes():
+        if tuple(methods) == ("GET",):
+            # GET-only extra routes are metadata (e.g. /v1/models): no
+            # admission gate, no deadline, no lane — an OpenAI SDK client
+            # enumerating models must not eat a 429/503 from a pod that is
+            # merely busy or draining, and a metadata probe must not
+            # inflate the inflight gauge or shai_shed_total
+            def _wrap_meta(h):
+                async def _meta_handler(request: Request, **params):
+                    _require_ready()
+                    return h(request, **params)
+                return _meta_handler
+            app.route(pattern, tuple(methods))(_wrap_meta(handler))
+            continue
+
         def _wrap(h):
             async def _handler(request: Request, **params):
                 _require_ready()
                 t0 = time.perf_counter()
-                with obs_trace.span("model_infer", annotation=False):
-                    out = await _run_model(lambda: h(request, **params))
-                if isinstance(out, StreamingResponse):
-                    # record when the stream DRAINS, not when the handler
-                    # returns (that's just the submission)
-                    inner = out.iterator
+                scope = _InferScope(request)
+                with scope:
+                    with obs_trace.span("model_infer", annotation=False):
+                        out = await _run_model(lambda: h(request, **params))
+                    if isinstance(out, StreamingResponse):
+                        # the request stays in flight (and latency runs)
+                        # until the stream DRAINS, not when the handler
+                        # returns (that's just the submission) — so live
+                        # SSE streams count against MAX_INFLIGHT and the
+                        # drain actually waits for them
+                        release = scope.hand_off_inflight()
+                        inner = out.iterator
 
-                    def timed_iter():
-                        try:
-                            for chunk in inner:
-                                yield chunk
-                        finally:
-                            dt = time.perf_counter() - t0
-                            collector.record(dt)
-                            pub.publish(dt)
+                        def timed_iter():
+                            try:
+                                for chunk in inner:
+                                    yield chunk
+                            finally:
+                                release()
+                                dt = time.perf_counter() - t0
+                                collector.record(dt)
+                                pub.publish(dt)
 
-                    out.iterator = timed_iter()
-                    return out
+                        out.iterator = timed_iter()
+                        return out
                 dt = time.perf_counter() - t0
                 collector.record(dt)
                 pub.publish(dt)
@@ -487,9 +695,27 @@ def create_app(
 
 
 def serve_forever(cfg: ServeConfig, service: ModelService) -> None:
-    """Pod entrypoint: build the app, start the metrics exporter, serve."""
+    """Pod entrypoint: build the app, start the metrics exporter, serve.
+
+    Installs the SIGTERM graceful-drain path: readiness flips to 503 (the
+    LB stops routing), new work sheds with Retry-After, in-flight requests
+    finish inside ``cfg.drain_budget_s``, the engine loop drains, then the
+    server stops and the process exits 0 — instead of Kubernetes' default
+    SIGKILL killing mid-decode requests at the grace-period edge."""
+    import signal
+
     from .httpd import Server
 
     pub = MetricsPublisher(cfg.app, cfg.nodepool, cfg.pod_name)
     app = create_app(cfg, service, publisher=pub)
-    Server(app, port=cfg.port).run()
+    server = Server(app, port=cfg.port)
+
+    def _on_sigterm(signum, frame):
+        app.state["begin_drain"](on_done=server.request_shutdown)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # not the main thread (embedded/test use)
+        log.warning("cannot install SIGTERM drain handler off the main "
+                    "thread; relying on the platform grace period")
+    server.run()

@@ -28,12 +28,18 @@ log = logging.getLogger(__name__)
 
 
 class HTTPError(Exception):
-    """Raise inside a handler to return a non-200 JSON error."""
+    """Raise inside a handler to return a non-200 JSON error.
 
-    def __init__(self, status: int, detail: str):
+    ``headers``: extra response headers — the shed/backoff paths use it to
+    carry ``Retry-After`` on 429/503 so clients and meshes back off
+    instead of hammering a saturated or draining pod."""
+
+    def __init__(self, status: int, detail: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(detail)
         self.status = status
         self.detail = detail
+        self.headers = dict(headers or {})
 
 
 class Request:
@@ -308,7 +314,8 @@ class App:
             try:
                 response = await self._dispatch(request)
             except HTTPError as e:
-                response = Response({"detail": e.detail}, status=e.status)
+                response = Response({"detail": e.detail}, status=e.status,
+                                    headers=e.headers)
             except Exception:
                 log.error("handler error on %s %s\n%s", request.method,
                           request.path, traceback.format_exc())
@@ -334,37 +341,119 @@ class App:
                 }
             )
             if isinstance(response, StreamingResponse):
-                import asyncio
-
-                loop = asyncio.get_event_loop()
-                it = iter(response.iterator)
-                _END = object()
-
-                def _next():
-                    try:
-                        return next(it)
-                    except StopIteration:
-                        return _END
-
-                while True:
-                    # dedicated pool: each live SSE stream parks one thread
-                    # in _next (possibly for minutes on a queued request);
-                    # the default executor is capped at min(32, cpus+4) and
-                    # shared with asyncio internals (getaddrinfo), so
-                    # saturating it stalls every OTHER stream and DNS
-                    # lookup (ADVICE r3)
-                    chunk = await loop.run_in_executor(_stream_pool(), _next)
-                    if chunk is _END:
-                        break
-                    if isinstance(chunk, str):
-                        chunk = chunk.encode()
-                    if chunk:
-                        await send({"type": "http.response.body",
-                                    "body": chunk, "more_body": True})
-                await send({"type": "http.response.body", "body": b""})
+                await self._drain_stream(response, receive, send)
                 return
             await send({"type": "http.response.body", "body": response.body})
         finally:
             # the root span covers the DRAIN, not just the handler return —
             # an SSE token stream's trace ends with its last token
             _finish_trace(response.status)
+
+    async def _drain_stream(self, response: "StreamingResponse",
+                            receive: Callable[[], Awaitable],
+                            send: Callable) -> None:
+        """Pump a StreamingResponse to the client while watching for
+        ``http.disconnect``.
+
+        The old loop only ever awaited the next chunk, so a client that
+        went away mid-SSE was invisible: the chunk generator kept running
+        (parking a ``_stream_pool`` thread in ``_next``) and the engine
+        kept decoding for a dead socket until ``max_new_tokens``. Now the
+        drain races each chunk pull against the ASGI disconnect message;
+        when the client goes first, the generator is CLOSED — its
+        ``finally`` path is the cancellation seam every streaming handler
+        already owns (e.g. the vllm unit's ``loop.cancel(fut)``), so
+        abandoned requests free their KV blocks and slot the same way an
+        explicit stop sequence does. A failed socket write is treated
+        identically (the disconnect often shows up there first).
+        """
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        it = iter(response.iterator)
+        _END = object()
+
+        def _next():
+            try:
+                return next(it)
+            except StopIteration:
+                return _END
+
+        def _close():
+            close = getattr(it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    log.exception("stream iterator close failed")
+
+        async def _until_disconnect():
+            # receive() contract after the request body: the next message
+            # is http.disconnect once the client actually goes away
+            # (serve.httpd blocks until socket EOF; httpx.ASGITransport
+            # resolves at response end). A transport error counts too.
+            try:
+                while True:
+                    message = await receive()
+                    if message["type"] == "http.disconnect":
+                        return
+            except Exception:
+                return
+
+        gone = loop.create_task(_until_disconnect())
+        pull = None
+        aborted = False
+        try:
+            while True:
+                # dedicated pool: each live SSE stream parks one thread
+                # in _next (possibly for minutes on a queued request);
+                # the default executor is capped at min(32, cpus+4) and
+                # shared with asyncio internals (getaddrinfo), so
+                # saturating it stalls every OTHER stream and DNS
+                # lookup (ADVICE r3)
+                pull = loop.run_in_executor(_stream_pool(), _next)
+                done, _ = await asyncio.wait(
+                    {pull, gone}, return_when=asyncio.FIRST_COMPLETED)
+                if gone in done and pull not in done:
+                    aborted = True  # client went away mid-stream
+                    break
+                chunk = pull.result()
+                if chunk is _END:
+                    break
+                if isinstance(chunk, str):
+                    chunk = chunk.encode()
+                if not chunk:
+                    continue
+                try:
+                    await send({"type": "http.response.body",
+                                "body": chunk, "more_body": True})
+                except Exception:
+                    aborted = True  # socket died mid-write
+                    break
+            if not aborted:
+                await send({"type": "http.response.body", "body": b""})
+        finally:
+            gone.cancel()
+            try:
+                await gone
+            except (asyncio.CancelledError, Exception):
+                pass
+            if aborted:
+                # a generator cannot be closed while executing: wait for
+                # the in-flight pull (our generators poll bounded queues,
+                # so this is short), then close on a pool thread so the
+                # handler's finally-path (engine cancel) runs off-loop
+                if pull is not None and not pull.done():
+                    try:
+                        await asyncio.wait_for(
+                            asyncio.shield(pull), timeout=5.0)
+                    except Exception:
+                        # pull is stuck past any sane bound — close as
+                        # soon as it returns; the thread is leaked until
+                        # then, which the log makes visible
+                        log.warning("abandoned stream still pulling; "
+                                    "deferring generator close")
+                        pull.add_done_callback(lambda f: _close())
+                        pull = None
+                if pull is not None:
+                    await loop.run_in_executor(_stream_pool(), _close)

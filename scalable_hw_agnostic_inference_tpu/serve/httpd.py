@@ -31,6 +31,10 @@ class _Connection:
         self.app = app
         self.reader = reader
         self.writer = writer
+        # bytes read past the current request (the ASGI disconnect watch
+        # may pull pipelined bytes off the socket; they belong to the NEXT
+        # request and are consumed first by the head/body readers)
+        self._pushback = b""
 
     async def run(self):
         try:
@@ -49,9 +53,36 @@ class _Connection:
             except Exception:
                 pass
 
+    async def _read_head(self) -> bytes:
+        """Request head up to (excluding) the blank line; pushback-aware."""
+        buf = bytearray(self._pushback)
+        self._pushback = b""
+        while True:
+            i = buf.find(b"\r\n\r\n")
+            if i >= 0:
+                self._pushback = bytes(buf[i + 4:])
+                return bytes(buf[:i])
+            if len(buf) > MAX_HEADER_BYTES:
+                raise asyncio.LimitOverrunError("headers too large", len(buf))
+            data = await self.reader.read(65536)
+            if not data:
+                raise asyncio.IncompleteReadError(bytes(buf), None)
+            buf += data
+
+    async def _read_body(self, length: int) -> bytes:
+        """Exactly ``length`` body bytes; pushback-aware."""
+        buf = bytearray(self._pushback[:length])
+        self._pushback = self._pushback[length:]
+        while len(buf) < length:
+            data = await self.reader.read(length - len(buf))
+            if not data:
+                raise asyncio.IncompleteReadError(bytes(buf), length)
+            buf += data
+        return bytes(buf)
+
     async def _one_request(self) -> bool:
         try:
-            raw = await self.reader.readuntil(b"\r\n\r\n")
+            raw = await self._read_head()
         except asyncio.LimitOverrunError:
             await self._simple_response(431, b"headers too large")
             return False
@@ -83,7 +114,7 @@ class _Connection:
         if length > MAX_BODY_BYTES:
             await self._simple_response(413, b"body too large")
             return False
-        body = await self.reader.readexactly(length) if length else b""
+        body = await self._read_body(length) if length else b""
 
         path, _, query = target.partition("?")
         scope = {
@@ -112,7 +143,24 @@ class _Connection:
         async def receive():
             if messages:
                 return messages.pop(0)
-            return {"type": "http.disconnect"}
+            # Body fully delivered: a further receive() is the app ASKING
+            # about the client connection (the ASGI disconnect watch under
+            # a streaming response). Block until the socket actually drops
+            # — returning http.disconnect immediately would abort every
+            # stream at its first chunk. Bytes that arrive instead are a
+            # pipelined next request: buffer them for the next
+            # _one_request and keep watching. (Bounded: a client flooding
+            # the pipeline while ignoring its response reads as gone.)
+            while True:
+                try:
+                    data = await self.reader.read(65536)
+                except (ConnectionResetError, OSError):
+                    return {"type": "http.disconnect"}
+                if not data:
+                    return {"type": "http.disconnect"}
+                self._pushback += data
+                if len(self._pushback) > MAX_HEADER_BYTES:
+                    return {"type": "http.disconnect"}
 
         async def send(message):
             nonlocal sent_body, started_response, chunked, keep_alive
@@ -206,6 +254,7 @@ class Server:
         # Bind the socket FIRST so kubelet probes connect during model load;
         # App startup hooks only *kick off* loading (serve.app runs the actual
         # load on the model executor), so awaiting them here is cheap.
+        self._loop = asyncio.get_running_loop()
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port, reuse_address=True,
             limit=MAX_HEADER_BYTES,
@@ -245,13 +294,43 @@ class Server:
         host = self.host if self.host != "0.0.0.0" else "127.0.0.1"
         return host, self.port
 
+    def request_shutdown(self):
+        """Thread-safe server stop — the drain path's exit (callable from
+        the SIGTERM drain thread against a blocking ``run()`` just as well
+        as against ``start_background()``)."""
+        loop, server = self._loop, self._server
+        if loop is None:
+            return
+
+        app = self.app
+
+        def _shutdown():
+            if server is not None:
+                server.close()
+
+            async def _finish():
+                # app shutdown hooks (e.g. cova's shared-client close) run
+                # BEFORE task teardown — cancelling first would kill them
+                run_shutdown = getattr(app, "_run_shutdown", None)
+                if run_shutdown is not None:
+                    try:
+                        await run_shutdown()
+                    except Exception:
+                        log.exception("app shutdown hooks failed")
+                current = asyncio.current_task()
+                for task in asyncio.all_tasks(loop):
+                    if task is not current:
+                        task.cancel()
+
+            loop.create_task(_finish())
+
+        try:
+            loop.call_soon_threadsafe(_shutdown)
+        except RuntimeError:  # loop already closed
+            pass
+
     def stop(self):
-        if self._loop and self._server:
-            def _shutdown():
-                self._server.close()
-                for task in asyncio.all_tasks(self._loop):
-                    task.cancel()
-            self._loop.call_soon_threadsafe(_shutdown)
+        self.request_shutdown()
         if self._thread:
             self._thread.join(timeout=5)
 

@@ -171,6 +171,17 @@ class MetricsPublisher:
                 )
                 for kind in ("drafted", "accepted", "committed")
             }
+            # load-shedding counter (resilience.admission): requests
+            # refused at the door — per reason, so dashboards can split a
+            # drain's 503s from an overload's 429s (runbook: README
+            # "Resilience"; this is the pod-level twin of the failover
+            # controller's overload trigger)
+            self._prom_shed = Counter(
+                "shai_shed_total",
+                "Requests shed by the admission gate / drain",
+                ["app", "nodepool", "reason"],
+                registry=self.registry,
+            )
         self._spec_last = {"drafted": 0, "accepted": 0, "committed": 0}
         self._engine_last_steps = -1
 
@@ -202,6 +213,25 @@ class MetricsPublisher:
                 }
             )
             print(line, file=self._stream, flush=True)
+
+    def count_shed(self, reason: str) -> None:
+        """Record one shed (refused) request under ``reason`` — exported as
+        ``shai_shed_total{reason=...}`` and one JSON line for the push-model
+        path (overloads are exactly when the control plane needs to see
+        per-pod shed rates)."""
+        if _HAVE_PROM and self.registry is not None:
+            self._prom_shed.labels(self.app, self.nodepool, reason).inc()
+        if self.emit_json:
+            # reason rides in the metric NAME: "data" is a name -> number
+            # map for the CloudWatch-style consumer (a string value would
+            # break its float() ingestion), mirroring the Prometheus twin's
+            # reason label
+            print(json.dumps({
+                "ns": METRIC_NAMESPACE,
+                "ts": round(time.time(), 3),
+                "pod": self.pod_name,
+                "data": {f"{self.app}-shed-{reason}": 1},
+            }), file=self._stream, flush=True)
 
     def publish_spec(self, drafted: int, accepted: int,
                      committed: int) -> None:

@@ -13,7 +13,15 @@ one pod alone would hang the whole slice. So the multi-host unit
 
 Determinism contract: a service's ``infer`` must reach the device only
 through the payload (services derive rngs from ``payload["seed"]``), which
-the serving layer already guarantees for the generate paths. The broadcast
+the serving layer already guarantees for the generate paths. This is also
+why the engine-backed unit does NOT declare ``supports_multihost``: its
+step-granular deadline expiry and cancellation act on leader-local wall
+time and leader-only events (``http.disconnect``) — mirroring it would let
+the leader drop a request from the batch while the follower keeps it, and
+the divergent batch composition hangs the slice's collectives. An
+engine-backed multihost unit needs expiry/cancel decisions made by the
+leader and broadcast as part of the mirrored stream, not recomputed
+per-host. The broadcast
 rides the cluster's coordination-service KV store (the same service
 ``jax.distributed`` heartbeats and gloo rendezvous run through): the leader
 publishes each pickled request under a monotonically increasing sequence
@@ -39,6 +47,7 @@ import threading
 from typing import Any, Dict
 
 from ..obs import trace as obs_trace
+from ..resilience import faults as rz_faults
 
 log = logging.getLogger(__name__)
 
@@ -114,13 +123,21 @@ class MultihostDriver:
 
             def wrapped(*args, _inner=inner, _name=name, **kwargs):
                 with self._lock:
-                    # W3C context rides the RPC: the follower's mirrored
-                    # work annotates under the LEADER's trace id, so one
-                    # request is one trace across the whole slice
-                    _broadcast_bytes(pickle.dumps(
-                        (_OP_INFER,
-                         (_name, args, kwargs,
-                          obs_trace.current_traceparent()))))
+                    # chaos site: a dropped mirror broadcast is the
+                    # leader-runs-alone hang (followers never enter the
+                    # collective) — the failure the chaos suite proves the
+                    # fail-together heartbeat converts into a restart
+                    if rz_faults.get().should_drop(rz_faults.MIRROR):
+                        log.error("fault injection: mirror broadcast for "
+                                  "%s DROPPED", _name)
+                    else:
+                        # W3C context rides the RPC: the follower's
+                        # mirrored work annotates under the LEADER's trace
+                        # id, so one request is one trace across the slice
+                        _broadcast_bytes(pickle.dumps(
+                            (_OP_INFER,
+                             (_name, args, kwargs,
+                              obs_trace.current_traceparent()))))
                     return _inner(*args, **kwargs)
 
             setattr(self.service, name, wrapped)

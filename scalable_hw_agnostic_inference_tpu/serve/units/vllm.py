@@ -16,6 +16,8 @@ import numpy as np
 
 from ...models.registry import register_model
 from ...obs import trace as obs_trace
+from ...resilience import deadline as rz_deadline
+from ...resilience.drain import StepWatchdog
 from ...utils.env import ServeConfig
 from ..app import ModelService
 from ..asgi import HTTPError
@@ -252,6 +254,16 @@ class VllmService(ModelService):
         log.info("engine: warmed %d executables (buckets=%s, prefixes=%s)",
                  n, list(engine.buckets.buckets), prefix_lens)
         self.loop = EngineLoop(engine).start()
+        # step watchdog (liveness): a wedged dispatch — work pending but no
+        # step completing for N x the p99 step time — fails /health so
+        # Kubernetes restarts the pod instead of serving a black hole.
+        # Thresholds are env-tunable for tiers with legitimately slow steps.
+        import os
+
+        self._watchdog = StepWatchdog(
+            lambda: engine.obs, lambda: engine.has_work,
+            multiplier=float(os.environ.get("SHAI_WATCHDOG_MULT", "30")),
+            min_stall_s=float(os.environ.get("SHAI_WATCHDOG_MIN_S", "10")))
 
     def ready_error(self) -> Optional[str]:
         # a dead engine loop (crashed step()) must drain the pod: /readiness
@@ -260,6 +272,18 @@ class VllmService(ModelService):
         if loop is not None and not loop.alive:
             return "engine loop is not running"
         return None
+
+    def liveness_error(self) -> Optional[str]:
+        wd = getattr(self, "_watchdog", None)
+        return None if wd is None else wd.check()
+
+    def drain(self, budget_s: float) -> None:
+        """SIGTERM: let queued + running engine requests finish within the
+        budget, then stop the loop (outstanding futures fail on the way
+        out rather than hanging past the pod's grace period)."""
+        loop = getattr(self, "loop", None)
+        if loop is not None:
+            loop.drain(budget_s)
 
     def engine_telemetry(self):
         eng = getattr(self, "_engine", None)
@@ -371,13 +395,31 @@ class VllmService(ModelService):
             ids = ids[:max_text]
         return self._collect(self.loop.submit(
             ids, params, prefix=prefix, cross_states=cross_states,
-            cross_len=cross_len))
+            cross_len=cross_len, deadline_at=self._deadline_at()))
+
+    @staticmethod
+    def _deadline_at() -> float:
+        """The request deadline as an absolute monotonic instant for the
+        engine (0 = none) — set by the serving layer's _InferScope and
+        carried here by the lane's contextvars copy."""
+        dl = rz_deadline.current_deadline()
+        return 0.0 if dl is None else dl.at
+
+    @staticmethod
+    def _result_timeout() -> float:
+        """How long to block on an engine future: past the deadline (plus
+        step slack for the engine's own expiry to land) or the legacy 600s
+        backstop for deadline-less requests."""
+        dl = rz_deadline.current_deadline()
+        if dl is None:
+            return 600.0
+        return max(0.1, dl.remaining_s) + 30.0
 
     def _collect(self, fut) -> Dict[str, Any]:
         """Await one engine future and shape the result — THE translation
-        from Finished to the serving dict (rejected → 503), shared by infer
-        and the OpenAI n>1 fan-out."""
-        fin = fut.result(timeout=600.0)
+        from Finished to the serving dict (rejected → 503, deadline →
+        504), shared by infer and the OpenAI n>1 fan-out."""
+        fin = fut.result(timeout=self._result_timeout())
         # graft the engine's per-phase timeline onto the request trace:
         # queue/prefill/decode become spans of THIS request even though the
         # engine loop ran them on its own thread
@@ -386,6 +428,10 @@ class VllmService(ModelService):
             tr.add_phase_spans(fin.timing)
         if fin.stop_reason == "rejected":
             raise HTTPError(503, "request rejected: prompt cannot fit the KV pool")
+        if fin.stop_reason == "timeout":
+            raise HTTPError(
+                504, f"deadline exceeded: request timed out in the engine "
+                     f"after {len(fin.token_ids)} tokens")
         with obs_trace.span("detokenize"):
             text = self._decode(fin.token_ids)
         out = {
@@ -477,7 +523,9 @@ class VllmService(ModelService):
             ids = self._encode(prompt, add_special=add_special)
             if not ids:
                 raise HTTPError(400, "empty prompt")
-            futs = [self.loop.submit(list(ids), params) for _ in range(n)]
+            futs = [self.loop.submit(list(ids), params,
+                                     deadline_at=self._deadline_at())
+                    for _ in range(n)]
             outs = []
             try:
                 for fut in futs:
@@ -593,9 +641,11 @@ class VllmService(ModelService):
         stop = body.get("stop") or []
         stops = [stop] if isinstance(stop, str) else list(stop)
         tokq: "_q.Queue[int]" = _q.Queue()
-        fut = self.loop.submit(ids, params, on_token=tokq.put)
+        fut = self.loop.submit(ids, params, on_token=tokq.put,
+                               deadline_at=self._deadline_at())
         # captured HERE (handler context): the chunk generator drains on a
         # stream-pool thread where the request contextvar is absent
+        result_timeout = self._result_timeout()
         req_trace = obs_trace.current_trace()
         rid = f"shai-{self._next_openai_id()}"
         created = int(_time.time())
@@ -643,7 +693,7 @@ class VllmService(ModelService):
                         finish = "stop"
                         self.loop.cancel(fut)
                         break
-                fin = fut.result(timeout=600.0)
+                fin = fut.result(timeout=result_timeout)
                 if req_trace is not None and fin.timing:
                     req_trace.add_phase_spans(fin.timing)
                 if fin.stop_reason == "rejected":
@@ -652,6 +702,16 @@ class VllmService(ModelService):
                         "message": "request rejected: prompt cannot fit "
                                    "the KV pool",
                         "type": "server_error"}}) + "\n\n")
+                    yield "data: [DONE]\n\n"
+                    return
+                if fin.stop_reason == "timeout":
+                    # deadline hit mid-stream: already-emitted tokens stand;
+                    # headers went out as 200, so signal in-band like the
+                    # rejected path
+                    yield ("data: " + _json.dumps({"error": {
+                        "message": "deadline exceeded: generation timed "
+                                   "out in the engine",
+                        "type": "timeout_error"}}) + "\n\n")
                     yield "data: [DONE]\n\n"
                     return
                 if finish is None:

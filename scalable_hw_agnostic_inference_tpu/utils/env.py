@@ -95,6 +95,15 @@ class ServeConfig:
     port: int = 8000
     warmup: bool = True
     metrics_port: int = 9100
+    # resilience (serve-layer request lifecycle)
+    deadline_ms: int = 0                 # default per-request deadline; 0 = none
+    drain_budget_s: float = 30.0         # SIGTERM: max seconds to finish in-flight
+    # admission-gate shed thresholds; defaults mirror the failover
+    # controller's OverloadThresholds so pod-level 429s and fleet-level
+    # failover describe the same saturation line
+    admit_max_queue: float = 8.0
+    admit_max_kv: float = 0.95
+    max_inflight: int = 0                # hard in-flight cap; 0 = off
     # artifact store root (local dir, gs://..., or hf://repo)
     artifact_root: str = "/tmp/shai-artifacts"
     seed: int = 0
@@ -127,6 +136,11 @@ class ServeConfig:
             port=env_int("PORT", 8000),
             warmup=env_bool("WARMUP", True),
             metrics_port=env_int("METRICS_PORT", 9100),
+            deadline_ms=env_int("DEADLINE_MS", 0),
+            drain_budget_s=env_float("DRAIN_BUDGET_S", 30.0),
+            admit_max_queue=env_float("ADMIT_MAX_QUEUE", 8.0),
+            admit_max_kv=env_float("ADMIT_MAX_KV", 0.95),
+            max_inflight=env_int("MAX_INFLIGHT", 0),
             artifact_root=env_str("ARTIFACT_ROOT", "/tmp/shai-artifacts"),
             seed=env_int("SEED", 0),
         )
@@ -146,6 +160,12 @@ class ServeConfig:
             raise ValueError(
                 f"QUANTIZATION={self.quantization!r} not supported; "
                 f"expected '' or 'int8'")
+        if self.deadline_ms < 0:
+            raise ValueError("DEADLINE_MS must be >= 0 (0 disables)")
+        if self.drain_budget_s < 0:
+            raise ValueError("DRAIN_BUDGET_S must be >= 0")
+        if self.max_inflight < 0:
+            raise ValueError("MAX_INFLIGHT must be >= 0 (0 disables)")
 
     def describe(self) -> Dict[str, Any]:
         """Redacted config for the self-describing ``GET /`` endpoint."""

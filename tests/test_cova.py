@@ -155,13 +155,43 @@ async def test_fleet_tolerates_non_dict_stats_json(monkeypatch):
         async def __aexit__(self, *a):
             return False
 
-        async def get(self, url):
+        async def get(self, url, **kw):
             return FakeResp()
 
     monkeypatch.setattr(httpx, "AsyncClient", FakeClient)
     body = await CovaClient({"weird": {"url": "http://127.0.0.1:9"}}).fleet()
     assert body["models"]["weird"] == ["not", "a", "dict"]
     assert body["overloaded"] == []
+
+
+@pytest.mark.asyncio
+async def test_read_timeout_does_not_open_breaker(monkeypatch):
+    """Read-phase timeouts mean the backend is reachable but slow — they
+    must be surfaced (504) WITHOUT feeding the circuit breaker, or a few
+    legitimately long generations would open the circuit and fail-fast a
+    healthy backend. The breaker's contract is connect-phase-only."""
+    import httpx
+
+    from scalable_hw_agnostic_inference_tpu.serve.asgi import HTTPError
+
+    class TimeoutClient:
+        def __init__(self, *a, **kw):
+            pass
+
+        async def post(self, url, **kw):
+            raise httpx.ReadTimeout("generation exceeded read budget")
+
+        async def aclose(self):
+            pass
+
+    monkeypatch.setattr(httpx, "AsyncClient", TimeoutClient)
+    client = CovaClient({"m": {"url": "http://127.0.0.1:9"}})
+    for _ in range(5):   # well past failure_threshold=3
+        with pytest.raises(HTTPError) as ei:
+            await client.post("m", "/generate", {"prompt": "x"})
+        assert ei.value.status == 504
+    assert client.breaker_of("m").state == "closed"
+    await client.aclose()
 
 
 @pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py

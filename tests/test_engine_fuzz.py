@@ -133,3 +133,162 @@ def test_engine_fuzz_invariants(tiny_model, seed):
             assert fin.token_ids == solo, (
                 f"req {i}: schedule changed greedy output\n"
                 f"  fuzz: {fin.token_ids}\n  solo: {solo}")
+
+
+# ---------------------------------------------------------------------------
+# engine.cancel invariants (ISSUE 4): cancel in EVERY phase must free
+# exactly the request's KV blocks — pool accounting conserved
+# ---------------------------------------------------------------------------
+
+def _assert_pool_conserved(eng, where=""):
+    """free + cache-retained must equal total-1 (block 0 is the null
+    block) — the no-leak invariant every cancel path must preserve."""
+    cache_held = len(eng.cache._hash2block)
+    total = eng.ecfg.total_blocks
+    assert eng.cache.allocator.n_free + cache_held == total - 1, (
+        f"block leak {where}: free={eng.cache.allocator.n_free} "
+        f"cached={cache_held} total={total}")
+
+
+def test_cancel_while_queued_frees_nothing_and_conserves(tiny_model):
+    """Cancel before admission: no blocks were reserved, none may leak,
+    and the Finished carries zero tokens."""
+    eng = make_engine(tiny_model, enable_prefix_caching=False)
+    free0 = eng.cache.allocator.n_free
+    rid = eng.add_request(list(range(2, 11)),
+                          SamplingParams(temperature=0.0, max_new_tokens=4))
+    fin = eng.cancel(rid)
+    assert fin is not None and fin.stop_reason == "cancelled"
+    assert fin.token_ids == []
+    assert eng.cache.allocator.n_free == free0
+    assert not eng.has_work
+    assert eng.cancel(rid) is None          # double-cancel: already gone
+    assert eng.cancel(10_000) is None       # unknown id
+
+
+def test_cancel_mid_decode_frees_exact_blocks(tiny_model):
+    """Cancel a decoding request: its slot and every block it held must
+    return to the pool (exact accounting — prefix caching off)."""
+    eng = make_engine(tiny_model, enable_prefix_caching=False)
+    free0 = eng.cache.allocator.n_free
+    rid = eng.add_request(list(range(2, 19)),
+                          SamplingParams(temperature=0.0, max_new_tokens=12))
+    for _ in range(4):                      # prefill + a few decode steps
+        eng.step()
+    assert any(s is not None for s in eng.slots), "not decoding yet"
+    assert eng.cache.allocator.n_free < free0, "no blocks reserved?"
+    fin = eng.cancel(rid)
+    assert fin is not None and fin.stop_reason == "cancelled"
+    assert 0 < len(fin.token_ids) < 12
+    assert eng.cache.allocator.n_free == free0
+    assert not eng.has_work
+    # the freed slot is reusable: a fresh request completes normally
+    [fin2] = eng.generate([list(range(2, 9))],
+                          SamplingParams(temperature=0.0, max_new_tokens=3))
+    assert fin2.stop_reason in ("eos", "length")
+    assert eng.cache.allocator.n_free == free0
+
+
+def test_cancel_mid_chunk_prefill_frees_partial_reservation(tiny_model):
+    """Cancel while a long prompt is chunk-prefilling: the partially
+    written blocks (prefill_cursor mid-prompt) must all free."""
+    eng = make_engine(tiny_model, enable_prefix_caching=False)
+    free0 = eng.cache.allocator.n_free
+    long_prompt = list(np.random.default_rng(0).integers(2, 100, 90))
+    long_prompt = [int(x) for x in long_prompt]
+    assert len(long_prompt) > eng.buckets.max  # really takes the chunk path
+    rid = eng.add_request(long_prompt, SamplingParams(temperature=0.0,
+                                                      max_new_tokens=4))
+    eng.step()                              # first chunk lands
+    chunking = [s for s in eng.slots
+                if s is not None and s.prefill_cursor is not None]
+    assert chunking, "request is not mid-chunk"
+    fin = eng.cancel(rid)
+    assert fin is not None and fin.stop_reason == "cancelled"
+    assert fin.token_ids == []              # never reached decode
+    assert eng.cache.allocator.n_free == free0
+    assert not eng.has_work
+
+
+def test_cancel_mid_speculative_decode_conserves_pool(tiny_model):
+    """Cancel a request the speculative path is driving (draft → verify →
+    shrink-rollback of rejected reservations): abort must compose with the
+    rollback accounting — the pool returns to baseline."""
+    eng = make_engine(tiny_model, enable_prefix_caching=False,
+                      speculative_model="[ngram]", num_speculative_tokens=4,
+                      max_new_tokens=32)
+    free0 = eng.cache.allocator.n_free
+    # repetitive prompt: the ngram drafter actually proposes
+    prompt = [5, 6, 7, 8] * 6
+    rid = eng.add_request(list(prompt), SamplingParams(temperature=0.0,
+                                                       max_new_tokens=24))
+    for _ in range(3):                      # prefill + spec verify steps
+        eng.step()
+    assert any(s is not None for s in eng.slots)
+    fin = eng.cancel(rid)
+    assert fin is not None and fin.stop_reason == "cancelled"
+    assert eng.cache.allocator.n_free == free0
+    # solo-prefix property survives the speculative path too
+    solo = _solo(tiny_model, list(prompt), 24)
+    assert fin.token_ids == solo[:len(fin.token_ids)]
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+@pytest.mark.parametrize("seed", [10, 11])
+@pytest.mark.parametrize("spec", [False, True])
+def test_cancel_fuzz_every_phase_conserves_pool(tiny_model, seed, spec):
+    """Aggressive-cancellation fuzz: cancel ~40% of requests at random
+    points (queued, mid-chunk, mid-decode, mid-speculative-verify) under a
+    tight pool; after the drain the pool must balance and every request
+    must be terminal exactly once."""
+    cfg, _, _ = tiny_model
+    rng = np.random.default_rng(seed)
+    over = dict(speculative_model="[ngram]", num_speculative_tokens=3,
+                max_new_tokens=16) if spec else {}
+    eng = make_engine(tiny_model, **over)
+    total_blocks = eng.ecfg.total_blocks
+
+    prompts = []
+    for i in range(12):
+        if spec and rng.random() < 0.5:
+            base = [int(x) for x in rng.integers(2, 50, 4)]
+            prompts.append(base * int(rng.choice([4, 8])))  # draftable
+        else:
+            ln = int(rng.choice([3, 9, 17, 40, 90]))
+            prompts.append([int(x) for x in rng.integers(2, cfg.vocab_size,
+                                                         ln)])
+    pending = list(range(12))
+    rng.shuffle(pending)
+    rids: dict = {}
+    done: dict = {}
+    steps = 0
+    while (pending or eng.has_work) and steps < 3000:
+        steps += 1
+        for _ in range(int(rng.integers(0, 3))):
+            if not pending:
+                break
+            i = pending.pop()
+            rids[eng.add_request(list(prompts[i]),
+                                 SamplingParams(temperature=0.0,
+                                                max_new_tokens=8))] = i
+        # aggressive: a cancel attempt most steps, all phases reachable
+        if rng.random() < 0.4 and rids:
+            live = [r for r in rids if r not in done]
+            if live:
+                rid = live[int(rng.integers(len(live)))]
+                fin = eng.cancel(rid)
+                if fin is not None:
+                    assert fin.stop_reason == "cancelled"
+                    done[rid] = fin
+        for f in eng.step():
+            assert f.req_id not in done, "request finished twice"
+            done[f.req_id] = f
+
+    assert steps < 3000, "engine did not drain (livelock)"
+    assert len(done) == 12, f"only {len(done)}/12 requests terminal"
+    cache_held = len(eng.cache._hash2block)
+    assert eng.cache.allocator.n_free + cache_held == total_blocks - 1, (
+        f"block leak: free={eng.cache.allocator.n_free} "
+        f"cached={cache_held} total={total_blocks}")
+    for fin in done.values():
+        assert fin.stop_reason in ("eos", "length", "rejected", "cancelled")

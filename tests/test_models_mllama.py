@@ -306,6 +306,7 @@ def test_tiled_preprocessing_matches_hf_processor(hf_model):
                                atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_engine_cross_len_masks_padding_states(hf_model):
     """A request whose image fills only part of the static Lv buffer must
     ignore the padding rows: output equals a run where padding rows carry

@@ -399,3 +399,30 @@ async def test_serve_ui_and_profile_endpoint(tmp_path):
             if os.path.isdir(trace_dir) and any(os.scandir(trace_dir)):
                 break
         assert any(os.scandir(trace_dir)), "no trace artifacts written"
+
+
+def test_server_stop_runs_shutdown_hooks():
+    """``Server.request_shutdown`` must run the app's @shutdown hooks
+    (cova closes its shared httpx client there) before task teardown —
+    the bundled server sends no ASGI lifespan events, so this is the only
+    path those hooks have in production."""
+    app = App("t")
+    ran = {"v": False}
+
+    @app.shutdown
+    async def _hook():
+        ran["v"] = True
+
+    @app.get("/ping")
+    def ping(request):
+        return {"ok": True}
+
+    srv = Server(app, host="127.0.0.1", port=0)
+    host, port = srv.start_background()
+    r = httpx.get(f"http://{host}:{port}/ping")
+    assert r.status_code == 200
+    srv.stop()
+    deadline = time.time() + 5.0
+    while not ran["v"] and time.time() < deadline:
+        time.sleep(0.01)
+    assert ran["v"], "shutdown hooks never ran on server stop"

@@ -118,6 +118,7 @@ def test_sd_coalescer_follower_membership_is_identity_based():
     assert sum(ran) == 3 and max(ran) <= 2
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_sd_coalesced_warmup_compiles_batch1_executable():
     """ADVICE r4 (high): with SD_BATCH_MAX>1 every request — including a
     solo one — runs txt2img_batch, so warmup must build the

@@ -112,6 +112,22 @@ class TestMetrics:
         assert lines[0]["ns"] == "hw-agnostic-infer"
         assert lines[0]["pod"] == "p0"
 
+    def test_count_shed_json_data_is_numeric(self):
+        """The shed reason rides in the metric NAME — "data" is a
+        name -> number map for the CloudWatch-style consumer, so a string
+        "reason" entry would break its float() ingestion (and collapse
+        per-reason counts)."""
+        buf = io.StringIO()
+        pub = MetricsPublisher("sd21", "np", pod_name="p0", stream=buf)
+        pub.count_shed("queue_depth")
+        pub.count_shed("draining")
+        lines = [json.loads(l) for l in buf.getvalue().strip().splitlines()]
+        assert lines[0]["data"] == {"sd21-shed-queue_depth": 1}
+        assert lines[1]["data"] == {"sd21-shed-draining": 1}
+        for line in lines:
+            assert all(isinstance(v, (int, float))
+                       for v in line["data"].values())
+
     def test_prometheus_counter(self):
         pub = MetricsPublisher("sd21", "np", emit_json=False)
         pub.publish(0.1)
