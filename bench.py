@@ -43,6 +43,7 @@ SD_BASELINE_IMG_S = 1.0 / 0.67
 #: one unit mapping for the measurement AND crash paths
 UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
                   "mllama": "tokens/sec", "llama_spec": "tokens/sec",
+                  "vllm": "tokens/sec",
                   "sd": "images/sec", "sd8": "images/sec",
                   "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
@@ -65,7 +66,7 @@ def _which_from_argv(argv) -> str:
         return "llama_spec"
     if any(a.startswith("llama") for a in argv):
         return "llama"
-    for k in ("flux", "t5", "mllama", "sd8"):
+    for k in ("vllm", "flux", "t5", "mllama", "sd8"):
         if k in argv:
             return k
     return "sd"
@@ -400,6 +401,98 @@ def bench_llama_spec(tiny: bool) -> dict:
     return out
 
 
+def bench_vllm(tiny: bool) -> dict:
+    """Continuous-batching engine decode tok/s, async pipeline ON vs OFF.
+
+    The PR-6 tentpole's measured number: the same paged-engine decode
+    workload run twice — ``SHAI_ASYNC_DECODE=1`` (device-resident batch
+    state + one-step-lookahead dispatch) and ``=0`` (the lock-step
+    reference oracle) — in one line, so a BENCH_*.json row shows both the
+    absolute tok/s and the realized pipelining speedup. The per-mode
+    ``step_gap_mean_ms`` (obs.steploop ``shai_engine_step_gap_seconds``)
+    says WHERE the win came from: the async path's inter-step host gap
+    collapses to ~0 while lock-step pays marshal+readback every step.
+    """
+    import os
+
+    import numpy as np
+
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+    from scalable_hw_agnostic_inference_tpu.models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig.tiny()
+        ecfg = EngineConfig(max_model_len=128, max_num_seqs=4, block_size=8,
+                            context_encoding_buckets=(32,),
+                            max_new_tokens=48)
+        batch, prompt_len, new = 4, 24, 48
+        name = "vllm-tiny"
+    else:
+        cfg = llama_mod.LlamaConfig.llama32_1b()
+        ecfg = EngineConfig(max_model_len=1024, max_num_seqs=8,
+                            block_size=16, context_encoding_buckets=(128,),
+                            max_new_tokens=128)
+        batch, prompt_len, new = 8, 128, 128
+        name = "vllm-1b-geometry"
+
+    params = llama_mod.geometry_params(cfg, quant=False)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(3, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(batch)]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=new)
+
+    def measure(async_on: bool):
+        os.environ["SHAI_ASYNC_DECODE"] = "1" if async_on else "0"
+        try:
+            eng = LLMEngine(cfg, params, ecfg)
+        finally:
+            os.environ.pop("SHAI_ASYNC_DECODE", None)
+
+        def run():
+            fins = eng.generate(prompts, sp)
+            assert len(fins) == batch
+            assert all(len(f.token_ids) == new for f in fins)
+            return fins
+
+        run()   # warm: prefill + decode executables
+        runs = 3
+        fins = []
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            fins = run()
+        dt = (time.perf_counter() - t0) / runs
+        gap = eng.obs.step_gap.snapshot()
+        return {
+            "tok_s": round(batch * new / dt, 2),
+            "step_gap_mean_ms": (round(gap["sum"] / gap["count"] * 1e3, 4)
+                                 if gap["count"] else 0.0),
+            "pipeline_flushes": eng.obs.pipeline_flushes,
+            "phases": _phases_of(fins),
+        }
+
+    on = measure(True)
+    off = measure(False)
+    base = _published("vllm_decode_tok_s")
+    out = _dollars({
+        "metric": f"{name} engine decode tok/s (bs={batch}, "
+                  f"SHAI_ASYNC_DECODE on vs off, "
+                  f"{jax.devices()[0].platform})",
+        "value": on["tok_s"],
+        "unit": "tokens/sec",
+        "vs_baseline": round(on["tok_s"] / base, 3) if base else 1.0,
+    })
+    out["async"] = on
+    out["lockstep"] = off
+    out["async_speedup"] = (round(on["tok_s"] / off["tok_s"], 3)
+                            if off["tok_s"] else 0.0)
+    out["phases"] = on["phases"]
+    return out
+
+
 def bench_flux(tiny: bool) -> dict:
     """Flux (rectified-flow DiT) txt2img on ONE chip.
 
@@ -660,7 +753,7 @@ def inner_main() -> None:
 
         enable_persistent_cache_from_env()
     out = {"llama": bench_llama, "llama_spec": bench_llama_spec,
-           "flux": bench_flux, "t5": bench_t5,
+           "vllm": bench_vllm, "flux": bench_flux, "t5": bench_t5,
            "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)
     # structured platform provenance: is_real() keys off this, never off

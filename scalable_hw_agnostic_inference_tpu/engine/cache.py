@@ -260,6 +260,20 @@ class PagedKVCache:
         self._seqs[seq_id] = alloc
         return alloc
 
+    def blocks_to_extend(self, seq_id: int, n_new: int = 1) -> int:
+        """Fresh blocks :meth:`extend` would need to grow ``seq_id`` by
+        ``n_new`` tokens (0 when the current tail block still has room).
+
+        The async decode pipeline prices a whole step's growth through this
+        BEFORE touching the allocator: the steady (lookahead) path must
+        never trigger a recompute-preemption mid-dispatch — when the summed
+        need exceeds ``n_available`` it flushes and lets the lock-step
+        grow-with-preemption path handle the pressure instead.
+        """
+        alloc = self._seqs[seq_id]
+        return max(0, self._blocks_needed(alloc.n_tokens + n_new)
+                   - len(alloc.blocks))
+
     def extend(self, seq_id: int, n_new: int = 1) -> SeqAllocation:
         """Grow a sequence by ``n_new`` tokens, allocating blocks as needed."""
         alloc = self._seqs[seq_id]

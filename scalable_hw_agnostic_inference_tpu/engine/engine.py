@@ -31,6 +31,7 @@ from ..resilience import faults as _faults
 from ..ops.sampling import sample_logits
 from .cache import PagedKVCache
 from .config import EngineConfig
+from .resident import InflightStep, ResidentBatch, composition_sig
 from .runner import make_decode, make_prefill
 from .types import (  # noqa: F401  (re-exported: public engine API)
     Finished,
@@ -43,6 +44,19 @@ from . import logprobs as _lp_mod
 from . import warm as _warm_mod
 
 log = logging.getLogger(__name__)
+
+
+def _resolve_async() -> bool:
+    """``SHAI_ASYNC_DECODE`` gate, default ON: pipelined decode with
+    device-resident batch state and one-step-lookahead dispatch. ``0`` runs
+    the lock-step path — the reference oracle the differential tests
+    (``tests/test_engine_async.py``) compare against."""
+    import os
+
+    env = os.environ.get("SHAI_ASYNC_DECODE", "")
+    if env:
+        return env.strip().lower() not in ("0", "false", "off", "no")
+    return True
 
 
 class LLMEngine:
@@ -177,6 +191,14 @@ class LLMEngine:
         self.obs = StepTelemetry(total_blocks=ecfg.total_blocks)
         self._last_rollback_tokens = 0
         self._step_kind = "idle"
+        # async pipelined decode (SHAI_ASYNC_DECODE, default on): device-
+        # resident batch arrays + at most ONE in-flight lookahead dispatch.
+        # The lock-step path stays intact as the differential oracle.
+        self._async = _resolve_async()
+        self._pipe: Optional[InflightStep] = None
+        self._res = ResidentBatch()
+        self._t_fetch = 0.0          # last decode-readback completion
+        self._last_decode_step = -2  # step-gap continuity gate
         self._ids = itertools.count()
         self._step_count = 0
         self._rng = jax.random.PRNGKey(ecfg.seed)
@@ -255,6 +277,13 @@ class LLMEngine:
                                 logprobs=(list(r.already_lp)
                                           if r.params.logprobs else None),
                                 timing=self._timing_of(r))
+        if any(s is not None and s.req.req_id == req_id for s in self.slots):
+            # the in-flight lookahead step (async decode) may have computed
+            # one extra token for this slot: retire it so the host mirrors
+            # are current before teardown — the extra token is discarded
+            # (never emitted) and its block reservation frees with the
+            # slot's release below, same flush
+            self._flush_pipeline(reason)
         for s in self.slots:
             if s is not None and s.req.req_id == req_id:
                 self._record_tpot(s)
@@ -319,7 +348,19 @@ class LLMEngine:
 
         Returns every request that finished during this step, whatever the
         path (decode EOS/length, admission rejection, preemption close-out).
+
+        Two dispatch disciplines behind one contract (``SHAI_ASYNC_DECODE``):
+        the async path pipelines decode dispatches one step ahead of the
+        host readback; the lock-step path is the reference oracle. Both
+        commit/stream/finish the same tokens on the same ``step()`` call.
         """
+        if self._async:
+            return self._step_async()
+        return self._step_sync()
+
+    def _step_sync(self) -> List[Finished]:
+        """Lock-step step: marshal -> dispatch -> readback -> bookkeeping,
+        one blocking device round-trip per decode step."""
         t0 = time.monotonic()
         self._step_count += 1
         self._done_this_step = []
@@ -333,6 +374,15 @@ class LLMEngine:
         # expire BEFORE admission: a queued request already past its
         # deadline must not be admitted into a prefill nobody waits for
         self._expire_deadlines()
+        self._admit_phase()
+        if any(s is not None for s in self.slots):
+            self._decode_step()
+        self._record_step(time.monotonic() - t0)
+        return self._done_this_step
+
+    def _admit_phase(self) -> None:
+        """One step's chunk-continuation + admission ladder (shared by the
+        lock-step and async step bodies)."""
         chunking = [s for s in self.slots
                     if s is not None and s.prefill_cursor is not None]
         if chunking:
@@ -355,10 +405,204 @@ class LLMEngine:
             self._admit_one()       # short multimodal: single-seq
         else:
             self._admit_batch()
-        if any(s is not None for s in self.slots):
-            self._decode_step()
+
+    # -- async pipelined decode (SHAI_ASYNC_DECODE, the default) -----------
+    #
+    # The decode hot loop never makes the device wait on the host: step N+1
+    # is dispatched (JAX dispatch is async) with step N's device-side
+    # sampled tokens fed straight back as its inputs, BEFORE step N's
+    # results are read back; all of step N's host bookkeeping (EOS/length/
+    # stop checks, on_token streaming, logprobs assembly, obs records) then
+    # runs while step N+1 executes. Any event that changes batch
+    # composition or control flow — join/finish/preempt, deadline expiry,
+    # cancellation, spec-decode entry, bucket change — flushes the pipeline
+    # first: the in-flight step is retired, surviving slots' host mirrors
+    # catch up, and a finished/cancelled slot's extra computed token is
+    # discarded (never emitted; its reservation frees with the slot).
+    #
+    # Token-exactness vs the lock-step oracle holds by construction: the
+    # dispatch composition, batch-row packing, and rng folds of step k are
+    # all functions of state known BEFORE step k-1's readback (a finishing
+    # slot participates in exactly one extra dispatch in both disciplines),
+    # so pipelining only reorders host work, never device inputs.
+
+    def _step_async(self) -> List[Finished]:
+        t0 = time.monotonic()
+        self._step_count += 1
+        self._done_this_step = []
+        self._step_kind = "idle"
+        inj = _faults.get()
+        if inj.active:
+            inj.sleep_at(_faults.ENGINE_STEP)
+            inj.raise_at(_faults.ENGINE_STEP)
+        now = time.monotonic()
+        deadline_due = (
+            any(0.0 < r.deadline_at <= now for r in self.waiting)
+            or any(s is not None and 0.0 < s.req.deadline_at <= now
+                   for s in self.slots))
+        chunking = any(s is not None and s.prefill_cursor is not None
+                       for s in self.slots)
+        # the steady (pure-decode) path needs no host-side inputs at all;
+        # anything else — admission work, chunked prefill, a due deadline,
+        # a drafter wanting the pending token — is an event step
+        if (self._pipe is not None and not self.waiting and not chunking
+                and not deadline_due and self._drafter is None):
+            self._steady_step()
+        else:
+            if self._pipe is not None:
+                self._flush_pipeline(
+                    "deadline" if deadline_due else
+                    "admission" if self.waiting else
+                    "chunking" if chunking else "spec")
+            self._expire_deadlines()
+            self._admit_phase()
+            if any(s is not None for s in self.slots):
+                self._decode_dispatch()
         self._record_step(time.monotonic() - t0)
         return self._done_this_step
+
+    def _steady_step(self) -> None:
+        """Pipelined decode step: dispatch N+1 on device feedback, then
+        retire step N and do its host bookkeeping while N+1 runs."""
+        prev = self._pipe
+        running = self._running_slots()
+        if not running:
+            # the previous commit finished every slot; retire the trailing
+            # dispatch (its tokens are the discarded extra) and go idle
+            self._flush_pipeline("drained")
+            return
+        if composition_sig(running,
+                           self._batch_bucket(len(running))) != prev.sig:
+            # join/finish changed the compacted batch view: the device
+            # feedback arrays are packed for the OLD rows — re-marshal
+            self._flush_pipeline("recompose")
+            self._decode_dispatch()
+            return
+        # price the whole step's growth before touching the allocator: the
+        # steady path must never recompute-preempt around an in-flight
+        # lookahead; pool pressure falls back to the grow-with-preemption
+        # ladder below
+        need = sum(self.cache.blocks_to_extend(s.req.req_id, 1)
+                   for s in running)
+        if need > self.cache.n_available:
+            self._flush_pipeline("kv_pressure")
+            self._decode_dispatch()
+            return
+        self._step_kind = "decode"
+        for s in running:
+            self.cache.extend(s.req.req_id, 1)
+        Bb = self._batch_bucket(len(running))
+        _, decode = self._decode_for(self._max_ctx_blocks(running),
+                                     len(running))
+        a = self._res.refresh(self, running, Bb)  # tables re-up if grown
+        rng = jax.random.fold_in(self._rng, self._step_count * 2)
+        tokens_dev, pos_dev = prev.nxt, prev.pos_next
+        prev.pos_next = None  # donated into this dispatch
+        self._dispatch_async(decode, running, Bb, tokens_dev, pos_dev,
+                             a, rng)
+        t_f = self._retire_pipe(prev)
+        # the dispatch beat the readback: the recorded inter-step gap is
+        # (clamped) zero — the device went straight into step N+1
+        self.obs.step_gap.observe(max(0.0, self._pipe.t_dispatch - t_f))
+        self._commit_pending(running)
+
+    def _decode_dispatch(self) -> None:
+        """Event-path decode: host-marshaled dispatch (mirrors are current)
+        with the readback DEFERRED to the next step — re-establishes the
+        pipeline in the same call that handled the event."""
+        if self._drafter is not None and self._spec_step():
+            self._step_kind = "spec"
+            return
+        self._step_kind = "decode"
+        self._grow_running(lambda s: 1)
+        running = self._running_slots()
+        if not running:
+            return
+        Bb = self._batch_bucket(len(running))
+        n_exec = self.n_executables
+        _, decode = self._decode_for(self._max_ctx_blocks(running),
+                                     len(running))
+        a = self._res.refresh(self, running, Bb)
+        tokens = np.zeros((Bb,), np.int32)
+        pos = np.zeros((Bb,), np.int32)
+        for i, s in enumerate(running):
+            tokens[i] = s.pending_token
+            pos[i] = self.cache.seq(s.req.req_id).n_tokens - 1
+        rng = jax.random.fold_in(self._rng, self._step_count * 2)
+        self._dispatch_async(decode, running, Bb, jnp.asarray(tokens),
+                             jnp.asarray(pos), a, rng,
+                             gap_ok=self.n_executables == n_exec)
+        self._commit_pending(running)
+
+    def _dispatch_async(self, decode, running, Bb: int, tokens_dev,
+                        pos_dev, a, rng, gap_ok: bool = True) -> None:
+        """Enqueue one feedback-decode dispatch and record it in-flight.
+
+        ``gap_ok=False`` suppresses the step-gap observation (the caller
+        compiled a new executable this step — warmup, not a dispatch gap).
+        """
+        args = [self.params, self.cache.kv, tokens_dev, pos_dev,
+                a["tables"], a["active"], rng, a["temp"], a["topk"],
+                a["topp"]]
+        if self._cross_kv is not None:
+            args += [self._cross_kv, a["has_image"], a["slot_idx"],
+                     a["cross_len"]]
+        cold = self._pipe is None
+        t_d = time.monotonic()
+        with annotate("engine.decode"):
+            (self.cache.kv, nxt, pos_next, top_ids, top_lp,
+             tok_lp) = decode(*args)
+        if cold and gap_ok and self._t_fetch \
+                and self._last_decode_step == self._step_count - 1:
+            # flush/cold step: the dispatch had to wait for the readback —
+            # this gap is the serialization cost of the event
+            self.obs.step_gap.observe(max(0.0, t_d - self._t_fetch))
+        self._last_decode_step = self._step_count
+        self._pipe = InflightStep(
+            sig=composition_sig(running, Bb), running=list(running),
+            nxt=nxt, pos_next=pos_next, top_ids=top_ids, top_lp=top_lp,
+            tok_lp=tok_lp,
+            want_lp=any(s.req.params.logprobs for s in running),
+            t_dispatch=t_d)
+
+    def _retire_pipe(self, pipe: InflightStep) -> float:
+        """Host half of a dispatched step: fetch the sampled tokens (the
+        only blocking device sync in the async loop) and mirror them into
+        ``pending_token`` + logprob entries. Slots that finished or were
+        cancelled since the dispatch are skipped — their extra token is
+        exactly the discarded lookahead. Returns the fetch stamp."""
+        if pipe.want_lp:
+            nxt, top_ids, top_lp, tok_lp = jax.device_get(
+                (pipe.nxt, pipe.top_ids, pipe.top_lp, pipe.tok_lp))
+        else:
+            nxt = np.asarray(pipe.nxt)
+            top_ids = top_lp = tok_lp = None
+        t_f = time.monotonic()
+        self._t_fetch = t_f
+        self._apply_sampled(pipe.running, nxt, top_ids, top_lp, tok_lp)
+        return t_f
+
+    def _flush_pipeline(self, reason: str) -> None:
+        """Retire the in-flight lookahead (no-op when none): the explicit
+        pipeline flush every composition/control-flow event pays. Counted
+        per reason — a high flush rate is the 'pipeline never gets to
+        stream' signal on ``/metrics``."""
+        pipe, self._pipe = self._pipe, None
+        if pipe is None:
+            return
+        self._retire_pipe(pipe)
+        self.obs.count_flush(reason)
+
+    def finish_pending(self) -> None:
+        """Retire any in-flight lookahead step — the engine loop calls this
+        when the engine goes idle so host mirrors don't sit one step stale
+        across an idle gap (and the last step's buffers free)."""
+        self._flush_pipeline("idle")
+        # idle breaks step-gap continuity: the step COUNTER does not tick
+        # while the loop waits for work, so without this reset the first
+        # dispatch of the next burst would book the whole wall-clock idle
+        # gap as a dispatch gap (seen live: a 1.5 s "gap" between bursts)
+        self._last_decode_step = -2
 
     def _record_step(self, duration_s: float) -> None:
         """One obs step record per engine step — occupancy, KV pressure,
@@ -861,9 +1105,13 @@ class LLMEngine:
             _faults.get().raise_at(_faults.COMPILE)
             if self._warmed:
                 self.obs.count_recompile("decode")
+            # async engines compile the feedback variant (returns pos+1,
+            # donates the position buffer) into the SAME (ctx, batch)
+            # ladder — one executable per key either way
             self._decode_fns[key] = make_decode(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
-                bb, ctx_blocks=m, shardings=self.shardings)
+                bb, ctx_blocks=m, shardings=self.shardings,
+                feedback=self._async)
         return bb, self._decode_fns[key]
 
     def _verify_for(self, m_blocks: int, n_active: int = -1):
@@ -893,6 +1141,10 @@ class LLMEngine:
 
     def _preempt_lowest(self) -> None:
         """Recompute-preempt the most recently admitted sequence."""
+        # defensive: preemption streams/commits the victim's pending token,
+        # so the host mirror must be current (the event paths flush before
+        # ever reaching the allocator; this covers any future caller)
+        self._flush_pipeline("preempt")
         victims = [s for s in self.slots if s is not None]
         victim = max(victims, key=lambda s: s.req.req_id)
         log.warning("preempting seq %d (block pool exhausted)", victim.req.req_id)
@@ -1057,10 +1309,14 @@ class LLMEngine:
         running = self._running_slots()
         if not running:
             return True  # everything preempted away; step is done
+        n_exec = self.n_executables
         Bb, verify = self._verify_for(self._max_ctx_blocks(running),
                                       len(running))
 
-        a = self._marshal_running(running, Bb)
+        # verify shares the device-resident batch view with decode: same
+        # composition, same persistent tables/knob arrays — only the
+        # per-step token/position data is marshaled fresh
+        a = self._res.refresh(self, running, Bb)
         tokens = np.zeros((Bb, k + 1), np.int32)
         pos0 = np.zeros((Bb,), np.int32)
         n_drafted = [len(drafts.get(s.slot, ())) for s in running]
@@ -1073,15 +1329,19 @@ class LLMEngine:
         # same device stream slot as the vanilla decode this step replaces
         rng = jax.random.fold_in(self._rng, self._step_count * 2)
         args = [self.params, self.cache.kv, jnp.asarray(tokens),
-                jnp.asarray(pos0), jnp.asarray(a["tables"]),
-                jnp.asarray(a["active"]), rng, jnp.asarray(a["temp"]),
-                jnp.asarray(a["topk"]), jnp.asarray(a["topp"])]
+                jnp.asarray(pos0), a["tables"], a["active"], rng,
+                a["temp"], a["topk"], a["topp"]]
         if self._cross_kv is not None:
-            args += [self._cross_kv, jnp.asarray(a["has_image"]),
-                     jnp.asarray(a["slot_idx"]), jnp.asarray(a["cross_len"])]
+            args += [self._cross_kv, a["has_image"], a["slot_idx"],
+                     a["cross_len"]]
+        t_d = time.monotonic()
         with annotate("engine.verify"):
             (self.cache.kv, o, oex, accept_p, o_lp, d_lp, oex_lp,
              top_ids, top_lp) = verify(*args)
+        if self._t_fetch and self.n_executables == n_exec \
+                and self._last_decode_step == self._step_count - 1:
+            self.obs.step_gap.observe(max(0.0, t_d - self._t_fetch))
+        self._last_decode_step = self._step_count
         o = np.asarray(o)
         oex = np.asarray(oex)
         accept_p = np.asarray(accept_p)
@@ -1092,6 +1352,7 @@ class LLMEngine:
             oex_lp = np.asarray(oex_lp)
             top_ids = np.asarray(top_ids)
             top_lp = np.asarray(top_lp)
+        self._t_fetch = time.monotonic()
 
         from .speculative import accept_drafts
 
@@ -1169,6 +1430,7 @@ class LLMEngine:
         running = self._running_slots()
         if not running:
             return
+        n_exec = self.n_executables
         Bb, decode = self._decode_for(self._max_ctx_blocks(running),
                                       len(running))
 
@@ -1187,15 +1449,35 @@ class LLMEngine:
         if self._cross_kv is not None:
             args += [self._cross_kv, jnp.asarray(a["has_image"]),
                      jnp.asarray(a["slot_idx"]), jnp.asarray(a["cross_len"])]
+        t_d = time.monotonic()
         with annotate("engine.decode"):
             self.cache.kv, nxt, top_ids_d, top_lp_d, tok_lp_d = decode(*args)
+        if self._t_fetch and self.n_executables == n_exec \
+                and self._last_decode_step == self._step_count - 1:
+            # lock-step inter-step gap: the host work (marshal, bookkeeping)
+            # the device idled behind between consecutive decode dispatches
+            # (a first-use compile is warmup, not a dispatch gap — skipped)
+            self.obs.step_gap.observe(max(0.0, t_d - self._t_fetch))
+        self._last_decode_step = self._step_count
         nxt = np.asarray(nxt)
         if any(s.req.params.logprobs for s in running):
             top_ids_d = np.asarray(top_ids_d)
             top_lp_d = np.asarray(top_lp_d)
             tok_lp_d = np.asarray(tok_lp_d)
+        else:
+            top_ids_d = top_lp_d = tok_lp_d = None
+        self._t_fetch = time.monotonic()
 
-        for i, s in enumerate(running):
+        self._commit_pending(running)
+        self._apply_sampled(running, nxt, top_ids_d, top_lp_d, tok_lp_d)
+
+    def _commit_pending(self, running) -> None:
+        """Commit every running slot's pending token — the host half of a
+        decode step: append/stream it, run the EOS/length/stop ladder, and
+        finish+release what's done. Shared verbatim by the lock-step and
+        async paths so the two disciplines cannot drift. Slots finished or
+        cancelled since the snapshot are skipped (identity check)."""
+        for s in running:
             if self.slots[s.slot] is not s:
                 continue  # defensive: slot changed mid-step
             s.generated.append(s.pending_token)
@@ -1221,9 +1503,17 @@ class LLMEngine:
                 self.cache.release(s.req.req_id)
                 self.slots[s.slot] = None
                 self._has_image[s.slot] = 0.0
-            else:
-                s.pending_token = int(nxt[i])
-                if p.logprobs:
-                    s.lps.append(self._lp_entry(
-                        p.logprobs, nxt[i], tok_lp_d[i],
-                        top_ids_d[i], top_lp_d[i]))
+
+    def _apply_sampled(self, running, nxt, top_ids, top_lp, tok_lp) -> None:
+        """Mirror a decode dispatch's sampled tokens into the surviving
+        slots' ``pending_token`` (+ logprob entries). In the async path this
+        runs one step late (the host mirror lags the device by one step);
+        a slot finished/cancelled in between keeps its token discarded."""
+        for i, s in enumerate(running):
+            if self.slots[s.slot] is not s:
+                continue  # finished/cancelled: the sampled token is dropped
+            s.pending_token = int(nxt[i])
+            p = s.req.params
+            if p.logprobs:
+                s.lps.append(self._lp_entry(
+                    p.logprobs, nxt[i], tok_lp[i], top_ids[i], top_lp[i]))

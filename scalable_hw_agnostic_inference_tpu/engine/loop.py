@@ -188,6 +188,11 @@ class EngineLoop:
                 self._drain_submissions(block=not self.engine.has_work)
                 self._drain_cancels()
                 if not self.engine.has_work:
+                    # async decode: going idle can leave the final lookahead
+                    # step in flight (every slot finished at its commit) —
+                    # retire it here so host mirrors don't sit one step
+                    # stale across the idle gap and its buffers free
+                    self.engine.finish_pending()
                     continue
                 try:
                     for fin in self.engine.step():

@@ -577,11 +577,20 @@ def _make_token_forward(cfg: LlamaConfig, block_size: int, m_ctx: int,
 def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                 max_num_seqs: int, ctx_blocks: Optional[int] = None,
                 shardings: Optional[EngineShardings] = None,
-                paged: Optional[bool] = None):
+                paged: Optional[bool] = None, feedback: bool = False):
     """Compile one decode step for the whole slot batch.
 
     ``decode(params, kv, tokens [B], pos [B], tables [B, M], active [B],
     rng, temperature [B], top_k [B], top_p [B]) -> (kv, next_tokens [B])``.
+
+    ``feedback``: the async-pipeline variant (``SHAI_ASYNC_DECODE``). The
+    executable additionally returns ``pos + 1`` so the engine can feed the
+    sampled-token and position arrays of step N straight back as step
+    N+1's inputs without a host round-trip, and ``pos`` is donated along
+    with the KV pool (the position buffer ping-pongs in place; ``tokens``
+    is NOT donated — the host still reads step N's sampled tokens back one
+    step later for EOS/stop bookkeeping, and a donated buffer could not be
+    fetched after being consumed by the next dispatch).
 
     ``pos[b]`` is the index the new token is written at (== tokens so far).
     Inactive slots carry ``tables`` of zeros and write harmlessly into the
@@ -627,6 +636,8 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
         # logprob data rides along (tiny vs the matmuls); the engine only
         # transfers it to the host when a running request asked for it
         top_ids, top_lp, tok_lp = token_logprobs(logits, nxt)
+        if feedback:
+            return kv, nxt, pos + 1, top_ids, top_lp, tok_lp
         return kv, nxt, top_ids, top_lp, tok_lp
 
     if cross_set:
@@ -643,16 +654,17 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
             return _decode_impl(params, kv, tokens, pos, tables, active, rng,
                                 temperature, top_k, top_p)
 
+    donate = (1, 3) if feedback else (1,)
     if shardings is None:
-        return jax.jit(decode, donate_argnums=(1,))
+        return jax.jit(decode, donate_argnums=donate)
     sh, rep = shardings, shardings.rep
     kvsh = sh.kv_pool(cfg.n_layers - len(cross_set))
     in_sh = (sh.params, kvsh) + (rep,) * 8
     if cross_set:
         in_sh += (sh.cross_pool(len(cross_set)), rep, rep, rep)
-    return jax.jit(decode, donate_argnums=(1,),
-                   in_shardings=in_sh,
-                   out_shardings=(kvsh, rep, rep, rep, rep))
+    out_sh = (kvsh,) + (rep,) * (5 if feedback else 4)
+    return jax.jit(decode, donate_argnums=donate,
+                   in_shardings=in_sh, out_shardings=out_sh)
 
 
 def make_verify(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,

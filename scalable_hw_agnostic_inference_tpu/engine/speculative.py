@@ -16,6 +16,13 @@ the host. It shines on the workloads the reference stack actually serves:
 summarization/extraction-style prompts where the output quotes the input,
 and the self-repetition every greedy decode drifts into.
 
+Async-decode interplay (``SHAI_ASYNC_DECODE``, engine.resident): drafting
+reads each slot's ``pending_token``, so a speculative step is a pipeline
+*event* — the engine flushes (retires) any in-flight lookahead dispatch
+before ``_spec_step`` runs, and the verify dispatch shares the
+device-resident batch view (tables/active/sampling knobs) with decode
+instead of re-marshaling it host->device per step.
+
 Acceptance is exact: at temperature 0 a draft survives iff it equals the
 model's argmax at its position; at temperature > 0 the standard
 delta-proposal rejection rule applies — accept draft ``d`` with probability

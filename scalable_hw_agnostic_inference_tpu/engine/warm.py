@@ -114,6 +114,9 @@ def _run_warm_calls(eng) -> None:
         eng.cache.kv, logits = fn(*args)
         logits.block_until_ready()
     for (m, bb), fn in list(eng._decode_fns.items()):
+        # async engines warm the feedback variant through the same ladder
+        # (one extra pos+1 output rides in *_rest; the donated position
+        # buffer here is a warm-only throwaway)
         args = [eng.params, eng.cache.kv, jnp.zeros((bb,), jnp.int32),
                 jnp.zeros((bb,), jnp.int32), jnp.zeros((bb, M), jnp.int32),
                 jnp.zeros((bb,), bool), jax.random.PRNGKey(0),
@@ -123,7 +126,7 @@ def _run_warm_calls(eng) -> None:
             args += [eng._cross_kv, jnp.zeros((bb,), jnp.float32),
                      jnp.zeros((bb,), jnp.int32),
                      jnp.full((bb,), max(eng.cross_seq_len, 1), jnp.int32)]
-        eng.cache.kv, nxt, *_lp = fn(*args)
+        eng.cache.kv, nxt, *_rest = fn(*args)
         nxt.block_until_ready()
     K = eng.ecfg.num_speculative_tokens
     for (m, bb), fn in list(eng._verify_fns.items()):

@@ -104,6 +104,11 @@ class StableDiffusion:
             return jnp.round(img).astype(jnp.uint8)
 
         self._decode = jax.jit(_decode_u8)
+        # stepwise-mode decode: same policy as the fused pipeline's tail
+        # (_decode_body — the batch-2/4 per-image VAE split on TPU); jit
+        # caches per latent shape, so one wrapper serves every batch size
+        self._decode_split = jax.jit(
+            lambda p, z: self._decode_body(p, z))
 
     # -- jit builders -----------------------------------------------------
 
@@ -306,7 +311,11 @@ class StableDiffusion:
         g = jnp.float32(guidance_scale)
         for i in range(len(ts)):
             lat = step(self.unet_params, lat, ts[i], a_t[i], a_p[i], ctx2, g)
-        return np.asarray(self._decode(self.vae_params, lat))
+        # decode through _decode_body, not the plain fused _decode: the
+        # stepwise fallback must share the batch-2/4 per-image VAE split
+        # policy (XLA:TPU's fused batch-4 decode is HBM-pathological —
+        # ~115 GB accessed vs 35 GB split, PERF_MODEL.md sd_vae_b4)
+        return np.asarray(self._decode_split(self.vae_params, lat))
 
     def txt2img(
         self,

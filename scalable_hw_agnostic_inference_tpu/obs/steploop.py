@@ -31,6 +31,13 @@ TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                 1.0)
 QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                       5.0, 10.0, 30.0, 60.0)
+#: inter-step device gap: host time between fetching one decode step's
+#: results and enqueueing the next decode dispatch — the serial host work
+#: the device sits idle behind. The async pipeline (SHAI_ASYNC_DECODE)
+#: dispatches ahead of the fetch, so steady steps observe (clamped) zero;
+#: lock-step observes the full marshal+bookkeeping gap every step.
+STEP_GAP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                    0.025, 0.05, 0.1, 0.5)
 
 
 class BucketHistogram:
@@ -89,11 +96,18 @@ class StepTelemetry:
         self.ttft = BucketHistogram(TTFT_BUCKETS)
         self.tpot = BucketHistogram(TPOT_BUCKETS)
         self.queue_wait = BucketHistogram(QUEUE_WAIT_BUCKETS)
+        self.step_gap = BucketHistogram(STEP_GAP_BUCKETS)
         # cumulative counters
         self.steps = 0
         self.preemptions = 0
         self.recompiles = 0          # post-warm (bucket-miss) executables
         self.requests_finished = 0
+        # async-decode pipeline flushes: the in-flight lookahead step was
+        # retired early because an event changed batch composition or
+        # control flow (cancel/timeout/join/finish/spec/preempt/idle) —
+        # each one is a serialization point the steady path avoids
+        self.pipeline_flushes = 0
+        self._flush_reasons: Dict[str, int] = {}
         self.warmed_executables = 0  # closed-set size at readiness
         # last-step gauges (scraped between steps)
         self._gauges: Dict[str, float] = {}
@@ -111,6 +125,17 @@ class StepTelemetry:
     def count_recompile(self, kind: str = "") -> None:
         with self._lock:
             self.recompiles += 1
+
+    def count_flush(self, reason: str = "") -> None:
+        with self._lock:
+            self.pipeline_flushes += 1
+            if reason:
+                self._flush_reasons[reason] = (
+                    self._flush_reasons.get(reason, 0) + 1)
+
+    def flush_reasons(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._flush_reasons)
 
     def record_step(self, *, kind: str, duration_s: float, n_running: int,
                     n_waiting: int, n_chunking: int, blocks_free: int,
@@ -192,10 +217,12 @@ class StepTelemetry:
                 "requests_finished": self.requests_finished,
                 "warmed_executables": self.warmed_executables,
                 "kv_blocks_total": self.total_blocks,
+                "pipeline_flushes": self.pipeline_flushes,
             }
             out.update(self._gauges)
         for name, h in (("ttft", self.ttft), ("tpot", self.tpot),
-                        ("queue_wait", self.queue_wait)):
+                        ("queue_wait", self.queue_wait),
+                        ("step_gap", self.step_gap)):
             out[f"{name}_count"] = h.count
         return out
 
@@ -203,4 +230,5 @@ class StepTelemetry:
         """Named histogram snapshots for the Prometheus adapter."""
         return {"ttft_seconds": self.ttft.snapshot(),
                 "tpot_seconds": self.tpot.snapshot(),
-                "queue_wait_seconds": self.queue_wait.snapshot()}
+                "queue_wait_seconds": self.queue_wait.snapshot(),
+                "step_gap_seconds": self.step_gap.snapshot()}

@@ -22,6 +22,35 @@ NEG_INF = -1e30
 # platform registers as "tpu"; the name is kept for older plugin builds)
 TPU_PLATFORMS = ("tpu", "axon")
 
+#: every platform name SHAI_PLATFORM_OVERRIDE may legally carry — the TPU
+#: names plus the PJRT backends this code can dispatch for. A typo'd or
+#: truncated value would silently steer kernel dispatch; reject it here,
+#: at the decision site, instead of deep inside Mosaic.
+KNOWN_PLATFORMS = TPU_PLATFORMS + ("cpu", "gpu", "cuda", "rocm", "metal")
+
+_override_logged: set = set()
+
+
+def _validated_override(ovr: str) -> str:
+    """Validate the override against the known platform names and log ONCE
+    per value when active: a ``tpu`` override leaked into a CPU process
+    (e.g. a deviceless-AOT env var inherited by a test run) otherwise
+    surfaces as a Mosaic dispatch crash far from the cause."""
+    if ovr not in KNOWN_PLATFORMS:
+        raise ValueError(
+            f"SHAI_PLATFORM_OVERRIDE={ovr!r} is not a known platform "
+            f"(expected one of {', '.join(KNOWN_PLATFORMS)}); unset it or "
+            f"fix the value — a wrong override steers kernel dispatch for "
+            f"a device the computation will never run on")
+    if ovr not in _override_logged:
+        _override_logged.add(ovr)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "SHAI_PLATFORM_OVERRIDE=%s active: ops dispatch follows the "
+            "override, not the process backend (deviceless-AOT mode)", ovr)
+    return ovr
+
 
 def effective_platform() -> str:
     """Platform the CURRENT computation will actually run on.
@@ -45,7 +74,7 @@ def effective_platform() -> str:
 
     ovr = os.environ.get("SHAI_PLATFORM_OVERRIDE", "")
     if ovr:
-        return ovr
+        return _validated_override(ovr)
     dd = jax.config.jax_default_device
     if dd is not None:
         # the option accepts a platform STRING too (JAX_DEFAULT_DEVICE=cpu)

@@ -52,6 +52,10 @@ ENGINE_HISTOGRAMS = {
                      "Per-output-token decode pace after the first token"),
     "queue_wait_seconds": ("shai_queue_wait_seconds",
                            "Submit-to-admission wait in the engine queue"),
+    "step_gap_seconds": ("shai_engine_step_gap_seconds",
+                         "Inter-step device gap: host time between a decode "
+                         "readback and the next dispatch (0 when the async "
+                         "pipeline dispatched ahead of the readback)"),
 }
 _ENGINE_GAUGES = {
     "running": ("shai_engine_running", "Sequences decoding right now"),
@@ -71,6 +75,9 @@ _ENGINE_COUNTERS = {
                    "Post-warm bucket-miss executable compiles"),
     "requests_finished": ("shai_engine_requests_finished",
                           "Requests finished by the engine"),
+    "pipeline_flushes": ("shai_engine_pipeline_flushes",
+                         "Async-decode lookahead steps retired early by a "
+                         "composition/control-flow event"),
 }
 
 

@@ -463,6 +463,17 @@ class VllmService(ModelService):
             out["ttft_p99_ms"] = round(rep["p99"] * 1e3, 2)
         if eng.tpot.count:
             out["tpot_p50_ms"] = round(eng.tpot.report()["p50"] * 1e3, 2)
+        # async decode pipeline health: flush count (serialization events,
+        # per-reason breakdown as flat keys) and the realized inter-step
+        # gap — near-zero mean gap says the lookahead is actually hiding
+        # the host work (SHAI_ASYNC_DECODE)
+        out["pipeline_flushes"] = eng.obs.pipeline_flushes
+        for reason, n in eng.obs.flush_reasons().items():
+            out[f"pipeline_flush_{reason}"] = n
+        gap = eng.obs.step_gap.snapshot()
+        if gap["count"]:
+            out["step_gap_mean_ms"] = round(
+                gap["sum"] / gap["count"] * 1e3, 4)
         if eng.spec is not None:
             # speculative decoding counters: acceptance rate and realized
             # tokens-per-verify become shai_service_* gauges, next to the

@@ -84,7 +84,10 @@ def project_rows(perf: dict) -> dict:
         t_dec = dec["t_roofline_s"] / eta
         t_pre = pre["t_roofline_s"] / eta
         batch = dec.get("batch", 8)
-        t_req = t_pre + GEN_TOKENS * t_dec   # one batch of requests
+        # prefill already yields the FIRST token (scripts/breaking_point.py's
+        # TPOT definition): a GEN_TOKENS request pays GEN_TOKENS - 1 decode
+        # steps, not GEN_TOKENS
+        t_req = t_pre + (GEN_TOKENS - 1) * t_dec   # one batch of requests
         r = base("paged-engine decode (bs=8) + bucketed prefill projection, "
                  f"{GEN_TOKENS}-token streamed requests")
         r["slo"] = "ttfb"

@@ -241,9 +241,10 @@ def test_project_rows_math():
     assert sd["breakpoint"]["over_threshold_at_c1"] is True
     # b8 flash tier
     assert rows["sd21-tpub8"]["breakpoint"]["rps"] == pytest.approx(8 / 2.4, abs=1e-3)
-    # vllm: t_req = 0.04 + 16*0.02 = 0.36 -> 22.2 RPS; TTFT/TPOT recorded
+    # vllm: prefill already yields the first token (breaking_point.py's
+    # TPOT definition), so t_req = 0.04 + (16 - 1)*0.02 = 0.34 -> 23.5 RPS
     v = rows["vllm-tpu"]
-    assert v["breakpoint"]["rps"] == pytest.approx(8 / 0.36, abs=0.01)
+    assert v["breakpoint"]["rps"] == pytest.approx(8 / 0.34, abs=0.01)
     assert v["breakpoint"]["ttfb_p50"] == pytest.approx(0.04)
     assert v["breakpoint"]["tpot"] == pytest.approx(0.02)
     assert v["slo"] == "ttfb"

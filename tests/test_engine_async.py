@@ -1,0 +1,405 @@
+"""Differential tests: async pipelined decode vs the lock-step oracle.
+
+``SHAI_ASYNC_DECODE=1`` (the default) restructures the decode hot loop —
+device-resident batch state, on-device token feedback, one-step-lookahead
+dispatch — but must be TOKEN-EXACT against the lock-step path it replaced:
+identical token streams, logprobs, stop reasons, streaming-callback order,
+and KV pool balance, across every scheduling shape the engine supports.
+The lock-step path (``SHAI_ASYNC_DECODE=0``) is kept alive exactly to be
+this oracle.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+from scalable_hw_agnostic_inference_tpu.engine.engine import (
+    LLMEngine,
+    SamplingParams,
+)
+from scalable_hw_agnostic_inference_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return cfg, params
+
+
+def make_engine(tiny_model, async_on, monkeypatch, **over):
+    cfg, params = tiny_model
+    monkeypatch.setenv("SHAI_ASYNC_DECODE", "1" if async_on else "0")
+    kw = dict(max_model_len=64, max_num_seqs=3, block_size=8,
+              context_encoding_buckets=(16, 32), max_new_tokens=16)
+    kw.update(over)
+    eng = LLMEngine(cfg, params, EngineConfig(**kw))
+    assert eng._async is async_on
+    return eng
+
+
+def pool_balanced(eng) -> bool:
+    return eng.cache.allocator.n_free == eng.ecfg.total_blocks - 1
+
+
+def assert_finished_equal(a, b):
+    assert a.req_id == b.req_id
+    assert a.token_ids == b.token_ids, (a.req_id, a.token_ids, b.token_ids)
+    assert a.stop_reason == b.stop_reason
+    if a.logprobs is None or b.logprobs is None:
+        assert a.logprobs == b.logprobs
+        return
+    assert len(a.logprobs) == len(b.logprobs)
+    for e1, e2 in zip(a.logprobs, b.logprobs):
+        assert e1["token"] == e2["token"]
+        assert e1["logprob"] == pytest.approx(e2["logprob"], abs=1e-5)
+        assert e1["top_ids"] == e2["top_ids"]
+
+
+# ---------------------------------------------------------------------------
+# vanilla decode parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sp", [
+    SamplingParams(temperature=0.0, max_new_tokens=8),
+    pytest.param(SamplingParams(temperature=0.9, top_k=5, max_new_tokens=8),
+                 marks=pytest.mark.slow),
+    pytest.param(SamplingParams(temperature=0.7, top_p=0.8,
+                                max_new_tokens=8),
+                 marks=pytest.mark.slow),
+], ids=["greedy", "topk", "topp"])
+def test_async_generate_matches_lockstep(tiny_model, monkeypatch, sp):
+    prompts = [[1, 5, 9], [1, 200, 300, 400, 17, 23], [2, 2, 7, 7]]
+    a = make_engine(tiny_model, True, monkeypatch)
+    b = make_engine(tiny_model, False, monkeypatch)
+    fa = a.generate(prompts, sp)
+    fb = b.generate(prompts, sp)
+    for x, y in zip(fa, fb):
+        assert_finished_equal(x, y)
+    assert pool_balanced(a) and pool_balanced(b)
+    # the pipelined path really pipelined: its recorded inter-step gap is
+    # the clamped zero of dispatch-before-readback, never the lock-step
+    # marshal+bookkeeping gap
+    assert a.obs.step_gap.snapshot()["sum"] <= b.obs.step_gap.snapshot()["sum"]
+
+
+def test_async_logprobs_and_eos_match_lockstep(tiny_model, monkeypatch):
+    # pick an EOS id the tiny model actually emits so the eos-pop path
+    # (commit pops the pending lp entry) is exercised under the lag
+    probe = make_engine(tiny_model, False, monkeypatch)
+    [fin] = probe.generate([[1, 5, 9]],
+                           SamplingParams(temperature=0.0, max_new_tokens=8))
+    eos = fin.token_ids[3]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8, eos_id=eos,
+                        logprobs=3)
+    a = make_engine(tiny_model, True, monkeypatch)
+    b = make_engine(tiny_model, False, monkeypatch)
+    [fa] = a.generate([[1, 5, 9]], sp)
+    [fb] = b.generate([[1, 5, 9]], sp)
+    assert fa.stop_reason == "eos"
+    assert_finished_equal(fa, fb)
+    assert pool_balanced(a) and pool_balanced(b)
+
+
+def test_async_streaming_order_matches_lockstep(tiny_model, monkeypatch):
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    streams = {}
+    for mode in (True, False):
+        eng = make_engine(tiny_model, mode, monkeypatch)
+        toks = []
+        eng.add_request([3, 4, 5], sp, on_token=toks.append)
+        while eng.has_work:
+            eng.step()
+        streams[mode] = toks
+    assert streams[True] == streams[False]
+    assert len(streams[True]) == 6
+
+
+# ---------------------------------------------------------------------------
+# composition-changing events: join/finish, preemption, cancel, deadline
+# ---------------------------------------------------------------------------
+
+def _run_schedule(eng, schedule, sp_of):
+    """Drive ``eng`` through a deterministic (step -> actions) schedule.
+
+    ``schedule``: dict step_idx -> list of ("add", prompt) | ("cancel", idx)
+    where idx indexes the order of adds. Returns (finished_by_rid,
+    streams_by_rid, rids).
+    """
+    fins, streams, rids = {}, {}, []
+    step = 0
+    while True:
+        for action in schedule.get(step, ()):
+            if action[0] == "add":
+                toks = []
+                rid = eng.add_request(action[1], sp_of(len(rids)),
+                                      on_token=toks.append)
+                rids.append(rid)
+                streams[rid] = toks
+            elif action[1] < len(rids):  # cancel targets only added reqs
+                victim = rids[action[1]]
+                fin = eng.cancel(victim)
+                if fin is not None:
+                    fins[fin.req_id] = fin
+        if eng.has_work:
+            for f in eng.step():
+                fins[f.req_id] = f
+        step += 1
+        if not eng.has_work and step > max(schedule, default=0):
+            return fins, streams, rids
+
+
+@pytest.mark.slow
+def test_async_mixed_join_finish_schedule(tiny_model, monkeypatch):
+    """Staggered joins + different lengths: every finish/join recomposes
+    the batch mid-pipeline; outputs must still be token-exact."""
+    schedule = {
+        0: [("add", [1, 5, 9]), ("add", [2, 7])],
+        3: [("add", [42, 43, 44, 45])],
+        6: [("add", [9, 9, 9])],
+    }
+
+    def sp_of(i):
+        return SamplingParams(temperature=0.0,
+                              max_new_tokens=(4, 9, 5, 7)[i])
+
+    out = {}
+    for mode in (True, False):
+        eng = make_engine(tiny_model, mode, monkeypatch)
+        out[mode] = _run_schedule(eng, schedule, sp_of)
+        assert pool_balanced(eng)
+    fa, sa, ra = out[True]
+    fb, sb, rb = out[False]
+    assert ra == rb
+    for rid in ra:
+        assert_finished_equal(fa[rid], fb[rid])
+        assert sa[rid] == sb[rid]
+
+
+def test_async_preemption_parity_and_pool_balance(tiny_model, monkeypatch):
+    """A pool sized to force recompute-preemption: the async path must
+    flush around the preempting grow path and still match token-for-token
+    (preemption re-queues generated tokens as prompt suffix)."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=12)
+    out = {}
+    for mode in (True, False):
+        eng = make_engine(tiny_model, mode, monkeypatch, num_blocks=6,
+                          max_model_len=64)
+        fins = {}
+        rids = [eng.add_request([11 + i, 7, 9, 3], sp) for i in range(3)]
+        while eng.has_work:
+            for f in eng.step():
+                fins[f.req_id] = f
+        out[mode] = (fins, rids, eng.obs.preemptions)
+        assert pool_balanced(eng)
+    fa, ra, pa = out[True]
+    fb, rb, pb = out[False]
+    assert pa == pb and pa > 0, "schedule did not exercise preemption"
+    for rid in ra:
+        assert_finished_equal(fa[rid], fb[rid])
+
+
+def test_async_cancel_mid_decode_flush_conserves_blocks(tiny_model,
+                                                        monkeypatch):
+    """Cancel with the lookahead step in flight: the flush discards the
+    extra computed token (never emitted) and frees its blocks the same
+    call; emitted partials match a lock-step cancel at the same step."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=14)
+    out = {}
+    for mode in (True, False):
+        eng = make_engine(tiny_model, mode, monkeypatch)
+        rid = eng.add_request([3, 4, 5], sp)
+        keep = eng.add_request([8, 8, 9], sp)
+        for _ in range(5):
+            eng.step()
+        if mode:
+            assert eng._pipe is not None, "lookahead should be in flight"
+        fin = eng.cancel(rid)
+        assert fin is not None and fin.stop_reason == "cancelled"
+        fins = {rid: fin}
+        while eng.has_work:
+            for f in eng.step():
+                fins[f.req_id] = f
+        out[mode] = (fins, rid, keep)
+        assert pool_balanced(eng)
+        if mode:
+            assert eng.obs.flush_reasons().get("cancelled") == 1
+    fa, rid, keep = out[True]
+    fb, _, _ = out[False]
+    assert_finished_equal(fa[rid], fb[rid])
+    assert_finished_equal(fa[keep], fb[keep])
+
+
+@pytest.mark.slow
+def test_async_deadline_expiry_terminal_and_conserved(tiny_model,
+                                                      monkeypatch):
+    """A deadline passing mid-decode (lookahead in flight) must finish the
+    request with stop reason ``timeout`` and conserve the pool. Wall-clock
+    decides WHICH step expires, so this asserts invariants, not parity."""
+    eng = make_engine(tiny_model, True, monkeypatch)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=200)
+    rid = eng.add_request([3, 4, 5], sp,
+                          deadline_at=time.monotonic() + 0.05)
+    survivor = eng.add_request([8, 8, 9],
+                               SamplingParams(temperature=0.0,
+                                              max_new_tokens=6))
+    fins = {}
+    t0 = time.monotonic()
+    while eng.has_work and time.monotonic() - t0 < 30.0:
+        for f in eng.step():
+            fins[f.req_id] = f
+    assert fins[rid].stop_reason == "timeout"
+    assert fins[survivor].stop_reason == "length"
+    assert len(fins[survivor].token_ids) == 6
+    assert pool_balanced(eng)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding shares the resident state; entry forces a flush
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_async_speculative_matches_lockstep(tiny_model, monkeypatch):
+    over = dict(max_model_len=128, max_new_tokens=24,
+                speculative_model="[ngram]", num_speculative_tokens=3)
+    base = [5, 6, 7, 8] * 5
+    sp = SamplingParams(temperature=0.0, max_new_tokens=16)
+    out = {}
+    for mode in (True, False):
+        eng = make_engine(tiny_model, mode, monkeypatch, **over)
+        fins = eng.generate([base, base[2:] + [9]], sp)
+        out[mode] = (fins, eng.spec.committed, eng.spec.verify_steps)
+        assert pool_balanced(eng)
+        assert eng.spec.verify_steps > 0, "workload never drafted"
+    for x, y in zip(out[True][0], out[False][0]):
+        assert_finished_equal(x, y)
+    assert out[True][1:] == out[False][1:]
+
+
+# ---------------------------------------------------------------------------
+# randomized differential fuzz over full schedules
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_async_differential_fuzz(tiny_model, monkeypatch):
+    """Seeded random schedules — staggered joins, random lengths and
+    sampling knobs (logprobs included), cancels at random steps — replayed
+    identically against both disciplines. Request ids are deterministic
+    (same add order), so the comparison is exact per request."""
+    master = np.random.default_rng(0xA57)
+    for round_i in range(4):
+        seed = int(master.integers(1 << 30))
+        rng = np.random.default_rng(seed)
+        n_req = int(rng.integers(3, 7))
+        schedule = {}
+        params = []
+        for i in range(n_req):
+            step = int(rng.integers(0, 10))
+            prompt = rng.integers(1, 500, int(rng.integers(2, 9))).tolist()
+            schedule.setdefault(step, []).append(("add", prompt))
+            params.append(SamplingParams(
+                temperature=float(rng.choice([0.0, 0.8])),
+                top_k=int(rng.choice([0, 5])),
+                max_new_tokens=int(rng.integers(3, 12)),
+                logprobs=int(rng.choice([0, 2]))))
+        for idx in rng.choice(n_req, size=2, replace=False):
+            step = int(rng.integers(2, 14))
+            schedule.setdefault(step, []).append(("cancel", int(idx)))
+        out = {}
+        for mode in (True, False):
+            eng = make_engine(tiny_model, mode, monkeypatch)
+            fins, streams, rids = _run_schedule(
+                eng, schedule, lambda i: params[i])
+            out[mode] = (fins, streams, rids)
+            assert pool_balanced(eng), f"seed {seed} mode {mode}: pool leak"
+        fa, sa, ra = out[True]
+        fb, sb, rb = out[False]
+        assert ra == rb, f"seed {seed}: request ids diverged"
+        assert set(fa) == set(fb), f"seed {seed}: finished sets diverged"
+        for rid in fa:
+            assert_finished_equal(fa[rid], fb[rid])
+            assert sa.get(rid) == sb.get(rid), f"seed {seed} rid {rid}"
+
+
+# ---------------------------------------------------------------------------
+# pipeline mechanics
+# ---------------------------------------------------------------------------
+
+def test_finish_pending_retires_trailing_inflight(tiny_model, monkeypatch):
+    """When every slot finishes at a commit, the final lookahead dispatch
+    stays in flight; finish_pending (the engine-loop idle hook) retires it
+    without disturbing state, and is a no-op thereafter."""
+    eng = make_engine(tiny_model, True, monkeypatch)
+    eng.generate([[1, 2, 3]], SamplingParams(temperature=0.0,
+                                             max_new_tokens=5))
+    assert eng._pipe is not None
+    eng.finish_pending()
+    assert eng._pipe is None
+    assert pool_balanced(eng)
+    flushes = eng.obs.pipeline_flushes
+    eng.finish_pending()   # idempotent: nothing in flight
+    assert eng.obs.pipeline_flushes == flushes
+    # engine still serves after the idle retire
+    [fin] = eng.generate([[7, 7, 2]], SamplingParams(temperature=0.0,
+                                                     max_new_tokens=4))
+    assert len(fin.token_ids) == 4
+    assert pool_balanced(eng)
+
+
+def test_resident_tables_track_block_identity_not_count():
+    """The allocator's free list is LIFO: a shrink-then-regrow cycle
+    (speculative rollback) can hand two slots each other's freed blocks
+    with every per-row block COUNT unchanged. The resident batch view must
+    re-upload tables on block IDENTITY change, or dispatches read/write
+    the wrong physical blocks with no error."""
+    import types
+
+    from scalable_hw_agnostic_inference_tpu.engine.resident import (
+        ResidentBatch,
+    )
+
+    M = 4
+
+    class _Seq:
+        def __init__(self, blocks):
+            self.blocks = blocks
+
+        def table(self, m):
+            t = np.zeros((m,), np.int32)
+            t[:len(self.blocks)] = self.blocks
+            return t
+
+    seqs = {0: _Seq([1]), 1: _Seq([2])}
+    eng = types.SimpleNamespace(
+        cache=types.SimpleNamespace(seq=lambda rid: seqs[rid]),
+        ecfg=types.SimpleNamespace(blocks_per_seq=M),
+        _marshal_running=lambda running, Bb: {
+            "tables": np.stack([seqs[s.req.req_id].table(M)
+                                for s in running]),
+            "active": np.ones((Bb,), bool)})
+    running = [types.SimpleNamespace(req=types.SimpleNamespace(req_id=i),
+                                     slot=i) for i in range(2)]
+    res = ResidentBatch()
+    a1 = res.refresh(eng, running, 2)
+    assert np.asarray(a1["tables"]).tolist() == [[1, 0, 0, 0], [2, 0, 0, 0]]
+    # swap block identities, counts unchanged — the LIFO churn shape
+    seqs[0].blocks, seqs[1].blocks = [2], [1]
+    a2 = res.refresh(eng, running, 2)
+    assert np.asarray(a2["tables"]).tolist() == [[2, 0, 0, 0], [1, 0, 0, 0]]
+
+
+def test_async_gate_env_off_is_lockstep(tiny_model, monkeypatch):
+    eng = make_engine(tiny_model, False, monkeypatch)
+    eng.generate([[1, 2, 3]], SamplingParams(temperature=0.0,
+                                             max_new_tokens=4))
+    assert eng._pipe is None
+    assert eng.obs.pipeline_flushes == 0

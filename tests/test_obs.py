@@ -252,6 +252,8 @@ def test_spec_engine_emits_timing_and_step_records(tiny_model):
     assert snap["spec_acceptance_rate"] >= 0.0
 
 
+@pytest.mark.slow  # tier-1 preemption coverage: test_engine.py pressure
+# test + test_engine_async.py differential (PR 6 budget trade)
 def test_preemption_path_counts_and_keeps_timing(tiny_model):
     from scalable_hw_agnostic_inference_tpu.engine.engine import (
         SamplingParams,
