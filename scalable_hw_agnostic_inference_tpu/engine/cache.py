@@ -128,6 +128,11 @@ class PagedKVCache:
 
         self.kv = [{"k": zeros("k"), "v": zeros("v")} for _ in range(n_layers)]
         self._seqs: Dict[int, SeqAllocation] = {}
+        self.total_blocks = total_blocks
+        # fixed device allocation: price it ONCE (the HBM ledger reads it
+        # every engine step — a per-step re-sum is hot-loop host work)
+        self._pool_bytes = sum(int(a["k"].nbytes) + int(a["v"].nbytes)
+                               for a in self.kv)
         # telemetry counters (obs.steploop reads them through the engine):
         # speculative rollbacks give reserved tokens/blocks back via shrink —
         # a high rollback rate is the "drafter wasting pool headroom" signal
@@ -319,6 +324,46 @@ class PagedKVCache:
 
     def seq(self, seq_id: int) -> SeqAllocation:
         return self._seqs[seq_id]
+
+    # -- HBM ledger feed (obs.hbm) -----------------------------------------
+
+    @property
+    def pool_bytes(self) -> int:
+        """Total device bytes of the preallocated KV pool (all layers;
+        priced once at construction — the pool never resizes)."""
+        return self._pool_bytes
+
+    @property
+    def used_bytes(self) -> float:
+        """Logical bytes of allocated (non-free) blocks — the pool is a
+        fixed device allocation, so block-level pressure shows up here,
+        not in ``pool_bytes``. The reserved null block 0 is excluded: an
+        empty pool reads 0, matching :meth:`leaked_blocks`' accounting."""
+        if self.total_blocks <= 0:
+            return 0.0
+        used = (self.total_blocks - 1) - self.allocator.n_free
+        return self.pool_bytes * (used / self.total_blocks)
+
+    @property
+    def leaked_blocks(self) -> int:
+        """Allocated blocks no live holder explains: not referenced by any
+        admitted sequence nor by the prefix cache. Always 0 in a correct
+        engine — a sequence's natural KV growth is *held* growth — so this
+        is the exact KV-leak signal the HBM ledger's drift detector
+        tracks (a raw used-block count would read every decoding sequence
+        as a leak)."""
+        held = set()
+        for a in self._seqs.values():
+            held.update(a.blocks)
+        held.update(self._block2hash.keys())
+        used = (self.total_blocks - 1) - self.allocator.n_free  # 0 reserved
+        return max(0, used - len(held))
+
+    @property
+    def leaked_bytes(self) -> float:
+        if self.total_blocks <= 0:
+            return 0.0
+        return self.pool_bytes * (self.leaked_blocks / self.total_blocks)
 
     @property
     def active(self) -> List[int]:

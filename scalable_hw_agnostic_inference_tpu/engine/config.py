@@ -50,6 +50,14 @@ class EngineConfig:
     # n-gram window the drafter matches against prompt+generated history
     ngram_prompt_lookup_max: int = 4
     ngram_prompt_lookup_min: int = 1
+    # conformance observability (obs.slo / obs.sentinel): per-model SLO
+    # targets (0 = objective off; SHAI_SLO_* env vars override) and the
+    # PERF_MODEL.json projection key the perf sentinel compares live tok/s
+    # against ("" = geometry heuristic over the model id)
+    slo_ttft_ms: float = 0.0
+    slo_tpot_ms: float = 0.0
+    slo_error_rate: float = 0.0
+    perf_projection: str = ""
 
     def __post_init__(self):
         if self.block_size < 1:
@@ -103,6 +111,9 @@ class EngineConfig:
             if self.num_speculative_tokens >= self.max_model_len:
                 raise ValueError(
                     "num_speculative_tokens must be < max_model_len")
+        for knob in ("slo_ttft_ms", "slo_tpot_ms", "slo_error_rate"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0 (0 disables)")
 
     @property
     def speculative_enabled(self) -> bool:

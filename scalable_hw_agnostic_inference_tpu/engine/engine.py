@@ -25,6 +25,9 @@ import numpy as np
 
 from ..core.bucketing import BucketRegistry
 from ..models.llama import LlamaConfig
+from ..obs import sentinel as obs_sentinel
+from ..obs.hbm import HbmLedger
+from ..obs.slo import SloEngine, SloTargets
 from ..obs.steploop import StepTelemetry
 from ..obs.trace import annotate
 from ..resilience import faults as _faults
@@ -189,6 +192,39 @@ class LLMEngine:
         # TTFT/TPOT/queue-wait histograms, exported by the serving layer as
         # Prometheus histograms and flight-recorder step records
         self.obs = StepTelemetry(total_blocks=ecfg.total_blocks)
+        # conformance layer (obs): SLO burn rates, perf-model sentinel, and
+        # the live HBM ledger ride the telemetry object so ONE provider
+        # seam (ModelService.engine_telemetry) feeds /stats, /metrics, the
+        # flight recorder, and the failover controller alike
+        self.obs.slo = SloEngine.maybe_from_env(SloTargets(
+            ttft_ms=ecfg.slo_ttft_ms, tpot_ms=ecfg.slo_tpot_ms,
+            error_rate=ecfg.slo_error_rate))
+        self.obs.sentinel = obs_sentinel.PerfSentinel.from_env(
+            default_key=(ecfg.perf_projection
+                         or obs_sentinel.default_projection_key(
+                             ecfg.model, quantized=ecfg.quantization == "int8",
+                             tp=ecfg.tensor_parallel_size)))
+        hbm_limit = 0.0
+        try:
+            from ..core.budget import GIB, detect_hbm_gib
+
+            if jax.local_devices()[0].platform != "cpu":
+                hbm_limit = detect_hbm_gib(jax.local_devices()[0]) * GIB
+        except Exception:  # deviceless dryruns must still boot
+            pass
+        self.obs.hbm = HbmLedger(bytes_limit=hbm_limit)
+        from ..obs.util import env_int as _env_int
+
+        # ledger cadence: every Nth step (default every step — cheap on
+        # the tiny tiers; production tiers with thousands of blocks can
+        # widen it, the drift windows are sample-count-based either way)
+        self._hbm_every = max(1, _env_int("SHAI_HBM_SAMPLE_EVERY", 1))
+        self._hbm_dev = jax.local_devices()[0]
+        self._weights_bytes: Optional[int] = None
+        self._kv_pool_bytes = 0
+        self._cross_bytes = 0
+        self._tokens_this_step = 0
+        self._n_exec_last = 0
         self._last_rollback_tokens = 0
         self._step_kind = "idle"
         # async pipelined decode (SHAI_ASYNC_DECODE, default on): device-
@@ -364,6 +400,7 @@ class LLMEngine:
         t0 = time.monotonic()
         self._step_count += 1
         self._done_this_step = []
+        self._tokens_this_step = 0
         self._step_kind = "idle"
         inj = _faults.get()
         if inj.active:
@@ -430,6 +467,7 @@ class LLMEngine:
         t0 = time.monotonic()
         self._step_count += 1
         self._done_this_step = []
+        self._tokens_this_step = 0
         self._step_kind = "idle"
         inj = _faults.get()
         if inj.active:
@@ -606,7 +644,9 @@ class LLMEngine:
 
     def _record_step(self, duration_s: float) -> None:
         """One obs step record per engine step — occupancy, KV pressure,
-        rollback delta, speculative counters at step end."""
+        rollback delta, speculative counters at step end — plus the
+        conformance feeds: the perf sentinel's (tokens, busy-seconds)
+        sample and one HBM ledger tick."""
         rb = self.cache.rollback_tokens
         self.obs.record_step(
             kind=self._step_kind, duration_s=duration_s,
@@ -617,12 +657,99 @@ class LLMEngine:
                               if self.cache.prefix_caching else 0),
             finished=len(self._done_this_step),
             rollback_tokens=rb - self._last_rollback_tokens,
-            spec=self.spec.as_dict() if self.spec is not None else None)
+            spec=self.spec.as_dict() if self.spec is not None else None,
+            finished_ids=[f.req_id for f in self._done_this_step])
         self._last_rollback_tokens = rb
+        # first-use executable builds are warmup, not throughput: a step
+        # that compiled must not enter the sentinel's rate window (same
+        # rule the step-gap metric applies)
+        compiled = self.n_executables != self._n_exec_last
+        self._n_exec_last = self.n_executables
+        sen = self.obs.sentinel
+        if sen is not None and not compiled and sen.record_step(
+                kind=self._step_kind, duration_s=duration_s,
+                tokens=self._tokens_this_step):
+            # healthy -> degraded transition: attach the numbers that say
+            # WHY throughput trails the model (host gap vs pool thrash vs
+            # drafter collapse) to the one structured diagnosis line
+            gap = self.obs.step_gap.snapshot()
+            sen.diagnose({
+                "step_gap_mean_ms": round(
+                    gap["sum"] / gap["count"] * 1e3, 4) if gap["count"]
+                else 0.0,
+                "pipeline_flushes": self.obs.pipeline_flushes,
+                "preemptions": self.obs.preemptions,
+                "ttft_count": self.obs.ttft.count,
+                "n_running": self.n_running,
+                "n_waiting": self.n_waiting,
+            })
+        self._sample_hbm()
+
+    def _sample_hbm(self) -> None:
+        """One HBM ledger tick: attribute device bytes to named pools and
+        feed the steady-state drift detector. The static pools (weights,
+        KV pool, cross-KV) are priced once; the dynamic share (resident
+        mirror, in-flight lookahead, logical KV usage) is recomputed per
+        step. The drift value is the UNEXPLAINED share only — KV bytes no
+        live sequence or prefix-cache entry holds (``cache.leaked_bytes``)
+        plus device bytes outside every attributed pool — because a
+        decoding sequence's held KV grows monotonically by design and
+        must never read as a leak."""
+        led = self.obs.hbm
+        if led is None or self._step_count % self._hbm_every:
+            return
+        if self._weights_bytes is None:
+            try:
+                self._weights_bytes = sum(
+                    int(getattr(leaf, "nbytes", 0))
+                    for leaf in jax.tree_util.tree_leaves(self.params))
+            except Exception:
+                self._weights_bytes = 0
+            self._kv_pool_bytes = self.cache.pool_bytes
+            if self._cross_kv is not None:
+                self._cross_bytes = sum(
+                    int(a["k"].nbytes) + int(a["v"].nbytes)
+                    for a in self._cross_kv)
+        resident = self._res.device_bytes()
+        inflight = 0 if self._pipe is None else self._pipe.device_bytes()
+        kv_used = self.cache.used_bytes
+        kv_leaked = self.cache.leaked_bytes
+        pools = {"weights": self._weights_bytes,
+                 "kv_pool": self._kv_pool_bytes,
+                 "resident": resident,
+                 "inflight": inflight}
+        if self._cross_kv is not None:
+            pools["cross_kv"] = self._cross_bytes
+        stats = None
+        dev = self._hbm_dev
+        if dev.platform != "cpu":
+            # CPU backends report host-heap noise (or nothing) here; the
+            # accounted view is the deterministic one for tests/dryruns
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+        stats = stats or {}
+        bytes_in_use = stats.get("bytes_in_use")
+        drift = kv_leaked
+        if bytes_in_use is not None:
+            drift += max(0.0, float(bytes_in_use) - sum(pools.values()))
+        led.sample(
+            pools=pools,
+            composition=(self.n_running, self.n_waiting, self.n_chunking),
+            bytes_in_use=bytes_in_use,
+            bytes_limit=stats.get("bytes_limit"),
+            peak_bytes=stats.get("peak_bytes_in_use"),
+            largest_free=stats.get("largest_free_block_bytes"),
+            drift_value=drift,
+            extra={"kv_used_bytes": kv_used,
+                   "kv_leaked_bytes": kv_leaked})
 
     def _finish(self, fin: Finished) -> None:
         self.finished.append(fin)
         self._done_this_step.append(fin)
+        if self.obs.slo is not None:
+            self.obs.slo.record_outcome(fin.stop_reason)
 
     def _mark_first_token(self, req: Request) -> float:
         """TTFT record point (first admission only — a preemption resume is
@@ -632,6 +759,8 @@ class LLMEngine:
             ttft = now - req.t_submit
             self.ttft.record(ttft)
             self.obs.ttft.observe(ttft)
+            if self.obs.slo is not None:
+                self.obs.slo.record_ttft(ttft)
         if not req.t_first:
             req.t_first = now
         return now
@@ -643,6 +772,8 @@ class LLMEngine:
             tpot = (time.monotonic() - s.t_first) / len(s.generated)
             self.tpot.record(tpot)
             self.obs.tpot.observe(tpot)
+            if self.obs.slo is not None:
+                self.obs.slo.record_tpot(tpot)
 
     def _note_admitted(self, req: Request) -> None:
         """Queue-wait record point, at the first admission only (THE hook
@@ -1376,6 +1507,7 @@ class LLMEngine:
             finished = False
             for m, c in enumerate(committed):
                 n_processed += 1
+                self._tokens_this_step += 1  # perf-sentinel feed
                 s.generated.append(c)
                 hit_eos = c == p.eos_id
                 if hit_eos:
@@ -1481,6 +1613,7 @@ class LLMEngine:
             if self.slots[s.slot] is not s:
                 continue  # defensive: slot changed mid-step
             s.generated.append(s.pending_token)
+            self._tokens_this_step += 1  # perf-sentinel throughput feed
             p = s.req.params
             hit_eos = s.pending_token == p.eos_id
             if hit_eos:

@@ -57,6 +57,12 @@ class InflightStep:
     want_lp: bool
     t_dispatch: float                 # monotonic enqueue stamp (gap metric)
 
+    def device_bytes(self) -> int:
+        """Bytes the un-retired step's outputs pin on device (HBM ledger)."""
+        return sum(int(getattr(a, "nbytes", 0) or 0)
+                   for a in (self.nxt, self.pos_next, self.top_ids,
+                             self.top_lp, self.tok_lp))
+
 
 class ResidentBatch:
     """Composition-keyed device mirror of the decode batch arrays."""
@@ -70,6 +76,10 @@ class ResidentBatch:
         self.sig = None
         self.arrays = {}
         self.blocks = ()
+
+    def device_bytes(self) -> int:
+        """Bytes the resident mirror holds on device (HBM ledger feed)."""
+        return sum(int(getattr(a, "nbytes", 0)) for a in self.arrays.values())
 
     def refresh(self, engine, running, Bb: int) -> Dict[str, Any]:
         """Device arrays for ``running`` compacted into ``Bb`` rows.

@@ -8,12 +8,20 @@ and the flight recorder.
                     serve layer adapts them to Prometheus/JSON lines)
 - ``obs.flight``    bounded ring buffers of recent request timelines and
                     engine-step records, dumped by ``GET /debug/flight``
+- ``obs.hbm``       live HBM ledger: per-pool byte attribution, headroom/
+                    fragmentation gauges, steady-state leak drift detector
+- ``obs.slo``       per-model TTFT/TPOT/error objectives as rolling
+                    multi-window burn rates (the failover trigger feed)
+- ``obs.sentinel``  live tok/s vs PERF_MODEL.json projection conformance
 
 Layering: ``obs`` imports nothing from the rest of the package (and no
 third-party deps), so engine AND serve may both depend on it.
 """
 
 from .flight import FlightRecorder  # noqa: F401
+from .hbm import DriftDetector, HbmLedger  # noqa: F401
+from .sentinel import PerfSentinel  # noqa: F401
+from .slo import SloEngine, SloTargets  # noqa: F401
 from .steploop import (  # noqa: F401
     BucketHistogram,
     QUEUE_WAIT_BUCKETS,

@@ -35,12 +35,17 @@ class FlightRecorder:
 
     def record_request(self, trace_dict: Dict[str, Any]) -> None:
         """Ring-append one completed request's trace (the asgi layer's
-        trace sink). Cheap: one lock + one deque append."""
+        trace sink). Cheap: one lock + one deque append. The trace id is
+        lifted to the record's top level so flight timelines join to
+        distributed traces (and the step records' ``finished_ids`` join to
+        the trace root's ``engine_req_id``) without digging into spans."""
+        rec = {"recorded_at": round(time.time(), 4),
+               "trace_id": trace_dict.get("trace_id"),
+               "trace": trace_dict}
         with self._lock:
             self._seq += 1
-            self._requests.append({"seq": self._seq,
-                                   "recorded_at": round(time.time(), 4),
-                                   "trace": trace_dict})
+            rec["seq"] = self._seq
+            self._requests.append(rec)
 
     @property
     def n_recorded(self) -> int:

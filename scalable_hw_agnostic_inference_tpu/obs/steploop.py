@@ -92,6 +92,14 @@ class StepTelemetry:
     def __init__(self, total_blocks: int = 0, max_steps: int = 256):
         self._lock = threading.Lock()
         self.total_blocks = total_blocks
+        # conformance instruments (optional, attached by the engine at
+        # construction): obs.slo.SloEngine, obs.sentinel.PerfSentinel,
+        # obs.hbm.HbmLedger. Riding on the telemetry object keeps ONE
+        # provider seam (ModelService.engine_telemetry) feeding /stats,
+        # /metrics, and the failover controller alike.
+        self.slo = None
+        self.sentinel = None
+        self.hbm = None
         self._steps: deque = deque(maxlen=max_steps)
         self.ttft = BucketHistogram(TTFT_BUCKETS)
         self.tpot = BucketHistogram(TPOT_BUCKETS)
@@ -141,9 +149,13 @@ class StepTelemetry:
                     n_waiting: int, n_chunking: int, blocks_free: int,
                     blocks_evictable: int = 0, finished: int = 0,
                     rollback_tokens: int = 0,
-                    spec: Optional[Dict[str, Any]] = None) -> None:
+                    spec: Optional[Dict[str, Any]] = None,
+                    finished_ids: Sequence[int] = ()) -> None:
         """One engine ``step()`` completed; ``kind`` names the decode path
-        taken (``"decode"``, ``"spec"``, ``"idle"``)."""
+        taken (``"decode"``, ``"spec"``, ``"idle"``). ``finished_ids`` are
+        the engine request ids that reached a terminal state this step —
+        the join key between ``/debug/flight`` step records and request
+        traces (whose root carries ``engine_req_id``)."""
         total = self.total_blocks or 1
         used = max(0, total - blocks_free)
         rec = {
@@ -159,6 +171,7 @@ class StepTelemetry:
             "kv_blocks_evictable": blocks_evictable,
             "kv_utilization": round(used / total, 4),
             "rollback_tokens": rollback_tokens,
+            "finished_ids": list(finished_ids),
         }
         if spec:
             rec["spec"] = dict(spec)

@@ -1,0 +1,31 @@
+"""Shared env parsing for the obs modules (stdlib-only).
+
+Lenient by contract: these are tuning knobs read during engine
+construction — a malformed value must fall back to its default, never
+fail pod boot (a typo in ``SHAI_HBM_WINDOW`` is not a reason to crash-loop
+a serving tier).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "")
+    if not v:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "")
+    if not v:
+        return default
+    try:
+        return int(float(v))   # "8.5" degrades to 8, not a boot crash
+    except ValueError:
+        return default

@@ -62,14 +62,30 @@ class OverloadThresholds:
     max_kv_utilization: float = 0.95   # page pool fraction in use
 
 
+def slo_breached(stats: Optional[dict]) -> bool:
+    """One pod's merged snapshot → latency SLO burning? The ``slo_breach``
+    key is merged in by :func:`fetch_engine_stats` from the pod's
+    ``/stats`` → ``"slo"`` section (the obs.slo burn-rate engine: fast
+    5 m AND slow 1 h windows both over budget). Absent telemetry — pod
+    without SLO targets, old image — reads healthy; note the pod-local
+    admission gate sees the raw engine snapshot (no ``slo_breach`` key),
+    so a latency breach reroutes the FLEET without also shedding at the
+    door of the pod that is still serving."""
+    return isinstance(stats, dict) and bool(stats.get("slo_breach"))
+
+
 def is_overloaded(stats: Optional[dict],
                   th: OverloadThresholds = OverloadThresholds()) -> bool:
     """One pod's engine snapshot → saturated? Missing/partial snapshots
     (pod loading, old image) read as healthy — absence of telemetry must
-    not flap the routing mode."""
+    not flap the routing mode. A merged latency-SLO breach (see
+    :func:`slo_breached`) counts as saturation too: a tier missing its own
+    TTFT/TPOT targets needs traffic moved exactly like a full queue."""
     if not isinstance(stats, dict):
         return False
     if stats.get("waiting", 0) > th.max_queue_depth:
+        return True
+    if slo_breached(stats):
         return True
     return stats.get("kv_utilization", 0.0) > th.max_kv_utilization
 
@@ -110,6 +126,16 @@ def decide(state: ControllerState, events: List[Event],
         state.last_trigger = failures[0].message[:200]
         return "failover"
     if state.mode == "weighted" and engine_stats:
+        # latency-driven trigger first (distinct label): a majority of pods
+        # burning their SLO budget fails over even with empty queues — a
+        # tier can be slow without being full (perf regression, thermal
+        # throttle, drafter collapse), and the burn-rate engine is the
+        # only signal that sees it
+        burning = sum(1 for s in engine_stats if slo_breached(s))
+        if burning * 2 > len(engine_stats):
+            state.last_trigger = (
+                f"slo burn-rate breach on {burning}/{len(engine_stats)} pods")
+            return "failover"
         hot = sum(1 for s in engine_stats if is_overloaded(s, thresholds))
         if hot * 2 > len(engine_stats):  # strict majority: one hot pod is
             state.last_trigger = (       # a scheduling blip, not capacity
@@ -228,7 +254,12 @@ def fetch_engine_stats(urls: Sequence[str],
     yield ``None`` — which :func:`is_overloaded` reads as healthy — so the
     overload-majority denominator in :func:`decide` stays the fleet size.
     (Dropping them instead would let a single hot pod constitute a "strict
-    majority" during a rolling restart.)"""
+    majority" during a rolling restart.)
+
+    The pod's ``"slo"`` section (obs.slo burn-rate engine) is merged into
+    the entry as ``slo_breach`` / ``slo_ttft_fast_burn`` etc., so the
+    latency-driven failover trigger in :func:`decide` rides the same poll.
+    """
     import httpx
 
     out: List[Optional[dict]] = []
@@ -236,9 +267,16 @@ def fetch_engine_stats(urls: Sequence[str],
         eng = None
         try:
             r = httpx.get(f"{u.rstrip('/')}/stats", timeout=timeout)
-            got = r.json().get("engine")
+            body = r.json()
+            got = body.get("engine")
             if isinstance(got, dict):
-                eng = got
+                eng = dict(got)
+                slo = body.get("slo")
+                if isinstance(slo, dict):
+                    eng["slo_breach"] = slo.get("breach", 0.0)
+                    for k, v in slo.items():
+                        if k.endswith("_burn"):
+                            eng[f"slo_{k}"] = v
         except Exception:
             log.debug("stats poll failed for %s", u, exc_info=True)
         out.append(eng)

@@ -217,7 +217,39 @@ class CovaClient:
         overloaded = sorted(n for n, st in results.items()
                             if isinstance(st, dict)
                             and is_overloaded(st.get("engine")))
-        return {"models": results, "overloaded": overloaded}
+        # conformance at a glance (PR 7): per backend, the three verdicts —
+        # SLO burn, HBM headroom/leak, perf-vs-model — compressed to the
+        # fields a fleet dashboard actually keys on; backends without the
+        # instruments (plain services, old images) simply omit fields
+        conformance: Dict[str, Dict[str, Any]] = {}
+        for name, st in results.items():
+            if not isinstance(st, dict):
+                continue
+            ent: Dict[str, Any] = {}
+            slo = st.get("slo")
+            if isinstance(slo, dict):
+                ent["slo_breach"] = bool(slo.get("breach"))
+                burns = [v for k, v in slo.items()
+                         if k.endswith("_fast_burn")
+                         and isinstance(v, (int, float))]
+                if burns:
+                    ent["slo_fast_burn_max"] = round(max(burns), 2)
+            hbm = st.get("hbm")
+            if isinstance(hbm, dict):
+                if "headroom_bytes" in hbm:
+                    ent["hbm_headroom_gib"] = round(
+                        float(hbm["headroom_bytes"]) / (1 << 30), 3)
+                ent["hbm_leak_suspect"] = bool(hbm.get("leak_suspect"))
+            perf = st.get("perf")
+            if isinstance(perf, dict) and "conformance" in perf:
+                ent["perf_conformance"] = perf["conformance"]
+                ent["perf_degraded"] = bool(perf.get("degraded"))
+            if ent:
+                conformance[name] = ent
+        slo_breached = sorted(n for n, e in conformance.items()
+                              if e.get("slo_breach"))
+        return {"models": results, "overloaded": overloaded,
+                "conformance": conformance, "slo_breached": slo_breached}
 
     async def chain(self, prompt: str, image_b64: str = "") -> Dict[str, Any]:
         """The full cova chain: prompt → image → caption → embeddings.

@@ -219,8 +219,9 @@ def create_app(
 
     app.state.update(cfg=cfg, service=service, collector=collector, publisher=pub,
                      status=state, flight=flight, gate=gate, drainer=drainer)
-    # lifecycle probes must not ring the flight recorder
-    app.trace_exclude |= {"/health/ready", "/debug/faults"}
+    # lifecycle probes and scrape surfaces must not ring the flight recorder
+    app.trace_exclude |= {"/health/ready", "/debug/faults",
+                          "/debug/conformance", "/profile"}
 
     def _do_load_and_warm():
         t0 = time.perf_counter()
@@ -503,9 +504,46 @@ def create_app(
         tele = service.engine_telemetry()
         if tele is not None:
             out["engine"] = tele.snapshot()
+            # conformance sections (PR 7): the failover controller reads
+            # "slo" (burn-rate breach → latency-driven failover trigger)
+            # and cova /fleet aggregates "hbm"/"perf" per backend
+            for sec, obj in (("slo", getattr(tele, "slo", None)),
+                             ("hbm", getattr(tele, "hbm", None)),
+                             ("perf", getattr(tele, "sentinel", None))):
+                if obj is not None:
+                    try:
+                        out[sec] = obj.snapshot()
+                    except Exception:
+                        pass
         from ..core.aot import compile_stats
 
         out["aot"] = compile_stats()
+        return out
+
+    @app.get("/debug/conformance")
+    def debug_conformance(request: Request):
+        """One-stop conformance verdict: declared budgets vs live reality.
+        Joins the HBM ledger, SLO burn rates, and the perf sentinel into a
+        single OK/attention payload — what a human curls FIRST on a
+        degraded pod, before digging into /debug/flight."""
+        tele = service.engine_telemetry()
+        out: Dict[str, Any] = {"app": cfg.app}
+        hbm = slo = perf = None
+        if tele is not None:
+            hbm = getattr(tele, "hbm", None)
+            slo = getattr(tele, "slo", None)
+            perf = getattr(tele, "sentinel", None)
+            out["engine"] = tele.snapshot()
+        out["hbm"] = hbm.snapshot() if hbm is not None else None
+        out["slo"] = slo.snapshot() if slo is not None else None
+        out["perf"] = perf.snapshot() if perf is not None else None
+        verdict = {
+            "hbm_leak_suspect": bool((out["hbm"] or {}).get("leak_suspect")),
+            "slo_breach": bool((out["slo"] or {}).get("breach")),
+            "perf_degraded": bool((out["perf"] or {}).get("degraded")),
+        }
+        verdict["ok"] = not any(verdict.values())
+        out["verdict"] = verdict
         return out
 
     @app.get("/debug/faults")
@@ -569,6 +607,20 @@ def create_app(
     # "task" pins the stop coroutine — the event loop holds tasks weakly,
     # and a GC'd stop task would leave the trace session open forever
     profile_state = {"until": 0.0, "dir": None, "task": None}
+
+    @app.get("/profile")
+    def profile_status(request: Request):
+        """Profiler session state: clients used to have to probe with a
+        POST and read the 409 to learn whether a trace was running. ``dir``
+        is the LAST session's trace directory (current session's while one
+        runs) so tooling can find the artifact without parsing logs."""
+        now = time.time()
+        running = now < profile_state["until"] or bool(profile_state["task"])
+        return {
+            "running": running,
+            "seconds_left": round(max(0.0, profile_state["until"] - now), 1),
+            "trace_dir": profile_state["dir"],
+        }
 
     @app.post("/profile/{seconds:int}")
     async def profile(request: Request, seconds: int):

@@ -79,6 +79,16 @@ _ENGINE_COUNTERS = {
                          "Async-decode lookahead steps retired early by a "
                          "composition/control-flow event"),
 }
+#: conformance-layer gauge families: each instrument riding the engine
+#: telemetry object exports its flat numeric snapshot verbatim under a
+#: prefix — obs.slo → shai_slo_* (per-objective burn rates + breach),
+#: obs.hbm → shai_hbm_* (per-pool bytes, headroom, fragmentation, leak
+#: flag), obs.sentinel → shai_perf_* (live/projected tok/s, conformance)
+_CONFORMANCE_PREFIXES = (
+    ("slo", "shai_slo_", "SLO burn-rate engine gauge"),
+    ("hbm", "shai_hbm_", "Live HBM ledger gauge"),
+    ("sentinel", "shai_perf_", "Perf-model sentinel gauge"),
+)
 
 
 class EngineTelemetryCollector:
@@ -128,6 +138,23 @@ class EngineTelemetryCollector:
                  for le, c in hs["buckets"]],
                 sum_value=float(hs["sum"]))
             yield h
+        # conformance layer (PR 7): SLO burn rates, HBM ledger, perf
+        # sentinel — attached to the telemetry object by the engine; a
+        # tier without a given instrument simply exports nothing for it
+        for attr, prefix, doc in _CONFORMANCE_PREFIXES:
+            obj = getattr(tele, attr, None)
+            if obj is None:
+                continue
+            try:
+                snap = obj.snapshot()
+            except Exception:
+                continue
+            for k, v in snap.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                g = GaugeMetricFamily(f"{prefix}{k}", doc, labels=["app"])
+                g.add_metric([self.app], float(v))
+                yield g
 
 
 class MetricsPublisher:

@@ -426,6 +426,10 @@ class VllmService(ModelService):
         tr = obs_trace.current_trace()
         if tr is not None and fin.timing:
             tr.add_phase_spans(fin.timing)
+            # flight-recorder join key: step records carry finished_ids,
+            # the trace root carries the engine request id (first id wins
+            # for the OpenAI n>1 fan-out — one trace, n engine requests)
+            tr.root.attrs.setdefault("engine_req_id", fin.req_id)
         if fin.stop_reason == "rejected":
             raise HTTPError(503, "request rejected: prompt cannot fit the KV pool")
         if fin.stop_reason == "timeout":
@@ -707,6 +711,8 @@ class VllmService(ModelService):
                 fin = fut.result(timeout=result_timeout)
                 if req_trace is not None and fin.timing:
                     req_trace.add_phase_spans(fin.timing)
+                    req_trace.root.attrs.setdefault("engine_req_id",
+                                                    fin.req_id)
                 if fin.stop_reason == "rejected":
                     # headers already went out as 200 — signal in-band
                     yield ("data: " + _json.dumps({"error": {

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Metrics-documentation gate: every ``shai_*`` metric name the code
+registers must appear in README.md.
+
+The README's "Observability" section is the operator contract — dashboards
+and alert rules are written from it. A metric added in code but not in the
+doc is invisible to the people it exists for; this script makes that a CI
+failure instead of a review nitpick.
+
+Mechanics: scan the exporting modules (``serve/metrics.py``, ``serve/
+app.py``, ``obs/*.py``, ``orchestrate/capacity_checker.py``) for string
+literals matching ``shai_...``. Literal names must appear verbatim in
+README (substring match, so the Prometheus ``_total`` suffix in the doc
+covers a bare counter name in code). Template names (f-strings like
+``shai_hbm_{pool}_bytes`` or bare prefixes like ``shai_slo_``) are checked
+by their static prefix — the README must document the family.
+
+Usage::
+
+    python scripts/check_metrics_docs.py            # exit 1 on undocumented
+    python scripts/check_metrics_docs.py --list     # dump what was found
+
+Wired into the test suite via ``tests/test_metrics_docs.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "scalable_hw_agnostic_inference_tpu")
+
+#: modules that register / construct exported metric names
+SCAN_FILES = (
+    os.path.join(PKG, "serve", "metrics.py"),
+    os.path.join(PKG, "serve", "app.py"),
+    os.path.join(PKG, "obs", "steploop.py"),
+    os.path.join(PKG, "obs", "hbm.py"),
+    os.path.join(PKG, "obs", "slo.py"),
+    os.path.join(PKG, "obs", "sentinel.py"),
+    os.path.join(PKG, "orchestrate", "capacity_checker.py"),
+)
+README = os.path.join(ROOT, "README.md")
+
+#: a shai_ token inside a string literal; {placeholder} segments allowed
+_TOKEN = re.compile(r"""["'](shai_[a-zA-Z0-9_{}]*)["']""")
+
+
+def collect_tokens(paths=SCAN_FILES) -> Dict[str, List[str]]:
+    """token -> files it appears in (tokens deduped across files)."""
+    out: Dict[str, List[str]] = {}
+    for p in paths:
+        try:
+            with open(p) as f:
+                src = f.read()
+        except OSError:
+            continue
+        for tok in set(_TOKEN.findall(src)):
+            out.setdefault(tok, []).append(os.path.relpath(p, ROOT))
+    return out
+
+
+def undocumented(tokens: Dict[str, List[str]], readme_text: str
+                 ) -> Dict[str, List[str]]:
+    """Tokens the README does not cover. A template/prefix token reduces
+    to its static prefix; a literal token must appear as-is (substring —
+    the doc's ``_total``-suffixed form covers the bare counter name)."""
+    missing: Dict[str, List[str]] = {}
+    for tok, files in sorted(tokens.items()):
+        probe = tok.split("{", 1)[0] if "{" in tok else tok
+        probe = probe.rstrip("_") if probe.endswith("_") else probe
+        if probe and probe not in readme_text:
+            missing[tok] = files
+    return missing
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--list", action="store_true",
+                    help="print every discovered token and exit 0")
+    args = ap.parse_args()
+
+    tokens = collect_tokens()
+    if not tokens:
+        print("no shai_* metric tokens found — scan list is stale?",
+              file=sys.stderr)
+        return 2
+    if args.list:
+        for tok, files in sorted(tokens.items()):
+            print(f"{tok:48s} {', '.join(files)}")
+        return 0
+    with open(README) as f:
+        readme_text = f.read()
+    missing = undocumented(tokens, readme_text)
+    print(f"checked {len(tokens)} shai_* metric tokens against README.md")
+    if missing:
+        print("\nUNDOCUMENTED metric names (add them to README's "
+              "Observability section):", file=sys.stderr)
+        for tok, files in missing.items():
+            print(f"  {tok}  ({', '.join(files)})", file=sys.stderr)
+        return 1
+    print("OK: every registered metric family is documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -209,3 +209,61 @@ def test_engine_enforces_budget_when_opted_in(monkeypatch):
         LLMEngine(cfg, params, ecfg)
     # within budget boots fine under enforcement
     LLMEngine(cfg, params, _ecfg())
+
+
+# ---------------------------------------------------------------------------
+# detect_hbm_gib: runtime first, device-kind table, v5e default (PR 7)
+# ---------------------------------------------------------------------------
+
+class _FakeDevice:
+    """Mock device: controllable memory_stats + device_kind."""
+
+    def __init__(self, stats=None, kind="", raises=False):
+        self._stats = stats
+        self._raises = raises
+        self.device_kind = kind
+
+    def memory_stats(self):
+        if self._raises:
+            raise RuntimeError("backend has no memory stats")
+        return self._stats
+
+
+def test_detect_hbm_gib_prefers_runtime_memory_stats():
+    from scalable_hw_agnostic_inference_tpu.core.budget import detect_hbm_gib
+
+    dev = _FakeDevice(stats={"bytes_limit": int(32 * GIB)}, kind="TPU v5e")
+    # the runtime's own limit wins even when the kind table disagrees
+    assert detect_hbm_gib(dev) == pytest.approx(32.0)
+
+
+def test_detect_hbm_gib_falls_back_to_device_kind_table():
+    from scalable_hw_agnostic_inference_tpu.core.budget import detect_hbm_gib
+
+    # memory_stats raising AND returning useless payloads both fall through
+    for broken in (_FakeDevice(raises=True, kind="TPU v5 lite"),
+                   _FakeDevice(stats=None, kind="TPU v5 lite"),
+                   _FakeDevice(stats={}, kind="TPU v5 lite"),
+                   _FakeDevice(stats={"bytes_limit": 0}, kind="TPU v5 lite")):
+        assert detect_hbm_gib(broken) == pytest.approx(16.0)
+    assert detect_hbm_gib(_FakeDevice(raises=True, kind="TPU v4")) == \
+        pytest.approx(32.0)
+    assert detect_hbm_gib(_FakeDevice(raises=True, kind="TPU v5p")) == \
+        pytest.approx(95.0)
+    # order matters: "v5 lite" must hit the 16 GiB row, not the bare "v5"
+    assert detect_hbm_gib(_FakeDevice(raises=True,
+                                      kind="tpu v5litepod-8")) == \
+        pytest.approx(16.0)
+
+
+def test_detect_hbm_gib_defaults_to_v5e_tier():
+    from scalable_hw_agnostic_inference_tpu.core.budget import (
+        HBM_GIB,
+        detect_hbm_gib,
+    )
+
+    # unknown kind, no stats: the deploy target's tier — never a crash
+    dev = _FakeDevice(raises=True, kind="FutureAccelerator 9000")
+    assert detect_hbm_gib(dev) == HBM_GIB["v5e"] == pytest.approx(16.0)
+    # no device_kind attribute at all (bare object)
+    assert detect_hbm_gib(object()) == pytest.approx(16.0)

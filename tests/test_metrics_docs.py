@@ -1,0 +1,38 @@
+"""Metrics-documentation gate as a test: every registered ``shai_*``
+metric family must be documented in README.md (scripts/check_metrics_docs
+.py — the operator contract dashboards and alerts are written from)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import check_metrics_docs as cmd  # noqa: E402
+
+
+def test_every_registered_metric_is_documented():
+    tokens = cmd.collect_tokens()
+    # sanity: the scan actually sees the core families (a refactor that
+    # moves them must update the scan list, not silently pass)
+    assert any(t.startswith("shai_requests_total") for t in tokens)
+    assert any(t.startswith("shai_hbm_") for t in tokens)
+    assert any(t.startswith("shai_slo_") for t in tokens)
+    assert any(t.startswith("shai_perf_") for t in tokens)
+    with open(cmd.README) as f:
+        readme = f.read()
+    missing = cmd.undocumented(tokens, readme)
+    assert not missing, (
+        f"metric names registered in code but absent from README.md: "
+        f"{missing} — document them in the Observability section")
+
+
+def test_undocumented_detects_a_fake_metric():
+    """The gate must actually bite: a token the README can't contain."""
+    missing = cmd.undocumented(
+        {"shai_not_a_real_metric_xyz": ["fake.py"]}, "no metrics here")
+    assert "shai_not_a_real_metric_xyz" in missing
+    # template tokens reduce to their family prefix
+    assert not cmd.undocumented(
+        {"shai_hbm_{pool}_bytes": ["f.py"]},
+        "docs mention shai_hbm_ family")

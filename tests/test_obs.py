@@ -204,6 +204,8 @@ def test_flight_recorder_ring_and_dump():
     assert d["recorded_total"] == 5
     assert [r["trace"]["trace_id"] for r in d["requests"]] == \
         ["t2", "t3", "t4"]
+    # the trace id rides at the record's top level (the trace-join key)
+    assert [r["trace_id"] for r in d["requests"]] == ["t2", "t3", "t4"]
     assert d["engine_steps"] == [{"step": 1}]
 
     def boom(n):
