@@ -1,0 +1,38 @@
+"""shai-lint: repo-specific AST invariant checkers (stdlib-only).
+
+The serving stack survives on invariants no test exercises directly: the
+async decode steady path must never block on the host, donated buffers must
+never be read after dispatch, engine state has a declared threading
+contract, every env knob parses leniently and is documented, and every
+debug/poll route stays out of the flight-recorder's trace ring. Each of
+these bug classes was found LIVE during review hardening; this package
+makes them mechanical.
+
+- ``core``      shared infra: findings, module loading, the inline
+                allowlist grammar, baseline IO, the all-checkers runner
+- ``contract``  THE declared tables every checker reads: hot-path
+                functions, donation bindings, the thread-discipline
+                contract, env parse/doc exemptions, poll routes
+- ``hostsync``  device→host synchronization inside declared hot paths
+- ``donation``  reads of donated buffers after the donating dispatch
+- ``threads``   attribute-write sites vs the concurrency contract
+- ``envknobs``  env reads must use the lenient parsers + appear in README
+- ``routes``    GET debug/poll routes must be in ``trace_exclude``
+
+CLI: ``python scripts/shai_lint.py`` (JSON + human output, committed
+findings baseline). Tier-1: ``tests/test_static_analysis.py``.
+
+Layering: imports nothing from the rest of the package and no third-party
+deps — the linter must load in milliseconds and never depend on the code
+it inspects.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Module,
+    iter_modules,
+    load_baseline,
+    run_all,
+    save_baseline,
+)
+from .contract import DEFAULT_CONTRACT, Contract  # noqa: F401

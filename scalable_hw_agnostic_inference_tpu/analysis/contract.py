@@ -1,0 +1,214 @@
+"""THE declared invariant tables shai-lint checks the tree against.
+
+Every checker reads its ground truth from here, not from heuristics buried
+in checker code: which functions are the decode hot path, which callables
+donate which argument positions, which attributes of which classes are
+loop-thread-only / lock-guarded / immutable-after-init, which env reads
+are deliberately strict, which GET routes are poll surfaces. Changing an
+invariant is a one-line diff in this file — reviewed as a contract change,
+not an incidental checker tweak.
+
+Tests override :data:`DEFAULT_CONTRACT` with fixture-sized tables via
+``dataclasses.replace``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassPolicy:
+    """Concurrency contract for one class's attributes.
+
+    Any attribute not listed in ``immutable_after_init`` or
+    ``lock_guarded`` is *owner-thread-only* mutable state: it may be
+    written only from ``owning_modules`` (for the engine: code that runs
+    on the engine-loop thread).
+    """
+
+    #: attrs bound in __init__ (or a declared init method) and never again
+    immutable_after_init: Tuple[str, ...] = ()
+    #: methods that count as construction time (lock/immutability exempt)
+    init_methods: Tuple[str, ...] = ("__init__",)
+    #: attr -> the ``self.<lock>`` a write site must hold lexically
+    lock_guarded: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: repo-relative modules allowed to write the mutable attrs
+    owning_modules: Tuple[str, ...] = ()
+    #: dotted-path markers identifying an instance at a write site OUTSIDE
+    #: the class body (e.g. ``engine.`` / ``eng.`` locals, ``.engine.``
+    #: attribute chains). Checked as a prefix or infix of the write path.
+    instance_markers: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    # -- host-sync: declared decode hot paths ------------------------------
+    #: repo-relative file -> qualnames whose bodies (nested defs included)
+    #: must not synchronize device->host. "*" = every function in the file.
+    hot_paths: Dict[str, Tuple[str, ...]] = dataclasses.field(
+        default_factory=dict)
+
+    # -- donation ----------------------------------------------------------
+    #: files scanned for ``jax.jit(fn, donate_argnums=...)`` factory defs
+    donation_factory_files: Tuple[str, ...] = ()
+    #: files whose call sites are checked for donated-read-after-dispatch
+    donation_check_files: Tuple[str, ...] = ()
+    #: method name -> (factory name, index of the executable in the
+    #: accessor's returned tuple; None = the whole return value). Example:
+    #: ``_decode_for`` returns ``(batch_bucket, decode_fn)`` built by
+    #: ``make_decode`` -> ("make_decode", 1).
+    accessor_factories: Dict[str, Tuple[str, Optional[int]]] = (
+        dataclasses.field(default_factory=dict))
+    #: function qualname -> {parameter name: factory name} for executables
+    #: passed in as arguments (the dispatch helpers)
+    param_factories: Dict[str, Dict[str, str]] = dataclasses.field(
+        default_factory=dict)
+    #: instance-attribute callables built by a factory (``self._cross_write``)
+    attr_factories: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: method name -> 0-based positional indices (self excluded) whose
+    #: argument buffers the method donates onward
+    donating_calls: Dict[str, Tuple[int, ...]] = dataclasses.field(
+        default_factory=dict)
+
+    # -- thread discipline -------------------------------------------------
+    thread_contract: Dict[str, ClassPolicy] = dataclasses.field(
+        default_factory=dict)
+    #: module -> {dict var name: (guarded keys, lock name)} for
+    #: closure-state dicts (serve.app's ``state``)
+    dict_guards: Dict[str, Dict[str, Tuple[Tuple[str, ...], str]]] = (
+        dataclasses.field(default_factory=dict))
+
+    # -- env knobs ---------------------------------------------------------
+    #: the modules that OWN raw env reads (the parser seam itself)
+    env_parser_modules: Tuple[str, ...] = ()
+    #: modules exempt from the env rules entirely (with the reason)
+    env_exempt_modules: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
+    #: (file, env name) -> reason: declared strict-parse/raw-read exemptions
+    env_exempt_sites: Dict[Tuple[str, str], str] = dataclasses.field(
+        default_factory=dict)
+    #: lenient parser helpers (calls to these register the name, satisfy
+    #: the read rule, and are doc-checked)
+    env_parser_names: Tuple[str, ...] = (
+        "env_int", "env_float", "env_str", "env_bool", "env_flag")
+    #: env names that need no README entry (platform/infra, not knobs)
+    env_doc_exempt: Tuple[str, ...] = ()
+
+    # -- trace exclusion ---------------------------------------------------
+    #: files defining the app surface: routes + trace_exclude literals
+    trace_files: Tuple[str, ...] = ()
+    #: GET routes (beyond /debug/*) that are poll surfaces and must be
+    #: excluded from the flight-recorder trace ring
+    poll_routes: Tuple[str, ...] = ()
+
+
+#: the live tree's contract ---------------------------------------------------
+
+DEFAULT_CONTRACT = Contract(
+    # The async decode hot loop (PR 6): the steady path dispatches step N+1
+    # before retiring step N — any host synchronization here serializes the
+    # pipeline and silently reverts the 1.4x async win. _retire_pipe is ON
+    # this list although it contains the one intentional blocking fetch:
+    # that fetch is documented via the allow grammar, not exempted.
+    hot_paths={
+        "engine/engine.py": (
+            "LLMEngine._step_async",
+            "LLMEngine._steady_step",
+            "LLMEngine._decode_dispatch",
+            "LLMEngine._dispatch_async",
+            "LLMEngine._retire_pipe",
+        ),
+        "engine/resident.py": ("*",),
+        # the jitted decode/verify bodies: a host sync here would be a
+        # trace-time crash on device — and on CPU fallbacks a silent
+        # per-step serialization
+        "engine/runner.py": (
+            "make_decode", "make_verify", "_make_token_forward"),
+    },
+    donation_factory_files=("engine/runner.py", "core/aot.py"),
+    donation_check_files=(
+        "engine/engine.py", "engine/runner.py", "engine/warm.py",
+        "engine/cross.py", "core/aot.py"),
+    accessor_factories={
+        "_prefill_for": ("make_prefill", None),
+        "_cont_for": ("make_prefill_cont", None),
+        "_decode_for": ("make_decode", 1),
+        "_verify_for": ("make_verify", 1),
+    },
+    param_factories={
+        # the async dispatch helper receives the compiled decode executable
+        "LLMEngine._dispatch_async": {"decode": "make_decode"},
+    },
+    attr_factories={"_cross_write": "make_cross_slot_write"},
+    donating_calls={
+        # _dispatch_async(decode, running, Bb, tokens_dev, pos_dev, a, rng):
+        # pos_dev (index 4) is donated into the feedback-decode dispatch
+        # (tokens_dev is NOT — the host reads it back one step later)
+        "_dispatch_async": (4,),
+    },
+    thread_contract={
+        # The engine is single-threaded by design: ONE loop thread owns it;
+        # the serve lane reaches it only through EngineLoop's queues. Any
+        # attribute write from outside the owning modules is a cross-thread
+        # mutation of unlocked state.
+        "LLMEngine": ClassPolicy(
+            immutable_after_init=(
+                "cfg", "ecfg", "params", "cross_seq_len", "shardings",
+                "cache", "buckets", "_chunk_cap", "_ctx_buckets",
+                "_drafter", "spec", "_spec_rng", "_sample1", "_lp1",
+                "_cross_embed", "_cross_write", "ttft", "tpot", "obs",
+                "_hbm_every", "_hbm_dev", "_async", "_ids", "_res"),
+            owning_modules=(
+                "engine/engine.py", "engine/warm.py", "engine/cross.py",
+                "engine/logprobs.py", "engine/speculative.py",
+                "engine/loop.py"),
+            instance_markers=("engine.", "eng."),
+        ),
+        "ResidentBatch": ClassPolicy(
+            owning_modules=("engine/resident.py", "engine/engine.py"),
+            instance_markers=("._res.",),
+        ),
+        # EngineLoop bridges the serve lane and the loop thread: the
+        # futures table is the one cross-thread structure, guarded by
+        # _futures_lock at every mutation site.
+        "EngineLoop": ClassPolicy(
+            immutable_after_init=("engine", "_poll_s", "_submit_q",
+                                  "_cancel_q", "_futures_lock", "_stop",
+                                  "_draining", "_thread"),
+            lock_guarded={"_futures": "_futures_lock"},
+            owning_modules=("engine/loop.py",),
+            instance_markers=(".loop.",),
+        ),
+        # The flight ring takes writes from every request thread.
+        "FlightRecorder": ClassPolicy(
+            immutable_after_init=("max_requests", "max_steps", "_lock"),
+            lock_guarded={"_requests": "_lock", "_seq": "_lock"},
+            owning_modules=("obs/flight.py",),
+        ),
+    },
+    dict_guards={
+        # serve.app closure state shared between the event loop and lane/
+        # stream threads: the in-flight counters must move under the lock
+        "serve/app.py": {
+            "state": (("inflight", "lane_pending"), "inflight_lock"),
+        },
+    },
+    env_parser_modules=("obs/util.py", "utils/env.py"),
+    env_exempt_modules={
+        "perf/topo.py": "env snapshot/restore helper — sets and restores "
+                        "arbitrary entries around subprocess topology "
+                        "probes; it parses nothing",
+    },
+    env_exempt_sites={},
+    env_doc_exempt=(
+        # platform/infra variables owned by JAX/XLA or the test harness,
+        # not operator-facing serving knobs
+        "XLA_FLAGS", "JAX_DEFAULT_DEVICE", "JAX_PLATFORMS",
+        "ALLOW_MULTIPLE_LIBTPU_LOAD", "SHAI_TEST_DURATIONS",
+    ),
+    trace_files=("serve/app.py", "serve/asgi.py"),
+    poll_routes=("/profile", "/health", "/readiness", "/health/ready",
+                 "/metrics", "/stats"),
+)

@@ -1,0 +1,229 @@
+"""shai-lint shared infrastructure: findings, parsed modules, the inline
+allowlist grammar, the findings baseline, and the all-checkers runner.
+
+Allowlist grammar (one line, same line as the finding or the line above)::
+
+    # shai-lint: allow(<rule>[,<rule>...]) <reason>
+
+The reason is REQUIRED: an allow comment documents an intentional
+violation, it does not silence one. A reason-less allow comment leaves the
+finding live and adds a note saying why — the reviewer sees both.
+
+Baseline: a committed JSON list of finding fingerprints
+(``analysis/baseline.json``). Fingerprints are line-number-free so code
+motion above a pre-existing finding doesn't churn the file. CI semantics:
+a finding in the baseline is known debt (reported, exit 0); a finding not
+in the baseline fails the run (exit 1). ``scripts/shai_lint.py
+--update-baseline`` rewrites the file from a fresh run.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: repo root (the directory holding the package and README.md)
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PKG_ROOT = os.path.join(REPO_ROOT, "scalable_hw_agnostic_inference_tpu")
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+README_PATH = os.path.join(REPO_ROOT, "README.md")
+
+_ALLOW_RE = re.compile(
+    r"#\s*shai-lint:\s*allow\(([a-zA-Z0-9_\-, ]+)\)\s*(.*)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One checker hit. ``context`` is a stable anchor (qualname, env var
+    name, route pattern); ``message`` must be line-number-free so the
+    baseline fingerprint survives code motion."""
+
+    rule: str
+    path: str           # repo-relative, forward slashes
+    line: int
+    context: str
+    message: str
+    allowed: bool = False   # suppressed by a valid inline allow comment
+    reason: str = ""        # the allow comment's reason when allowed
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.context}|{self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = " (allowed)" if self.allowed else ""
+        return (f"{self.path}:{self.line} [{self.rule}] {self.context}: "
+                f"{self.message}{tag}")
+
+
+class Module:
+    """One parsed source file: AST with parent links, source lines,
+    module-level string constants, and import aliases."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source)
+        self.lines = source.splitlines()
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._shai_parent = node  # type: ignore[attr-defined]
+        #: module-level NAME = "literal" (env-name constants like ENV_TTFT_MS)
+        self.str_constants: Dict[str, str] = {}
+        #: import alias -> dotted module ("np" -> "numpy")
+        self.aliases: Dict[str, str] = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                self.str_constants[node.targets[0].id] = node.value.value
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    # -- allowlist grammar -------------------------------------------------
+
+    def allow_at(self, node: ast.AST, rule: str
+                 ) -> Tuple[bool, str, Optional[str]]:
+        """(allowed, reason, problem) for ``node`` under ``rule``: an allow
+        comment on the node's first or last line, or anywhere in the
+        contiguous comment block directly above it. ``problem`` is set
+        when a matching comment exists but is malformed (missing reason)
+        — the finding stays live."""
+        lineno = getattr(node, "lineno", 0)
+        end = getattr(node, "end_lineno", lineno) or lineno
+        candidates = [lineno, end]
+        ln = lineno - 1
+        while 1 <= ln <= len(self.lines) \
+                and self.lines[ln - 1].lstrip().startswith("#"):
+            candidates.append(ln)
+            ln -= 1
+        for ln in candidates:
+            if not 1 <= ln <= len(self.lines):
+                continue
+            m = _ALLOW_RE.search(self.lines[ln - 1])
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",")}
+            if rule not in rules:
+                continue
+            reason = m.group(2).strip()
+            if not reason:
+                return (False, "",
+                        "allow comment is missing its required reason")
+            return True, reason, None
+        return False, "", None
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolved_dotted(module: Module, node: ast.AST) -> Optional[str]:
+    """Like :func:`dotted` but with the first segment resolved through the
+    module's import aliases (``np.asarray`` -> ``numpy.asarray``)."""
+    d = dotted(node)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    base = module.aliases.get(head, head)
+    return f"{base}.{rest}" if rest else base
+
+
+def str_arg(module: Module, node: ast.AST) -> Optional[str]:
+    """Resolve an expression to a string: literal, or a module-level
+    string constant by name."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return module.str_constants.get(node.id)
+    return None
+
+
+def iter_modules(pkg_root: str = PKG_ROOT) -> List[Module]:
+    """Every parseable ``*.py`` under the package tree, sorted by relpath
+    (relative to the REPO root, e.g. ``scalable_hw_agnostic_inference_tpu/
+    engine/engine.py`` shortens to ``engine/engine.py``)."""
+    mods: List[Module] = []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, pkg_root)
+            with open(full, encoding="utf-8") as f:
+                src = f.read()
+            mods.append(Module(rel, src))
+    return mods
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: str = BASELINE_PATH) -> List[str]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError:
+        return []
+    return list(data.get("findings", []))
+
+
+def save_baseline(findings: Iterable[Finding],
+                  path: str = BASELINE_PATH) -> None:
+    fps = sorted({f.fingerprint for f in findings if not f.allowed})
+    with open(path, "w") as f:
+        json.dump({"version": 1, "findings": fps}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+# -- runner ------------------------------------------------------------------
+
+def run_all(modules: Optional[List[Module]] = None, contract=None,
+            readme_text: Optional[str] = None) -> List[Finding]:
+    """Run every checker; returns ALL findings (allowed ones included,
+    flagged) sorted by (path, line, rule). Callers filter on ``allowed``."""
+    from . import donation, envknobs, hostsync, routes, threads
+    from .contract import DEFAULT_CONTRACT
+
+    contract = contract or DEFAULT_CONTRACT
+    if modules is None:
+        modules = iter_modules()
+    if readme_text is None:
+        try:
+            with open(README_PATH, encoding="utf-8") as f:
+                readme_text = f.read()
+        except OSError:
+            readme_text = ""
+    findings: List[Finding] = []
+    findings += hostsync.check(modules, contract)
+    findings += donation.check(modules, contract)
+    findings += threads.check(modules, contract)
+    findings += envknobs.check(modules, contract, readme_text)
+    findings += routes.check(modules, contract)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings

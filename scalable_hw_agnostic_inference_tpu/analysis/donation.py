@@ -1,0 +1,358 @@
+"""Donation-after-use checker: reads of donated buffers after dispatch.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's device buffer to
+the executable — after the call the caller's array aliases freed (or
+reused) memory. Reading it is not an error JAX reliably reports: on TPU it
+can read garbage (silent KV corruption, invisible to the differential
+oracle because BOTH disciplines would read the same garbage). The engine's
+idiom is donate-and-rebind in one statement (``self.cache.kv, ... =
+decode(*args)``); this checker flags every deviation.
+
+Mechanics (per ``contract``):
+
+1. **Factory registry** — scan ``donation_factory_files`` for
+   ``jax.jit(fn, donate_argnums=...)`` inside ``def make_X``; the registry
+   maps factory name -> union of donated positions (a conditional
+   ``(1, 3) if feedback else (1,)`` contributes both).
+2. **Binding resolution** — inside each function of
+   ``donation_check_files``, a name becomes a *donating callable* via a
+   direct ``jax.jit`` assignment, a factory call, a declared accessor
+   (``_, decode = self._decode_for(...)``), a declared factory-built
+   instance attribute (``self._cross_write``), or a declared parameter.
+3. **Call-site tracking** — at each donating call, the argument at every
+   donated position (resolved through literal ``*args`` lists built with
+   ``args = [...]`` / ``args += [...]`` / ``args.append(...)``) starts a
+   watch on its dotted path. A later READ of that path in the same
+   function is a finding; a STORE to it (including the donating
+   statement's own assignment targets) retires the watch.
+4. ``donating_calls`` declares helper methods that donate specific
+   positional arguments onward (the async dispatch helper).
+
+Statement order is source order — control flow is not modeled; this is a
+lint for a codebase whose convention is strictly linear donate-and-rebind.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import Finding, Module, dotted, resolved_dotted
+
+RULE = "donation"
+
+
+# -- factory registry --------------------------------------------------------
+
+def _donate_positions(fn_scope: ast.AST, value: ast.AST) -> Set[int]:
+    """Int positions named by a ``donate_argnums`` value expression:
+    literal int/tuple, a conditional of literals, or a local name assigned
+    one of those earlier in ``fn_scope``."""
+    out: Set[int] = set()
+
+    def collect(node: Optional[ast.AST]) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            out.add(node.value)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                collect(e)
+        elif isinstance(node, ast.IfExp):
+            collect(node.body)
+            collect(node.orelse)
+        elif isinstance(node, ast.Name):
+            for stmt in ast.walk(fn_scope):
+                if isinstance(stmt, ast.Assign) \
+                        and any(isinstance(t, ast.Name) and t.id == node.id
+                                for t in stmt.targets):
+                    collect(stmt.value)
+
+    collect(value)
+    return out
+
+
+def factory_registry(modules: List[Module], contract
+                     ) -> Dict[str, FrozenSet[int]]:
+    """factory def name -> union of donated positions it compiles with."""
+    reg: Dict[str, Set[int]] = {}
+    for module in modules:
+        if module.relpath not in contract.donation_factory_files:
+            continue
+        for top in module.tree.body:
+            if not isinstance(top, ast.FunctionDef):
+                continue
+            for node in ast.walk(top):
+                if not (isinstance(node, ast.Call)
+                        and resolved_dotted(module, node.func) == "jax.jit"):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "donate_argnums":
+                        pos = _donate_positions(top, kw.value)
+                        if pos:
+                            reg.setdefault(top.name, set()).update(pos)
+    return {k: frozenset(v) for k, v in reg.items()}
+
+
+# -- per-function tracking ---------------------------------------------------
+
+def _statements(body: List[ast.stmt]) -> List[ast.stmt]:
+    """Flatten a function body into source-ordered simple statements
+    (descending into if/for/while/with/try bodies, NOT into nested defs)."""
+    out: List[ast.stmt] = []
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        out.append(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            inner = getattr(stmt, field, None)
+            if isinstance(inner, list):
+                out.extend(_statements(inner))
+        for h in getattr(stmt, "handlers", []) or []:
+            out.extend(_statements(h.body))
+    out.sort(key=lambda s: (s.lineno, s.col_offset))
+    return out
+
+
+def _shallow(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions a compound statement evaluates ITSELF (test, iter,
+    with-items) — its body statements are visited in their own right, so
+    scanning the whole subtree here would double-visit and mis-order."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def _store_paths(target: ast.AST) -> Set[str]:
+    """Dotted paths a Store target rebinds (tuple targets unpacked;
+    subscript stores rebind nothing)."""
+    out: Set[str] = set()
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            out |= _store_paths(e)
+    else:
+        d = dotted(target)
+        if d is not None:
+            out.add(d)
+    return out
+
+
+def _jit_donations(module: Module, call: ast.Call,
+                   scope: ast.AST) -> Optional[FrozenSet[int]]:
+    if resolved_dotted(module, call.func) != "jax.jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            pos = _donate_positions(scope, kw.value)
+            if pos:
+                return frozenset(pos)
+    return None
+
+
+class _Scope:
+    """Linear donation tracking through one function body."""
+
+    def __init__(self, module: Module, qual: str, fn: ast.AST,
+                 registry: Dict[str, FrozenSet[int]], contract):
+        self.module = module
+        self.qual = qual
+        self.fn = fn
+        self.registry = registry
+        self.contract = contract
+        self.bindings: Dict[str, FrozenSet[int]] = {}
+        self.list_vars: Dict[str, List[ast.expr]] = {}
+        #: watched dotted path -> (donating callee, donation line)
+        self.watch: Dict[str, Tuple[str, int]] = {}
+        self.findings: List[Finding] = []
+        params = contract.param_factories.get(qual, {})
+        for pname, factory in params.items():
+            if factory in registry:
+                self.bindings[pname] = registry[factory]
+
+    # binding helpers ------------------------------------------------------
+
+    def _bind_from_value(self, targets: List[ast.AST],
+                         value: ast.AST) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        donated = _jit_donations(self.module, value, self.fn)
+        callee = dotted(value.func)
+        factory = None
+        result_index: Optional[int] = None
+        if donated is None and callee is not None:
+            tail = callee.split(".")[-1]
+            if tail in self.registry:
+                factory, result_index = tail, None
+            elif tail in self.contract.accessor_factories:
+                factory, result_index = self.contract.accessor_factories[tail]
+            if factory is not None:
+                donated = self.registry.get(factory)
+        if donated is None:
+            return
+        for target in targets:
+            if result_index is not None and isinstance(
+                    target, (ast.Tuple, ast.List)):
+                if result_index < len(target.elts) and isinstance(
+                        target.elts[result_index], ast.Name):
+                    self.bindings[target.elts[result_index].id] = donated
+            elif isinstance(target, ast.Name):
+                self.bindings[target.id] = donated
+
+    def _track_list(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.List):
+            self.list_vars[stmt.targets[0].id] = list(stmt.value.elts)
+        elif isinstance(stmt, ast.AugAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and isinstance(stmt.op, ast.Add) \
+                and stmt.target.id in self.list_vars \
+                and isinstance(stmt.value, ast.List):
+            self.list_vars[stmt.target.id].extend(stmt.value.elts)
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            c = stmt.value
+            if isinstance(c.func, ast.Attribute) \
+                    and isinstance(c.func.value, ast.Name) \
+                    and c.func.value.id in self.list_vars:
+                if c.func.attr == "append" and c.args:
+                    self.list_vars[c.func.value.id].append(c.args[0])
+                elif c.func.attr == "extend" and c.args \
+                        and isinstance(c.args[0], ast.List):
+                    self.list_vars[c.func.value.id].extend(c.args[0].elts)
+
+    # call-site donation ---------------------------------------------------
+
+    def _donations_of_call(self, call: ast.Call
+                           ) -> Optional[Tuple[str, FrozenSet[int]]]:
+        callee = dotted(call.func)
+        if callee is None:
+            return None
+        tail = callee.split(".")[-1]
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in self.bindings:
+            return callee, self.bindings[call.func.id]
+        if tail in self.contract.attr_factories:
+            donated = self.registry.get(self.contract.attr_factories[tail])
+            if donated:
+                return callee, donated
+        if tail in self.contract.donating_calls:
+            return callee, frozenset(self.contract.donating_calls[tail])
+        return None
+
+    def _positional_args(self, call: ast.Call) -> List[ast.expr]:
+        if len(call.args) == 1 and isinstance(call.args[0], ast.Starred) \
+                and isinstance(call.args[0].value, ast.Name):
+            return list(self.list_vars.get(call.args[0].value.id, []))
+        return [a for a in call.args if not isinstance(a, ast.Starred)]
+
+    def _register_donations(self, stmt: ast.stmt) -> None:
+        for node in (n for root in _shallow(stmt)
+                     for n in ast.walk(root)):
+            if not isinstance(node, ast.Call):
+                continue
+            got = self._donations_of_call(node)
+            if got is None:
+                continue
+            callee, positions = got
+            args = self._positional_args(node)
+            for i in sorted(positions):
+                if i >= len(args):
+                    continue
+                path = dotted(args[i])
+                if path is not None:
+                    self.watch[path] = (callee, node.lineno)
+
+    def _scan_reads(self, stmt: ast.stmt) -> None:
+        if not self.watch:
+            return
+        for node in (n for root in _shallow(stmt)
+                     for n in ast.walk(root)):
+            if not isinstance(node, (ast.Attribute, ast.Name)):
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            parent = getattr(node, "_shai_parent", None)
+            if isinstance(parent, ast.Attribute):
+                continue  # only the full dotted chain matches
+            path = dotted(node)
+            if path is None:
+                continue
+            # a read of the donated path itself, or of anything under it
+            # (`kv.shape` after `kv` was donated)
+            watched = next((w for w in self.watch
+                            if path == w or path.startswith(w + ".")),
+                           None)
+            if watched is None:
+                continue
+            callee, dline = self.watch[watched]
+            path = watched
+            allowed, reason, problem = self.module.allow_at(node, RULE)
+            msg = (f"read of `{path}` after its buffer was donated to "
+                   f"`{callee}(...)`")
+            if problem:
+                msg += f" ({problem})"
+            self.findings.append(Finding(
+                rule=RULE, path=self.module.relpath, line=node.lineno,
+                context=self.qual, message=msg, allowed=allowed,
+                reason=reason))
+            del self.watch[path]  # one finding per donated path
+
+    def _kill_stores(self, stmt: ast.stmt) -> None:
+        killed: Set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                killed |= _store_paths(t)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            killed |= _store_paths(stmt.target)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            killed |= _store_paths(stmt.target)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    killed |= _store_paths(item.optional_vars)
+        for path in killed:
+            self.watch.pop(path, None)
+
+    def run(self) -> List[Finding]:
+        for stmt in _statements(self.fn.body):
+            # reads of previously-donated paths fire BEFORE this
+            # statement's own donations/rebinds take effect
+            self._scan_reads(stmt)
+            if isinstance(stmt, ast.Assign):
+                self._bind_from_value(stmt.targets, stmt.value)
+            self._track_list(stmt)
+            self._register_donations(stmt)
+            self._kill_stores(stmt)
+        return self.findings
+
+
+def _walk_defs(tree: ast.Module):
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield f"{prefix}{child.name}", child
+                yield from walk(child, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def check(modules: List[Module], contract) -> List[Finding]:
+    registry = factory_registry(modules, contract)
+    findings: List[Finding] = []
+    for module in modules:
+        if module.relpath not in contract.donation_check_files:
+            continue
+        for qual, fn in _walk_defs(module.tree):
+            findings += _Scope(module, qual, fn, registry, contract).run()
+    return findings

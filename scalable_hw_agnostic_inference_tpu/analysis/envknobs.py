@@ -1,0 +1,139 @@
+"""Env-knob registry checker: parse leniently, read centrally, document.
+
+PR 7's review found ``SHAI_HBM_WINDOW=8.5`` crash-looping engine
+construction through a bare ``int()`` — a malformed TUNING knob must
+degrade to its default, never take a serving tier down at boot. The
+lenient parsers (``obs/util.py``, re-exported through ``utils/env.py``)
+fixed that for ``obs/``; this checker generalizes the rule to the whole
+package, three sub-rules:
+
+- ``env-parse``: a raw env read wrapped in ``int(...)``/``float(...)`` —
+  the boot-crash-loop class. Use ``env_int``/``env_float``.
+- ``env-read``: any direct ``os.environ``/``os.getenv`` access outside
+  the parser modules. Reads go through the parser seam
+  (``env_str``/``env_flag`` for strings/gates) so the knob registry stays
+  complete; deliberate raw reads carry a declared exemption
+  (``contract.env_exempt_*``) or ``# shai-lint: allow(env-knob) reason``.
+- ``env-doc``: every knob name the package reads — collected from read
+  sites, parser calls, and every ``SHAI_*`` string literal — must appear
+  in README.md (the operator contract; subsumes the metric-docs gate's
+  approach for env vars).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Module, resolved_dotted, str_arg
+
+#: a SHAI_* knob name anywhere in source (docstrings/comments included —
+#: if the code talks about it, the operator doc must too)
+_SHAI_NAME = re.compile(r"\bSHAI_[A-Z0-9_]+\b")
+
+_READ_FUNCS = {"os.environ.get", "os.getenv"}
+
+
+def _env_read_name(module: Module, node: ast.AST) -> Optional[Tuple[str,
+                                                                    bool]]:
+    """(env name or "<dynamic>", is_read) when ``node`` reads the
+    process environment directly."""
+    if isinstance(node, ast.Call):
+        d = resolved_dotted(module, node.func)
+        if d in _READ_FUNCS and node.args:
+            return (str_arg(module, node.args[0]) or "<dynamic>", True)
+    if isinstance(node, ast.Subscript) \
+            and isinstance(getattr(node, "ctx", None), ast.Load):
+        d = resolved_dotted(module, node.value)
+        if d == "os.environ":
+            return (str_arg(module, node.slice) or "<dynamic>", True)
+    return None
+
+
+def _wrapped_in_cast(node: ast.AST) -> Optional[str]:
+    """"int"/"float" when an ancestor call casts this read's value within
+    the same expression."""
+    cur = getattr(node, "_shai_parent", None)
+    while cur is not None and not isinstance(cur, ast.stmt):
+        if isinstance(cur, ast.Call) and isinstance(cur.func, ast.Name) \
+                and cur.func.id in ("int", "float"):
+            return cur.func.id
+        cur = getattr(cur, "_shai_parent", None)
+    return None
+
+
+def check(modules: List[Module], contract, readme_text: str
+          ) -> List[Finding]:
+    findings: List[Finding] = []
+    #: name -> first (path, line) that reads it (doc check anchor)
+    registered: Dict[str, Tuple[str, int]] = {}
+
+    for module in modules:
+        path = module.relpath
+        for m in _SHAI_NAME.finditer(module.source):
+            name = m.group(0)
+            line = module.source.count("\n", 0, m.start()) + 1
+            registered.setdefault(name, (path, line))
+        # lenient-parser calls register their knob for the doc check —
+        # in EVERY module, parser modules included (ServeConfig.from_env
+        # lives in utils/env.py and its knobs are part of the registry)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                tail = (fn.attr if isinstance(fn, ast.Attribute)
+                        else fn.id if isinstance(fn, ast.Name) else "")
+                if tail in contract.env_parser_names and node.args:
+                    name = str_arg(module, node.args[0])
+                    if name:
+                        registered.setdefault(name, (path, node.lineno))
+        if path in contract.env_parser_modules \
+                or path in contract.env_exempt_modules:
+            continue
+        for node in ast.walk(module.tree):
+            got = _env_read_name(module, node)
+            if got is None:
+                continue
+            name, _ = got
+            if name != "<dynamic>":
+                registered.setdefault(name, (path, node.lineno))
+            exempt_reason = contract.env_exempt_sites.get((path, name))
+            cast = _wrapped_in_cast(node)
+            # the umbrella token allow(env-knob) covers both sub-rules; an
+            # annotation naming the finding's own rule works too
+            sub_rule = "env-parse" if cast is not None else "env-read"
+            allowed, reason, problem = module.allow_at(node, "env-knob")
+            if not allowed and problem is None:
+                allowed, reason, problem = module.allow_at(node, sub_rule)
+            if cast is not None:
+                msg = (f"raw env read cast through {cast}() — a malformed "
+                       f"value crash-loops boot; use the lenient "
+                       f"env_{cast} parser")
+                if problem:
+                    msg += f" ({problem})"
+                findings.append(Finding(
+                    rule="env-parse", path=path, line=node.lineno,
+                    context=name, message=msg,
+                    allowed=allowed or exempt_reason is not None,
+                    reason=reason or (exempt_reason or "")))
+            else:
+                msg = ("direct environment read bypasses the parser seam "
+                       "(obs/util.py, utils/env.py)")
+                if problem:
+                    msg += f" ({problem})"
+                findings.append(Finding(
+                    rule="env-read", path=path, line=node.lineno,
+                    context=name, message=msg,
+                    allowed=allowed or exempt_reason is not None,
+                    reason=reason or (exempt_reason or "")))
+
+    for name in sorted(registered):
+        if name in contract.env_doc_exempt or name in readme_text:
+            continue
+        path, line = registered[name]
+        findings.append(Finding(
+            rule="env-doc", path=path, line=line, context=name,
+            message=("env knob is read/declared in code but absent from "
+                     "README.md — document it in the environment-knob "
+                     "registry")))
+    return findings

@@ -1,0 +1,108 @@
+"""Host-sync detector: device→host synchronization in declared hot paths.
+
+The async decode loop's whole value is that the host never makes the
+device wait (PR 6: 1.42x at bs=4). One stray ``np.asarray`` / ``.item()``
+/ ``jax.device_get`` in the steady path re-serializes every step — and
+nothing fails: tokens are still exact, only the step gap quietly grows.
+This checker makes that a lint failure instead of a perf regression
+someone has to notice on a dashboard.
+
+Flagged inside hot-path functions (``contract.hot_paths``):
+
+- ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` calls
+- ``np.asarray(...)`` / ``np.array(...)`` (host pull of a device value;
+  ``np.zeros``/``np.arange`` etc. are host allocations and stay legal)
+- ``jax.device_get(...)``
+- ``float(x)`` / ``int(x)`` on a non-literal (implicit device fetch when
+  ``x`` is a traced/device value; ``int(len(...))`` and constants pass)
+
+Intentional syncs carry the allow grammar with a reason::
+
+    # shai-lint: allow(host-sync) the one blocking fetch of the pipeline
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .core import Finding, Module, resolved_dotted
+
+RULE = "host-sync"
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_SYNC_FUNCS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+_CAST_FUNCS = {"int", "float"}
+
+
+def _sync_kind(module: Module, node: ast.Call) -> Optional[str]:
+    """Why this call is a host sync, or None."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
+        return f".{f.attr}()"
+    d = resolved_dotted(module, f)
+    if d in _SYNC_FUNCS:
+        return f"{d}(...)"
+    if isinstance(f, ast.Name) and f.id in _CAST_FUNCS and node.args:
+        a = node.args[0]
+        if isinstance(a, ast.Constant):
+            return None
+        if isinstance(a, ast.Call) and isinstance(a.func, ast.Name) \
+                and a.func.id == "len":
+            return None
+        return f"{f.id}(...) on a non-literal"
+    return None
+
+
+def _qualname_defs(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
+    """(qualname, def node) for every function, ``Class.method`` style."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((q, child))
+                walk(child, f"{q}.")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def check(modules: List[Module], contract) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in modules:
+        hot = contract.hot_paths.get(module.relpath)
+        if not hot:
+            continue
+        star = "*" in hot
+        seen = set()  # a nested def is walked under its parent too
+        for qual, fn in _qualname_defs(module.tree):
+            # a nested def inherits its enclosing hot scope; the qualname
+            # prefix check covers both the function and its inner defs
+            if not star and not any(
+                    qual == h or qual.startswith(h + ".") for h in hot):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _sync_kind(module, node)
+                if kind is None:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                allowed, reason, problem = module.allow_at(node, RULE)
+                msg = f"host sync {kind} in declared hot path"
+                if problem:
+                    msg += f" ({problem})"
+                findings.append(Finding(
+                    rule=RULE, path=module.relpath, line=node.lineno,
+                    context=qual, message=msg, allowed=allowed,
+                    reason=reason))
+    return findings

@@ -1,0 +1,85 @@
+"""Trace-exclusion checker: debug/poll GET routes stay off the flight ring.
+
+PR 7's review caught ``GET /profile`` missing from ``trace_exclude``: a
+dashboard polling profiler state at 2 Hz would have evicted every real
+request timeline from the bounded flight-recorder ring — the postmortem
+buffer erased by the tool meant to read it. This rule makes that class
+mechanical: every GET route registered in ``serve/app.py`` that is a
+debug surface (``/debug/...``) or a declared poll route
+(``contract.poll_routes``) must be a member of the static
+``trace_exclude`` set (the asgi default literal plus ``app.trace_exclude
+|= {...}`` updates).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Finding, Module, dotted
+
+RULE = "trace-exclude"
+
+
+def _string_set(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+    return out
+
+
+def _excluded_routes(modules: List[Module], contract) -> Set[str]:
+    excluded: Set[str] = set()
+    for module in modules:
+        if module.relpath not in contract.trace_files:
+            continue
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (dotted(t) or "").endswith("trace_exclude"):
+                        excluded |= _string_set(node.value)
+            elif isinstance(node, ast.AugAssign) and \
+                    (dotted(node.target) or "").endswith("trace_exclude"):
+                excluded |= _string_set(node.value)
+    return excluded
+
+
+def _get_routes(module: Module):
+    """(pattern, decorator node) for every ``@app.get("...")`` route."""
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            if isinstance(deco, ast.Call) \
+                    and isinstance(deco.func, ast.Attribute) \
+                    and deco.func.attr == "get" and deco.args \
+                    and isinstance(deco.args[0], ast.Constant) \
+                    and isinstance(deco.args[0].value, str):
+                yield deco.args[0].value, deco
+
+
+def check(modules: List[Module], contract) -> List[Finding]:
+    excluded = _excluded_routes(modules, contract)
+    findings: List[Finding] = []
+    for module in modules:
+        if module.relpath not in contract.trace_files:
+            continue
+        for pattern, deco in _get_routes(module):
+            if not (pattern.startswith("/debug/")
+                    or pattern in contract.poll_routes):
+                continue
+            if pattern in excluded:
+                continue
+            allowed, reason, problem = module.allow_at(deco, RULE)
+            msg = ("debug/poll GET route is missing from trace_exclude — "
+                   "polling it would evict real request timelines from "
+                   "the flight ring")
+            if problem:
+                msg += f" ({problem})"
+            findings.append(Finding(
+                rule=RULE, path=module.relpath, line=deco.lineno,
+                context=pattern, message=msg, allowed=allowed,
+                reason=reason))
+    return findings

@@ -1,0 +1,231 @@
+"""Thread-discipline checker: attribute-write sites vs the declared
+concurrency contract (``contract.thread_contract``).
+
+The engine is mutated from exactly one thread (the engine loop); the serve
+lane reaches it only through ``EngineLoop``'s queues, whose futures table
+is the one lock-guarded cross-thread structure. None of that is visible
+in types — a well-meaning ``service.loop.engine.waiting.append(...)`` from
+a request handler compiles, passes every test that doesn't race, and
+corrupts batch state under load. This checker turns the contract into
+failures at the write site.
+
+Per :class:`~.contract.ClassPolicy`:
+
+- ``immutable_after_init`` attrs: ``self.X`` writes (assign/augassign/
+  subscript-store/mutator call) only inside ``init_methods``.
+- ``lock_guarded`` attrs: every write site lexically inside
+  ``with self.<lock>:``  (init methods exempt — the object is not yet
+  shared).
+- everything else is owner-thread-only: writes through a declared
+  instance marker (``engine.``, ``.loop.`` …) are legal only in
+  ``owning_modules``.
+
+``contract.dict_guards`` covers closure-state dicts (serve.app's
+``state``): writes to the guarded keys must hold the named lock.
+
+Deliberate exceptions carry ``# shai-lint: allow(thread) <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Module, dotted
+
+RULE = "thread"
+
+#: method names that mutate their receiver in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "clear", "update", "remove", "discard", "add",
+    "setdefault", "sort", "reverse",
+}
+
+
+def _enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    cur = getattr(node, "_shai_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = getattr(cur, "_shai_parent", None)
+    return None
+
+
+def _holds_lock(node: ast.AST, lock_paths: Set[str]) -> bool:
+    """True when ``node`` sits lexically inside ``with <lock>:`` for one
+    of the dotted lock paths."""
+    cur = getattr(node, "_shai_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                if dotted(item.context_expr) in lock_paths:
+                    return True
+        cur = getattr(cur, "_shai_parent", None)
+    return False
+
+
+def _self_write_sites(cls: ast.ClassDef):
+    """Yield (method node, attr, site node, kind) for every write through
+    ``self`` in the class body: plain/aug assigns to ``self.X``, subscript
+    stores into ``self.X[...]``, and mutator calls ``self.X.m(...)``."""
+    for method in [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        for node in ast.walk(method):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Attribute) \
+                            and isinstance(leaf.value, ast.Name) \
+                            and leaf.value.id == "self" \
+                            and isinstance(leaf.ctx, ast.Store):
+                        yield method, leaf.attr, node, "write"
+                    elif isinstance(leaf, ast.Subscript) \
+                            and isinstance(leaf.ctx, ast.Store):
+                        base = leaf.value
+                        if isinstance(base, ast.Attribute) \
+                                and isinstance(base.value, ast.Name) \
+                                and base.value.id == "self":
+                            yield method, base.attr, node, "item write"
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                recv = node.func.value
+                if isinstance(recv, ast.Attribute) \
+                        and isinstance(recv.value, ast.Name) \
+                        and recv.value.id == "self":
+                    yield method, recv.attr, node, f".{node.func.attr}()"
+
+
+def _finding(module: Module, node: ast.AST, context: str, message: str
+             ) -> Finding:
+    allowed, reason, problem = module.allow_at(node, RULE)
+    if problem:
+        message += f" ({problem})"
+    return Finding(rule=RULE, path=module.relpath, line=node.lineno,
+                   context=context, message=message, allowed=allowed,
+                   reason=reason)
+
+
+def _check_class_body(module: Module, cls: ast.ClassDef, policy,
+                      findings: List[Finding]) -> None:
+    lock_attrs = set(policy.lock_guarded)
+    for method, attr, node, kind in _self_write_sites(cls):
+        in_init = method.name in policy.init_methods
+        if attr in policy.immutable_after_init and not in_init \
+                and not kind.startswith("."):
+            # mutator CALLS (`self.cache.extend(...)`) are the attr's own
+            # object managing itself — immutability here is about the
+            # BINDING (and direct item stores into it) staying fixed
+            findings.append(_finding(
+                module, node, f"{cls.name}.{method.name}",
+                f"{kind} to immutable-after-init attr `{attr}` outside "
+                f"{'/'.join(policy.init_methods)}"))
+        elif attr in lock_attrs and not in_init:
+            lock = policy.lock_guarded[attr]
+            if not _holds_lock(node, {f"self.{lock}", lock}):
+                findings.append(_finding(
+                    module, node, f"{cls.name}.{method.name}",
+                    f"{kind} to lock-guarded attr `{attr}` outside "
+                    f"`with self.{lock}`"))
+
+
+def _external_write_paths(module: Module):
+    """(site node, dotted path, kind) for attribute writes and mutator
+    calls anywhere in the module (coarse: callers filter by markers)."""
+    for node in ast.walk(module.tree):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            for leaf in ast.walk(t):
+                if isinstance(leaf, ast.Attribute) \
+                        and isinstance(getattr(leaf, "ctx", None), ast.Store):
+                    d = dotted(leaf)
+                    if d is not None:
+                        yield node, d, "write"
+                elif isinstance(leaf, ast.Subscript) \
+                        and isinstance(getattr(leaf, "ctx", None), ast.Store):
+                    d = dotted(leaf.value)
+                    if d is not None:
+                        yield node, d, "item write"
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            d = dotted(node.func.value)
+            if d is not None:
+                yield node, d, f".{node.func.attr}()"
+
+
+def _matches_marker(path: str, markers: Tuple[str, ...]) -> bool:
+    """A write path hits an instance marker as a leading segment
+    (``engine.slots`` for marker ``engine.``) or an infix (``service.loop.
+    engine.slots`` for ``.engine.``)."""
+    probe = f".{path}"
+    return any(m.lstrip(".") and
+               (probe.find(f".{m.lstrip('.')}") == 0
+                or (m.startswith(".") and m in probe))
+               for m in markers)
+
+
+def check(modules: List[Module], contract) -> List[Finding]:
+    findings: List[Finding] = []
+    policies = contract.thread_contract
+    for module in modules:
+        # 1) in-class writes vs immutability + lock requirements
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef) and node.name in policies:
+                _check_class_body(module, node, policies[node.name],
+                                  findings)
+        # 2) writes through instance markers from non-owning modules
+        for cls_name, policy in policies.items():
+            if not policy.instance_markers or not policy.owning_modules:
+                continue
+            if module.relpath in policy.owning_modules:
+                continue
+            for site, path, kind in _external_write_paths(module):
+                if not _matches_marker(path, policy.instance_markers):
+                    continue
+                # writes from inside the class's own body were checked above
+                fn = _enclosing_function(site)
+                findings.append(_finding(
+                    module, site,
+                    getattr(fn, "name", "<module>"),
+                    f"{kind} to `{path}` — {cls_name} state is "
+                    f"owner-thread-only (owning modules: "
+                    f"{', '.join(policy.owning_modules)})"))
+        # 3) guarded closure dicts
+        guards = contract.dict_guards.get(module.relpath, {})
+        if guards:
+            for node in ast.walk(module.tree):
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = list(node.targets)
+                elif isinstance(node, ast.AugAssign):
+                    targets = [node.target]
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if not (isinstance(leaf, ast.Subscript)
+                                and isinstance(getattr(leaf, "ctx", None),
+                                               ast.Store)
+                                and isinstance(leaf.value, ast.Name)
+                                and leaf.value.id in guards):
+                            continue
+                        keys, lock = guards[leaf.value.id]
+                        key = leaf.slice
+                        if isinstance(key, ast.Constant) \
+                                and key.value in keys \
+                                and not _holds_lock(leaf, {lock}):
+                            fn = _enclosing_function(leaf)
+                            findings.append(_finding(
+                                module, node,
+                                getattr(fn, "name", "<module>"),
+                                f"write to `{leaf.value.id}[\"{key.value}\"]`"
+                                f" outside `with {lock}`"))
+    return findings

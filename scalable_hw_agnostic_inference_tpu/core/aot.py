@@ -65,8 +65,10 @@ def enable_persistent_cache(cache_dir: str) -> None:
 def enable_persistent_cache_from_env() -> None:
     """Persistent cache at ``$SHAI_XLA_CACHE`` (default /tmp/shai-xla-cache)
     — the one owner of both literals for every bench/perf entry point."""
-    enable_persistent_cache(os.environ.get("SHAI_XLA_CACHE",
-                                           "/tmp/shai-xla-cache"))
+    from ..obs.util import env_str
+
+    enable_persistent_cache(env_str("SHAI_XLA_CACHE",
+                                    "/tmp/shai-xla-cache"))
 
 
 def host_init(init_fn, *arg_thunks):

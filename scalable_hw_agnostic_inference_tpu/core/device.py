@@ -117,6 +117,8 @@ def force_host_device_count(n: int) -> None:
     """
     import re
 
+    # shai-lint: allow(env-knob) XLA_FLAGS is a read-modify-write of the
+    # platform's own variable, not a serving knob behind the parser seam
     flags = os.environ.get("XLA_FLAGS", "")
     flag = f"--xla_force_host_platform_device_count={n}"
     if "xla_force_host_platform_device_count" in flags:

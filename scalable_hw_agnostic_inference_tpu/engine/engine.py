@@ -54,12 +54,9 @@ def _resolve_async() -> bool:
     device-resident batch state and one-step-lookahead dispatch. ``0`` runs
     the lock-step path — the reference oracle the differential tests
     (``tests/test_engine_async.py``) compare against."""
-    import os
+    from ..obs.util import env_flag
 
-    env = os.environ.get("SHAI_ASYNC_DECODE", "")
-    if env:
-        return env.strip().lower() not in ("0", "false", "off", "no")
-    return True
+    return env_flag("SHAI_ASYNC_DECODE", True)
 
 
 class LLMEngine:
@@ -82,10 +79,10 @@ class LLMEngine:
         # refuse to boot HERE, with the breakdown, instead of OOMing minutes
         # into warmup (VERDICT r3 missing #2). CPU runs (tests, virtual-mesh
         # dryruns) skip unless SHAI_ENFORCE_HBM=1 opts in.
-        import os as _os
+        from ..obs.util import env_flag as _env_flag
 
         if (jax.devices()[0].platform != "cpu"
-                or _os.environ.get("SHAI_ENFORCE_HBM") == "1"):
+                or _env_flag("SHAI_ENFORCE_HBM", False)):
             from ..core.budget import causal_lm_budget, detect_hbm_gib
 
             causal_lm_budget(
@@ -610,9 +607,13 @@ class LLMEngine:
         cancelled since the dispatch are skipped — their extra token is
         exactly the discarded lookahead. Returns the fetch stamp."""
         if pipe.want_lp:
+            # shai-lint: allow(host-sync) THE one blocking fetch of the
+            # pipeline: retiring step N must read its sampled tokens (and
+            # logprobs) back — everything else overlaps step N+1
             nxt, top_ids, top_lp, tok_lp = jax.device_get(
                 (pipe.nxt, pipe.top_ids, pipe.top_lp, pipe.tok_lp))
         else:
+            # shai-lint: allow(host-sync) same fetch, logprob-free shape
             nxt = np.asarray(pipe.nxt)
             top_ids = top_lp = tok_lp = None
         t_f = time.monotonic()

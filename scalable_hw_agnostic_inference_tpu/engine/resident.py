@@ -59,6 +59,7 @@ class InflightStep:
 
     def device_bytes(self) -> int:
         """Bytes the un-retired step's outputs pin on device (HBM ledger)."""
+        # shai-lint: allow(host-sync) .nbytes is host shape metadata
         return sum(int(getattr(a, "nbytes", 0) or 0)
                    for a in (self.nxt, self.pos_next, self.top_ids,
                              self.top_lp, self.tok_lp))
@@ -79,6 +80,7 @@ class ResidentBatch:
 
     def device_bytes(self) -> int:
         """Bytes the resident mirror holds on device (HBM ledger feed)."""
+        # shai-lint: allow(host-sync) .nbytes is shape metadata (host int)
         return sum(int(getattr(a, "nbytes", 0)) for a in self.arrays.values())
 
     def refresh(self, engine, running, Bb: int) -> Dict[str, Any]:

@@ -453,13 +453,13 @@ def _resolve_paged(paged):
     """Default the paged-kernel switch: on for TPU backends, off elsewhere
     (the interpreter is test-only); the ``SHAI_PAGED_DECODE`` env var (0/1)
     overrides."""
-    import os
+    from ..obs.util import env_flag
 
     if paged is not None:
         return paged
-    env = os.environ.get("SHAI_PAGED_DECODE", "")
-    if env:
-        return env not in ("0", "false")
+    env = env_flag("SHAI_PAGED_DECODE", None)
+    if env is not None:
+        return env
     from ..ops.attention import on_tpu_platform
 
     return on_tpu_platform()

@@ -12,7 +12,6 @@ to remember to enable before the incident.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
@@ -26,7 +25,9 @@ class FlightRecorder:
     def __init__(self, max_requests: Optional[int] = None,
                  max_steps: int = 256):
         if max_requests is None:
-            max_requests = int(os.environ.get("SHAI_FLIGHT_REQUESTS", "128"))
+            from .util import env_int
+
+            max_requests = env_int("SHAI_FLIGHT_REQUESTS", 128)
         self.max_requests = max_requests
         self.max_steps = max_steps
         self._lock = threading.Lock()

@@ -32,6 +32,8 @@ from typing import Any, Callable, Dict, Optional
 
 log = logging.getLogger(__name__)
 
+from .util import env_str
+
 ENV_PROJECTED = "SHAI_PERF_PROJECTED_TOK_S"   # direct projected rate
 ENV_PROJECTION = "SHAI_PERF_PROJECTION"       # PERF_MODEL.json key
 ENV_MODEL_PATH = "SHAI_PERF_MODEL"            # override the json path
@@ -41,7 +43,7 @@ ENV_MIN_TOKENS = "SHAI_PERF_MIN_TOKENS"
 
 
 def perf_model_path() -> str:
-    env = os.environ.get(ENV_MODEL_PATH, "")
+    env = env_str(ENV_MODEL_PATH)
     if env:
         return env
     # repo-root sibling of the package: <root>/PERF_MODEL.json
@@ -104,7 +106,7 @@ class PerfSentinel:
         from .util import env_float as _envf
 
         rate = _envf(ENV_PROJECTED, 0.0)
-        key = os.environ.get(ENV_PROJECTION, "") or default_key
+        key = env_str(ENV_PROJECTION) or default_key
         if rate <= 0 and key:
             proj = load_projections().get(key)
             if isinstance(proj, dict):

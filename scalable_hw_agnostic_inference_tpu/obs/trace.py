@@ -37,7 +37,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-_enabled = os.environ.get("SHAI_TRACE", "1") != "0"
+from .util import env_flag as _env_flag
+
+_enabled = _env_flag("SHAI_TRACE", True)
 
 
 def configure(enabled: bool) -> None:

@@ -1,14 +1,25 @@
-"""Shared env parsing for the obs modules (stdlib-only).
+"""Shared lenient env parsing — THE seam every env knob reads through.
 
-Lenient by contract: these are tuning knobs read during engine
+Lenient by contract: these are tuning knobs read during process
 construction — a malformed value must fall back to its default, never
 fail pod boot (a typo in ``SHAI_HBM_WINDOW`` is not a reason to crash-loop
-a serving tier).
+a serving tier). Every fallback logs a warning so the typo is visible in
+the pod log instead of silently shipping a default.
+
+``utils.env`` re-exports these for the serve-layer ``ServeConfig``
+contract; shai-lint (``analysis/envknobs.py``) enforces that no module
+outside this seam parses the environment raw. Strict-by-design reads
+(multihost ordinals that MUST fail loudly) carry an inline
+``# shai-lint: allow(env-knob) <reason>`` annotation instead.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
 
 
 def env_float(name: str, default: float) -> float:
@@ -18,6 +29,8 @@ def env_float(name: str, default: float) -> float:
     try:
         return float(v)
     except ValueError:
+        log.warning("malformed env knob %s=%r — using default %r",
+                    name, v, default)
         return default
 
 
@@ -28,4 +41,30 @@ def env_int(name: str, default: int) -> int:
     try:
         return int(float(v))   # "8.5" degrades to 8, not a boot crash
     except ValueError:
+        log.warning("malformed env knob %s=%r — using default %r",
+                    name, v, default)
         return default
+
+
+def env_str(name: str, default: str = "") -> str:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v
+
+
+def env_flag(name: str, default: Optional[bool]) -> Optional[bool]:
+    """Boolean gate with lenient tri-state semantics: a recognized truthy/
+    falsy spelling wins, anything else (unset OR malformed) degrades to
+    the default — ``SHAI_ASYNC_DECODE=flase`` must not silently select
+    the opposite of what the operator meant to keep. ``default=None``
+    keeps "unset" distinguishable (platform-dependent gates)."""
+    v = os.environ.get(name, "").strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "off", "no"):
+        return False
+    if v:
+        log.warning("malformed env flag %s=%r — using default %r",
+                    name, v, default)
+    return default

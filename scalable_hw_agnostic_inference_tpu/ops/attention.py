@@ -70,9 +70,9 @@ def effective_platform() -> str:
     would initialize the (possibly wedged) device tunnel just to answer a
     question about a device the computation will never run on.
     """
-    import os
+    from ..obs.util import env_str
 
-    ovr = os.environ.get("SHAI_PLATFORM_OVERRIDE", "")
+    ovr = env_str("SHAI_PLATFORM_OVERRIDE")
     if ovr:
         return _validated_override(ovr)
     dd = jax.config.jax_default_device
@@ -179,9 +179,9 @@ def dot_product_attention(
         scale = 1.0 / (D ** 0.5)
     if impl == "auto":
         # measured-dispatch escape hatch (scripts/perf_attn.py)
-        import os
+        from ..obs.util import env_str
 
-        impl = os.environ.get("SHAI_ATTN_IMPL", "auto")
+        impl = env_str("SHAI_ATTN_IMPL", "auto")
         if impl == "auto" and not causal and kv_lengths is None:
             if (_jax_flash_eligible(q, k, mask, bias, kv_lengths, causal)
                     and _JAX_FLASH_WINDOW[0] <= T * S < _JAX_FLASH_WINDOW[1]

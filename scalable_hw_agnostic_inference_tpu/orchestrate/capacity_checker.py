@@ -204,7 +204,14 @@ def start_metrics_exporter() -> bool:
     9101, 0 disables). Returns True when the exporter is up."""
     import os
 
-    port = int(os.environ.get("CONTROLLER_METRICS_PORT", "9101") or "0")
+    from ..obs.util import env_int
+
+    # shai-lint: allow(env-knob) "" must keep DISABLING the exporter (the
+    # blank-the-knob deployment convention predates the registry; the
+    # lenient parsers deliberately read "" as unset-use-default)
+    if os.environ.get("CONTROLLER_METRICS_PORT") == "":
+        return False
+    port = env_int("CONTROLLER_METRICS_PORT", 9101)
     if not port:
         return False
     try:
@@ -331,17 +338,17 @@ def main_loop(app: str = "sd21", manifest_dir: str = "/deploy",
 
 
 if __name__ == "__main__":
-    import os
+    from ..obs.util import env_int, env_str
 
     logging.basicConfig(level="INFO")
     main_loop(
-        app=os.environ.get("APP", "sd21"),
-        manifest_dir=os.environ.get("MANIFEST_DIR", "/deploy"),
-        nodepools=tuple(os.environ.get("NODEPOOLS", "tpu,v5e").split(",")),
-        load_deploy=os.environ.get("LOAD_DEPLOY", "load"),
-        interval_s=int(os.environ.get("INTERVAL_S", "300")),
+        app=env_str("APP", "sd21"),
+        manifest_dir=env_str("MANIFEST_DIR", "/deploy"),
+        nodepools=tuple(env_str("NODEPOOLS", "tpu,v5e").split(",")),
+        load_deploy=env_str("LOAD_DEPLOY", "load"),
+        interval_s=env_int("INTERVAL_S", 300),
         # comma-separated pod /stats base URLs: enables the engine-overload
         # failover trigger (queue depth / KV pressure from obs telemetry)
         stats_urls=tuple(u for u in
-                         os.environ.get("STATS_URLS", "").split(",") if u),
+                         env_str("STATS_URLS").split(",") if u),
     )

@@ -41,7 +41,10 @@ def resolve_service_url(name: str, spec: Dict[str, Any]) -> str:
     if spec.get("url"):
         return spec["url"].rstrip("/")
     envbase = name.upper().replace("-", "_")
+    # shai-lint: allow(env-knob) K8s service-discovery vars are injected
+    # per backend NAME — dynamic, not part of the knob registry
     host = os.environ.get(f"{envbase}_SERVICE_HOST")
+    # shai-lint: allow(env-knob) same K8s service-discovery contract
     port = os.environ.get(f"{envbase}_SERVICE_PORT", "80")
     if host:
         return f"http://{host}:{port}"
@@ -382,8 +385,10 @@ def main() -> None:
     logging.basicConfig(level="INFO")
     from ..serve.httpd import Server
 
-    path = os.environ.get("MODELS_CONFIG", "/config/models.json")
-    port = int(os.environ.get("PORT", "8080"))
+    from ..obs.util import env_int, env_str
+
+    path = env_str("MODELS_CONFIG", "/config/models.json")
+    port = env_int("PORT", 8080)
     Server(create_cova_app(path), port=port).run()
 
 

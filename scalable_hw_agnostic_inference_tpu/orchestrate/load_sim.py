@@ -104,14 +104,16 @@ def main_loop(deployment: str = "load", namespace: str = "load",
 
 
 if __name__ == "__main__":
+    from ..obs.util import env_float, env_int, env_str
+
     logging.basicConfig(level="INFO")
     main_loop(
-        deployment=os.environ.get("LOAD_DEPLOY", "load"),
-        namespace=os.environ.get("NAMESPACE", "load"),
-        period_steps=int(os.environ.get("PERIOD_STEPS", "24")),
-        magnitude=float(os.environ.get("MAGNITUDE", "20")),
-        minimum=float(os.environ.get("MINIMUM", "1")),
-        step_s=int(os.environ.get("STEP_S", "1200")),
-        kind=os.environ.get("WAVE", "cosine"),
-        state_path=os.environ.get("STATE_PATH", "/tmp/load-sim-state.json"),
+        deployment=env_str("LOAD_DEPLOY", "load"),
+        namespace=env_str("NAMESPACE", "load"),
+        period_steps=env_int("PERIOD_STEPS", 24),
+        magnitude=env_float("MAGNITUDE", 20.0),
+        minimum=env_float("MINIMUM", 1.0),
+        step_s=env_int("STEP_S", 1200),
+        kind=env_str("WAVE", "cosine"),
+        state_path=env_str("STATE_PATH", "/tmp/load-sim-state.json"),
     )

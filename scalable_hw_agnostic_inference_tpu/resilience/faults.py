@@ -49,7 +49,6 @@ safe on the engine hot path.
 from __future__ import annotations
 
 import logging
-import os
 import random
 import re
 import threading
@@ -233,8 +232,10 @@ def get() -> FaultInjector:
         return inj
     with _global_lock:
         if _global is None:
-            spec = os.environ.get("SHAI_FAULTS", "")
-            seed = int(os.environ.get("SHAI_FAULTS_SEED", "0") or "0")
+            from ..obs.util import env_int, env_str
+
+            spec = env_str("SHAI_FAULTS")
+            seed = env_int("SHAI_FAULTS_SEED", 0)
             try:
                 _global = FaultInjector(spec, seed) if spec else _NOOP
             except ValueError:
@@ -259,8 +260,9 @@ def endpoint_enabled() -> bool:
     production pod must not accept fault writes from anyone who can reach
     its port. ``SHAI_FAULTS`` alone does NOT arm it: a canary running a
     benign env fault must not open an unauthenticated write endpoint."""
-    return (os.environ.get("SHAI_FAULTS_ENDPOINT", "").lower()
-            in ("1", "true", "yes", "on"))
+    from ..obs.util import env_flag
+
+    return bool(env_flag("SHAI_FAULTS_ENDPOINT", False))
 
 
 def reset() -> None:

@@ -7,20 +7,19 @@ from argv or the ``MODEL`` env var, everything else from the env contract
 """
 
 import logging
-import os
 import sys
 
 from ..models.registry import get_model, list_models
-from ..utils.env import ServeConfig
+from ..utils.env import ServeConfig, env_str
 from .app import serve_forever
 
 
 def main() -> None:
     logging.basicConfig(
-        level=os.environ.get("LOG_LEVEL", "INFO"),
+        level=env_str("LOG_LEVEL", "INFO"),
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
-    name = sys.argv[1] if len(sys.argv) > 1 else os.environ.get("MODEL", "")
+    name = sys.argv[1] if len(sys.argv) > 1 else env_str("MODEL", "")
     if not name:
         print(f"usage: python -m scalable_hw_agnostic_inference_tpu.serve <model>\n"
               f"available: {', '.join(list_models())}", file=sys.stderr)

@@ -258,12 +258,12 @@ class VllmService(ModelService):
         # step completing for N x the p99 step time — fails /health so
         # Kubernetes restarts the pod instead of serving a black hole.
         # Thresholds are env-tunable for tiers with legitimately slow steps.
-        import os
+        from ...obs.util import env_float
 
         self._watchdog = StepWatchdog(
             lambda: engine.obs, lambda: engine.has_work,
-            multiplier=float(os.environ.get("SHAI_WATCHDOG_MULT", "30")),
-            min_stall_s=float(os.environ.get("SHAI_WATCHDOG_MIN_S", "10")))
+            multiplier=env_float("SHAI_WATCHDOG_MULT", 30.0),
+            min_stall_s=env_float("SHAI_WATCHDOG_MIN_S", 10.0))
 
     def ready_error(self) -> Optional[str]:
         # a dead engine loop (crashed step()) must drain the pod: /readiness

@@ -16,6 +16,14 @@ import os
 from typing import Any, Dict, Optional
 
 
+# one leniency owner: a malformed knob degrades to its default with a
+# warning instead of crash-looping pod boot (PR 7's SHAI_HBM_WINDOW=8.5
+# lesson, generalized by shai-lint's env-parse rule). Range/enum VALIDATION
+# stays strict below — a value that parses but is out of contract
+# (DEVICE=cuda) still fails loudly.
+from ..obs.util import env_flag, env_float, env_int  # noqa: F401
+
+
 def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
     v = os.environ.get(name)
     if v is None or v == "":
@@ -23,25 +31,8 @@ def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
     return v
 
 
-def env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    if v is None or v == "":
-        return default
-    return int(v)
-
-
-def env_float(name: str, default: float) -> float:
-    v = os.environ.get(name)
-    if v is None or v == "":
-        return default
-    return float(v)
-
-
 def env_bool(name: str, default: bool) -> bool:
-    v = os.environ.get(name)
-    if v is None or v == "":
-        return default
-    return v.lower() in ("1", "true", "yes", "on")
+    return env_flag(name, default)
 
 
 VALID_DEVICES = ("tpu", "cpu")

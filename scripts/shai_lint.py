@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""shai-lint CLI: run the repo's AST invariant checkers over the package.
+
+Checkers (``scalable_hw_agnostic_inference_tpu/analysis/``):
+
+- ``host-sync``      device→host synchronization in declared hot paths
+- ``donation``       reads of donated buffers after the donating dispatch
+- ``thread``         attribute writes vs the declared concurrency contract
+- ``env-parse`` / ``env-read`` / ``env-doc``   env-knob registry rules
+- ``trace-exclude``  debug/poll GET routes must stay off the flight ring
+
+Exit-code contract::
+
+    0   no findings beyond the committed baseline (allowed/annotated and
+        baselined findings are reported, not fatal)
+    1   at least one non-baselined finding
+    2   internal error (bad baseline path, unparseable tree)
+
+Baseline workflow: pre-existing debt lives in ``analysis/baseline.json``
+(line-number-free fingerprints, committed). A new finding fails CI; fixing
+debt leaves stale fingerprints, which this CLI reports so the file shrinks
+monotonically. Refresh with::
+
+    python scripts/shai_lint.py --update-baseline
+
+Intentional violations are annotated in source, not baselined::
+
+    # shai-lint: allow(host-sync) the one blocking fetch of the pipeline
+
+Usage::
+
+    python scripts/shai_lint.py              # human output, gate semantics
+    python scripts/shai_lint.py --json       # machine output (same gate)
+    python scripts/shai_lint.py --rule env-doc
+    python scripts/shai_lint.py --update-baseline
+
+Wired into tier-1 via ``tests/test_static_analysis.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from scalable_hw_agnostic_inference_tpu.analysis import (  # noqa: E402
+    core as lint_core,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of human text")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="only run/report these rule names (repeatable)")
+    ap.add_argument("--baseline", default=lint_core.BASELINE_PATH,
+                    help="findings baseline file")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this run and exit 0")
+    ap.add_argument("--show-allowed", action="store_true",
+                    help="also list allow-annotated findings")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    try:
+        findings = lint_core.run_all()
+        baseline = set(lint_core.load_baseline(args.baseline))
+    except (OSError, SyntaxError, ValueError) as e:
+        # ValueError covers json.JSONDecodeError from a corrupt baseline —
+        # the documented exit-2 internal-error contract, not a "finding"
+        print(f"shai-lint internal error: {e}", file=sys.stderr)
+        return 2
+    # the baseline is rewritten from the UNFILTERED run: --rule narrows
+    # reporting only, never what --update-baseline persists (a filtered
+    # rewrite would silently erase every other rule's baselined debt)
+    all_live = [f for f in findings if not f.allowed]
+    if args.rule:
+        findings = [f for f in findings if f.rule in set(args.rule)]
+
+    live = [f for f in findings if not f.allowed]
+    allowed = [f for f in findings if f.allowed]
+    new = [f for f in live if f.fingerprint not in baseline]
+    baselined = [f for f in live if f.fingerprint in baseline]
+    # staleness is judged against the unfiltered run for the same reason
+    stale = sorted(baseline - {f.fingerprint for f in all_live})
+    dt = time.perf_counter() - t0
+
+    if args.update_baseline:
+        lint_core.save_baseline(all_live, args.baseline)
+        print(f"baseline rewritten: {len(all_live)} finding(s) -> "
+              f"{os.path.relpath(args.baseline, ROOT)}")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in baselined],
+            "allowed": [f.to_dict() for f in allowed],
+            "stale_baseline": stale,
+            "elapsed_s": round(dt, 3),
+        }, indent=1, sort_keys=True))
+        return 1 if new else 0
+
+    print(f"shai-lint: {len(findings)} finding(s) in {dt:.2f}s "
+          f"({len(new)} new, {len(baselined)} baselined, "
+          f"{len(allowed)} allow-annotated)")
+    for f in new:
+        print(f"  NEW        {f.render()}")
+    for f in baselined:
+        print(f"  baselined  {f.render()}")
+    if args.show_allowed:
+        for f in allowed:
+            print(f"  allowed    {f.render()}  # {f.reason}")
+    if stale:
+        print(f"\n{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (fixed debt — run "
+              f"--update-baseline to shrink the file):")
+        for fp in stale:
+            print(f"  {fp}")
+    if new:
+        print("\nFAIL: new findings above are not in the baseline. Fix "
+              "them, annotate intentional ones with\n"
+              "`# shai-lint: allow(<rule>) <reason>`, or (for inherited "
+              "debt only) --update-baseline.", file=sys.stderr)
+        return 1
+    print("OK: no findings beyond the committed baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
