@@ -1,0 +1,16 @@
+# Repo gates. `make lint` is the one-stop static gate (AST + IR + docs +
+# budget); `make lint-fast` suits pre-commit (pair with
+# `python scripts/shai_lint.py --changed` for diff-scoped AST runs).
+
+PY ?= python
+
+.PHONY: lint lint-fast test
+
+lint:
+	$(PY) scripts/check_all.py
+
+lint-fast:
+	$(PY) scripts/check_all.py --fast
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

@@ -17,14 +17,21 @@ makes them mechanical.
 - ``donation``  reads of donated buffers after the donating dispatch
 - ``threads``   attribute-write sites vs the concurrency contract
 - ``envknobs``  env reads must use the lenient parsers + appear in README
+                (+ deploy manifests may only set knobs the code reads)
 - ``routes``    GET debug/poll routes must be in ``trace_exclude``
+- ``ir/``       jaxpr-lint: IR-level checks on the COMPILED executable
+                factories (donation efficacy, dtype drift, collective
+                schedules, host interop, baked constants) — NOT imported
+                here; it needs jax and runs via ``shai_lint.py --ir``
 
 CLI: ``python scripts/shai_lint.py`` (JSON + human output, committed
-findings baseline). Tier-1: ``tests/test_static_analysis.py``.
+findings baseline with rename-stable fingerprints); ``--ir`` for the IR
+pass; ``scripts/check_all.py`` for the one-exit-code repo gate. Tier-1:
+``tests/test_static_analysis.py`` + ``tests/test_ir_analysis.py``.
 
-Layering: imports nothing from the rest of the package and no third-party
-deps — the linter must load in milliseconds and never depend on the code
-it inspects.
+Layering: this package (``ir/`` excepted) imports nothing from the rest
+of the repo and no third-party deps — the AST linter must load in
+milliseconds and never depend on the code it inspects.
 """
 
 from .core import (  # noqa: F401

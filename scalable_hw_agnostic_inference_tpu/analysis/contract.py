@@ -43,6 +43,37 @@ class ClassPolicy:
 
 
 @dataclasses.dataclass(frozen=True)
+class IrSpec:
+    """Declared tables for the jaxpr-lint IR pass (``analysis/ir/``).
+
+    The AST layer checks what Python source says; this layer checks what
+    the COMPILED programs actually are. ``programs`` names registry keys
+    resolved by ``analysis/ir/factories.py`` — each key builds one
+    executable variant (tiny config, CPU/virtual-device mesh) and lowers
+    (where cheap, compiles) it. Keys carry their geometry in the name
+    (``decode_feedback@tp2``) so a finding names the exact variant.
+    """
+
+    #: registry keys analysis/ir/factories.py knows how to build; the IR
+    #: pass builds and checks every one of these
+    programs: Tuple[str, ...] = ()
+    #: program keys whose compute is declared bf16 — dtype-drift applies
+    bf16_programs: Tuple[str, ...] = ()
+    #: program keys that are decode-hot — host-interop applies (a
+    #: pure_callback in a hot executable serializes every step)
+    hot_programs: Tuple[str, ...] = ()
+    #: composition name -> program keys whose collective schedules must be
+    #: IDENTICAL (primitive, axis names, shapes, replica groups, order) —
+    #: divergence between programs that run on the ranks of one slice is
+    #: a runtime hang, not an error message
+    compositions: Dict[str, Tuple[str, ...]] = dataclasses.field(
+        default_factory=dict)
+    #: bytes above which a constant baked into a program body is a
+    #: finding (per-executable HBM bloat the HBM ledger cannot attribute)
+    const_limit_bytes: int = 1 << 16
+
+
+@dataclasses.dataclass(frozen=True)
 class Contract:
     # -- host-sync: declared decode hot paths ------------------------------
     #: repo-relative file -> qualnames whose bodies (nested defs included)
@@ -102,6 +133,9 @@ class Contract:
     #: GET routes (beyond /debug/*) that are poll surfaces and must be
     #: excluded from the flight-recorder trace ring
     poll_routes: Tuple[str, ...] = ()
+
+    # -- IR pass (jaxpr-lint) ----------------------------------------------
+    ir: IrSpec = dataclasses.field(default_factory=IrSpec)
 
 
 #: the live tree's contract ---------------------------------------------------
@@ -211,4 +245,53 @@ DEFAULT_CONTRACT = Contract(
     trace_files=("serve/app.py", "serve/asgi.py"),
     poll_routes=("/profile", "/health", "/readiness", "/health/ready",
                  "/metrics", "/stats"),
+    ir=IrSpec(
+        # every registered executable-factory variant the engine serves
+        # with, built at tiny geometry by analysis/ir/factories.py:
+        # runner.py's prefill/cont/decode (both feedback disciplines)/
+        # verify/cross writers, the AOT export tier (core/aot.py's
+        # artifact analog of per-rank NEFFs), and the SP legs in
+        # parallel/ring.py. @tpN/@spN suffixes lower on an N-way virtual
+        # CPU mesh; @tp2_paged lowers the Pallas paged path for the tpu
+        # platform (trace + SPMD partition only, like the dryrun legs).
+        programs=(
+            "prefill", "prefill@tp2", "prefill_cont",
+            "decode", "decode_feedback",
+            "decode@tp2", "decode_feedback@tp2", "decode@tp2_paged",
+            "verify",
+            "cross_kv", "cross_slot_write",
+            "aot_decode_export",
+            "ring@sp2", "ring_causal@sp2", "ulysses@sp2",
+        ),
+        # the engine's token paths are declared-bf16 compute (residual
+        # stream, KV pool); f32 is legal only behind an explicit astype
+        # (rmsnorm/logits islands). The SP legs are dtype-polymorphic
+        # test rigs, not declared-bf16.
+        bf16_programs=(
+            "prefill", "prefill@tp2", "prefill_cont",
+            "decode", "decode_feedback",
+            "decode@tp2", "decode_feedback@tp2", "decode@tp2_paged",
+            "verify", "cross_kv", "cross_slot_write",
+        ),
+        # a host callback inside any of these serializes every engine
+        # step (decode) or admission (prefill/cross) on the host
+        hot_programs=(
+            "prefill", "prefill@tp2", "prefill_cont",
+            "decode", "decode_feedback",
+            "decode@tp2", "decode_feedback@tp2", "decode@tp2_paged",
+            "verify", "cross_kv", "cross_slot_write",
+        ),
+        compositions={
+            # one multihost slice may roll SHAI_ASYNC_DECODE across its
+            # hosts: the two decode disciplines must keep identical
+            # collective schedules or the first mixed step deadlocks
+            "decode-disciplines@tp2": ("decode@tp2",
+                                       "decode_feedback@tp2"),
+            # the causal flag must not change ring attention's
+            # communication pattern (a causal "optimization" that skips
+            # rotations per-rank is exactly how ring impls deadlock)
+            "ring-mask-variants@sp2": ("ring@sp2", "ring_causal@sp2"),
+        },
+        const_limit_bytes=1 << 16,
+    ),
 )

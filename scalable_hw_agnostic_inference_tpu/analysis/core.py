@@ -33,6 +33,7 @@ PKG_ROOT = os.path.join(REPO_ROOT, "scalable_hw_agnostic_inference_tpu")
 BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "baseline.json")
 README_PATH = os.path.join(REPO_ROOT, "README.md")
+DEPLOY_ROOT = os.path.join(REPO_ROOT, "deploy")
 
 _ALLOW_RE = re.compile(
     r"#\s*shai-lint:\s*allow\(([a-zA-Z0-9_\-, ]+)\)\s*(.*)$")
@@ -42,19 +43,24 @@ _ALLOW_RE = re.compile(
 class Finding:
     """One checker hit. ``context`` is a stable anchor (qualname, env var
     name, route pattern); ``message`` must be line-number-free so the
-    baseline fingerprint survives code motion."""
+    baseline fingerprint survives code motion. ``snippet`` is the
+    whitespace-normalized source of the offending node's first line —
+    fingerprints are built from (rule, context, message, snippet), never
+    from ``path`` or ``line``, so moving a file (or the code within it)
+    does not resurrect every baselined finding under new fingerprints."""
 
     rule: str
-    path: str           # repo-relative, forward slashes
+    path: str           # repo-relative, forward slashes (display only)
     line: int
     context: str
     message: str
     allowed: bool = False   # suppressed by a valid inline allow comment
     reason: str = ""        # the allow comment's reason when allowed
+    snippet: str = ""       # normalized source anchor (display + identity)
 
     @property
     def fingerprint(self) -> str:
-        return f"{self.rule}|{self.path}|{self.context}|{self.message}"
+        return f"{self.rule}|{self.context}|{self.message}|{self.snippet}"
 
     def to_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -130,6 +136,16 @@ class Module:
         return False, "", None
 
 
+def snippet_of(module: Module, node: ast.AST) -> str:
+    """Whitespace-normalized source of ``node``'s first line — the
+    path-free half of a finding's identity (the other half is the
+    qualified ``context``)."""
+    lineno = getattr(node, "lineno", 0)
+    if not 1 <= lineno <= len(module.lines):
+        return ""
+    return " ".join(module.lines[lineno - 1].split())
+
+
 def dotted(node: ast.AST) -> Optional[str]:
     """``a.b.c`` for a Name/Attribute chain; None for anything else."""
     parts: List[str] = []
@@ -181,6 +197,31 @@ def iter_modules(pkg_root: str = PKG_ROOT) -> List[Module]:
     return mods
 
 
+def deploy_env_names(deploy_root: str = DEPLOY_ROOT
+                     ) -> Dict[str, Tuple[str, int]]:
+    """``SHAI_*`` names set in K8s manifests (and the generator that
+    renders them): name -> first (repo-relative path, line). A name here
+    that no code reads is a typo'd knob silently no-oping in YAML."""
+    import re as _re
+
+    pat = _re.compile(r"\bSHAI_[A-Z0-9_]+\b")
+    out: Dict[str, Tuple[str, int]] = {}
+    if not os.path.isdir(deploy_root):
+        return out
+    for dirpath, dirnames, filenames in os.walk(deploy_root):
+        dirnames[:] = sorted(dirnames)
+        for fn in sorted(filenames):
+            if not fn.endswith((".yaml", ".yml", ".py")):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, REPO_ROOT).replace(os.sep, "/")
+            with open(full, encoding="utf-8") as f:
+                for i, ln in enumerate(f, 1):
+                    for m in pat.finditer(ln):
+                        out.setdefault(m.group(0), (rel, i))
+    return out
+
+
 # -- baseline ----------------------------------------------------------------
 
 def load_baseline(path: str = BASELINE_PATH) -> List[str]:
@@ -193,10 +234,18 @@ def load_baseline(path: str = BASELINE_PATH) -> List[str]:
 
 
 def save_baseline(findings: Iterable[Finding],
-                  path: str = BASELINE_PATH) -> None:
-    fps = sorted({f.fingerprint for f in findings if not f.allowed})
+                  path: str = BASELINE_PATH,
+                  carry: Iterable[str] = ()) -> None:
+    # version 2: rename-stable fingerprints (rule|context|message|snippet,
+    # no path segment). Version-1 entries still load — they simply never
+    # match a fresh finding, so they surface as stale and the file shrinks
+    # through the normal --update-baseline workflow. ``carry`` preserves
+    # fingerprints owned by a pass that did not run (the CLI rewrites one
+    # pass's rules at a time).
+    fps = sorted({f.fingerprint for f in findings if not f.allowed}
+                 | set(carry))
     with open(path, "w") as f:
-        json.dump({"version": 1, "findings": fps}, f, indent=1,
+        json.dump({"version": 2, "findings": fps}, f, indent=1,
                   sort_keys=True)
         f.write("\n")
 
@@ -204,9 +253,13 @@ def save_baseline(findings: Iterable[Finding],
 # -- runner ------------------------------------------------------------------
 
 def run_all(modules: Optional[List[Module]] = None, contract=None,
-            readme_text: Optional[str] = None) -> List[Finding]:
-    """Run every checker; returns ALL findings (allowed ones included,
-    flagged) sorted by (path, line, rule). Callers filter on ``allowed``."""
+            readme_text: Optional[str] = None,
+            deploy_names: Optional[Dict[str, Tuple[str, int]]] = None
+            ) -> List[Finding]:
+    """Run every AST checker; returns ALL findings (allowed ones included,
+    flagged) sorted by (path, line, rule). Callers filter on ``allowed``.
+    The IR pass (``analysis/ir``) is separate — it imports jax and is run
+    explicitly via ``scripts/shai_lint.py --ir``."""
     from . import donation, envknobs, hostsync, routes, threads
     from .contract import DEFAULT_CONTRACT
 
@@ -219,11 +272,14 @@ def run_all(modules: Optional[List[Module]] = None, contract=None,
                 readme_text = f.read()
         except OSError:
             readme_text = ""
+    if deploy_names is None:
+        deploy_names = deploy_env_names()
     findings: List[Finding] = []
     findings += hostsync.check(modules, contract)
     findings += donation.check(modules, contract)
     findings += threads.check(modules, contract)
-    findings += envknobs.check(modules, contract, readme_text)
+    findings += envknobs.check(modules, contract, readme_text,
+                               deploy_names=deploy_names)
     findings += routes.check(modules, contract)
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings

@@ -37,7 +37,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
-from .core import Finding, Module, dotted, resolved_dotted
+from .core import Finding, Module, dotted, resolved_dotted, snippet_of
 
 RULE = "donation"
 
@@ -301,7 +301,7 @@ class _Scope:
             self.findings.append(Finding(
                 rule=RULE, path=self.module.relpath, line=node.lineno,
                 context=self.qual, message=msg, allowed=allowed,
-                reason=reason))
+                reason=reason, snippet=snippet_of(self.module, node)))
             del self.watch[path]  # one finding per donated path
 
     def _kill_stores(self, stmt: ast.stmt) -> None:

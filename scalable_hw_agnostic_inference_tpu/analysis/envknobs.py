@@ -18,6 +18,9 @@ package, three sub-rules:
   sites, parser calls, and every ``SHAI_*`` string literal — must appear
   in README.md (the operator contract; subsumes the metric-docs gate's
   approach for env vars).
+- ``env-deploy``: every ``SHAI_*`` name a K8s manifest under ``deploy/``
+  sets must be one the code actually reads — a typo'd knob in YAML
+  parses, applies, and silently no-ops today; this makes it a finding.
 """
 
 from __future__ import annotations
@@ -26,7 +29,7 @@ import ast
 import re
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Finding, Module, resolved_dotted, str_arg
+from .core import Finding, Module, resolved_dotted, snippet_of, str_arg
 
 #: a SHAI_* knob name anywhere in source (docstrings/comments included —
 #: if the code talks about it, the operator doc must too)
@@ -63,7 +66,8 @@ def _wrapped_in_cast(node: ast.AST) -> Optional[str]:
     return None
 
 
-def check(modules: List[Module], contract, readme_text: str
+def check(modules: List[Module], contract, readme_text: str,
+          deploy_names: Optional[Dict[str, Tuple[str, int]]] = None
           ) -> List[Finding]:
     findings: List[Finding] = []
     #: name -> first (path, line) that reads it (doc check anchor)
@@ -115,7 +119,8 @@ def check(modules: List[Module], contract, readme_text: str
                     rule="env-parse", path=path, line=node.lineno,
                     context=name, message=msg,
                     allowed=allowed or exempt_reason is not None,
-                    reason=reason or (exempt_reason or "")))
+                    reason=reason or (exempt_reason or ""),
+                    snippet=snippet_of(module, node)))
             else:
                 msg = ("direct environment read bypasses the parser seam "
                        "(obs/util.py, utils/env.py)")
@@ -125,7 +130,8 @@ def check(modules: List[Module], contract, readme_text: str
                     rule="env-read", path=path, line=node.lineno,
                     context=name, message=msg,
                     allowed=allowed or exempt_reason is not None,
-                    reason=reason or (exempt_reason or "")))
+                    reason=reason or (exempt_reason or ""),
+                    snippet=snippet_of(module, node)))
 
     for name in sorted(registered):
         if name in contract.env_doc_exempt or name in readme_text:
@@ -136,4 +142,15 @@ def check(modules: List[Module], contract, readme_text: str
             message=("env knob is read/declared in code but absent from "
                      "README.md — document it in the environment-knob "
                      "registry")))
+
+    # manifests may only set names the code reads: a typo'd SHAI_ knob in
+    # YAML is accepted by the cluster and ignored by every pod
+    for name in sorted(deploy_names or {}):
+        if name in registered or name in contract.env_doc_exempt:
+            continue
+        path, line = deploy_names[name]
+        findings.append(Finding(
+            rule="env-deploy", path=path, line=line, context=name,
+            message=("env knob is set in a deploy manifest but no code "
+                     "reads it — a typo'd name here silently no-ops")))
     return findings

@@ -26,7 +26,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional, Tuple
 
-from .core import Finding, Module, resolved_dotted
+from .core import Finding, Module, resolved_dotted, snippet_of
 
 RULE = "host-sync"
 
@@ -54,8 +54,28 @@ def _sync_kind(module: Module, node: ast.Call) -> Optional[str]:
     return None
 
 
+def _lambda_targets(stmt: ast.AST) -> List[Tuple[str, ast.Lambda]]:
+    """(name, lambda node) for ``name = lambda ...`` assignments — a
+    callable bound this way is a function in every sense the hot-path
+    contract cares about, so it inherits hot scope exactly like a def."""
+    if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+        return []
+    value = getattr(stmt, "value", None)
+    if not isinstance(value, ast.Lambda):
+        return []
+    targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+    out = []
+    for t in targets:
+        name = t.id if isinstance(t, ast.Name) else (
+            t.attr if isinstance(t, ast.Attribute) else None)
+        if name:
+            out.append((name, value))
+    return out
+
+
 def _qualname_defs(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
-    """(qualname, def node) for every function, ``Class.method`` style."""
+    """(qualname, body node) for every function — ``def``, ``async def``,
+    and assigned ``lambda`` alike — ``Class.method`` style."""
     out: List[Tuple[str, ast.AST]] = []
 
     def walk(node: ast.AST, prefix: str) -> None:
@@ -67,6 +87,8 @@ def _qualname_defs(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
             elif isinstance(child, ast.ClassDef):
                 walk(child, f"{prefix}{child.name}.")
             else:
+                for name, lam in _lambda_targets(child):
+                    out.append((f"{prefix}{name}", lam))
                 walk(child, prefix)
 
     walk(tree, "")
@@ -104,5 +126,5 @@ def check(modules: List[Module], contract) -> List[Finding]:
                 findings.append(Finding(
                     rule=RULE, path=module.relpath, line=node.lineno,
                     context=qual, message=msg, allowed=allowed,
-                    reason=reason))
+                    reason=reason, snippet=snippet_of(module, node)))
     return findings

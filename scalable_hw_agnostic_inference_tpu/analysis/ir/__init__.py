@@ -1,0 +1,54 @@
+"""jaxpr-lint: IR-level invariant checking for compiled executables.
+
+PR 8's ``shai-lint`` checks what Python source SAYS; the bug classes that
+actually hang a slice or blow an HBM budget live below the AST — XLA
+silently drops a declared donation on an aval mismatch, a non-weak f32
+scalar promotes a bf16 hot path, two rank programs of one TP composition
+disagree on their collective schedule, a host callback serializes the
+step loop, a closed-over array bloats every compiled bucket. This
+package lowers (and where cheap, compiles on CPU / virtual devices) the
+REGISTERED executable factories and checks five rules against the IR:
+
+- ``program``    IrProgram: trace/lower/compile/export one factory
+                 variant and expose its jaxpr, aliasing table, collective
+                 schedule, consts, and callbacks
+- ``factories``  the program registry: every factory the engine serves
+                 with, built at tiny geometry (``contract.ir.programs``)
+- ``rules``      donation-efficacy, dtype-drift, collective-schedule,
+                 host-interop, baked-constants
+
+Findings flow through the PR 8 machinery end-to-end: ``analysis.core``
+Findings with rename-stable fingerprints, the inline allow grammar
+anchored at the factory ``def``, the committed baseline, and the
+``scripts/shai_lint.py`` CLI (``--ir``; same 0/1/2 exit contract).
+
+Layering: this subpackage imports jax (lazily, inside functions) — it is
+NOT imported by ``analysis/__init__`` or any AST checker, so plain
+shai-lint still loads in milliseconds.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..core import Finding
+from .rules import IR_RULES  # noqa: F401
+
+
+def run_ir(contract=None, keys: Optional[Tuple[str, ...]] = None,
+           rules: Optional[Tuple[str, ...]] = None) -> List[Finding]:
+    """Build, prepare, and check the registered IR programs.
+
+    ``keys`` narrows the program selection (compositions with missing
+    members are skipped); ``rules`` narrows the rule set. Requires a
+    jax backend with >= 2 (virtual CPU) devices for the @tp2/@sp2 legs —
+    ``scripts/shai_lint.py --ir`` sets that up before importing jax.
+    """
+    from ..contract import DEFAULT_CONTRACT
+    from . import factories, rules as irrules
+
+    contract = contract or DEFAULT_CONTRACT
+    progs = factories.build_programs(contract, keys)
+    for p in progs:
+        p.prepare()
+    return irrules.check(progs, contract, rules)

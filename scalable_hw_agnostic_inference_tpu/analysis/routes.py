@@ -16,7 +16,7 @@ from __future__ import annotations
 import ast
 from typing import List, Set
 
-from .core import Finding, Module, dotted
+from .core import Finding, Module, dotted, snippet_of
 
 RULE = "trace-exclude"
 
@@ -81,5 +81,5 @@ def check(modules: List[Module], contract) -> List[Finding]:
             findings.append(Finding(
                 rule=RULE, path=module.relpath, line=deco.lineno,
                 context=pattern, message=msg, allowed=allowed,
-                reason=reason))
+                reason=reason, snippet=snippet_of(module, deco)))
     return findings

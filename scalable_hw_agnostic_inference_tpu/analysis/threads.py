@@ -31,7 +31,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Finding, Module, dotted
+from .core import Finding, Module, dotted, snippet_of
 
 RULE = "thread"
 
@@ -65,12 +65,28 @@ def _holds_lock(node: ast.AST, lock_paths: Set[str]) -> bool:
     return False
 
 
+def _class_callables(cls: ast.ClassDef):
+    """(name, body node) for every callable in the class body: ``def``,
+    ``async def``, and ``name = lambda ...`` attributes — a mutator call
+    inside a class-level lambda is a write site like any other."""
+    for n in cls.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield n.name, n
+        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Lambda):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    yield t.id, n.value
+        elif isinstance(n, ast.AnnAssign) \
+                and isinstance(n.value, ast.Lambda) \
+                and isinstance(n.target, ast.Name):
+            yield n.target.id, n.value
+
+
 def _self_write_sites(cls: ast.ClassDef):
-    """Yield (method node, attr, site node, kind) for every write through
+    """Yield (method name, attr, site node, kind) for every write through
     ``self`` in the class body: plain/aug assigns to ``self.X``, subscript
     stores into ``self.X[...]``, and mutator calls ``self.X.m(...)``."""
-    for method in [n for n in cls.body
-                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+    for method_name, method in _class_callables(cls):
         for node in ast.walk(method):
             targets: List[ast.AST] = []
             if isinstance(node, ast.Assign):
@@ -83,14 +99,14 @@ def _self_write_sites(cls: ast.ClassDef):
                             and isinstance(leaf.value, ast.Name) \
                             and leaf.value.id == "self" \
                             and isinstance(leaf.ctx, ast.Store):
-                        yield method, leaf.attr, node, "write"
+                        yield method_name, leaf.attr, node, "write"
                     elif isinstance(leaf, ast.Subscript) \
                             and isinstance(leaf.ctx, ast.Store):
                         base = leaf.value
                         if isinstance(base, ast.Attribute) \
                                 and isinstance(base.value, ast.Name) \
                                 and base.value.id == "self":
-                            yield method, base.attr, node, "item write"
+                            yield method_name, base.attr, node, "item write"
             if isinstance(node, ast.Call) \
                     and isinstance(node.func, ast.Attribute) \
                     and node.func.attr in _MUTATORS:
@@ -98,7 +114,7 @@ def _self_write_sites(cls: ast.ClassDef):
                 if isinstance(recv, ast.Attribute) \
                         and isinstance(recv.value, ast.Name) \
                         and recv.value.id == "self":
-                    yield method, recv.attr, node, f".{node.func.attr}()"
+                    yield method_name, recv.attr, node, f".{node.func.attr}()"
 
 
 def _finding(module: Module, node: ast.AST, context: str, message: str
@@ -108,28 +124,28 @@ def _finding(module: Module, node: ast.AST, context: str, message: str
         message += f" ({problem})"
     return Finding(rule=RULE, path=module.relpath, line=node.lineno,
                    context=context, message=message, allowed=allowed,
-                   reason=reason)
+                   reason=reason, snippet=snippet_of(module, node))
 
 
 def _check_class_body(module: Module, cls: ast.ClassDef, policy,
                       findings: List[Finding]) -> None:
     lock_attrs = set(policy.lock_guarded)
-    for method, attr, node, kind in _self_write_sites(cls):
-        in_init = method.name in policy.init_methods
+    for method_name, attr, node, kind in _self_write_sites(cls):
+        in_init = method_name in policy.init_methods
         if attr in policy.immutable_after_init and not in_init \
                 and not kind.startswith("."):
             # mutator CALLS (`self.cache.extend(...)`) are the attr's own
             # object managing itself — immutability here is about the
             # BINDING (and direct item stores into it) staying fixed
             findings.append(_finding(
-                module, node, f"{cls.name}.{method.name}",
+                module, node, f"{cls.name}.{method_name}",
                 f"{kind} to immutable-after-init attr `{attr}` outside "
                 f"{'/'.join(policy.init_methods)}"))
         elif attr in lock_attrs and not in_init:
             lock = policy.lock_guarded[attr]
             if not _holds_lock(node, {f"self.{lock}", lock}):
                 findings.append(_finding(
-                    module, node, f"{cls.name}.{method.name}",
+                    module, node, f"{cls.name}.{method_name}",
                     f"{kind} to lock-guarded attr `{attr}` outside "
                     f"`with self.{lock}`"))
 

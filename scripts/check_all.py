@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""One-shot repo gate: every static check under a single exit code.
+
+Runs, in order (each in its own subprocess so one crash cannot mask the
+rest):
+
+1. ``scripts/shai_lint.py``            AST invariant checkers (~1.5s)
+2. ``scripts/shai_lint.py --ir``       jaxpr-lint IR pass (lowers the
+                                       registered executable factories
+                                       on virtual CPU devices, ~10s)
+3. ``scripts/check_metrics_docs.py``   every shai_* metric documented
+4. ``scripts/check_tier1_budget.py``   tier-1 selection inside budget
+
+Exit code is the MAX of the individual codes, so the 0/1/2 contract of
+shai-lint survives aggregation (1 = findings somewhere, 2 = an internal
+error somewhere). ``make lint`` is an alias for this script; pass
+``--fast`` to skip the two slower gates (IR + budget) for pre-commit use
+alongside ``shai_lint.py --changed``.
+
+Usage::
+
+    python scripts/check_all.py            # the full gate
+    python scripts/check_all.py --fast     # AST + metrics docs only
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHECKS = (
+    ("shai-lint (AST)", ["scripts/shai_lint.py"], True),
+    ("jaxpr-lint (IR)", ["scripts/shai_lint.py", "--ir"], False),
+    ("metrics docs", ["scripts/check_metrics_docs.py"], True),
+    ("tier-1 budget", ["scripts/check_tier1_budget.py"], False),
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fast", action="store_true",
+                    help="skip the slower gates (IR pass, tier-1 budget)")
+    args = ap.parse_args()
+
+    worst = 0
+    results = []
+    for name, argv, in_fast in CHECKS:
+        if args.fast and not in_fast:
+            results.append((name, None, 0.0))
+            continue
+        t0 = time.perf_counter()
+        r = subprocess.run([sys.executable] + argv, cwd=ROOT,
+                           capture_output=True, text=True)
+        dt = time.perf_counter() - t0
+        results.append((name, r.returncode, dt))
+        worst = max(worst, r.returncode)
+        if r.returncode:
+            print(f"--- {name} FAILED (exit {r.returncode}) " + "-" * 30)
+            sys.stdout.write(r.stdout)
+            sys.stderr.write(r.stderr)
+
+    print("\ncheck_all summary:")
+    for name, rc, dt in results:
+        state = ("skipped (--fast)" if rc is None
+                 else f"{'ok' if rc == 0 else f'FAIL ({rc})'} in {dt:.1f}s")
+        print(f"  {name:<18} {state}")
+    print(f"exit {worst}")
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())

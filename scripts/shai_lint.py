@@ -1,40 +1,62 @@
 #!/usr/bin/env python
-"""shai-lint CLI: run the repo's AST invariant checkers over the package.
+"""shai-lint CLI: the repo's AST and IR invariant checkers.
 
-Checkers (``scalable_hw_agnostic_inference_tpu/analysis/``):
+AST checkers (``scalable_hw_agnostic_inference_tpu/analysis/``, default —
+stdlib-only, whole tree in ~1.5s):
 
 - ``host-sync``      device→host synchronization in declared hot paths
 - ``donation``       reads of donated buffers after the donating dispatch
 - ``thread``         attribute writes vs the declared concurrency contract
-- ``env-parse`` / ``env-read`` / ``env-doc``   env-knob registry rules
+- ``env-parse`` / ``env-read`` / ``env-doc`` / ``env-deploy``   env-knob
+                     registry rules (deploy/ manifests included)
 - ``trace-exclude``  debug/poll GET routes must stay off the flight ring
 
-Exit-code contract::
+IR checkers (``--ir``; ``analysis/ir/`` — lowers and, where cheap,
+compiles the registered executable factories on virtual CPU devices):
+
+- ``donation-efficacy``   declared donate_argnums vs actual aliasing
+- ``dtype-drift``         implicit bf16→f32 promotion in bf16 compute
+- ``collective-schedule`` rank-composition collective schedules identical
+- ``host-interop``        pure/io/debug callbacks in hot executables
+- ``baked-constants``     oversized constants embedded in programs
+
+Exit-code contract (both passes)::
 
     0   no findings beyond the committed baseline (allowed/annotated and
         baselined findings are reported, not fatal)
     1   at least one non-baselined finding
-    2   internal error (bad baseline path, unparseable tree)
+    2   internal error (bad baseline path, unparseable tree, IR build
+        failure)
 
 Baseline workflow: pre-existing debt lives in ``analysis/baseline.json``
-(line-number-free fingerprints, committed). A new finding fails CI; fixing
-debt leaves stale fingerprints, which this CLI reports so the file shrinks
-monotonically. Refresh with::
+(rename-stable fingerprints — rule|context|message|snippet, no path —
+committed). A new finding fails CI; fixing debt leaves stale
+fingerprints, which this CLI reports so the file shrinks monotonically.
+Staleness is judged only against the rules the invocation actually ran
+(an AST-only run never calls IR debt stale). Refresh with::
 
-    python scripts/shai_lint.py --update-baseline
+    python scripts/shai_lint.py --update-baseline          # AST rules
+    python scripts/shai_lint.py --ir --update-baseline     # IR rules
 
 Intentional violations are annotated in source, not baselined::
 
     # shai-lint: allow(host-sync) the one blocking fetch of the pipeline
+    # shai-lint: allow(baked-constants) cos/sin table, priced in budget
+
+(IR rule annotations go on/above the factory ``def``.)
 
 Usage::
 
-    python scripts/shai_lint.py              # human output, gate semantics
-    python scripts/shai_lint.py --json       # machine output (same gate)
+    python scripts/shai_lint.py                  # AST, human output
+    python scripts/shai_lint.py --json           # machine output
+    python scripts/shai_lint.py --changed        # only git-changed files
+    python scripts/shai_lint.py --ir             # the IR pass (needs jax)
+    python scripts/shai_lint.py --ir --keys decode,decode_feedback
     python scripts/shai_lint.py --rule env-doc
-    python scripts/shai_lint.py --update-baseline
 
-Wired into tier-1 via ``tests/test_static_analysis.py``.
+Wired into tier-1 via ``tests/test_static_analysis.py`` and
+``tests/test_ir_analysis.py``; ``scripts/check_all.py`` runs both passes
+plus the docs/budget gates under one exit code.
 """
 
 from __future__ import annotations
@@ -42,6 +64,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -52,6 +75,72 @@ from scalable_hw_agnostic_inference_tpu.analysis import (  # noqa: E402
     core as lint_core,
 )
 
+AST_RULES = ("host-sync", "donation", "thread", "env-parse", "env-read",
+             "env-doc", "env-deploy", "trace-exclude")
+
+
+def _changed_relpaths() -> set:
+    """Package-relative paths of files changed vs HEAD (staged, unstaged,
+    and untracked)."""
+    out = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        r = subprocess.run(cmd, capture_output=True, text=True, cwd=ROOT)
+        if r.returncode:
+            continue
+        for ln in r.stdout.splitlines():
+            ln = ln.strip()
+            prefix = "scalable_hw_agnostic_inference_tpu/"
+            if ln.startswith(prefix) and ln.endswith(".py"):
+                out.add(ln[len(prefix):])
+    return out
+
+
+def _run_ast(args) -> list:
+    if not args.changed:
+        return lint_core.run_all()
+    changed = _changed_relpaths()
+    if not changed:
+        return []
+    from scalable_hw_agnostic_inference_tpu.analysis.contract import (
+        DEFAULT_CONTRACT,
+    )
+
+    contract = DEFAULT_CONTRACT
+    # changed files plus the cross-file ground truth the checkers read
+    # (factory registry, trace_exclude literals) — report only on changed
+    needed = changed | set(contract.donation_factory_files) \
+        | set(contract.trace_files)
+    modules = [m for m in lint_core.iter_modules()
+               if m.relpath in needed]
+    findings = lint_core.run_all(modules=modules, contract=contract,
+                                 deploy_names={})
+    return [f for f in findings if f.path in changed]
+
+
+def _run_ir(args) -> list:
+    # the IR pass needs a CPU backend with virtual devices for the
+    # @tp2/@sp2 legs — force it BEFORE jax initializes, plus the live
+    # config update for environments where sitecustomize already
+    # imported jax (tests/conftest.py discipline)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except (AttributeError, RuntimeError):
+        pass  # 0.4.x has no jax_num_cpu_devices / backend already up
+    from scalable_hw_agnostic_inference_tpu.analysis.ir import run_ir
+
+    keys = tuple(k.strip() for k in args.keys.split(",")
+                 if k.strip()) if args.keys else None
+    return run_ir(keys=keys)
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(
@@ -61,26 +150,54 @@ def main() -> int:
                     help="emit one JSON object instead of human text")
     ap.add_argument("--rule", action="append", default=None,
                     help="only run/report these rule names (repeatable)")
+    ap.add_argument("--ir", action="store_true",
+                    help="run the IR (jaxpr-lint) pass instead of the "
+                         "AST pass — lowers the registered executable "
+                         "factories (imports jax)")
+    ap.add_argument("--keys", default=None,
+                    help="--ir only: comma-separated program keys to "
+                         "build (default: every registered program)")
+    ap.add_argument("--changed", action="store_true",
+                    help="AST only: lint just the files git reports "
+                         "changed vs HEAD (pre-commit speed; staleness "
+                         "reporting is skipped)")
     ap.add_argument("--baseline", default=lint_core.BASELINE_PATH,
                     help="findings baseline file")
     ap.add_argument("--update-baseline", action="store_true",
-                    help="rewrite the baseline from this run and exit 0")
+                    help="rewrite this pass's rules in the baseline from "
+                         "this run and exit 0")
     ap.add_argument("--show-allowed", action="store_true",
                     help="also list allow-annotated findings")
     args = ap.parse_args()
+    if args.changed and args.ir:
+        print("--changed applies to the AST pass only", file=sys.stderr)
+        return 2
+    if args.update_baseline and (args.changed or args.keys):
+        # a partial view (changed files / a key subset) cannot be allowed
+        # to rewrite the baseline: debt outside the view would be erased
+        # and resurface as NEW on the next full run
+        print("--update-baseline requires a full run of its pass "
+              "(drop --changed / --keys)", file=sys.stderr)
+        return 2
 
     t0 = time.perf_counter()
     try:
-        findings = lint_core.run_all()
+        findings = _run_ir(args) if args.ir else _run_ast(args)
         baseline = set(lint_core.load_baseline(args.baseline))
-    except (OSError, SyntaxError, ValueError) as e:
+    except (OSError, SyntaxError, ValueError, KeyError, RuntimeError) as e:
         # ValueError covers json.JSONDecodeError from a corrupt baseline —
         # the documented exit-2 internal-error contract, not a "finding"
         print(f"shai-lint internal error: {e}", file=sys.stderr)
         return 2
-    # the baseline is rewritten from the UNFILTERED run: --rule narrows
-    # reporting only, never what --update-baseline persists (a filtered
-    # rewrite would silently erase every other rule's baselined debt)
+    # the baseline is rewritten from the UNFILTERED run of THIS pass:
+    # --rule narrows reporting only, never what --update-baseline
+    # persists, and the other pass's entries are carried over untouched
+    if args.ir:
+        from scalable_hw_agnostic_inference_tpu.analysis.ir import IR_RULES
+
+        own_rules = set(IR_RULES)
+    else:
+        own_rules = set(AST_RULES)
     all_live = [f for f in findings if not f.allowed]
     if args.rule:
         findings = [f for f in findings if f.rule in set(args.rule)]
@@ -89,18 +206,26 @@ def main() -> int:
     allowed = [f for f in findings if f.allowed]
     new = [f for f in live if f.fingerprint not in baseline]
     baselined = [f for f in live if f.fingerprint in baseline]
-    # staleness is judged against the unfiltered run for the same reason
-    stale = sorted(baseline - {f.fingerprint for f in all_live})
+    # staleness is judged against the unfiltered run, and only for the
+    # rules this invocation executed (fingerprints lead with the rule
+    # name); --changed sees a partial tree, so it skips the judgement
+    stale = [] if args.changed else sorted(
+        fp for fp in baseline - {f.fingerprint for f in all_live}
+        if fp.split("|", 1)[0] in own_rules)
     dt = time.perf_counter() - t0
 
     if args.update_baseline:
-        lint_core.save_baseline(all_live, args.baseline)
-        print(f"baseline rewritten: {len(all_live)} finding(s) -> "
+        keep = [fp for fp in baseline
+                if fp.split("|", 1)[0] not in own_rules]
+        lint_core.save_baseline(all_live, args.baseline, carry=keep)
+        print(f"baseline rewritten: {len(all_live)} finding(s) from this "
+              f"pass (+{len(keep)} carried) -> "
               f"{os.path.relpath(args.baseline, ROOT)}")
         return 0
 
     if args.json:
         print(json.dumps({
+            "pass": "ir" if args.ir else "ast",
             "new": [f.to_dict() for f in new],
             "baselined": [f.to_dict() for f in baselined],
             "allowed": [f.to_dict() for f in allowed],
@@ -109,7 +234,8 @@ def main() -> int:
         }, indent=1, sort_keys=True))
         return 1 if new else 0
 
-    print(f"shai-lint: {len(findings)} finding(s) in {dt:.2f}s "
+    what = "jaxpr-lint (IR)" if args.ir else "shai-lint"
+    print(f"{what}: {len(findings)} finding(s) in {dt:.2f}s "
           f"({len(new)} new, {len(baselined)} baselined, "
           f"{len(allowed)} allow-annotated)")
     for f in new:

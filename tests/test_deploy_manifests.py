@@ -123,6 +123,32 @@ def test_units_and_jobs_cover_the_matrix():
     assert big, "need a >=32-chip multi-host unit (70B TP=32 parity)"
 
 
+def test_manifest_env_knobs_are_read_by_code():
+    """Every SHAI_* env name a manifest (or gen_units.py) sets must be
+    one the package actually reads — shai-lint's env-deploy rule, run
+    here so a typo'd knob in YAML fails the manifest suite, not just the
+    lint gate."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    from scalable_hw_agnostic_inference_tpu.analysis import (
+        core as lint_core,
+    )
+    from scalable_hw_agnostic_inference_tpu.analysis import envknobs
+    from scalable_hw_agnostic_inference_tpu.analysis.contract import (
+        DEFAULT_CONTRACT,
+    )
+
+    names = lint_core.deploy_env_names()
+    assert names, "deploy/ scan found no SHAI_ names — scanner broken?"
+    findings = [
+        f for f in envknobs.check(lint_core.iter_modules(),
+                                  DEFAULT_CONTRACT, "ignored",
+                                  deploy_names=names)
+        if f.rule == "env-deploy" and not f.allowed]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 def test_cova_models_config_names_defined_services(objects):
     """The cova ConfigMap's models.json URLs point at in-tree Services."""
     import json

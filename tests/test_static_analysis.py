@@ -102,6 +102,61 @@ class TestHostSync:
         found = live(hostsync.check([m], HOT))
         assert len(found) == 1
 
+    def test_async_def_hot_path_and_nested_async_inherit(self):
+        c = dataclasses.replace(
+            Contract(), hot_paths={"engine/engine.py": ("Engine._steady",)})
+        m = mod("engine/engine.py", """\
+            import numpy as np
+
+            class Engine:
+                async def _steady(self, pipe):
+                    a = np.asarray(pipe.nxt)
+
+                    async def inner():
+                        return np.asarray(pipe.top)
+                    return a, await inner()
+
+                async def _event(self, pipe):
+                    return np.asarray(pipe.nxt)   # not hot
+            """)
+        found = live(hostsync.check([m], c))
+        assert len(found) == 2
+        assert all(f.context.startswith("Engine._steady") for f in found)
+
+    def test_lambda_assigned_to_hot_name_inherits_scope(self):
+        # a hot path rebound as `name = lambda ...` is the same contract
+        m = mod("engine/engine.py", """\
+            import numpy as np
+
+            class Engine:
+                _steady = lambda self, pipe: np.asarray(pipe.nxt)
+            """)
+        found = live(hostsync.check([m], HOT))
+        assert len(found) == 1 and found[0].context == "Engine._steady"
+
+    def test_lambda_nested_in_hot_body_inherits_scope(self):
+        m = mod("engine/engine.py", """\
+            import numpy as np
+
+            class Engine:
+                def _steady(self, running):
+                    pull = lambda s: np.asarray(s.nxt)
+                    return [pull(s) for s in running]
+            """)
+        found = live(hostsync.check([m], HOT))
+        assert len(found) == 1
+
+    def test_module_level_lambda_under_star_scope(self):
+        c = dataclasses.replace(
+            Contract(), hot_paths={"engine/resident.py": ("*",)})
+        m = mod("engine/resident.py", """\
+            import numpy as np
+
+            fetch = lambda x: np.asarray(x)
+            """)
+        found = live(hostsync.check([m], c))
+        assert len(found) == 1 and found[0].context == "fetch"
+
     def test_allowlisted_with_reason_and_without(self):
         m = mod("engine/engine.py", """\
             import numpy as np
@@ -356,6 +411,30 @@ class TestThreadDiscipline:
         found = threads.check([m], THR)
         assert len(found) == 1 and found[0].allowed
 
+    def test_class_body_lambda_mutator_checked(self):
+        # a lock-guarded mutation hidden in a class-level lambda is a
+        # write site like any other
+        m = mod("engine/loop.py", """\
+            class Loop:
+                def __init__(self):
+                    self._futures = {}
+
+                flush = lambda self: self._futures.clear()
+            """)
+        found = live(threads.check([m], THR))
+        assert len(found) == 1 and found[0].context == "Loop.flush"
+
+    def test_annotated_class_body_lambda_checked(self):
+        m = mod("engine/loop.py", """\
+            class Loop:
+                def __init__(self):
+                    self._futures = {}
+
+                flush: object = lambda self: self._futures.clear()
+            """)
+        found = live(threads.check([m], THR))
+        assert len(found) == 1 and found[0].context == "Loop.flush"
+
 
 # -- env knobs ---------------------------------------------------------------
 
@@ -456,6 +535,96 @@ class TestEnvKnobs:
         c = dataclasses.replace(ENV, env_doc_exempt=("XLA_FLAGS",
                                                      "WHATEVER"))
         assert live(envknobs.check([topo, annotated], c, "")) == []
+
+
+class TestEnvDeploy:
+    def test_typod_manifest_knob_flagged(self):
+        # code reads SHAI_REAL; the manifest sets SHAI_REAL and a typo —
+        # the typo applies fine on the cluster and no pod ever reads it
+        m = mod("serve/x.py", """\
+            from ..obs.util import env_int
+            A = env_int("SHAI_REAL", 1)
+            """)
+        deploy = {"SHAI_REAL": ("deploy/units/x-deploy.yaml", 10),
+                  "SHAI_RAEL": ("deploy/units/x-deploy.yaml", 11)}
+        found = live(envknobs.check([m], ENV, "SHAI_REAL SHAI_RAEL",
+                                    deploy_names=deploy))
+        assert [f.rule for f in found] == ["env-deploy"]
+        assert found[0].context == "SHAI_RAEL"
+        assert found[0].path == "deploy/units/x-deploy.yaml"
+
+    def test_read_name_in_manifest_is_clean(self):
+        m = mod("serve/x.py", """\
+            from ..obs.util import env_int
+            A = env_int("SHAI_REAL", 1)
+            """)
+        deploy = {"SHAI_REAL": ("deploy/units/x-deploy.yaml", 10)}
+        assert live(envknobs.check([m], ENV, "SHAI_REAL",
+                                   deploy_names=deploy)) == []
+
+    def test_live_deploy_names_all_read_by_code(self):
+        """Every SHAI_* name a committed manifest sets resolves to a code
+        read site (the live half of the env-deploy rule)."""
+        names = lint_core.deploy_env_names()
+        assert names, "deploy/ scan found no SHAI_ names — scanner broken?"
+        found = live(envknobs.check(lint_core.iter_modules(),
+                                    DEFAULT_CONTRACT, "ignored",
+                                    deploy_names=names))
+        deploy_findings = [f for f in found if f.rule == "env-deploy"]
+        assert deploy_findings == [], "\n".join(
+            f.render() for f in deploy_findings)
+
+
+# -- rename-stable fingerprints ----------------------------------------------
+
+class TestFingerprintStability:
+    SRC = """\
+        import numpy as np
+
+        class Engine:
+            def _steady(self, pipe):
+                return np.asarray(pipe.nxt)
+        """
+
+    def test_fingerprint_survives_file_move(self):
+        c = dataclasses.replace(
+            Contract(),
+            hot_paths={"engine/engine.py": ("Engine._steady",),
+                       "engine/moved_engine.py": ("Engine._steady",)})
+        before = live(hostsync.check([mod("engine/engine.py",
+                                          self.SRC)], c))
+        after = live(hostsync.check([mod("engine/moved_engine.py",
+                                         self.SRC)], c))
+        assert len(before) == len(after) == 1
+        # identity is (rule, context, message, snippet) — path-free
+        assert before[0].fingerprint == after[0].fingerprint
+        assert "engine/engine.py" not in before[0].fingerprint
+
+    def test_old_path_keyed_entries_go_stale_not_resurrected(self, tmp_path):
+        """Migration: a version-1 baseline entry (path in the fingerprint)
+        never matches a fresh finding — it reports as stale debt, and the
+        finding it used to cover shows up as NEW (so it gets fixed or
+        annotated, not silently inherited under a moved path)."""
+        old_fp = ("host-sync|engine/engine.py|Engine._steady|"
+                  "host sync numpy.asarray(...) in declared hot path")
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"version": 1, "findings": [old_fp]}))
+        loaded = set(lint_core.load_baseline(str(bl)))
+        c = dataclasses.replace(
+            Contract(), hot_paths={"engine/engine.py": ("Engine._steady",)})
+        fresh = {f.fingerprint
+                 for f in live(hostsync.check(
+                     [mod("engine/engine.py", self.SRC)], c))}
+        assert old_fp in loaded and not (fresh & loaded)
+
+    def test_update_baseline_writes_version_2(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        f = lint_core.Finding(rule="host-sync", path="a.py", line=3,
+                              context="X.y", message="m", snippet="s")
+        lint_core.save_baseline([f], str(bl))
+        data = json.loads(bl.read_text())
+        assert data["version"] == 2
+        assert data["findings"] == [f.fingerprint]
 
 
 # -- trace exclusion ---------------------------------------------------------
@@ -592,6 +761,42 @@ class TestCli:
              "--rule", "env-doc"],
             capture_output=True, text=True, cwd=ROOT, timeout=60)
         assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_cli_changed_mode_green_and_fast(self):
+        """--changed lints only git-touched files (pre-commit speed); on a
+        tree whose changed files are clean it exits 0. Staleness is not
+        judged from the partial view."""
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "shai_lint.py"),
+             "--changed", "--json"],
+            capture_output=True, text=True, cwd=ROOT, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        payload = json.loads(r.stdout)
+        assert payload["new"] == []
+        assert payload["stale_baseline"] == []
+
+    def test_check_all_fast_combined_gate(self):
+        """scripts/check_all.py --fast: AST + metrics docs under one exit
+        code (the full gate adds the IR pass and the tier-1 budget)."""
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "check_all.py"),
+             "--fast"],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "shai-lint (AST)" in r.stdout and "ok" in r.stdout
+
+    def test_cli_partial_run_cannot_rewrite_baseline(self):
+        """--update-baseline on a partial view (--changed / --ir --keys)
+        would erase every baselined finding outside the view; the CLI
+        refuses with the internal-error code."""
+        for extra in (["--changed"], ["--ir", "--keys", "decode"]):
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(ROOT, "scripts", "shai_lint.py"),
+                 "--update-baseline"] + extra,
+                capture_output=True, text=True, cwd=ROOT, timeout=60)
+            assert r.returncode == 2, (extra, r.stdout, r.stderr)
+            assert "full run" in r.stderr
 
     def test_cli_corrupt_baseline_is_exit_2(self, tmp_path):
         """The documented exit contract: a corrupt baseline is an internal
