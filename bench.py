@@ -43,7 +43,7 @@ SD_BASELINE_IMG_S = 1.0 / 0.67
 #: one unit mapping for the measurement AND crash paths
 UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
                   "mllama": "tokens/sec", "llama_spec": "tokens/sec",
-                  "vllm": "tokens/sec",
+                  "vllm": "tokens/sec", "kvtier": "x",
                   "sd": "images/sec", "sd8": "images/sec",
                   "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
@@ -66,7 +66,7 @@ def _which_from_argv(argv) -> str:
         return "llama_spec"
     if any(a.startswith("llama") for a in argv):
         return "llama"
-    for k in ("vllm", "flux", "t5", "mllama", "sd8"):
+    for k in ("vllm", "kvtier", "flux", "t5", "mllama", "sd8"):
         if k in argv:
             return k
     return "sd"
@@ -493,6 +493,103 @@ def bench_vllm(tiny: bool) -> dict:
     return out
 
 
+def bench_kvtier(tiny: bool) -> dict:
+    """KV-tier warm-hit TTFT: prompt replay after eviction pressure.
+
+    The PR-10 tentpole's measured number. One engine with the host tier ON
+    (``SHAI_KVTIER=1``, synchronous copies so the measurement is
+    deterministic) and a pool small enough that filler prompts evict the
+    probe prompt's prefix blocks — demoting them to the host tier. Each
+    round then measures (a) a COLD same-length prompt (full prefill) and
+    (b) the probe REPLAY, whose prefix swaps back in via the tier's
+    scatter-write restore instead of re-running prefill. ``value`` is the
+    cold/warm TTFT ratio (>1 = the tier is saving prefill work); the line
+    carries the tier's own counters so a regression says whether the hit
+    path or the copy path moved.
+    """
+    import os
+    import statistics
+
+    import numpy as np
+
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+    from scalable_hw_agnostic_inference_tpu.models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig.tiny()
+        ecfg = EngineConfig(max_model_len=256, max_num_seqs=1, block_size=8,
+                            num_blocks=26,
+                            context_encoding_buckets=(32, 64, 128),
+                            max_new_tokens=16, enable_prefix_caching=True)
+        prompt_len, new = 120, 8
+        name = "kvtier-tiny"
+    else:
+        cfg = llama_mod.LlamaConfig.llama32_1b()
+        ecfg = EngineConfig(max_model_len=1024, max_num_seqs=2,
+                            block_size=16, num_blocks=72,
+                            context_encoding_buckets=(128, 256, 512),
+                            max_new_tokens=16, enable_prefix_caching=True)
+        prompt_len, new = 480, 8
+        name = "kvtier-1b-geometry"
+
+    params = llama_mod.geometry_params(cfg, quant=False)
+    rng = np.random.default_rng(7)
+    probe = rng.integers(3, cfg.vocab_size, prompt_len).tolist()
+    fillers = [rng.integers(3, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(3)]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=new)
+
+    os.environ["SHAI_KVTIER"] = "1"
+    os.environ["SHAI_KVTIER_ASYNC"] = "0"  # deterministic copy timing
+    try:
+        eng = LLMEngine(cfg, params, ecfg)
+    finally:
+        os.environ.pop("SHAI_KVTIER", None)
+        os.environ.pop("SHAI_KVTIER_ASYNC", None)
+    assert eng.cache.tier is not None
+
+    def ttft_of(prompt):
+        [fin] = eng.generate([list(prompt)], sp)
+        return fin.timing["prefill_s"]
+
+    # warm every executable on the path (prefill buckets, cont chunks,
+    # decode, tier movers) before timing anything
+    ttft_of(probe)
+    for f in fillers:
+        ttft_of(f)
+    ttft_of(probe)
+
+    colds, warms = [], []
+    for r in range(3):
+        for f in fillers:  # eviction pressure: the probe's blocks demote
+            ttft_of(f)
+        cold = list(probe)
+        cold[0] = int(cold[0]) % (cfg.vocab_size - 4) + 3 + r + 1
+        colds.append(ttft_of(cold))      # same length, cold first block
+        warms.append(ttft_of(probe))     # host-tier restore path
+    cold_p50 = statistics.median(colds)
+    warm_p50 = statistics.median(warms)
+    snap = eng.cache.tier.snapshot()
+    base = _published("kvtier_warm_ttft_speedup")
+    val = round(cold_p50 / warm_p50, 3) if warm_p50 else 0.0
+    return {
+        "metric": f"{name} warm-host-tier TTFT speedup (prompt "
+                  f"{prompt_len}, replay after eviction, "
+                  f"{jax.devices()[0].platform})",
+        "value": val,
+        "unit": "x",
+        "vs_baseline": round(val / base, 3) if base else 1.0,
+        "cold_ttft_ms": round(cold_p50 * 1e3, 3),
+        "warm_ttft_ms": round(warm_p50 * 1e3, 3),
+        "tier": {k: snap[k] for k in ("hits", "misses", "stores",
+                                      "restored", "evictions", "errors")},
+    }
+
+
 def bench_flux(tiny: bool) -> dict:
     """Flux (rectified-flow DiT) txt2img on ONE chip.
 
@@ -753,7 +850,8 @@ def inner_main() -> None:
 
         enable_persistent_cache_from_env()
     out = {"llama": bench_llama, "llama_spec": bench_llama_spec,
-           "vllm": bench_vllm, "flux": bench_flux, "t5": bench_t5,
+           "vllm": bench_vllm, "kvtier": bench_kvtier,
+           "flux": bench_flux, "t5": bench_t5,
            "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)
     # structured platform provenance: is_real() keys off this, never off

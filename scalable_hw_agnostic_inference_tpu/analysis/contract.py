@@ -160,11 +160,17 @@ DEFAULT_CONTRACT = Contract(
         # per-step serialization
         "engine/runner.py": (
             "make_decode", "make_verify", "_make_token_forward"),
+        # the KV-tier movers' jitted bodies: a host sync traced into the
+        # demotion gather or restore scatter would serialize every
+        # eviction/warm-hit on the host (same discipline as runner.py)
+        "kvtier/restore.py": ("make_tier_gather", "make_tier_restore"),
     },
-    donation_factory_files=("engine/runner.py", "core/aot.py"),
+    donation_factory_files=("engine/runner.py", "core/aot.py",
+                            "kvtier/restore.py"),
     donation_check_files=(
         "engine/engine.py", "engine/runner.py", "engine/warm.py",
-        "engine/cross.py", "core/aot.py"),
+        "engine/cross.py", "core/aot.py", "engine/cache.py",
+        "kvtier/restore.py", "kvtier/pool.py"),
     accessor_factories={
         "_prefill_for": ("make_prefill", None),
         "_cont_for": ("make_prefill_cont", None),
@@ -175,7 +181,11 @@ DEFAULT_CONTRACT = Contract(
         # the async dispatch helper receives the compiled decode executable
         "LLMEngine._dispatch_async": {"decode": "make_decode"},
     },
-    attr_factories={"_cross_write": "make_cross_slot_write"},
+    attr_factories={"_cross_write": "make_cross_slot_write",
+                    # the cache's restore scatter (donate-and-rebind per
+                    # layer) and demotion gather (no donation)
+                    "_tier_restore": "make_tier_restore",
+                    "_tier_gather": "make_tier_gather"},
     donating_calls={
         # _dispatch_async(decode, running, Bb, tokens_dev, pos_dev, a, rng):
         # pos_dev (index 4) is donated into the feedback-decode dispatch
@@ -221,6 +231,25 @@ DEFAULT_CONTRACT = Contract(
             lock_guarded={"_requests": "_lock", "_seq": "_lock"},
             owning_modules=("obs/flight.py",),
         ),
+        # The host KV tier is written from TWO threads by design: the
+        # engine thread stores/probes/restores, the copy-out worker
+        # publishes materialized entries — every mutation of the entry
+        # map and the counters moves under _lock.
+        "HostKVTier": ClassPolicy(
+            immutable_after_init=(
+                "n_layers", "block_size", "n_kv_heads", "head_dim",
+                "dtype", "block_nbytes", "capacity_bytes", "async_copy",
+                "_lock"),
+            lock_guarded={"_entries": "_lock", "_stats": "_lock"},
+            owning_modules=("kvtier/pool.py",),
+            instance_markers=(".tier.",),
+        ),
+        # The copy-out worker's queue/thread bindings are fixed at
+        # construction; the queue object itself is the cross-thread seam.
+        "CopyOutWorker": ClassPolicy(
+            immutable_after_init=("_pool", "_q", "_thread"),
+            owning_modules=("kvtier/pool.py",),
+        ),
     },
     dict_guards={
         # serve.app closure state shared between the event loop and lane/
@@ -260,6 +289,7 @@ DEFAULT_CONTRACT = Contract(
             "decode@tp2", "decode_feedback@tp2", "decode@tp2_paged",
             "verify",
             "cross_kv", "cross_slot_write",
+            "tier_restore",
             "aot_decode_export",
             "ring@sp2", "ring_causal@sp2", "ulysses@sp2",
         ),
@@ -272,6 +302,7 @@ DEFAULT_CONTRACT = Contract(
             "decode", "decode_feedback",
             "decode@tp2", "decode_feedback@tp2", "decode@tp2_paged",
             "verify", "cross_kv", "cross_slot_write",
+            "tier_restore",
         ),
         # a host callback inside any of these serializes every engine
         # step (decode) or admission (prefill/cross) on the host
@@ -280,6 +311,7 @@ DEFAULT_CONTRACT = Contract(
             "decode", "decode_feedback",
             "decode@tp2", "decode_feedback@tp2", "decode@tp2_paged",
             "verify", "cross_kv", "cross_slot_write",
+            "tier_restore",
         ),
         compositions={
             # one multihost slice may roll SHAI_ASYNC_DECODE across its

@@ -269,6 +269,23 @@ def _build_ulysses(key: str) -> IrProgram:
                      donate_args=())
 
 
+def _build_tier_restore(key: str) -> IrProgram:
+    import jax.numpy as jnp
+
+    from ...kvtier.restore import make_tier_restore
+
+    cfg = _tiny_cfg()
+    fn = make_tier_restore()
+    pool = (TOT, BS, cfg.n_kv_heads, cfg.head_dim)
+    host = (2, BS, cfg.n_kv_heads, cfg.head_dim)  # a 2-block restore batch
+    args = (_sds(pool, jnp.bfloat16), _sds(pool, jnp.bfloat16),
+            _sds((2,), jnp.int32),
+            _sds(host, jnp.bfloat16), _sds(host, jnp.bfloat16))
+    return IrProgram(key=key, factory="make_tier_restore",
+                     anchor_path="kvtier/restore.py", jitted=fn, args=args,
+                     donate_args=(0, 1), compile_cpu=True)
+
+
 def _build_aot_export(key: str) -> IrProgram:
     # the artifact tier: the SAME decode executable, but inspected after a
     # jax.export serialize/deserialize roundtrip — what AotCache persists
@@ -296,6 +313,7 @@ BUILDERS = {
     "verify": lambda k: _build_verify(k),
     "cross_kv": lambda k: _build_cross_kv(k),
     "cross_slot_write": lambda k: _build_cross_slot_write(k),
+    "tier_restore": lambda k: _build_tier_restore(k),
     "aot_decode_export": lambda k: _build_aot_export(k),
     "ring@sp2": lambda k: _build_ring(k, causal=False),
     "ring_causal@sp2": lambda k: _build_ring(k, causal=True),

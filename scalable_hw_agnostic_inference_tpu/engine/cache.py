@@ -13,11 +13,26 @@ sees fragmentation: gathers go through block tables.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger(__name__)
+
+#: the CLOSED set of pad sizes the tier movers compile for: demotion
+#: gathers and restore scatters pad their index arrays to one of these
+#: (padding rows target reserved block 0), so attach-time priming covers
+#: every shape the post-ready path can dispatch
+_PAD_SIZES = (1, 2, 4, 8)
+_PAD_MAX = _PAD_SIZES[-1]
+
+
+def _pad_size(n: int) -> int:
+    """Smallest registered pad covering ``n`` (callers chunk at _PAD_MAX)."""
+    return 1 << max(0, n - 1).bit_length()
 
 
 class BlockAllocator:
@@ -96,7 +111,7 @@ class PagedKVCache:
     def __init__(self, n_layers: int, n_kv_heads: int, head_dim: int,
                  total_blocks: int, block_size: int, blocks_per_seq: int,
                  dtype=jnp.bfloat16, sharding=None,
-                 enable_prefix_caching: bool = False):
+                 enable_prefix_caching: bool = False, tier=None):
         self.n_layers = n_layers
         self.block_size = block_size
         self.blocks_per_seq = blocks_per_seq
@@ -139,6 +154,14 @@ class PagedKVCache:
         self.rollback_tokens = 0
         self.rollback_calls = 0
         self.rollback_blocks = 0
+        # host KV tier (kvtier/): eviction demotes cached blocks to a
+        # bounded host-RAM pool instead of destroying them; admission
+        # misses fall through to it and restore via a scatter-write
+        self.tier = None
+        self._tier_gather = None
+        self._tier_restore = None
+        if tier is not None:
+            self.attach_tier(tier)
 
     # -- prefix cache -------------------------------------------------------
 
@@ -153,12 +176,23 @@ class PagedKVCache:
             out.append(h)
         return out
 
-    def cached_prefix(self, tokens) -> List[int]:
+    def prefix_hashes(self, tokens) -> List[int]:
+        """The prompt's full-block chain hashes — computed ONCE per
+        admission attempt and shared by :meth:`cached_prefix`,
+        :meth:`tier_prefix_len`, and :meth:`restore_prefix` (hashing every
+        token is pure-Python work on the per-step admission path)."""
+        if not self.prefix_caching:
+            return []
+        return self._chain_hashes(tokens, self.block_size)
+
+    def cached_prefix(self, tokens, hashes: Optional[List[int]] = None
+                      ) -> List[int]:
         """Longest run of cached blocks matching the prompt's full blocks."""
         if not self.prefix_caching:
             return []
         blocks = []
-        for h in self._chain_hashes(tokens, self.block_size):
+        for h in (hashes if hashes is not None
+                  else self._chain_hashes(tokens, self.block_size)):
             b = self._hash2block.get(h)
             if b is None:
                 break
@@ -198,13 +232,24 @@ class PagedKVCache:
     @property
     def n_available(self) -> int:
         """Free blocks plus what eviction could reclaim — the admission
-        gate's denominator."""
+        gate's denominator. Tier-aware by construction: with a host tier
+        attached, evicting a cached block demotes its contents instead of
+        destroying them, so counting evictable blocks as available no
+        longer prices reclaimed cache hits as lost prefill work (the
+        admission gate still sheds earlier when the HOST pool itself
+        saturates — ``resilience.admission``)."""
         return self.allocator.n_free + self.n_evictable
 
     def _evict(self, n: int) -> int:
         """Drop up to ``n`` LRU cache-only blocks, LEAVES first — a chain
-        must shed from the tail or its survivors become unreachable."""
+        must shed from the tail or its survivors become unreachable.
+
+        With a host tier attached, eviction is a DEMOTION: the dropped
+        blocks' KV is gathered (one dispatch, before any re-allocation can
+        overwrite them) and handed to the tier, where a later admission
+        miss can restore it instead of re-running prefill."""
         dropped = 0
+        demoted: List[Tuple[int, int]] = []
         progress = True
         while dropped < n and progress:
             progress = False
@@ -224,9 +269,16 @@ class PagedKVCache:
                     self._nchild[parent] -= 1
                     if not self._nchild[parent]:
                         del self._nchild[parent]
+                if self.tier is not None and self.tier.accepts(h):
+                    demoted.append((h, b))
                 self.allocator.free([b])
                 dropped += 1
                 progress = True
+        if demoted:
+            # the gather dispatches BEFORE the caller's allocation can
+            # write the freed blocks (dispatch order is data order); its
+            # outputs are fresh buffers, safe to materialize later
+            self._demote(demoted)
         return dropped
 
     def _alloc(self, n: int) -> List[int]:
@@ -234,6 +286,152 @@ class PagedKVCache:
         if short > 0:
             self._evict(short)
         return self.allocator.alloc(n)
+
+    # -- host KV tier (kvtier/) --------------------------------------------
+
+    def attach_tier(self, tier) -> None:
+        """Wire a :class:`~..kvtier.pool.HostKVTier` behind the prefix
+        cache and prime the jitted movers against the live pool — the
+        closed pad-size set compiles HERE, never on a post-ready request
+        (the cold-graph-behind-the-LB discipline)."""
+        from ..kvtier.restore import make_tier_gather, make_tier_restore
+
+        self.tier = tier
+        self._tier_gather = make_tier_gather()
+        self._tier_restore = make_tier_restore()
+        lay0 = self.kv[0]
+        shape = lay0["k"].shape[1:]
+        dt = lay0["k"].dtype
+        for pad in _PAD_SIZES:
+            idx = jnp.zeros((pad,), jnp.int32)
+            self._tier_gather(self.kv, idx)
+            zeros = jnp.zeros((pad,) + shape, dt)
+            # priming writes zeros into reserved block 0 — garbage there
+            # is allowed by contract (tables mask it out)
+            lay0["k"], lay0["v"] = self._tier_restore(
+                lay0["k"], lay0["v"], idx, zeros, zeros)
+
+    def _demote(self, pairs: Sequence[Tuple[int, int]]) -> None:
+        """Copy evicted blocks' KV out to the host tier: one batched
+        gather per <=``_PAD_MAX`` chunk, handed to the tier (async mode
+        enqueues the device buffers; the copy-out worker pays the
+        transfer). Failures degrade to plain eviction, never raise."""
+        tier = self.tier
+        try:
+            i = 0
+            while i < len(pairs):
+                grp = list(pairs[i:i + _PAD_MAX])
+                n = len(grp)
+                idx = np.zeros((_pad_size(n),), np.int32)
+                idx[:n] = [b for _, b in grp]
+                k_all, v_all = self._tier_gather(self.kv, jnp.asarray(idx))
+                tier.store_batch([h for h, _ in grp], k_all, v_all, n)
+                i += n
+        except Exception:
+            log.warning("kv tier demotion failed; blocks evicted without "
+                        "copy", exc_info=True)
+            tier.count_error()
+
+    def tier_prefix_len(self, hashes: List[int], from_block: int) -> int:
+        """How many full blocks past ``from_block`` the host tier could
+        restore for this prompt — the admission ladder's fall-through
+        probe when :meth:`cached_prefix` stops short. ``hashes`` is the
+        caller's :meth:`prefix_hashes` result (hashed once, shared)."""
+        if self.tier is None or from_block >= len(hashes):
+            return 0
+        return self.tier.probe_run(hashes[from_block:])
+
+    def restore_prefix(self, hashes: List[int], from_block: int, take: int,
+                       pin: Sequence[int] = ()) -> List[int]:
+        """Swap up to ``take`` host-tier blocks back into the device pool
+        and register them as prefix-cache entries (refcount 1, the
+        cache's own reference — exactly the state :meth:`register_prefix`
+        leaves). Returns the restored device block ids; any shortfall
+        (raced host eviction, transfer failure, dry pool) degrades to
+        recompute for the uncovered remainder, never to an error.
+
+        ``pin``: the device-cached run the caller is about to share —
+        increfed around the allocation so the restore can never evict the
+        very blocks it is extending."""
+        if self.tier is None or take <= 0:
+            return []
+        run = self.tier.get_run(hashes[from_block:from_block + take])
+        if not run:
+            return []
+        for b in pin:
+            self.allocator.incref(b)
+        try:
+            try:
+                blocks = self._alloc(len(run))
+            except MemoryError:
+                return []
+            try:
+                self._tier_write(blocks, run)
+            except Exception:
+                log.warning("kv tier restore failed; falling back to "
+                            "recompute", exc_info=True)
+                self.allocator.free(blocks)
+                self.tier.count_error()
+                return []
+        finally:
+            if pin:
+                # pinned blocks are cache-registered (refcount >= 2 while
+                # pinned), so this decref can never free them
+                self.allocator.free(list(pin))
+        prev = hashes[from_block - 1] if from_block > 0 else None
+        if prev is not None and prev not in self._hash2block:
+            prev = None
+        for (h, _k, _v), b in zip(run, blocks):
+            self._hash2block[h] = b
+            self._block2hash[b] = h
+            self._lru[h] = None
+            if prev is not None:
+                self._parent[h] = prev
+                self._nchild[prev] = self._nchild.get(prev, 0) + 1
+            prev = h
+        self.tier.count_restored(len(blocks))
+        return blocks
+
+    def _tier_write(self, blocks: List[int],
+                    run: List[Tuple[int, np.ndarray, np.ndarray]]) -> None:
+        """ONE jitted scatter-write per layer per <=``_PAD_MAX`` chunk:
+        the restored blocks' host k/v goes back into the pool rows
+        ``blocks`` (padding rows target reserved block 0)."""
+        i = 0
+        while i < len(blocks):
+            grp = blocks[i:i + _PAD_MAX]
+            ent = run[i:i + _PAD_MAX]
+            n = len(grp)
+            pad = _pad_size(n)
+            idx = np.zeros((pad,), np.int32)
+            idx[:n] = grp
+            # entry arrays are [n_layers, Bs, Hkv, Dh]; stack per layer
+            per = ent[0][1].shape[1:]
+            kbuf = np.zeros((self.n_layers, pad) + per, ent[0][1].dtype)
+            vbuf = np.zeros((self.n_layers, pad) + per, ent[0][2].dtype)
+            for j, (_h, k, v) in enumerate(ent):
+                kbuf[:, j] = k
+                vbuf[:, j] = v
+            idx_dev = jnp.asarray(idx)
+            for li, lay in enumerate(self.kv):
+                lay["k"], lay["v"] = self._tier_restore(
+                    lay["k"], lay["v"], idx_dev,
+                    jnp.asarray(kbuf[li]), jnp.asarray(vbuf[li]))
+            i += n
+
+    def offload_preempt(self, tokens, seq_id: int) -> None:
+        """Preemption offload: publish the victim's full blocks to the
+        prefix cache (free — one incref per block) so re-admission reuses
+        them directly while they survive, and pool pressure demotes them
+        to the host tier through the eviction hook instead of destroying
+        prefill+decode work. Only meaningful with a tier attached — the
+        pre-tier engine keeps its exact preemption accounting."""
+        if self.tier is None or not self.prefix_caching:
+            return
+        alloc = self._seqs.get(seq_id)
+        if alloc is None:
+            return
+        self.register_prefix(tokens, alloc.blocks)
 
     # -- host-side sequence lifecycle --------------------------------------
 

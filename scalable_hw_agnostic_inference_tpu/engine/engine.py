@@ -100,16 +100,33 @@ class LLMEngine:
         # layer count returns ~20% of KV HBM on 11B-Vision to real blocks
         n_pool_layers = (model_cfg.n_layers
                          - len(model_cfg.cross_attention_layers))
+        kv_dtype = jnp.bfloat16 if ecfg.dtype == "bfloat16" else jnp.float32
+        # prefix caching serves the plain-text path only: cross models'
+        # cache semantics (vision states) don't content-address by tokens
+        prefix_caching = (ecfg.enable_prefix_caching
+                          and not model_cfg.cross_attention_layers)
+        # host KV tier (SHAI_KVTIER, kvtier/): prefix-cache eviction and
+        # preemption demote blocks to a bounded host-RAM pool; admission
+        # misses fall through to it and swap KV back in instead of
+        # re-running prefill. Rides the prefix cache (same chain hashes),
+        # unsharded pools only — a TP pool's restore scatter would need
+        # per-rank placement the tier does not carry.
+        tier = None
+        if prefix_caching and self.shardings is None:
+            from ..kvtier.pool import maybe_host_tier
+
+            tier = maybe_host_tier(
+                n_layers=n_pool_layers, block_size=ecfg.block_size,
+                n_kv_heads=model_cfg.n_kv_heads,
+                head_dim=model_cfg.head_dim, dtype=np.dtype(kv_dtype))
         self.cache = PagedKVCache(
             n_pool_layers, model_cfg.n_kv_heads, model_cfg.head_dim,
             ecfg.total_blocks, ecfg.block_size, ecfg.blocks_per_seq,
-            dtype=jnp.bfloat16 if ecfg.dtype == "bfloat16" else jnp.float32,
+            dtype=kv_dtype,
             sharding=None if self.shardings is None
             else self.shardings.kv_layer,
-            # prefix caching serves the plain-text path only: cross models'
-            # cache semantics (vision states) don't content-address by tokens
-            enable_prefix_caching=(ecfg.enable_prefix_caching
-                                   and not model_cfg.cross_attention_layers),
+            enable_prefix_caching=prefix_caching,
+            tier=tier,
         )
         self.buckets = BucketRegistry(sorted(ecfg.context_encoding_buckets))
         # chunked-prefill prompt cap: whole bucket-sized chunks only (the
@@ -210,6 +227,10 @@ class LLMEngine:
         except Exception:  # deviceless dryruns must still boot
             pass
         self.obs.hbm = HbmLedger(bytes_limit=hbm_limit)
+        # host KV tier counters ride the same ONE provider seam as the
+        # conformance instruments: /stats, /metrics, and the admission
+        # gate all read them off the telemetry object
+        self.obs.kvtier = self.cache.tier
         from ..obs.util import env_int as _env_int
 
         # ledger cadence: every Nth step (default every step — cheap on
@@ -735,6 +756,13 @@ class LLMEngine:
         drift = kv_leaked
         if bytes_in_use is not None:
             drift += max(0.0, float(bytes_in_use) - sum(pools.values()))
+        # host-RAM pools ride the same ledger snapshot as named pools but
+        # stay OUT of the attributed device sum (host bytes must not eat
+        # HBM headroom): the KV tier's occupancy exports as
+        # shai_hbm_host_kv_bytes next to the device pools it backs
+        host_pools = None
+        if self.cache.tier is not None:
+            host_pools = {"host_kv": self.cache.tier.used_bytes}
         led.sample(
             pools=pools,
             composition=(self.n_running, self.n_waiting, self.n_chunking),
@@ -743,6 +771,7 @@ class LLMEngine:
             peak_bytes=stats.get("peak_bytes_in_use"),
             largest_free=stats.get("largest_free_block_bytes"),
             drift_value=drift,
+            host_pools=host_pools,
             extra={"kv_used_bytes": kv_used,
                    "kv_leaked_bytes": kv_leaked})
 
@@ -1033,9 +1062,22 @@ class LLMEngine:
         n_total = len(req.prompt_ids)
         if n_total <= self.ecfg.block_size:
             return False  # no full block to share
-        cached = self.cache.cached_prefix(req.prompt_ids)
+        slot = self._free_slot()
+        if slot is None:
+            # probe NOTHING while blocked on a slot: a waiting request
+            # retries every step, and per-step probes would churn both
+            # LRUs and inflate the tier's hit counters with non-admissions
+            return False
+        # the chain hash is pure-Python token hashing — compute it ONCE
+        # and share it across the device walk, tier probe, and restore
+        hashes = self.cache.prefix_hashes(req.prompt_ids)
+        cached = self.cache.cached_prefix(req.prompt_ids, hashes=hashes)
+        # host-tier fall-through: blocks the device cache evicted (or a
+        # preemption demoted) may still be host-resident — they extend the
+        # warm run the start alignment below is computed from
+        n_tier = self.cache.tier_prefix_len(hashes, len(cached))
         start = self._cached_start_for(
-            n_total, len(cached) * self.ecfg.block_size)
+            n_total, (len(cached) + n_tier) * self.ecfg.block_size)
         if start == 0:
             return False
         chunk_bucket = self.buckets.bucket_for(n_total - start)
@@ -1044,14 +1086,36 @@ class LLMEngine:
             return False  # chunk executable would overrun blocks_per_seq
         if self._warmed and ("cont", sb, chunk_bucket) not in self._prefill:
             return False  # post-ready compiles are the cold-graph bug
-        slot = self._free_slot()
-        if slot is None:
-            return False
+        take = max(0, sb - len(cached))
         need_new = self._need_blocks(n_total) - sb
         # conservative: pinning the reused blocks removes up to sb blocks
-        # from the evictable supply n_available counts
-        if need_new > self.cache.n_available - sb:
+        # from the evictable supply n_available counts, and the restore
+        # itself consumes `take` fresh blocks before admission even starts
+        if need_new + take > self.cache.n_available - sb:
             return False  # normal paths own reject-vs-wait semantics
+        if take:
+            # the restore scatter donates the device pool buffers: retire
+            # any in-flight lookahead FIRST so the async discipline stays
+            # token-exact (no-op in lock-step / already-flushed steps)
+            self._flush_pipeline("kvtier")
+            cached = cached + self.cache.restore_prefix(
+                hashes, len(cached), take, pin=cached)
+            if len(cached) < sb:
+                # tier shortfall (raced host eviction, transfer failure):
+                # degrade to the blocks we DID land — they are device-
+                # cached now — and re-derive the warm start from them;
+                # recompute covers the rest, the request never fails
+                start = self._cached_start_for(
+                    n_total, len(cached) * self.ecfg.block_size)
+                if start == 0:
+                    return False
+                chunk_bucket = self.buckets.bucket_for(n_total - start)
+                sb = start // self.ecfg.block_size
+                if start + chunk_bucket > self.ecfg.max_model_len:
+                    return False
+                if self._warmed and ("cont", sb,
+                                     chunk_bucket) not in self._prefill:
+                    return False
         self.waiting.popleft()
         try:
             alloc = self.cache.admit(req.req_id, n_total,
@@ -1124,6 +1188,13 @@ class LLMEngine:
             args += list(self._set_slot_cross(slot, req))
         with annotate("engine.prefill"):
             self.cache.kv, _ = fn(*args)
+        # the first chunk's full blocks are final (prefill never rewrites
+        # them): register them NOW — a second identical long prompt, or
+        # this one resuming after preemption, shares them without waiting
+        # out the whole chunk ladder (register_prefix no-ops for cross
+        # engines, whose cache is disabled at construction)
+        self.cache.register_prefix(req.prompt_ids[:C],
+                                   self.cache.seq(req.req_id).blocks)
         self.slots[slot] = _Running(req, slot, [], pending_token=-1,
                                     prefill_cursor=C)
 
@@ -1163,6 +1234,13 @@ class LLMEngine:
             if req.params.logprobs:
                 self._record_admission_lps(logits, [tok], [(0, s)])
         else:
+            # intermediate chunk: its full blocks are final too — publish
+            # them per chunk instead of only at prompt completion (the
+            # chunked path previously registered nothing until the last
+            # chunk, so identical long prompts paid the full ladder twice)
+            self.cache.register_prefix(
+                req.prompt_ids[:start + n],
+                self.cache.seq(req.req_id).blocks)
             s.prefill_cursor = start + C
 
     def _cont_for(self, start_blocks: int, bucket: Optional[int] = None):
@@ -1281,6 +1359,19 @@ class LLMEngine:
         victim = max(victims, key=lambda s: s.req.req_id)
         log.warning("preempting seq %d (block pool exhausted)", victim.req.req_id)
         self.obs.count_preemption()
+        if (self.cache.tier is not None and victim.req.prefix is None
+                and victim.req.cross_states is None):
+            # demotion, not deletion: publish the victim's full blocks to
+            # the prefix cache before release — re-admission reuses them
+            # while they survive on device, and pool pressure demotes them
+            # to the host tier through the eviction hook; the resumed
+            # sequence restores KV instead of recomputing it. (KV exists
+            # for prompt+generated only — the pending token's write lands
+            # with the NEXT dispatch, which this victim never runs.)
+            kv_tokens = (victim.req.prompt_ids[:victim.prefill_cursor]
+                         if victim.prefill_cursor is not None
+                         else victim.req.prompt_ids + victim.generated)
+            self.cache.offload_preempt(kv_tokens, victim.req.req_id)
         self.cache.release(victim.req.req_id)
         self.slots[victim.slot] = None
         self._has_image[victim.slot] = 0.0

@@ -1,0 +1,29 @@
+"""KV tiering: a host-RAM offload tier for the paged KV cache.
+
+The device prefix cache (``engine/cache.py``) makes prompt reuse free —
+until the pool runs dry and LRU eviction *destroys* the cached blocks,
+converting banked prefill work back into recompute. This package makes
+eviction a demotion instead of a deletion:
+
+- ``pool``      the bounded host-RAM block pool (numpy-backed, fully
+                CPU-testable) plus the async copy-out worker thread;
+- ``restore``   the jitted device<->host block movers: a batched gather
+                (demotion) and one donated scatter-write per layer
+                (restore) — a warm-tier hit swaps KV back into the pool
+                instead of re-running prefill;
+- ``affinity``  stdlib-only prompt-affinity digests shared by the serving
+                pods (which advertise warm prefixes on ``/stats``) and
+                the cova orchestrator (which routes to them).
+
+Layering: this ``__init__`` and ``affinity`` import nothing beyond the
+stdlib so the cova control-plane image (build/Dockerfile.assets — no
+numpy/jax) can import the routing half; ``pool`` needs numpy and
+``restore`` needs jax, so they are imported as submodules only by the
+engine side (``from ..kvtier.pool import maybe_host_tier``).
+
+Env knobs (lenient parser seam, documented in README's registry):
+``SHAI_KVTIER`` (gate, default off), ``SHAI_KVTIER_BYTES`` (host pool
+capacity), ``SHAI_KVTIER_ASYNC`` (copy-out worker vs synchronous copies).
+"""
+
+from .affinity import AffinityTracker, prompt_affinity  # noqa: F401

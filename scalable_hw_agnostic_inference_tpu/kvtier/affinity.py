@@ -1,0 +1,60 @@
+"""Prompt-affinity digests: the warm-prefix handshake between pods and cova.
+
+The engine's prefix cache (and the host KV tier behind it) is keyed by
+token-block chain hashes, which the orchestrator cannot compute — it has
+no tokenizer. The shared proxy is a digest of the prompt's *leading
+characters*: two prompts whose leading blocks of tokens match necessarily
+share their leading text, so a text digest over a block-sized character
+window is a sound (slightly over-eager, never token-wrong) warmth signal.
+
+Serving pods digest every prompt they encode and advertise a bounded LRU
+of recent digests under ``/stats`` → ``kvtier.affinity``; cova digests the
+incoming prompt the same way and prefers the backend whose advertised set
+contains it (``orchestrate/cova.py``). Both sides import THIS module so
+the two digests cannot drift.
+
+Stdlib-only by contract: cova's control-plane image ships no numpy/jax
+(build/Dockerfile.assets), and this module rides in it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import List
+
+#: characters of leading prompt text the digest commits to — roughly one
+#: KV block's worth of tokens for typical tokenizers (block_size 16-64
+#: tokens x ~4 chars/token); a shared digest implies shared leading blocks
+AFFINITY_CHARS = 256
+#: hex chars kept per digest (64 bits — collision-safe for a routing hint)
+AFFINITY_HEX = 16
+
+
+def prompt_affinity(text: str, n_chars: int = AFFINITY_CHARS) -> str:
+    """Stable digest of the prompt's leading ``n_chars`` characters."""
+    head = text[:n_chars].encode("utf-8", errors="replace")
+    return hashlib.sha1(head).hexdigest()[:AFFINITY_HEX]
+
+
+class AffinityTracker:
+    """Bounded LRU set of recently served prompt digests (thread-safe:
+    every serving-lane thread notes into it; the /stats scrape reads)."""
+
+    def __init__(self, max_entries: int = 128):
+        self.max_entries = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._digests: "OrderedDict[str, None]" = OrderedDict()
+
+    def note(self, digest: str) -> None:
+        with self._lock:
+            self._digests.pop(digest, None)
+            self._digests[digest] = None
+            while len(self._digests) > self.max_entries:
+                self._digests.popitem(last=False)
+
+    def snapshot(self) -> List[str]:
+        """Most-recent-last list of advertised digests."""
+        with self._lock:
+            return list(self._digests)

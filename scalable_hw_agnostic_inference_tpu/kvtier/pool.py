@@ -1,0 +1,280 @@
+"""Bounded host-RAM KV block pool + the async copy-out worker.
+
+The pool is a content-addressed store: entries are keyed by the SAME
+chain hashes the device prefix cache uses (``engine/cache.py``), so a
+device-cache miss falls through here by walking the prompt's hash chain.
+Each entry holds one block's k/v for every layer as numpy arrays
+(``[n_layers, block_size, n_kv_heads, head_dim]`` each) — numpy-backed on
+purpose: the tier is fully CPU-testable and its accounting is exact
+(``used_bytes == entries * block_nbytes``, always).
+
+Copy-out discipline (``SHAI_KVTIER_ASYNC``, default on): the engine-side
+demotion gathers evicted blocks into fresh device buffers (one dispatch)
+and enqueues them; the :class:`CopyOutWorker` thread pays the
+device->host transfer off the engine thread, then publishes the entries.
+A full queue DROPS the demotion (counted) — the tier must never apply
+backpressure to the engine. ``=0`` copies synchronously at the eviction
+site: deterministic, the mode the differential tests pin.
+
+Failure contract: every tier failure — transfer error, queue overflow,
+capacity refusal, raced eviction — degrades to recompute. Nothing in this
+module can fail a request; it can only decline to save work (and count
+that it did: the ``errors``/``dropped`` counters are the degrade signal
+on ``/metrics``).
+
+Thread contract (``analysis/contract.py`` ClassPolicy): ``_entries`` and
+``_stats`` are lock-guarded — the engine thread stores/probes, the
+copy-out worker publishes, scrape threads snapshot, all under ``_lock``.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+#: default host pool capacity (SHAI_KVTIER_BYTES): 256 MiB — a few
+#: thousand blocks at typical small-model geometry; production tiers size
+#: it to the pod's RAM request
+DEFAULT_CAPACITY_BYTES = 256 << 20
+#: bounded copy-out queue: past this, demotions drop (never block)
+COPYOUT_QUEUE_DEPTH = 64
+
+
+def maybe_host_tier(*, n_layers: int, block_size: int, n_kv_heads: int,
+                    head_dim: int, dtype) -> Optional["HostKVTier"]:
+    """The ``SHAI_KVTIER`` gate: a configured :class:`HostKVTier`, or None
+    when the knob is off (the default — the tier is opt-in)."""
+    from ..obs.util import env_flag, env_int
+
+    if not env_flag("SHAI_KVTIER", False):
+        return None
+    capacity = max(0, env_int("SHAI_KVTIER_BYTES", DEFAULT_CAPACITY_BYTES))
+    tier = HostKVTier(
+        n_layers=n_layers, block_size=block_size, n_kv_heads=n_kv_heads,
+        head_dim=head_dim, dtype=dtype, capacity_bytes=capacity,
+        async_copy=env_flag("SHAI_KVTIER_ASYNC", True))
+    if tier.block_nbytes > tier.capacity_bytes:
+        log.warning(
+            "SHAI_KVTIER_BYTES=%d holds zero %d-byte blocks — the tier is "
+            "on but every demotion will be refused", capacity,
+            tier.block_nbytes)
+    return tier
+
+
+class CopyOutWorker:
+    """One daemon thread draining the demotion queue: materialize the
+    gathered device buffers host-side, then publish into the pool."""
+
+    def __init__(self, pool: "HostKVTier",
+                 max_queue: int = COPYOUT_QUEUE_DEPTH):
+        self._pool = pool
+        self._q: "queue.Queue[Tuple]" = queue.Queue(max_queue)
+        self._thread = threading.Thread(
+            target=self._run, name="shai-kvtier-copyout", daemon=True)
+        self._thread.start()
+
+    def submit(self, item: Tuple) -> bool:
+        """Enqueue one demotion batch; False = queue full (caller counts
+        the drop — the tier never backpressures the engine)."""
+        try:
+            self._q.put_nowait(item)
+            return True
+        except queue.Full:
+            return False
+
+    def drain(self) -> None:
+        """Block until every enqueued batch is published (tests/bench)."""
+        self._q.join()
+
+    def _run(self) -> None:
+        while True:
+            hashes, k_all, v_all, n = self._q.get()
+            try:
+                # the blocking device->host transfer the engine thread
+                # never pays: the gather outputs are fresh buffers, valid
+                # even after the evicted blocks were re-allocated
+                self._pool._ingest(hashes, np.asarray(k_all),
+                                   np.asarray(v_all), n)
+            except Exception:
+                log.warning("kv tier copy-out failed; blocks evicted "
+                            "without demotion", exc_info=True)
+                self._pool.count_error()
+            finally:
+                self._q.task_done()
+
+
+class HostKVTier:
+    """Bounded, LRU-evicting, content-addressed host block pool."""
+
+    def __init__(self, *, n_layers: int, block_size: int, n_kv_heads: int,
+                 head_dim: int, dtype, capacity_bytes: int,
+                 async_copy: bool = True):
+        self.n_layers = int(n_layers)
+        self.block_size = int(block_size)
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = np.dtype(dtype)
+        #: host bytes ONE block costs (k + v across every layer) — the
+        #: unit of every capacity/accounting decision in this class
+        self.block_nbytes = (2 * self.n_layers * self.block_size
+                             * self.n_kv_heads * self.head_dim
+                             * self.dtype.itemsize)
+        self.capacity_bytes = int(capacity_bytes)
+        self.async_copy = bool(async_copy)
+        self._lock = threading.Lock()
+        #: hash -> (k, v) numpy [n_layers, block_size, n_kv_heads, head_dim]
+        self._entries: "OrderedDict[int, Tuple[np.ndarray, np.ndarray]]" = (
+            OrderedDict())
+        self._stats: Dict[str, int] = {
+            "hits": 0, "misses": 0, "stores": 0, "evictions": 0,
+            "restored": 0, "errors": 0, "dropped": 0, "bytes": 0,
+        }
+        self._worker: Optional[CopyOutWorker] = None
+
+    # -- capacity / accounting ---------------------------------------------
+
+    @property
+    def used_bytes(self) -> int:
+        with self._lock:
+            return len(self._entries) * self.block_nbytes
+
+    @property
+    def n_entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def utilization(self) -> float:
+        if self.capacity_bytes <= 0:
+            return 1.0
+        return min(1.0, self.used_bytes / self.capacity_bytes)
+
+    def has(self, h: int) -> bool:
+        with self._lock:
+            return h in self._entries
+
+    def accepts(self, h: int) -> bool:
+        """Would :meth:`store` of hash ``h`` do useful work? (Not already
+        resident, and the pool can hold at least one block.)"""
+        if self.block_nbytes > self.capacity_bytes:
+            return False
+        return not self.has(h)
+
+    # -- demotion (engine thread enqueues / worker publishes) --------------
+
+    def store_batch(self, hashes: Sequence[int], k_all: Any, v_all: Any,
+                    n: int) -> None:
+        """Accept ``n`` demoted blocks: ``k_all``/``v_all`` are the gather
+        outputs ``[n_layers, pad, Bs, Hkv, Dh]`` (device arrays in async
+        mode — the worker materializes them; anything numpy-coercible in
+        sync mode), column ``j`` belonging to ``hashes[j]``."""
+        if self.async_copy:
+            if self._worker is None:
+                # lazy: engines that never demote never spawn the thread
+                self._worker = CopyOutWorker(self)
+            if not self._worker.submit((list(hashes), k_all, v_all, n)):
+                with self._lock:
+                    self._stats["dropped"] += n
+            return
+        try:
+            self._ingest(list(hashes), np.asarray(k_all), np.asarray(v_all),
+                         n)
+        except Exception:
+            log.warning("kv tier store failed; blocks evicted without "
+                        "demotion", exc_info=True)
+            self.count_error()
+
+    def _ingest(self, hashes: List[int], k_all: np.ndarray,
+                v_all: np.ndarray, n: int) -> None:
+        """Publish ``n`` materialized blocks, LRU-evicting to capacity."""
+        for j, h in enumerate(hashes[:n]):
+            with self._lock:
+                if h in self._entries:
+                    self._entries.move_to_end(h)
+                    continue
+                if self.block_nbytes > self.capacity_bytes:
+                    self._stats["dropped"] += 1
+                    continue
+                while ((len(self._entries) + 1) * self.block_nbytes
+                       > self.capacity_bytes):
+                    self._entries.popitem(last=False)
+                    self._stats["evictions"] += 1
+                self._entries[h] = (np.ascontiguousarray(k_all[:, j]),
+                                    np.ascontiguousarray(v_all[:, j]))
+                self._stats["stores"] += 1
+                self._stats["bytes"] += self.block_nbytes
+
+    def drain(self) -> None:
+        """Wait for pending async copy-outs to publish (tests/bench)."""
+        w = self._worker
+        if w is not None:
+            w.drain()
+
+    # -- restore-side lookups (engine thread) ------------------------------
+
+    def probe_run(self, hashes: Sequence[int]) -> int:
+        """Length of the leading contiguous run of resident hashes —
+        the admission ladder's fall-through probe. Counts one hit per
+        resident block and one miss when the walk stops short."""
+        with self._lock:
+            run = 0
+            for h in hashes:
+                if h not in self._entries:
+                    break
+                self._entries.move_to_end(h)
+                run += 1
+            self._stats["hits"] += run
+            if run < len(hashes):
+                self._stats["misses"] += 1
+            return run
+
+    def get_run(self, hashes: Sequence[int]
+                ) -> List[Tuple[int, np.ndarray, np.ndarray]]:
+        """Leading contiguous resident run as ``(hash, k, v)`` triples
+        (LRU-touched; entries STAY resident — a restored block evicted
+        from the device again re-demotes for free)."""
+        with self._lock:
+            out = []
+            for h in hashes:
+                e = self._entries.get(h)
+                if e is None:
+                    break
+                self._entries.move_to_end(h)
+                out.append((h, e[0], e[1]))
+            return out
+
+    # -- counters / export -------------------------------------------------
+
+    def count_error(self) -> None:
+        with self._lock:
+            self._stats["errors"] += 1
+
+    def count_restored(self, n: int) -> None:
+        with self._lock:
+            self._stats["restored"] += n
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat numeric snapshot: the ``/stats`` ``"kvtier"`` section and
+        the source of the ``shai_kvtier_*`` exports (``serve.metrics``)."""
+        with self._lock:
+            st = dict(self._stats)
+            entries = len(self._entries)
+        looked = st["hits"] + st["misses"]
+        used = entries * self.block_nbytes
+        return {
+            **{k: float(v) for k, v in st.items()},
+            "entries": float(entries),
+            "used_bytes": float(used),
+            "capacity_bytes": float(self.capacity_bytes),
+            "block_nbytes": float(self.block_nbytes),
+            "utilization": round(min(1.0, used / self.capacity_bytes), 4)
+            if self.capacity_bytes > 0 else 1.0,
+            "hit_rate": round(st["hits"] / looked, 4) if looked else 0.0,
+        }

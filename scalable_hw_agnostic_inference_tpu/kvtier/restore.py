@@ -1,0 +1,47 @@
+"""Jitted device<->host block movers for the KV tier.
+
+Two executables, both shape-keyed by jit itself and primed against the
+live pool at tier attach (``PagedKVCache.attach_tier``) so the first
+post-ready demotion or restore never pays an XLA compile:
+
+- :func:`make_tier_gather` — demotion read: one dispatch gathers the
+  evicted blocks' rows out of every layer of the pool into stacked
+  ``[L, n, Bs, Hkv, Dh]`` arrays. The outputs are FRESH buffers, so the
+  async copy-out worker can materialize them host-side later while the
+  freed blocks are re-allocated and overwritten underneath.
+- :func:`make_tier_restore` — warm-hit write: ONE donated scatter-write
+  per layer puts a host-tier block's k/v back into freshly allocated pool
+  rows, replacing the prefill recompute a destroyed block would have cost.
+  Index arrays are padded to a closed set of sizes (``engine/cache.py``'s
+  ``_PAD_SIZES``); padding rows target reserved block 0, whose contents
+  are garbage by contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_tier_gather():
+    """Batched demotion gather: ``(kv pytree, idx[n]) -> (k, v)`` stacked
+    ``[n_layers, n, block_size, n_kv_heads, head_dim]``."""
+
+    def gather(kv, idx):
+        k = jnp.stack([lay["k"][idx] for lay in kv])
+        v = jnp.stack([lay["v"][idx] for lay in kv])
+        return k, v
+
+    return jax.jit(gather)
+
+
+def make_tier_restore():
+    """Per-layer restore scatter: ``(pool_k, pool_v, idx[n], host_k, host_v)
+    -> (pool_k', pool_v')`` with both pool buffers donated (the caller
+    rebinds them in the same statement — the donate-and-rebind idiom)."""
+
+    def restore(pool_k, pool_v, idx, host_k, host_v):
+        return (pool_k.at[idx].set(host_k.astype(pool_k.dtype)),
+                pool_v.at[idx].set(host_v.astype(pool_v.dtype)))
+
+    return jax.jit(restore, donate_argnums=(0, 1))

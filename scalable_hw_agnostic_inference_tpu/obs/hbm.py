@@ -110,6 +110,7 @@ class HbmLedger:
                peak_bytes: Optional[float] = None,
                largest_free: Optional[float] = None,
                drift_value: Optional[float] = None,
+               host_pools: Optional[Dict[str, float]] = None,
                extra: Optional[Dict[str, float]] = None) -> None:
         """Record one tick.
 
@@ -120,6 +121,11 @@ class HbmLedger:
         bytes outside every pool): a fixed preallocated pool never grows
         while its blocks leak, and a decoding sequence's held KV grows by
         design, so neither raw pool bytes nor raw usage is a leak signal.
+
+        ``host_pools`` names HOST-RAM pools (the KV tier's ``host_kv``):
+        exported like device pools (``shai_hbm_host_kv_bytes``) but
+        excluded from the attributed sum — host bytes must never inflate
+        ``used``/``headroom`` math against the device HBM limit.
         """
         attributed = float(sum(pools.values()))
         device_stats = bytes_in_use is not None
@@ -136,6 +142,9 @@ class HbmLedger:
             composition, used if drift_value is None else float(drift_value))
         snap: Dict[str, float] = {f"{k}_bytes": float(v)
                                   for k, v in pools.items()}
+        if host_pools:
+            snap.update({f"{k}_bytes": float(v)
+                         for k, v in host_pools.items()})
         if extra:
             snap.update({k: float(v) for k, v in extra.items()})
         snap.update({

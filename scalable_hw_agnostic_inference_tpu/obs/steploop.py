@@ -100,6 +100,11 @@ class StepTelemetry:
         self.slo = None
         self.sentinel = None
         self.hbm = None
+        # host KV tier (kvtier.pool.HostKVTier): attached by the engine
+        # when SHAI_KVTIER is on; its gauges merge into snapshot() so the
+        # admission gate and /stats see host-pool saturation alongside
+        # the device KV gauges
+        self.kvtier = None
         self._steps: deque = deque(maxlen=max_steps)
         self.ttft = BucketHistogram(TTFT_BUCKETS)
         self.tpot = BucketHistogram(TPOT_BUCKETS)
@@ -158,6 +163,13 @@ class StepTelemetry:
         traces (whose root carries ``engine_req_id``)."""
         total = self.total_blocks or 1
         used = max(0, total - blocks_free)
+        # pressure vs occupancy: evictable prefix-cache blocks are
+        # RECLAIMABLE — a warm cache legitimately occupies ~100% of the
+        # pool (demoting to the host tier on demand), and pricing that as
+        # saturation made every warm pod shed 429s and flip the failover
+        # controller. kv_utilization (the admission/overload signal)
+        # counts live-held blocks only; kv_occupancy keeps the raw view.
+        live = max(0, used - max(0, blocks_evictable))
         rec = {
             "ts": round(time.time(), 4),
             "step": 0,  # filled under the lock below
@@ -169,7 +181,8 @@ class StepTelemetry:
             "finished": finished,
             "kv_blocks_free": blocks_free,
             "kv_blocks_evictable": blocks_evictable,
-            "kv_utilization": round(used / total, 4),
+            "kv_utilization": round(live / total, 4),
+            "kv_occupancy": round(used / total, 4),
             "rollback_tokens": rollback_tokens,
             "finished_ids": list(finished_ids),
         }
@@ -187,6 +200,7 @@ class StepTelemetry:
                 "waiting": float(n_waiting),
                 "chunking": float(n_chunking),
                 "kv_utilization": rec["kv_utilization"],
+                "kv_occupancy": rec["kv_occupancy"],
                 "kv_blocks_free": float(blocks_free),
                 "last_step_duration_s": rec["duration_s"],
             }
@@ -233,6 +247,18 @@ class StepTelemetry:
                 "pipeline_flushes": self.pipeline_flushes,
             }
             out.update(self._gauges)
+        kvt = self.kvtier
+        if kvt is not None:
+            # host-tier saturation + hit rate travel with the engine
+            # snapshot: the admission gate prices host_kv_utilization into
+            # shed decisions, and /stats consumers read it here
+            try:
+                ksnap = kvt.snapshot()
+            except Exception:
+                ksnap = {}
+            out["host_kv_utilization"] = ksnap.get("utilization", 0.0)
+            out["host_kv_used_bytes"] = ksnap.get("used_bytes", 0.0)
+            out["host_kv_hit_rate"] = ksnap.get("hit_rate", 0.0)
         for name, h in (("ttft", self.ttft), ("tpot", self.tpot),
                         ("queue_wait", self.queue_wait),
                         ("step_gap", self.step_gap)):

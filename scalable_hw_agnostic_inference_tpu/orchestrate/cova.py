@@ -22,13 +22,18 @@ import logging
 import os
 import random
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
+from ..kvtier.affinity import prompt_affinity
 from ..resilience import faults as rz_faults
 from ..resilience.breaker import CircuitBreaker
 from ..serve.asgi import App, HTTPError, Request, Response
 
 log = logging.getLogger(__name__)
+
+#: how long a /fleet snapshot steers routing before it re-polls — warm
+#: prefixes and overload flags move on engine timescales, not per request
+FLEET_CACHE_TTL_S = 2.0
 
 
 def resolve_service_url(name: str, spec: Dict[str, Any]) -> str:
@@ -98,6 +103,11 @@ class CovaClient:
         # N replicas re-probe a recovering backend in lockstep (tests that
         # need determinism inject their own seeded rng)
         self._rng = rng or random.Random()
+        # short-TTL /fleet snapshot for prefix-affinity routing (one poll
+        # steers many requests; a poll failure degrades to weighted order)
+        self._fleet_cache: Optional[Dict[str, Any]] = None
+        self._fleet_cache_at = 0.0
+        self.fleet_cache_ttl_s = FLEET_CACHE_TTL_S
 
     def url_of(self, name: str) -> str:
         if name not in self.models:
@@ -247,12 +257,107 @@ class CovaClient:
             if isinstance(perf, dict) and "conformance" in perf:
                 ent["perf_conformance"] = perf["conformance"]
                 ent["perf_degraded"] = bool(perf.get("degraded"))
+            kvt = st.get("kvtier")
+            if isinstance(kvt, dict):
+                # warm-prefix advertisement + tier health at a glance; the
+                # full affinity digest list stays in results[name]["kvtier"]
+                if "hit_rate" in kvt:
+                    ent["kvtier_hit_rate"] = kvt["hit_rate"]
+                aff = kvt.get("affinity")
+                if isinstance(aff, list):
+                    ent["warm_prefixes"] = len(aff)
             if ent:
                 conformance[name] = ent
         slo_breached = sorted(n for n, e in conformance.items()
                               if e.get("slo_breach"))
         return {"models": results, "overloaded": overloaded,
                 "conformance": conformance, "slo_breached": slo_breached}
+
+    # -- prefix-affinity routing (kvtier) -----------------------------------
+
+    def weighted_order(self, names: Optional[List[str]] = None) -> List[str]:
+        """The cost-optimized base order: text-generation backends by
+        descending ``weight`` from models.json (default 1.0), name-stable
+        on ties — the same weighted-vs-equal discipline the ingress runs
+        (``capacity_checker``), applied to cova's own fan-out."""
+        gen = [n for n in (names or self.models)
+               if self.models.get(n, {}).get("task", "text-generation")
+               == "text-generation"]
+
+        def weight_of(n: str) -> float:
+            try:
+                return float(self.models.get(n, {}).get("weight", 1.0))
+            except (TypeError, ValueError):
+                return 1.0
+
+        return sorted(gen, key=lambda n: (-weight_of(n), n))
+
+    async def _fleet_for_routing(self) -> Dict[str, Any]:
+        """Short-TTL cached /fleet snapshot; a poll failure returns the
+        empty dump (routing degrades to the weighted order, never fails)."""
+        now = time.monotonic()
+        if (self._fleet_cache is not None
+                and now - self._fleet_cache_at < self.fleet_cache_ttl_s):
+            return self._fleet_cache
+        try:
+            snap = await self.fleet()
+        except Exception:
+            log.debug("fleet poll for routing failed", exc_info=True)
+            snap = {"models": {}, "overloaded": []}
+        self._fleet_cache = snap
+        self._fleet_cache_at = time.monotonic()
+        return snap
+
+    @staticmethod
+    def rank_backends(prompt: str, order: List[str],
+                      fleet: Dict[str, Any]) -> Tuple[List[str], List[str]]:
+        """Prefix-affinity ranking: backends advertising the prompt's
+        leading-block digest (``/stats`` → ``kvtier.affinity``) move to
+        the front — their prefix cache / host tier serves the prefill
+        warm — unless they are overloaded; everything else keeps the
+        weighted order. Returns ``(ranked, warm)``; pure and deterministic
+        (unit-tested directly)."""
+        if len(order) <= 1:
+            return list(order), []
+        digest = prompt_affinity(prompt)
+        overloaded = set(fleet.get("overloaded") or ())
+        models = fleet.get("models") or {}
+        warm, cold = [], []
+        for n in order:
+            st = models.get(n)
+            aff = (st.get("kvtier") or {}).get("affinity") \
+                if isinstance(st, dict) else None
+            if (isinstance(aff, list) and digest in aff
+                    and n not in overloaded):
+                warm.append(n)
+            else:
+                cold.append(n)
+        return warm + cold, warm
+
+    async def generate(self, prompt: str, params: Dict[str, Any],
+                       names: Optional[List[str]] = None) -> Dict[str, Any]:
+        """Route ONE generation to the best backend: prefix-affinity first
+        (the pod already holding this prompt's warm KV), weighted order as
+        the fallback; a failed backend falls through to the next instead
+        of failing the request."""
+        order = self.weighted_order(names)
+        if not order:
+            raise HTTPError(400, "no text-generation models configured")
+        ranked, warm = self.rank_backends(prompt, order,
+                                          await self._fleet_for_routing())
+        last: Optional[HTTPError] = None
+        for name in ranked:
+            try:
+                out = await self.post(name, "/generate",
+                                      {"prompt": prompt, **params})
+            except HTTPError as e:
+                last = e
+                continue
+            out["model"] = name
+            out["routed_by"] = "affinity" if name in warm else "weighted"
+            return out
+        raise last if last is not None else HTTPError(
+            502, "no backend accepted the request")
 
     async def chain(self, prompt: str, image_b64: str = "") -> Dict[str, Any]:
         """The full cova chain: prompt → image → caption → embeddings.
@@ -297,10 +402,8 @@ class CovaClient:
                       names: Optional[List[str]] = None) -> Dict[str, Any]:
         """llm_gradio parity: same prompt to N generation services
         (``app/llm_gradio.py:76-94``)."""
-        gen = [n for n in (names or self.models)
-               if self.models.get(n, {}).get("task", "text-generation")
-               == "text-generation"]
-        if not gen:
+        gen = self.weighted_order(names)  # ONE task filter (order is
+        if not gen:                       # harmless to a gather fan-out)
             raise HTTPError(400, "no text-generation models configured")
 
         async def one(n):
@@ -366,6 +469,20 @@ def create_cova_app(models_path: str) -> App:
     @app.get("/fleet")
     async def fleet(request: Request):
         return await client.fleet()
+
+    @app.post("/generate")
+    async def generate(request: Request):
+        """Routed single-backend generation: prefix-affinity first (the
+        pod advertising this prompt's warm prefix on /fleet), weighted
+        order as the fallback."""
+        body = request.json()
+        prompt = str(body.get("prompt", ""))
+        if not prompt:
+            raise HTTPError(400, "missing prompt")
+        params = {k: body[k] for k in
+                  ("temperature", "top_k", "top_p", "max_new_tokens")
+                  if k in body}
+        return await client.generate(prompt, params, body.get("models"))
 
     @app.post("/compare")
     async def compare(request: Request):

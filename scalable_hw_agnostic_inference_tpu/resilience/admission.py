@@ -62,11 +62,23 @@ class AdmissionGate:
 
     def __init__(self, thresholds: Optional[OverloadThresholds] = None,
                  max_inflight: int = 0, retry_after_s: float = 1.0,
-                 drain_retry_after_s: float = 5.0):
+                 drain_retry_after_s: float = 5.0,
+                 tier_full_utilization: float = 0.95,
+                 tier_full_kv_utilization: float = 0.85):
         self.thresholds = thresholds or OverloadThresholds()
         self.max_inflight = max_inflight  # 0 = no cap
         self.retry_after_s = retry_after_s
         self.drain_retry_after_s = drain_retry_after_s
+        # host KV tier pricing (kvtier): while the host pool can absorb
+        # demotions, device eviction is cheap (a copy, not lost work) and
+        # the normal max_kv_utilization line applies. Once the HOST pool
+        # saturates (>= tier_full_utilization), every further eviction
+        # destroys banked prefill again — the gate tightens to the lower
+        # tier_full_kv_utilization line so shedding starts BEFORE the pod
+        # re-enters the recompute regime. Pods without a tier never report
+        # host_kv_utilization and are unaffected.
+        self.tier_full_utilization = tier_full_utilization
+        self.tier_full_kv_utilization = tier_full_kv_utilization
         self._lock = threading.Lock()
         self._shed: Dict[str, int] = {}
 
@@ -99,6 +111,14 @@ class AdmissionGate:
         if (lane_width > 0
                 and lane_pending - lane_width > self.thresholds.max_queue_depth):
             return Shed(429, "queue_depth", self.retry_after_s)
+        if (isinstance(stats, dict)
+                and stats.get("host_kv_utilization", 0.0)
+                >= self.tier_full_utilization
+                and stats.get("kv_utilization", 0.0)
+                > self.tier_full_kv_utilization):
+            # saturated host tier: demotion degraded back to deletion, so
+            # device-KV pressure is priced at the tighter line
+            return Shed(429, "kv_pressure", self.retry_after_s)
         if isinstance(stats, dict) and is_overloaded(stats, self.thresholds):
             reason = ("queue_depth"
                       if stats.get("waiting", 0) > self.thresholds.max_queue_depth

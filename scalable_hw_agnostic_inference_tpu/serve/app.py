@@ -123,6 +123,14 @@ class ModelService:
         occupancy here."""
         return {}
 
+    def affinity_digests(self) -> Optional[List[str]]:
+        """Recently served prompt-affinity digests (``kvtier.affinity``),
+        advertised under ``/stats`` → ``kvtier.affinity`` so the cova
+        orchestrator can route a repeated prompt to the pod whose prefix
+        cache / host tier is already warm. None = no advertisement
+        (services without an engine or without prefix caching)."""
+        return None
+
     def spec_counters(self) -> Optional[Dict[str, int]]:
         """Cumulative speculative-decoding counters
         (``{"drafted", "accepted", "committed"}``) for
@@ -509,12 +517,19 @@ def create_app(
             # and cova /fleet aggregates "hbm"/"perf" per backend
             for sec, obj in (("slo", getattr(tele, "slo", None)),
                              ("hbm", getattr(tele, "hbm", None)),
-                             ("perf", getattr(tele, "sentinel", None))):
+                             ("perf", getattr(tele, "sentinel", None)),
+                             ("kvtier", getattr(tele, "kvtier", None))):
                 if obj is not None:
                     try:
                         out[sec] = obj.snapshot()
                     except Exception:
                         pass
+        # warm-prefix advertisement (kvtier.affinity): cova's prefix-
+        # affinity router reads these digests off /fleet — exported even
+        # tier-less, the DEVICE prefix cache is warm too
+        aff = service.affinity_digests()
+        if aff is not None:
+            out.setdefault("kvtier", {})["affinity"] = aff
         from ..core.aot import compile_stats
 
         out["aot"] = compile_stats()

@@ -62,7 +62,12 @@ _ENGINE_GAUGES = {
     "waiting": ("shai_engine_waiting", "Requests in the admission queue"),
     "chunking": ("shai_engine_chunking", "Slots mid chunked-prefill"),
     "kv_utilization": ("shai_engine_kv_utilization",
-                       "KV page pool fraction in use"),
+                       "KV page pool fraction held by LIVE sequences "
+                       "(evictable prefix-cache blocks excluded — they "
+                       "reclaim on demand)"),
+    "kv_occupancy": ("shai_engine_kv_occupancy",
+                     "KV page pool fraction allocated, cached blocks "
+                     "included"),
     "kv_blocks_free": ("shai_engine_kv_blocks_free", "Free KV pool blocks"),
     "spec_acceptance_rate": ("shai_spec_acceptance_rate",
                              "Speculative draft acceptance rate"),
@@ -89,6 +94,38 @@ _CONFORMANCE_PREFIXES = (
     ("hbm", "shai_hbm_", "Live HBM ledger gauge"),
     ("sentinel", "shai_perf_", "Perf-model sentinel gauge"),
 )
+#: host KV tier (kvtier.pool.HostKVTier snapshot keys → metric names):
+#: counters carry the Prometheus _total suffix; gauges export raw
+_KVTIER_COUNTERS = {
+    "hits": ("shai_kvtier_hits_total",
+             "Host KV tier: prefix blocks found resident"),
+    "misses": ("shai_kvtier_misses_total",
+               "Host KV tier: prefix walks that stopped short"),
+    "evictions": ("shai_kvtier_evictions_total",
+                  "Host KV tier: blocks LRU-evicted from the host pool"),
+    "stores": ("shai_kvtier_stores_total",
+               "Host KV tier: blocks demoted into the host pool"),
+    "restored": ("shai_kvtier_restored_total",
+                 "Host KV tier: blocks swapped back into the device pool"),
+    "bytes": ("shai_kvtier_bytes_total",
+              "Host KV tier: cumulative bytes copied into the host pool"),
+    "errors": ("shai_kvtier_errors_total",
+               "Host KV tier: failures degraded to recompute"),
+    "dropped": ("shai_kvtier_dropped_total",
+                "Host KV tier: demotions dropped (queue full / no capacity)"),
+}
+_KVTIER_GAUGES = {
+    "used_bytes": ("shai_kvtier_used_bytes",
+                   "Host KV tier: bytes resident in the host pool"),
+    "capacity_bytes": ("shai_kvtier_capacity_bytes",
+                       "Host KV tier: configured capacity "
+                       "(SHAI_KVTIER_BYTES)"),
+    "entries": ("shai_kvtier_entries", "Host KV tier: resident blocks"),
+    "utilization": ("shai_kvtier_utilization",
+                    "Host KV tier: used/capacity fraction"),
+    "hit_rate": ("shai_kvtier_hit_rate",
+                 "Host KV tier: hits / (hits + misses)"),
+}
 
 
 class EngineTelemetryCollector:
@@ -155,6 +192,23 @@ class EngineTelemetryCollector:
                 g = GaugeMetricFamily(f"{prefix}{k}", doc, labels=["app"])
                 g.add_metric([self.app], float(v))
                 yield g
+        # host KV tier (kvtier): counters with their _total contract +
+        # occupancy gauges, from the same telemetry object
+        kvt = getattr(tele, "kvtier", None)
+        if kvt is not None:
+            try:
+                snap = kvt.snapshot()
+            except Exception:
+                return
+            for key, (name, doc) in _KVTIER_COUNTERS.items():
+                c = CounterMetricFamily(name, doc, labels=["app"])
+                c.add_metric([self.app], float(snap.get(key, 0)))
+                yield c
+            for key, (name, doc) in _KVTIER_GAUGES.items():
+                if key in snap:
+                    g = GaugeMetricFamily(name, doc, labels=["app"])
+                    g.add_metric([self.app], float(snap[key]))
+                    yield g
 
 
 class MetricsPublisher:

@@ -63,6 +63,12 @@ class VllmService(ModelService):
             self.ecfg = None
             self._ecfg_error = e
             self.concurrency = 1
+        # warm-prefix advertisement (kvtier.affinity): every encoded
+        # prompt's leading-text digest lands here; /stats exposes the set
+        # so cova's prefix-affinity router can steer repeats to this pod
+        from ...kvtier.affinity import AffinityTracker
+
+        self._affinity = AffinityTracker()
 
     @staticmethod
     def _resolve_ecfg(cfg: ServeConfig):
@@ -393,9 +399,19 @@ class VllmService(ModelService):
             if max_text < 1:
                 raise HTTPError(400, "image prefix leaves no prompt room")
             ids = ids[:max_text]
-        return self._collect(self.loop.submit(
+        out = self._collect(self.loop.submit(
             ids, params, prefix=prefix, cross_states=cross_states,
             cross_len=cross_len, deadline_at=self._deadline_at()))
+        if self._engine.cache.prefix_caching:
+            # advertise warmth ONLY for the /generate path cova routes,
+            # and only after the request actually served: chat-templated
+            # OpenAI prompts digest differently than cova's raw-prompt
+            # hash and would pollute the bounded tracker, and a shed/
+            # rejected request left no KV to be warm about
+            from ...kvtier.affinity import prompt_affinity
+
+            self._affinity.note(prompt_affinity(prompt))
+        return out
 
     @staticmethod
     def _deadline_at() -> float:
@@ -484,6 +500,12 @@ class VllmService(ModelService):
             # shai_spec_*_total counters the request path publishes
             out.update(eng.spec.as_dict())
         return out
+
+    def affinity_digests(self):
+        eng = getattr(self, "_engine", None)
+        if eng is None or not eng.cache.prefix_caching:
+            return None  # no warm prefixes to advertise
+        return self._affinity.snapshot()
 
     def spec_counters(self):
         eng = getattr(self, "_engine", None)

@@ -43,6 +43,9 @@ SCAN_FILES = (
     os.path.join(PKG, "obs", "slo.py"),
     os.path.join(PKG, "obs", "sentinel.py"),
     os.path.join(PKG, "orchestrate", "capacity_checker.py"),
+    # the host KV tier's shai_kvtier_* family (exported via serve/metrics;
+    # scanned here too so a counter added pool-side can't go undocumented)
+    os.path.join(PKG, "kvtier", "pool.py"),
 )
 README = os.path.join(ROOT, "README.md")
 

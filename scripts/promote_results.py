@@ -26,7 +26,10 @@ KEYS = {"sd": "sd21_img_s",
         "llama3b_int8": "llama3b_int8_decode_tok_s",
         # speculative decoding (prompt-lookup k=4): tokens/s plus the
         # acceptance_rate/tokens_per_verify fields the bench line carries
-        "llama_spec": "llama_spec_tps"}
+        "llama_spec": "llama_spec_tps",
+        # KV tiering (PR 10): cold/warm-host-tier TTFT ratio on prompt
+        # replay after eviction pressure (bench.py kvtier)
+        "kvtier": "kvtier_warm_ttft_speedup"}
 
 
 def _load_results() -> dict:

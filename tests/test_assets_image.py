@@ -53,6 +53,7 @@ def test_control_plane_imports_without_model_stack():
 def test_dockerfile_assets_copies_only_the_control_plane():
     text = open(os.path.join(ROOT, "build", "Dockerfile.assets")).read()
     for needed in ("orchestrate/", "serve/asgi.py", "serve/httpd.py",
+                   "kvtier/affinity.py",  # cova's prefix-affinity digest
                    "native/loadgen", "breaking_point.py", "kubectl"):
         assert needed in text, f"Dockerfile.assets must ship {needed}"
     # instructions only (comments may NAME the excluded trees)

@@ -75,6 +75,36 @@ def test_llama_spec_key_promotes_tokens_per_second():
                                   acceptance_rate=0.7))
 
 
+def test_kvtier_key_promotes_warm_ttft_speedup():
+    # PR-10 tentpole: the KV-tier bench publishes under its own key and
+    # dispatches as its own variant (never banking as another bench)
+    assert promote.KEYS["kvtier"] == "kvtier_warm_ttft_speedup"
+    bspec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(ROOT, "bench.py"))
+    bench = importlib.util.module_from_spec(bspec)
+    bspec.loader.exec_module(bench)
+    assert bench._which_from_argv(["bench.py", "kvtier"]) == "kvtier"
+    assert bench.UNITS_BY_BENCH["kvtier"] == "x"
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_kvtier_bench_warm_beats_cold_on_cpu_tiny():
+    """The acceptance number: prompt replay through the host tier must
+    beat a cold prefill on the CPU-tiny engine (value = cold/warm > 1)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--inner",
+         "kvtier", "--cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu" and out["unit"] == "x"
+    assert out["warm_ttft_ms"] < out["cold_ttft_ms"], out
+    assert out["value"] > 1.0
+    assert out["tier"]["restored"] > 0 and out["tier"]["errors"] == 0
+    assert promote.is_real(_entry(metric="kvtier warm ttft (tpu)",
+                                  unit="x"))
+
+
 def test_spec_bench_line_carries_phase_timings():
     """Engine bench lines attach the obs per-phase split (queue/prefill/
     decode medians from Finished.timing), so a BENCH_*.json regression

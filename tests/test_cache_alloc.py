@@ -1,0 +1,140 @@
+"""BlockAllocator refcount edges + prefix-cache/allocator ordering.
+
+The allocator underpins every block-accounting invariant the engine and
+the KV tier rely on; these tests pin the edges review keeps circling:
+double-free detection, incref of a block that eviction already freed, and
+the free-while-prefix-cached ordering (a sequence releasing its blocks
+must leave the cache's own reference intact — and vice versa).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalable_hw_agnostic_inference_tpu.engine import BlockAllocator
+from scalable_hw_agnostic_inference_tpu.engine.cache import PagedKVCache
+
+
+def make_cache(**over):
+    kw = dict(n_layers=2, n_kv_heads=2, head_dim=4, total_blocks=16,
+              block_size=4, blocks_per_seq=8, dtype=jnp.float32,
+              enable_prefix_caching=True)
+    kw.update(over)
+    return PagedKVCache(**kw)
+
+
+# -- raw allocator edges ------------------------------------------------------
+
+def test_double_free_detected_at_every_refcount():
+    a = BlockAllocator(8)
+    [b] = a.alloc(1)
+    a.free([b])
+    with pytest.raises(ValueError, match="double free"):
+        a.free([b])
+    # a shared block double-frees only past its LAST reference
+    [c] = a.alloc(1)
+    a.incref(c)
+    a.free([c])
+    a.free([c])
+    with pytest.raises(ValueError, match="double free"):
+        a.free([c])
+
+
+def test_free_of_reserved_block_zero_rejected():
+    a = BlockAllocator(8)
+    with pytest.raises(ValueError, match="reserved"):
+        a.free([0])
+
+
+def test_incref_on_freed_block_rejected():
+    """Eviction frees a cache-only block; a stale holder increfing it
+    afterwards (the use-after-evict class) must fail loudly, not resurrect
+    the block with refcount 1 while the free list also owns it."""
+    a = BlockAllocator(8)
+    [b] = a.alloc(1)
+    a.free([b])
+    with pytest.raises(ValueError, match="unallocated"):
+        a.incref(b)
+
+
+def test_partial_alloc_failure_leaves_freelist_intact():
+    a = BlockAllocator(4)  # 3 usable
+    a.alloc(3)
+    before = a.n_free
+    with pytest.raises(MemoryError):
+        a.alloc(1)
+    assert a.n_free == before
+
+
+# -- free-while-prefix-cached ordering ---------------------------------------
+
+def _admit_and_register(cache, seq_id, tokens):
+    alloc = cache.admit(seq_id, len(tokens))
+    cache.register_prefix(tokens, alloc.blocks)
+    return alloc
+
+
+def test_release_after_register_keeps_cache_reference():
+    """Sequence release drops ONE reference; registered blocks survive at
+    refcount 1 (the cache's), stay lookup-able, and remain evictable."""
+    cache = make_cache()
+    tokens = list(range(100, 108))  # 2 full blocks
+    alloc = _admit_and_register(cache, 0, tokens)
+    full = alloc.blocks[:2]
+    assert all(cache.allocator.refcount(b) == 2 for b in full)
+    cache.release(0)
+    assert all(cache.allocator.refcount(b) == 1 for b in full)
+    assert cache.cached_prefix(tokens) == full
+    assert cache.n_evictable >= 2
+
+
+def test_evict_then_stale_reuse_is_detected():
+    """After eviction freed a cached block, an incref through the stale
+    block id (the ordering bug free-while-prefix-cached protects against)
+    raises instead of corrupting the free list."""
+    cache = make_cache()
+    tokens = list(range(200, 208))
+    alloc = _admit_and_register(cache, 0, tokens)
+    stale = list(alloc.blocks[:2])
+    cache.release(0)
+    assert cache._evict(2) == 2
+    for b in stale:
+        with pytest.raises(ValueError):
+            cache.allocator.incref(b)
+    assert cache.cached_prefix(tokens) == []
+
+
+def test_shared_prefix_block_freed_only_after_every_holder():
+    """Cache ref + two sequences sharing a block: releases in any order
+    leave the block allocated until the LAST holder (the cache) lets go
+    via eviction."""
+    cache = make_cache()
+    tokens = list(range(300, 308))
+    alloc = _admit_and_register(cache, 0, tokens)
+    shared = alloc.blocks[:2]
+    cache.admit(1, len(tokens), reuse_blocks=shared)
+    assert all(cache.allocator.refcount(b) == 3 for b in shared)
+    cache.release(0)
+    cache.release(1)
+    assert all(cache.allocator.refcount(b) == 1 for b in shared)
+    free_before = cache.allocator.n_free
+    assert cache._evict(2) == 2
+    assert cache.allocator.n_free == free_before + 2
+
+
+def test_shrink_never_touches_shared_prefix_blocks():
+    """Rollback (speculative shrink) frees only fresh decode-tail blocks;
+    the reused prefix at the FRONT of the allocation keeps its refcounts."""
+    cache = make_cache()
+    tokens = list(range(400, 408))
+    alloc = _admit_and_register(cache, 0, tokens)
+    shared = alloc.blocks[:2]
+    cache.admit(1, len(tokens), reuse_blocks=shared)
+    # grow seq 1 by 5 tokens (2 fresh blocks), then roll them back
+    cache.extend(1, 5)
+    cache.shrink(1, 5)
+    assert all(cache.allocator.refcount(b) == 3 for b in shared)
+    cache.release(1)
+    cache.release(0)
+    assert all(cache.allocator.refcount(b) == 1 for b in shared)

@@ -133,6 +133,37 @@ async def test_fleet_aggregates_engine_telemetry(upstream_services, tmp_path):
         assert body["overloaded"] == []              # idle fleet is healthy
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+@pytest.mark.asyncio
+async def test_routed_generate_end_to_end(upstream_services, tmp_path):
+    """POST /generate routes ONE backend (prefix-affinity first, weighted
+    order fallback) and falls through dead backends instead of failing."""
+    urls = upstream_services
+    models = {
+        "llm": {"url": urls["llm"], "task": "text-generation",
+                "weight": 1},
+        # higher weight but unreachable: routing must fall through
+        "down": {"url": "http://127.0.0.1:9", "task": "text-generation",
+                 "weight": 5},
+        "embed": {"url": urls["embed"], "task": "embeddings"},
+    }
+    p = tmp_path / "models.json"
+    p.write_text(json.dumps({"models": models}))
+    app = create_cova_app(str(p))
+    async with make_client(app) as c:
+        r = await c.post("/generate", json={"prompt": "hello world",
+                                            "temperature": 0.0,
+                                            "max_new_tokens": 4})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["model"] == "llm"
+        assert body["routed_by"] in ("weighted", "affinity")
+        assert body["n_tokens"] == 4
+
+        r = await c.post("/generate", json={})
+        assert r.status_code == 400
+
+
 @pytest.mark.asyncio
 async def test_fleet_tolerates_non_dict_stats_json(monkeypatch):
     """A mis-pointed service URL can 200 with non-dict JSON (array/string);

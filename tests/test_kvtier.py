@@ -1,0 +1,526 @@
+"""KV tiering (kvtier/): host-RAM offload tier for the paged KV cache.
+
+THE invariant: the tier changes WHERE KV bytes come from — never what
+gets generated, and never the pool arithmetic. Differential tests pin
+token-exactness vs tier-off across greedy/sampled/preemption/async-decode
+schedules; the fuzz pins device AND host block accounting under seeded
+cancel/evict pressure; unit tests cover the host pool's bounded-LRU
+accounting, the async copy-out worker, admission-gate pricing, and cova's
+prefix-affinity routing.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+from scalable_hw_agnostic_inference_tpu.engine.engine import (
+    LLMEngine,
+    SamplingParams,
+)
+from scalable_hw_agnostic_inference_tpu.kvtier.affinity import (
+    AffinityTracker,
+    prompt_affinity,
+)
+from scalable_hw_agnostic_inference_tpu.kvtier.pool import HostKVTier
+from scalable_hw_agnostic_inference_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, params
+
+
+def make_engine(tiny_model, monkeypatch, tier=True, tier_async=False,
+                async_decode=None, **over):
+    cfg, _, params = tiny_model
+    monkeypatch.setenv("SHAI_KVTIER", "1" if tier else "0")
+    monkeypatch.setenv("SHAI_KVTIER_ASYNC", "1" if tier_async else "0")
+    if async_decode is not None:
+        monkeypatch.setenv("SHAI_ASYNC_DECODE", "1" if async_decode else "0")
+    kw = dict(max_model_len=128, max_num_seqs=3, block_size=8,
+              context_encoding_buckets=(16, 32), max_new_tokens=16,
+              enable_prefix_caching=True)
+    kw.update(over)
+    return LLMEngine(cfg, params, EngineConfig(**kw))
+
+
+def _prompts(seed, n, length=40):
+    rng = np.random.default_rng(seed)
+    return [[int(x) for x in rng.integers(2, 500, length)] for _ in range(n)]
+
+
+def _run_all(eng, prompts, sp):
+    ids = [eng.add_request(list(p), sp) for p in prompts]
+    done = {}
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    eng.finish_pending()
+    return [done[i] for i in ids]
+
+
+def _assert_pool_exact(eng):
+    """Device accounting closes: every allocated block is explained by
+    the prefix cache (no live sequences remain), nothing leaks; host
+    accounting closes: used_bytes is exactly entries * block_nbytes."""
+    cache = eng.cache
+    assert cache.active == []
+    used = (cache.total_blocks - 1) - cache.allocator.n_free
+    assert used == len(cache._block2hash)
+    assert cache.leaked_blocks == 0
+    tier = cache.tier
+    if tier is not None:
+        tier.drain()
+        snap = tier.snapshot()
+        assert snap["used_bytes"] == snap["entries"] * snap["block_nbytes"]
+        assert snap["used_bytes"] <= snap["capacity_bytes"]
+
+
+# -- differential: tier on == tier off ---------------------------------------
+
+def _differential(tiny_model, monkeypatch, sp, seed=2, n=4, rounds=2,
+                  tier_async=False, async_decode=None, **over):
+    prompts = _prompts(seed, n)
+    off = make_engine(tiny_model, monkeypatch, tier=False,
+                      async_decode=async_decode, **over)
+    want = [[f.token_ids for f in _run_all(off, prompts, sp)]
+            for _ in range(rounds)]
+    on = make_engine(tiny_model, monkeypatch, tier=True,
+                     tier_async=tier_async, async_decode=async_decode,
+                     **over)
+    got = [[f.token_ids for f in _run_all(on, prompts, sp)]
+           for _ in range(rounds)]
+    assert got == want
+    _assert_pool_exact(on)
+    return on
+
+
+def test_differential_greedy_eviction_replay(tiny_model, monkeypatch):
+    # small pool + replay rounds: round 2 re-admits prompts whose blocks
+    # were evicted (demoted) in round 1 — the restore path must be exact
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    eng = _differential(tiny_model, monkeypatch, sp, num_blocks=16,
+                        max_num_seqs=1)
+    snap = eng.cache.tier.snapshot()
+    assert snap["stores"] > 0, "eviction pressure never demoted a block"
+    assert snap["restored"] > 0, "replay never restored from the host tier"
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_differential_sampled_restore_vs_device_hit(tiny_model,
+                                                    monkeypatch):
+    """Sampled exactness where it is actually promised: a host-tier
+    restore must be byte-identical to the device-cache hit it replaces —
+    same admission path, same rng folds, same cont executable, so the
+    replay's sampled tokens match an engine whose pool never evicted.
+    (Across DIFFERENT admission paths sampled tokens are path-dependent
+    by the engine's step-indexed rng design — greedy parity is the
+    cross-path invariant, pinned above.)"""
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.9,
+                        max_new_tokens=6)
+    prompts = _prompts(3, 4)
+    # reference: pool big enough that nothing evicts — replays are pure
+    # device-cache hits
+    ref = make_engine(tiny_model, monkeypatch, tier=False, num_blocks=64,
+                      max_num_seqs=1)
+    want = [[f.token_ids for f in _run_all(ref, prompts, sp)]
+            for _ in range(2)]
+    assert ref.cache.allocator.n_free > 0
+    # probe: small pool, constant eviction — replays restore from host
+    eng = make_engine(tiny_model, monkeypatch, tier=True, num_blocks=16,
+                      max_num_seqs=1)
+    got = [[f.token_ids for f in _run_all(eng, prompts, sp)]
+           for _ in range(2)]
+    assert got == want
+    assert eng.cache.tier.snapshot()["restored"] > 0
+    _assert_pool_exact(eng)
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_differential_preemption(tiny_model, monkeypatch):
+    # a pool sized to force recompute-preemption (the engine_async
+    # geometry): tier-on resumes from offloaded/restored KV, tier-off
+    # recomputes — same tokens either way
+    sp = SamplingParams(temperature=0.0, max_new_tokens=12)
+    prompts = [[11 + i, 7, 9, 3] for i in range(3)]
+    off = make_engine(tiny_model, monkeypatch, tier=False, num_blocks=6,
+                      max_model_len=64)
+    want = [f.token_ids for f in _run_all(off, prompts, sp)]
+    on = make_engine(tiny_model, monkeypatch, tier=True, num_blocks=6,
+                     max_model_len=64)
+    got = [f.token_ids for f in _run_all(on, prompts, sp)]
+    assert got == want
+    assert on.obs.preemptions > 0, "schedule never preempted"
+    _assert_pool_exact(on)
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_differential_async_decode_both_disciplines(tiny_model, monkeypatch):
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    for async_decode in (False, True):
+        _differential(tiny_model, monkeypatch, sp, seed=5,
+                      async_decode=async_decode, num_blocks=16,
+                      max_num_seqs=2)
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_differential_async_copyout(tiny_model, monkeypatch):
+    # the copy-out worker publishes asynchronously: restores may miss
+    # in-flight entries (degrading to recompute) but never change tokens
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    eng = _differential(tiny_model, monkeypatch, sp, seed=6, rounds=3,
+                        tier_async=True, num_blocks=16, max_num_seqs=1)
+    eng.cache.tier.drain()
+    assert eng.cache.tier.snapshot()["stores"] > 0
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_warm_tier_hit_skips_prefill_blocks(tiny_model, monkeypatch):
+    """A replay after eviction allocates fewer FRESH blocks than a cold
+    admission (the restore swaps blocks in instead of recomputing), and
+    the tier counts the restore."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    eng = make_engine(tiny_model, monkeypatch, tier=True, num_blocks=16,
+                      max_num_seqs=1)
+    prompts = _prompts(7, 4)
+    _run_all(eng, prompts, sp)          # fills pool; early prompts demote
+    _run_all(eng, prompts[1:], sp)      # more pressure on prompt 0's run
+    assert len(eng.cache.cached_prefix(prompts[0])) < 4, \
+        "pressure should have evicted prompt 0's warm-start run"
+    restored_before = eng.cache.tier.snapshot()["restored"]
+    _run_all(eng, [prompts[0]], sp)     # replay: host-tier restore
+    assert eng.cache.tier.snapshot()["restored"] > restored_before
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_preemption_offload_reaches_tier(tiny_model, monkeypatch):
+    """Preemption publishes the victim's blocks (demotion, not deletion):
+    under sustained pressure they land in the host tier and the resumed
+    sequence's re-admission finds a warm prefix."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=20)
+    eng = make_engine(tiny_model, monkeypatch, tier=True, num_blocks=10,
+                      max_num_seqs=3)
+    prompts = _prompts(8, 3, length=20)
+    _run_all(eng, prompts, sp)
+    assert eng.obs.preemptions > 0
+    snap = eng.cache.tier.snapshot()
+    assert snap["stores"] > 0, \
+        "pool pressure never demoted the offloaded victim blocks"
+    _assert_pool_exact(eng)
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_tier_failure_degrades_to_recompute(tiny_model, monkeypatch):
+    """A tier whose restore explodes must cost recompute, never a failed
+    request or broken accounting."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    off = make_engine(tiny_model, monkeypatch, tier=False, num_blocks=16,
+                      max_num_seqs=1)
+    prompts = _prompts(9, 3)
+    want = [[f.token_ids for f in _run_all(off, prompts, sp)]
+            for _ in range(2)]
+    eng = make_engine(tiny_model, monkeypatch, tier=True, num_blocks=16,
+                      max_num_seqs=1)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected tier restore failure")
+
+    eng.cache._tier_write = boom
+    got = [[f.token_ids for f in _run_all(eng, prompts, sp)]
+           for _ in range(2)]
+    assert got == want
+    _assert_pool_exact(eng)
+
+
+def test_seeded_cancel_evict_fuzz(tiny_model, monkeypatch):
+    """Seeded add/step/cancel schedule under a tiny pool (constant
+    eviction + preemption + tier traffic): terminal-exactly-once per
+    request, device accounting closes, host accounting closes."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    eng = make_engine(tiny_model, monkeypatch, tier=True, num_blocks=12,
+                      max_num_seqs=2)
+    rng = np.random.default_rng(0xCAFE)
+    prompts = _prompts(10, 6)
+    live, done, submitted = set(), set(), 0
+    for step in range(120):
+        if submitted < 12 and rng.random() < 0.4:
+            rid = eng.add_request(list(prompts[submitted % len(prompts)]),
+                                  sp)
+            live.add(rid)
+            submitted += 1
+        if live and rng.random() < 0.15:
+            victim = sorted(live)[int(rng.integers(len(live)))]
+            fin = eng.cancel(victim)
+            if fin is not None:
+                assert victim not in done
+                done.add(victim)
+                live.discard(victim)
+        for f in eng.step():
+            assert f.req_id not in done, "terminal state delivered twice"
+            done.add(f.req_id)
+            live.discard(f.req_id)
+        if submitted >= 12 and not eng.has_work:
+            break
+    while eng.has_work:
+        for f in eng.step():
+            assert f.req_id not in done
+            done.add(f.req_id)
+            live.discard(f.req_id)
+    eng.finish_pending()
+    assert not live
+    assert len(done) == submitted
+    _assert_pool_exact(eng)
+    assert eng.cache.tier.snapshot()["errors"] == 0
+
+
+# -- host pool unit tests -----------------------------------------------------
+
+def _tier(capacity_blocks=4, async_copy=False):
+    t = HostKVTier(n_layers=2, block_size=4, n_kv_heads=2, head_dim=4,
+                   dtype=np.float32, capacity_bytes=0, async_copy=async_copy)
+    # capacity in whole blocks for readable tests
+    t.capacity_bytes = capacity_blocks * t.block_nbytes
+    return t
+
+
+def _blockdata(tier, n, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (tier.n_layers, n, tier.block_size, tier.n_kv_heads,
+             tier.head_dim)
+    return (rng.standard_normal(shape).astype(tier.dtype),
+            rng.standard_normal(shape).astype(tier.dtype))
+
+
+def test_pool_accounting_and_lru_eviction():
+    t = _tier(capacity_blocks=2)
+    k, v = _blockdata(t, 3)
+    t.store_batch([101, 102, 103], k, v, 3)
+    snap = t.snapshot()
+    assert snap["entries"] == 2 and snap["evictions"] == 1
+    assert snap["used_bytes"] == 2 * t.block_nbytes
+    assert not t.has(101) and t.has(102) and t.has(103)  # LRU dropped 101
+    # a probe touches: 102 becomes MRU, so the next insert evicts 103
+    assert t.probe_run([102]) == 1
+    k2, v2 = _blockdata(t, 1, seed=1)
+    t.store_batch([104], k2, v2, 1)
+    assert t.has(102) and t.has(104) and not t.has(103)
+
+
+def test_pool_roundtrip_preserves_block_bytes():
+    t = _tier(capacity_blocks=4)
+    k, v = _blockdata(t, 2, seed=3)
+    t.store_batch([7, 8], k, v, 2)
+    run = t.get_run([7, 8, 9])
+    assert [h for h, *_ in run] == [7, 8]
+    np.testing.assert_array_equal(run[0][1], k[:, 0])
+    np.testing.assert_array_equal(run[1][2], v[:, 1])
+
+
+def test_pool_zero_capacity_refuses_and_counts():
+    t = _tier(capacity_blocks=0)
+    assert not t.accepts(1)
+    k, v = _blockdata(t, 1)
+    t.store_batch([1], k, v, 1)
+    snap = t.snapshot()
+    assert snap["entries"] == 0 and snap["dropped"] == 1
+
+
+def test_pool_probe_counts_hits_and_misses():
+    t = _tier(capacity_blocks=4)
+    k, v = _blockdata(t, 2)
+    t.store_batch([1, 2], k, v, 2)
+    assert t.probe_run([1, 2, 3]) == 2
+    snap = t.snapshot()
+    assert snap["hits"] == 2 and snap["misses"] == 1
+    assert snap["hit_rate"] == pytest.approx(2 / 3, abs=1e-3)
+
+
+def test_async_worker_publishes_after_drain():
+    t = _tier(capacity_blocks=4, async_copy=True)
+    k, v = _blockdata(t, 2)
+    t.store_batch([11, 12], k, v, 2)
+    t.drain()
+    assert t.has(11) and t.has(12)
+    run = t.get_run([11, 12])
+    np.testing.assert_array_equal(run[0][1], k[:, 0])
+
+
+# -- telemetry export ---------------------------------------------------------
+
+def test_engine_snapshot_carries_host_kv_gauges(tiny_model, monkeypatch):
+    eng = make_engine(tiny_model, monkeypatch, tier=True)
+    snap = eng.obs.snapshot()
+    assert snap["host_kv_utilization"] == 0.0
+    assert "host_kv_hit_rate" in snap and "host_kv_used_bytes" in snap
+    off = make_engine(tiny_model, monkeypatch, tier=False)
+    assert "host_kv_utilization" not in off.obs.snapshot()
+
+
+def test_metrics_collector_exports_kvtier_family():
+    prom = pytest.importorskip("prometheus_client")
+    del prom
+    from scalable_hw_agnostic_inference_tpu.obs.steploop import StepTelemetry
+    from scalable_hw_agnostic_inference_tpu.serve.metrics import (
+        EngineTelemetryCollector,
+    )
+
+    tele = StepTelemetry(total_blocks=8)
+    tele.kvtier = _tier(capacity_blocks=4)
+    k, v = _blockdata(tele.kvtier, 1)
+    tele.kvtier.store_batch([42], k, v, 1)
+    tele.kvtier.probe_run([42, 43])
+    names = {m.name for m in
+             EngineTelemetryCollector(lambda: tele, "t").collect()}
+    # prometheus strips the _total suffix from counter FAMILY names; the
+    # exposition re-adds it per sample — the README documents the sample
+    # names (shai_kvtier_hits_total etc.)
+    for fam in ("shai_kvtier_hits", "shai_kvtier_misses",
+                "shai_kvtier_stores", "shai_kvtier_restored",
+                "shai_kvtier_evictions", "shai_kvtier_bytes",
+                "shai_kvtier_errors", "shai_kvtier_dropped"):
+        assert fam in names, fam
+    for g in ("shai_kvtier_used_bytes", "shai_kvtier_capacity_bytes",
+              "shai_kvtier_entries", "shai_kvtier_utilization",
+              "shai_kvtier_hit_rate"):
+        assert g in names, g
+
+
+def test_hbm_ledger_host_pool_excluded_from_attribution():
+    from scalable_hw_agnostic_inference_tpu.obs.hbm import HbmLedger
+
+    led = HbmLedger()
+    led.sample(pools={"kv_pool": 1000.0}, composition=(1, 0, 0),
+               host_pools={"host_kv": 555.0})
+    snap = led.snapshot()
+    assert snap["host_kv_bytes"] == 555.0
+    # accounted view: used == attributed == device pools only
+    assert snap["used_bytes"] == 1000.0
+    assert snap["attributed_bytes"] == 1000.0
+
+
+# -- affinity + routing -------------------------------------------------------
+
+def test_affinity_digest_is_leading_window_only():
+    a = prompt_affinity("x" * 300)
+    assert prompt_affinity("x" * 256 + "DIFFERENT TAIL") == a
+    assert prompt_affinity("y" + "x" * 299) != a
+    assert len(a) == 16
+
+
+def test_affinity_tracker_bounded_lru():
+    tr = AffinityTracker(max_entries=3)
+    for d in ("a", "b", "c", "a", "d"):
+        tr.note(d)
+    assert tr.snapshot() == ["c", "a", "d"]
+
+
+def _fleet(**models):
+    return {"models": {n: {"kvtier": {"affinity": aff}}
+                       for n, (aff, _ov) in models.items()},
+            "overloaded": [n for n, (_aff, ov) in models.items() if ov]}
+
+
+def test_rank_backends_prefers_warm_unless_overloaded():
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import CovaClient
+
+    dig = prompt_affinity("hello world")
+    order = ["a", "b", "c"]
+    fleet = _fleet(a=([], False), b=([dig], False), c=([dig], True))
+    ranked, warm = CovaClient.rank_backends("hello world", order, fleet)
+    assert ranked == ["b", "a", "c"] and warm == ["b"]
+    # no advertisement anywhere -> weighted order untouched
+    ranked, warm = CovaClient.rank_backends(
+        "hello world", order, _fleet(a=([], False), b=([], False)))
+    assert ranked == order and warm == []
+    # a broken fleet poll degrades to the weighted order
+    ranked, warm = CovaClient.rank_backends("hello world", order, {})
+    assert ranked == order and warm == []
+
+
+def test_weighted_order_and_routed_generate():
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import CovaClient
+    from scalable_hw_agnostic_inference_tpu.serve.asgi import HTTPError
+
+    models = {"cheap": {"weight": 3}, "big": {"weight": 1},
+              "embed": {"task": "embeddings"}}
+    c = CovaClient(models)
+    assert c.weighted_order() == ["cheap", "big"]
+
+    dig = prompt_affinity("the prompt")
+    calls = []
+
+    async def fake_post(name, route, payload):
+        calls.append(name)
+        if name == "big":
+            raise HTTPError(502, "down")
+        return {"generated_text": "ok"}
+
+    async def fake_fleet():
+        return _fleet(cheap=([], False), big=([dig], False))
+
+    c.post = fake_post
+    c._fleet_for_routing = fake_fleet
+    out = asyncio.run(c.generate("the prompt", {"max_new_tokens": 4}))
+    # warm backend tried first; its failure falls through to weighted order
+    assert calls == ["big", "cheap"]
+    assert out["model"] == "cheap" and out["routed_by"] == "weighted"
+
+
+# -- admission gate pricing ---------------------------------------------------
+
+def test_admission_gate_tightens_on_saturated_host_tier():
+    from scalable_hw_agnostic_inference_tpu.resilience.admission import (
+        AdmissionGate,
+    )
+
+    gate = AdmissionGate()
+    base = {"waiting": 0, "kv_utilization": 0.90}
+    # tier absorbing demotions: 0.90 device KV is under the normal line
+    assert gate.check({**base, "host_kv_utilization": 0.2}) is None
+    # tier saturated: the same device pressure sheds at the tighter line
+    shed = gate.check({**base, "host_kv_utilization": 1.0})
+    assert shed is not None and shed.status == 429
+    assert shed.reason == "kv_pressure"
+    # tier-less pods (no host_kv_utilization key) keep the normal line
+    assert gate.check(dict(base)) is None
+
+
+# -- chunked-prefill registration (satellite fix) -----------------------------
+
+def test_chunked_prefill_registers_blocks_per_chunk(tiny_model, monkeypatch):
+    """Full blocks produced by chunked prefill publish as they encode —
+    not only at prompt completion (the old gap: identical long prompts
+    paid the whole ladder twice)."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    eng = make_engine(tiny_model, monkeypatch, tier=False)
+    long_prompt = _prompts(11, 1, length=70)[0]  # > bucket max of 32
+    eng.add_request(list(long_prompt), sp)
+    eng.step()  # _admit_long: first chunk (32 tokens) encoded
+    assert eng.n_chunking == 1
+    hit = eng.cache.cached_prefix(long_prompt)
+    assert len(hit) >= 32 // 8, "first chunk's full blocks not registered"
+    eng.step()  # second chunk
+    assert len(eng.cache.cached_prefix(long_prompt)) >= 64 // 8
+    while eng.has_work:
+        eng.step()
+    # a second identical long prompt reuses the registered run
+    free_before = eng.cache.allocator.n_free
+    rid = eng.add_request(list(long_prompt), sp)
+    done = {}
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    assert rid in done
+    fresh_used = free_before - eng.cache.allocator.n_free
+    assert fresh_used < eng.cache._blocks_needed(len(long_prompt))
