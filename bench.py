@@ -44,6 +44,7 @@ SD_BASELINE_IMG_S = 1.0 / 0.67
 UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
                   "mllama": "tokens/sec", "llama_spec": "tokens/sec",
                   "vllm": "tokens/sec", "kvtier": "x",
+                  "ragged": "tokens/sec",
                   "sd": "images/sec", "sd8": "images/sec",
                   "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
@@ -66,7 +67,7 @@ def _which_from_argv(argv) -> str:
         return "llama_spec"
     if any(a.startswith("llama") for a in argv):
         return "llama"
-    for k in ("vllm", "kvtier", "flux", "t5", "mllama", "sd8"):
+    for k in ("vllm", "kvtier", "ragged", "flux", "t5", "mllama", "sd8"):
         if k in argv:
             return k
     return "sd"
@@ -590,6 +591,129 @@ def bench_kvtier(tiny: bool) -> dict:
     }
 
 
+def bench_ragged(tiny: bool) -> dict:
+    """Ragged paged attention + int8 KV A/B: one mixed-length decode
+    workload measured with ``SHAI_RAGGED_ATTENTION=1 SHAI_KV_QUANT=int8``
+    vs both off (the bucketed bf16 oracle).
+
+    Reports tok/s at MIXED prompt lengths (the case the bucket ladder
+    padded on), the pad fraction each mode dispatched, the decode
+    executable-ladder entry count (ragged collapses the
+    ``token_generation_buckets`` grid to one context entry), and
+    ``kv_quant_capacity_ratio``: how many KV blocks each pool dtype fits
+    at a fixed ``SHAI_HBM_GIB`` (params + activations priced by
+    ``core.budget.causal_lm_budget``, per-block bytes measured from the
+    LIVE pools — scales included) — the ~2x batch headroom per HBM byte
+    the int8 pool buys.
+    """
+    import os
+
+    import numpy as np
+
+    from scalable_hw_agnostic_inference_tpu.core.budget import (
+        GIB,
+        causal_lm_budget,
+    )
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+    from scalable_hw_agnostic_inference_tpu.models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig.tiny()
+        ecfg = EngineConfig(max_model_len=256, max_num_seqs=4, block_size=8,
+                            context_encoding_buckets=(32, 64, 128),
+                            token_generation_buckets=(64, 128),
+                            max_new_tokens=16)
+        lens, new = (12, 40, 90, 120), 12
+        name = "ragged-tiny"
+    else:
+        cfg = llama_mod.LlamaConfig.llama32_1b()
+        ecfg = EngineConfig(max_model_len=1024, max_num_seqs=4,
+                            block_size=16,
+                            context_encoding_buckets=(128, 256, 512),
+                            token_generation_buckets=(256, 512),
+                            max_new_tokens=32)
+        lens, new = (60, 200, 450, 700), 24
+        name = "ragged-1b-geometry"
+
+    params = llama_mod.geometry_params(cfg, quant=False)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(3, cfg.vocab_size, n).tolist() for n in lens]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=new)
+
+    def measure(ragged_quant: bool):
+        env = ({"SHAI_RAGGED_ATTENTION": "1", "SHAI_KV_QUANT": "int8"}
+               if ragged_quant else
+               {"SHAI_RAGGED_ATTENTION": "0", "SHAI_KV_QUANT": "off"})
+        os.environ.update(env)
+        try:
+            eng = LLMEngine(cfg, params, ecfg)
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+
+        def run():
+            fins = eng.generate(prompts, sp)
+            assert all(len(f.token_ids) == new for f in fins)
+
+        run()   # warm every executable on the mixed-length path
+        runs = 3
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            run()
+        dt = (time.perf_counter() - t0) / runs
+        snap = eng.obs.snapshot()
+        return {
+            "tok_s": round(len(prompts) * new / dt, 2),
+            "pad_fraction": snap["pad_fraction"],
+            "decode_ladder_entries": len(eng._decode_fns),
+            "executables": eng.n_executables,
+            "kv_pool_bytes": eng.cache.pool_bytes,
+            "kv_pool_blocks": eng.cache.total_blocks,
+        }
+
+    on = measure(True)
+    off = measure(False)
+
+    # capacity math at a pinned HBM size: blocks each pool dtype fits once
+    # params + peak activations are carved out (per-block bytes measured
+    # from the live pools above, scale arrays included)
+    from scalable_hw_agnostic_inference_tpu.obs.util import env_float
+
+    hbm_gib = env_float("SHAI_HBM_GIB", 16.0)
+    budget = causal_lm_budget(cfg, ecfg, hbm_gib_per_chip=hbm_gib)
+    kv_budget = max(0.0, (budget.usable_gib - budget.params_gib
+                          - budget.act_gib)) * GIB
+    blk_off = off["kv_pool_bytes"] / off["kv_pool_blocks"]
+    blk_on = on["kv_pool_bytes"] / on["kv_pool_blocks"]
+    max_blocks_off = int(kv_budget // blk_off)
+    max_blocks_on = int(kv_budget // blk_on)
+    ratio = (round(max_blocks_on / max_blocks_off, 3)
+             if max_blocks_off else 0.0)
+
+    base = _published("ragged_tps")
+    out = _dollars({
+        "metric": f"{name} ragged+int8KV decode tok/s (mixed lens "
+                  f"{list(lens)}, vs bucketed bf16, "
+                  f"{jax.devices()[0].platform})",
+        "value": on["tok_s"],
+        "unit": "tokens/sec",
+        "vs_baseline": round(on["tok_s"] / base, 3) if base else 1.0,
+    })
+    out["ragged_quant"] = on
+    out["bucketed"] = off
+    out["speedup"] = (round(on["tok_s"] / off["tok_s"], 3)
+                      if off["tok_s"] else 0.0)
+    out["kv_quant_capacity_ratio"] = ratio
+    out["max_kv_blocks_at_hbm"] = {"hbm_gib": hbm_gib,
+                                   "bf16": max_blocks_off,
+                                   "int8": max_blocks_on}
+    return out
+
+
 def bench_flux(tiny: bool) -> dict:
     """Flux (rectified-flow DiT) txt2img on ONE chip.
 
@@ -851,6 +975,7 @@ def inner_main() -> None:
         enable_persistent_cache_from_env()
     out = {"llama": bench_llama, "llama_spec": bench_llama_spec,
            "vllm": bench_vllm, "kvtier": bench_kvtier,
+           "ragged": bench_ragged,
            "flux": bench_flux, "t5": bench_t5,
            "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)

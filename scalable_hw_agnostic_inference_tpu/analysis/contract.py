@@ -203,7 +203,8 @@ DEFAULT_CONTRACT = Contract(
                 "cache", "buckets", "_chunk_cap", "_ctx_buckets",
                 "_drafter", "spec", "_spec_rng", "_sample1", "_lp1",
                 "_cross_embed", "_cross_write", "ttft", "tpot", "obs",
-                "_hbm_every", "_hbm_dev", "_async", "_ids", "_res"),
+                "_hbm_every", "_hbm_dev", "_async", "_ids", "_res",
+                "_ragged", "_kv_quant"),
             owning_modules=(
                 "engine/engine.py", "engine/warm.py", "engine/cross.py",
                 "engine/logprobs.py", "engine/speculative.py",
@@ -287,6 +288,16 @@ DEFAULT_CONTRACT = Contract(
             "prefill", "prefill@tp2", "prefill_cont",
             "decode", "decode_feedback",
             "decode@tp2", "decode_feedback@tp2", "decode@tp2_paged",
+            # ragged paged attention (SHAI_RAGGED_ATTENTION): full-window
+            # decode + dynamic-start continuation, CPU gather legs and the
+            # tpu-lowered Pallas kernel leg
+            "decode_ragged", "decode_ragged@tp2",
+            "prefill_rcont", "prefill_rcont@tp2",
+            # int8 KV pool (SHAI_KV_QUANT): quantized scatter on prefill,
+            # requantizing decode write + in-executable dequant, and the
+            # scale-carrying tier restore — dtype-drift and donation gate
+            # these from day one
+            "prefill_kvquant", "decode_kvquant", "tier_restore_quant",
             "verify",
             "cross_kv", "cross_slot_write",
             "tier_restore",
@@ -301,6 +312,9 @@ DEFAULT_CONTRACT = Contract(
             "prefill", "prefill@tp2", "prefill_cont",
             "decode", "decode_feedback",
             "decode@tp2", "decode_feedback@tp2", "decode@tp2_paged",
+            "decode_ragged", "decode_ragged@tp2",
+            "prefill_rcont", "prefill_rcont@tp2",
+            "prefill_kvquant", "decode_kvquant", "tier_restore_quant",
             "verify", "cross_kv", "cross_slot_write",
             "tier_restore",
         ),
@@ -310,6 +324,9 @@ DEFAULT_CONTRACT = Contract(
             "prefill", "prefill@tp2", "prefill_cont",
             "decode", "decode_feedback",
             "decode@tp2", "decode_feedback@tp2", "decode@tp2_paged",
+            "decode_ragged", "decode_ragged@tp2",
+            "prefill_rcont", "prefill_rcont@tp2",
+            "prefill_kvquant", "decode_kvquant", "tier_restore_quant",
             "verify", "cross_kv", "cross_slot_write",
             "tier_restore",
         ),

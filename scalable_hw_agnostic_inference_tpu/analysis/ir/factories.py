@@ -80,18 +80,32 @@ def _param_sds(cfg, shardings=None):
         shapes, shardings.params)
 
 
-def _kv_sds(cfg, shardings=None):
+def _kv_sds(cfg, shardings=None, quant: bool = False):
     import jax
     import jax.numpy as jnp
 
     n_self = cfg.n_layers - len(cfg.cross_attention_layers)
     shape = (TOT, BS, cfg.n_kv_heads, cfg.head_dim)
-    if shardings is None:
-        return [{n: jax.ShapeDtypeStruct(shape, jnp.bfloat16)
-                 for n in ("k", "v")} for _ in range(n_self)]
-    return [{n: jax.ShapeDtypeStruct(shape, jnp.bfloat16,
+    sc_shape = (TOT, cfg.n_kv_heads)
+    blk_dt = jnp.int8 if quant else jnp.bfloat16
+
+    def lay():
+        if shardings is None:
+            d = {n: jax.ShapeDtypeStruct(shape, blk_dt) for n in ("k", "v")}
+            if quant:
+                d.update({n: jax.ShapeDtypeStruct(sc_shape, jnp.float32)
+                          for n in ("ks", "vs")})
+            return d
+        d = {n: jax.ShapeDtypeStruct(shape, blk_dt,
                                      sharding=shardings.kv_layer[n])
-             for n in ("k", "v")} for _ in range(n_self)]
+             for n in ("k", "v")}
+        if quant:
+            d.update({n: jax.ShapeDtypeStruct(
+                sc_shape, jnp.float32, sharding=shardings.kv_scale)
+                for n in ("ks", "vs")})
+        return d
+
+    return [lay() for _ in range(n_self)]
 
 
 def _sds(shape, dtype, sharding=None):
@@ -112,11 +126,11 @@ def _engine_shardings(cfg, mesh):
     return EngineShardings(mesh, shapes, cfg)
 
 
-def _decode_args(cfg, rep=None, shardings=None):
+def _decode_args(cfg, rep=None, shardings=None, quant: bool = False):
     import jax.numpy as jnp
 
     _, params = _param_sds(cfg, shardings)
-    kv = _kv_sds(cfg, shardings)
+    kv = _kv_sds(cfg, shardings, quant=quant)
     return (params, kv,
             _sds((B,), jnp.int32, rep),        # tokens
             _sds((B,), jnp.int32, rep),        # pos
@@ -130,14 +144,17 @@ def _decode_args(cfg, rep=None, shardings=None):
 
 def _build_decode(key: str, feedback: bool, tp: bool = False,
                   paged: bool = False, artifact: bool = False,
-                  compile_cpu: bool = False) -> IrProgram:
+                  compile_cpu: bool = False, ragged: bool = False,
+                  kv_quant: bool = False) -> IrProgram:
     from ...engine.runner import make_decode
 
     cfg = _tiny_cfg()
     sh = _engine_shardings(cfg, _mesh("tp")) if tp else None
     fn = make_decode(cfg, BS, BPS, max_num_seqs=B, shardings=sh,
-                     paged=paged, feedback=feedback)
-    args = _decode_args(cfg, rep=sh.rep if sh else None, shardings=sh)
+                     paged=paged, feedback=feedback, ragged=ragged,
+                     kv_quant=kv_quant)
+    args = _decode_args(cfg, rep=sh.rep if sh else None, shardings=sh,
+                        quant=kv_quant)
     return IrProgram(
         key=key, factory="make_decode", anchor_path=RUNNER, jitted=fn,
         args=args, donate_args=(1, 3) if feedback else (1,),
@@ -146,17 +163,19 @@ def _build_decode(key: str, feedback: bool, tp: bool = False,
         artifact=artifact)
 
 
-def _build_prefill(key: str, tp: bool = False) -> IrProgram:
+def _build_prefill(key: str, tp: bool = False,
+                   kv_quant: bool = False) -> IrProgram:
     import jax.numpy as jnp
 
     from ...engine.runner import make_prefill
 
     cfg = _tiny_cfg()
     sh = _engine_shardings(cfg, _mesh("tp")) if tp else None
-    fn = make_prefill(cfg, BS, BPS, BUCKET, n_seqs=1, shardings=sh)
+    fn = make_prefill(cfg, BS, BPS, BUCKET, n_seqs=1, shardings=sh,
+                      kv_quant=kv_quant)
     rep = sh.rep if sh else None
     _, params = _param_sds(cfg, sh)
-    args = (params, _kv_sds(cfg, sh),
+    args = (params, _kv_sds(cfg, sh, quant=kv_quant),
             _sds((1, BUCKET), jnp.int32, rep),
             _sds((1,), jnp.int32, rep),
             _sds((1, BPS), jnp.int32, rep))
@@ -180,6 +199,55 @@ def _build_prefill_cont(key: str) -> IrProgram:
     return IrProgram(key=key, factory="make_prefill_cont",
                      anchor_path=RUNNER, jitted=fn, args=args,
                      donate_args=(1,))
+
+
+def _build_rcont(key: str, tp: bool = False,
+                 kv_quant: bool = False) -> IrProgram:
+    # the ragged continuation (SHAI_RAGGED_ATTENTION): chunk start as DATA
+    # — ONE executable per chunk bucket. Built on the CPU platform, so the
+    # traced attention is the XLA gather reference (the Pallas leg is
+    # covered by decode_ragged@tp2's tpu lowering).
+    import jax.numpy as jnp
+
+    from ...engine.runner import make_prefill_cont
+
+    cfg = _tiny_cfg()
+    sh = _engine_shardings(cfg, _mesh("tp")) if tp else None
+    fn = make_prefill_cont(cfg, BS, BPS, BUCKET, shardings=sh,
+                           kv_quant=kv_quant, ragged=True)
+    rep = sh.rep if sh else None
+    _, params = _param_sds(cfg, sh)
+    args = (params, _kv_sds(cfg, sh, quant=kv_quant),
+            _sds((1, BUCKET), jnp.int32, rep),
+            _sds((1,), jnp.int32, rep),
+            _sds((1, BPS), jnp.int32, rep),
+            _sds((1,), jnp.int32, rep))
+    return IrProgram(key=key, factory="make_prefill_cont",
+                     anchor_path=RUNNER, jitted=fn, args=args,
+                     donate_args=(1,), compile_cpu=not tp)
+
+
+def _build_tier_restore_quant(key: str) -> IrProgram:
+    # the quantized restore scatter: int8 blocks + f32 scale rows move in
+    # ONE donated call per layer (all four pool buffers donate-and-rebind)
+    import jax.numpy as jnp
+
+    from ...kvtier.restore import make_tier_restore
+
+    cfg = _tiny_cfg()
+    fn = make_tier_restore(quant=True)
+    pool = (TOT, BS, cfg.n_kv_heads, cfg.head_dim)
+    sc = (TOT, cfg.n_kv_heads)
+    host = (2, BS, cfg.n_kv_heads, cfg.head_dim)
+    host_sc = (2, cfg.n_kv_heads)
+    args = (_sds(pool, jnp.int8), _sds(pool, jnp.int8),
+            _sds(sc, jnp.float32), _sds(sc, jnp.float32),
+            _sds((2,), jnp.int32),
+            _sds(host, jnp.int8), _sds(host, jnp.int8),
+            _sds(host_sc, jnp.float32), _sds(host_sc, jnp.float32))
+    return IrProgram(key=key, factory="make_tier_restore",
+                     anchor_path="kvtier/restore.py", jitted=fn, args=args,
+                     donate_args=(0, 1, 2, 3), compile_cpu=True)
 
 
 def _build_verify(key: str) -> IrProgram:
@@ -310,6 +378,24 @@ BUILDERS = {
                                                    compile_cpu=True),
     "decode@tp2_paged": lambda k: _build_decode(k, feedback=False, tp=True,
                                                 paged=True),
+    # ragged paged attention (SHAI_RAGGED_ATTENTION): full-window decode,
+    # CPU leg traces the gather reference; the @tp2 leg lowers the Pallas
+    # ragged kernel for the tpu platform (paged=True forces the kernel,
+    # dryrun-style, like decode@tp2_paged)
+    "decode_ragged": lambda k: _build_decode(k, feedback=False, ragged=True,
+                                             compile_cpu=True),
+    "decode_ragged@tp2": lambda k: _build_decode(k, feedback=False, tp=True,
+                                                 paged=True, ragged=True),
+    "prefill_rcont": lambda k: _build_rcont(k),
+    "prefill_rcont@tp2": lambda k: _build_rcont(k, tp=True),
+    # int8 KV pool (SHAI_KV_QUANT): the quantized scatter (prefill write),
+    # the requantizing decode write + in-executable dequant reads, and the
+    # scale-carrying tier restore
+    "prefill_kvquant": lambda k: _build_prefill(k, kv_quant=True),
+    "decode_kvquant": lambda k: _build_decode(k, feedback=False,
+                                              kv_quant=True,
+                                              compile_cpu=True),
+    "tier_restore_quant": lambda k: _build_tier_restore_quant(k),
     "verify": lambda k: _build_verify(k),
     "cross_kv": lambda k: _build_cross_kv(k),
     "cross_slot_write": lambda k: _build_cross_slot_write(k),

@@ -47,10 +47,17 @@ class HbmBudgetError(RuntimeError):
 
 
 def detect_hbm_gib(device) -> float:
-    """Per-chip HBM of the LIVE device — asks the runtime first
-    (``memory_stats``), falls back to the device-kind table, then to the
-    v5e deploy tier. Gating on a hardcoded 16 GiB would wrongly refuse
-    working v5p/v4 deployments (and wave through smaller devices)."""
+    """Per-chip HBM of the LIVE device — ``SHAI_HBM_GIB`` (an explicit
+    operator declaration, also the capacity-math pin for deviceless bench
+    A/Bs) wins, then the runtime (``memory_stats``), then the device-kind
+    table, then the v5e deploy tier. Gating on a hardcoded 16 GiB would
+    wrongly refuse working v5p/v4 deployments (and wave through smaller
+    devices)."""
+    from ..obs.util import env_float
+
+    declared = env_float("SHAI_HBM_GIB", 0.0)
+    if declared > 0:
+        return declared
     try:
         stats = device.memory_stats()
         limit = (stats or {}).get("bytes_limit", 0)
@@ -249,13 +256,23 @@ def causal_lm_budget(cfg, ecfg, *, hbm_gib_per_chip: float = HBM_GIB["v5e"],
         ecfg.max_model_len * ecfg.max_num_seqs // ecfg.block_size)
     kv_heads_chip = (cfg.n_kv_heads // tp if cfg.n_kv_heads % tp == 0
                      else cfg.n_kv_heads)
-    kv_dtype = 2.0  # pool stays bf16 (int8 quant is weight-only)
+    # block pool dtype: bf16, or int8 + per-(block, head) f32 scales when
+    # SHAI_KV_QUANT=int8 is live (ops.quant KV-block quantization) — the
+    # boot gate must price the pool the engine will actually allocate, or
+    # a geometry sized FOR the 2x capacity would be refused at boot
+    from ..obs.util import env_str
+
+    kv_quant = env_str("SHAI_KV_QUANT", "").strip().lower() == "int8"
+    kv_dtype = 1.0 if kv_quant else 2.0
     kv_bytes = (num_blocks * ecfg.block_size * n_self * 2
                 * kv_heads_chip * cfg.head_dim * kv_dtype)
+    if kv_quant:
+        kv_bytes += num_blocks * n_self * 2 * kv_heads_chip * 4.0
     if cfg.cross_attention_layers:
+        # cross-KV buffers stay bf16 (per-slot vision states, not pooled)
         kv_bytes += (ecfg.max_num_seqs * cross_seq_len
                      * len(cfg.cross_attention_layers) * 2
-                     * kv_heads_chip * cfg.head_dim * kv_dtype)
+                     * kv_heads_chip * cfg.head_dim * 2.0)
 
     # peak activation residency: the widest prefill call. Per token the
     # live set is ~(residual + q/k/v + attn out + both MLP halves); flash

@@ -111,10 +111,16 @@ class PagedKVCache:
     def __init__(self, n_layers: int, n_kv_heads: int, head_dim: int,
                  total_blocks: int, block_size: int, blocks_per_seq: int,
                  dtype=jnp.bfloat16, sharding=None,
-                 enable_prefix_caching: bool = False, tier=None):
+                 enable_prefix_caching: bool = False, tier=None,
+                 quant: bool = False):
         self.n_layers = n_layers
         self.block_size = block_size
         self.blocks_per_seq = blocks_per_seq
+        #: int8 KV pool (SHAI_KV_QUANT): blocks live as int8 with ONE f32
+        #: scale per (block, kv head) riding alongside ("ks"/"vs") —
+        #: ~2x blocks per HBM byte, priced through the SAME pool_bytes
+        #: seam the HBM ledger and admission gate already read
+        self.quant = quant
         self.allocator = BlockAllocator(total_blocks)
         # automatic prefix caching (the vLLM knob): full blocks are
         # content-addressed by a chain hash over their tokens; the cache
@@ -132,22 +138,32 @@ class PagedKVCache:
         self._parent: Dict[int, int] = {}
         self._nchild: Dict[int, int] = {}
         shape = (total_blocks, block_size, n_kv_heads, head_dim)
+        sc_shape = (total_blocks, n_kv_heads)
 
-        def zeros(name: str) -> jax.Array:
-            z = jnp.zeros(shape, dtype)
+        def zeros(name: str, shp, dt) -> jax.Array:
+            z = jnp.zeros(shp, dt)
             if sharding is not None:
                 # tensor-parallel pool: split on the kv-head axis so each tp
                 # rank owns its heads' blocks (sharding: {"k": NS, "v": NS})
                 z = jax.device_put(z, sharding[name])
             return z
 
-        self.kv = [{"k": zeros("k"), "v": zeros("v")} for _ in range(n_layers)]
+        block_dt = jnp.int8 if quant else dtype
+        self.kv = [{"k": zeros("k", shape, block_dt),
+                    "v": zeros("v", shape, block_dt)}
+                   for _ in range(n_layers)]
+        if quant:
+            for lay in self.kv:
+                lay["ks"] = zeros("ks", sc_shape, jnp.float32)
+                lay["vs"] = zeros("vs", sc_shape, jnp.float32)
         self._seqs: Dict[int, SeqAllocation] = {}
         self.total_blocks = total_blocks
         # fixed device allocation: price it ONCE (the HBM ledger reads it
-        # every engine step — a per-step re-sum is hot-loop host work)
-        self._pool_bytes = sum(int(a["k"].nbytes) + int(a["v"].nbytes)
-                               for a in self.kv)
+        # every engine step — a per-step re-sum is hot-loop host work).
+        # Every leaf counts, scale arrays included: shai_hbm_kv_pool_bytes
+        # must show the REAL int8 pool cost, not the bf16 one
+        self._pool_bytes = sum(int(a.nbytes)
+                               for lay in self.kv for a in lay.values())
         # telemetry counters (obs.steploop reads them through the engine):
         # speculative rollbacks give reserved tokens/blocks back via shrink —
         # a high rollback rate is the "drafter wasting pool headroom" signal
@@ -297,8 +313,8 @@ class PagedKVCache:
         from ..kvtier.restore import make_tier_gather, make_tier_restore
 
         self.tier = tier
-        self._tier_gather = make_tier_gather()
-        self._tier_restore = make_tier_restore()
+        self._tier_gather = make_tier_gather(quant=self.quant)
+        self._tier_restore = make_tier_restore(quant=self.quant)
         lay0 = self.kv[0]
         shape = lay0["k"].shape[1:]
         dt = lay0["k"].dtype
@@ -308,8 +324,15 @@ class PagedKVCache:
             zeros = jnp.zeros((pad,) + shape, dt)
             # priming writes zeros into reserved block 0 — garbage there
             # is allowed by contract (tables mask it out)
-            lay0["k"], lay0["v"] = self._tier_restore(
-                lay0["k"], lay0["v"], idx, zeros, zeros)
+            if self.quant:
+                sc0 = jnp.zeros((pad,) + lay0["ks"].shape[1:], jnp.float32)
+                (lay0["k"], lay0["v"], lay0["ks"],
+                 lay0["vs"]) = self._tier_restore(
+                    lay0["k"], lay0["v"], lay0["ks"], lay0["vs"], idx,
+                    zeros, zeros, sc0, sc0)
+            else:
+                lay0["k"], lay0["v"] = self._tier_restore(
+                    lay0["k"], lay0["v"], idx, zeros, zeros)
 
     def _demote(self, pairs: Sequence[Tuple[int, int]]) -> None:
         """Copy evicted blocks' KV out to the host tier: one batched
@@ -324,8 +347,10 @@ class PagedKVCache:
                 n = len(grp)
                 idx = np.zeros((_pad_size(n),), np.int32)
                 idx[:n] = [b for _, b in grp]
-                k_all, v_all = self._tier_gather(self.kv, jnp.asarray(idx))
-                tier.store_batch([h for h, _ in grp], k_all, v_all, n)
+                # quantized pools gather (k, v, ks, vs) in ONE dispatch —
+                # the scales ride to the host next to their int8 blocks
+                arrays = self._tier_gather(self.kv, jnp.asarray(idx))
+                tier.store_batch([h for h, _ in grp], *arrays, n)
                 i += n
         except Exception:
             log.warning("kv tier demotion failed; blocks evicted without "
@@ -381,7 +406,8 @@ class PagedKVCache:
         prev = hashes[from_block - 1] if from_block > 0 else None
         if prev is not None and prev not in self._hash2block:
             prev = None
-        for (h, _k, _v), b in zip(run, blocks):
+        for ent, b in zip(run, blocks):
+            h = ent[0]
             self._hash2block[h] = b
             self._block2hash[b] = h
             self._lru[h] = None
@@ -392,11 +418,11 @@ class PagedKVCache:
         self.tier.count_restored(len(blocks))
         return blocks
 
-    def _tier_write(self, blocks: List[int],
-                    run: List[Tuple[int, np.ndarray, np.ndarray]]) -> None:
+    def _tier_write(self, blocks: List[int], run: List[Tuple]) -> None:
         """ONE jitted scatter-write per layer per <=``_PAD_MAX`` chunk:
-        the restored blocks' host k/v goes back into the pool rows
-        ``blocks`` (padding rows target reserved block 0)."""
+        the restored blocks' host k/v (and the scale rows of a quantized
+        pool) goes back into the pool rows ``blocks`` (padding rows target
+        reserved block 0). Pure copies — a restored block is byte-exact."""
         i = 0
         while i < len(blocks):
             grp = blocks[i:i + _PAD_MAX]
@@ -405,18 +431,28 @@ class PagedKVCache:
             pad = _pad_size(n)
             idx = np.zeros((pad,), np.int32)
             idx[:n] = grp
-            # entry arrays are [n_layers, Bs, Hkv, Dh]; stack per layer
-            per = ent[0][1].shape[1:]
-            kbuf = np.zeros((self.n_layers, pad) + per, ent[0][1].dtype)
-            vbuf = np.zeros((self.n_layers, pad) + per, ent[0][2].dtype)
-            for j, (_h, k, v) in enumerate(ent):
-                kbuf[:, j] = k
-                vbuf[:, j] = v
+            # entry arrays are [n_layers, <block dims>]; stack per layer —
+            # slot 0/1 = k/v blocks, slots 2/3 = the quantized scales
+            n_arr = len(ent[0]) - 1
+            bufs = []
+            for ai in range(n_arr):
+                per = ent[0][1 + ai].shape[1:]
+                buf = np.zeros((self.n_layers, pad) + per,
+                               ent[0][1 + ai].dtype)
+                for j, e in enumerate(ent):
+                    buf[:, j] = e[1 + ai]
+                bufs.append(buf)
             idx_dev = jnp.asarray(idx)
             for li, lay in enumerate(self.kv):
-                lay["k"], lay["v"] = self._tier_restore(
-                    lay["k"], lay["v"], idx_dev,
-                    jnp.asarray(kbuf[li]), jnp.asarray(vbuf[li]))
+                host = [jnp.asarray(b[li]) for b in bufs]
+                if self.quant:
+                    (lay["k"], lay["v"], lay["ks"],
+                     lay["vs"]) = self._tier_restore(
+                        lay["k"], lay["v"], lay["ks"], lay["vs"],
+                        idx_dev, *host)
+                else:
+                    lay["k"], lay["v"] = self._tier_restore(
+                        lay["k"], lay["v"], idx_dev, *host)
             i += n
 
     def offload_preempt(self, tokens, seq_id: int) -> None:

@@ -101,6 +101,29 @@ class LLMEngine:
         n_pool_layers = (model_cfg.n_layers
                          - len(model_cfg.cross_attention_layers))
         kv_dtype = jnp.bfloat16 if ecfg.dtype == "bfloat16" else jnp.float32
+        # int8 KV-block quantization (SHAI_KV_QUANT=int8, default off):
+        # the pool holds int8 blocks + per-(block, head) f32 scales — ~2x
+        # KV blocks per HBM byte, priced through cache.pool_bytes so the
+        # HBM ledger and admission gate see the real capacity. Lenient
+        # parse: an unrecognized value warns and stays off (a typo'd
+        # quant knob must not crash-loop a serving tier).
+        from ..obs.util import env_str as _env_str
+
+        kvq = _env_str("SHAI_KV_QUANT", "").strip().lower()
+        if kvq not in ("", "0", "off", "none", "int8"):
+            log.warning("SHAI_KV_QUANT=%r not recognized (supported: int8)"
+                        " — KV quantization stays off", kvq)
+            kvq = ""
+        self._kv_quant = kvq == "int8"
+        # ragged paged attention (SHAI_RAGGED_ATTENTION, default off):
+        # decode/verify attend mixed context lengths in ONE full-window
+        # dispatch (per-row compute skip), so the token_generation_buckets
+        # ladder collapses to a single context entry and chunked prefill's
+        # continuation ladder collapses to one dynamic-start executable
+        # per chunk bucket. Text engines only: the ragged continuation
+        # does not carry the mllama cross tail.
+        self._ragged = bool(_env_flag("SHAI_RAGGED_ATTENTION", False)
+                            and not model_cfg.cross_attention_layers)
         # prefix caching serves the plain-text path only: cross models'
         # cache semantics (vision states) don't content-address by tokens
         prefix_caching = (ecfg.enable_prefix_caching
@@ -118,15 +141,23 @@ class LLMEngine:
             tier = maybe_host_tier(
                 n_layers=n_pool_layers, block_size=ecfg.block_size,
                 n_kv_heads=model_cfg.n_kv_heads,
-                head_dim=model_cfg.head_dim, dtype=np.dtype(kv_dtype))
+                head_dim=model_cfg.head_dim,
+                dtype=np.int8 if self._kv_quant else np.dtype(kv_dtype),
+                quant=self._kv_quant)
+        kv_sharding = None
+        if self.shardings is not None:
+            kv_sharding = dict(self.shardings.kv_layer)
+            if self._kv_quant:
+                kv_sharding["ks"] = self.shardings.kv_scale
+                kv_sharding["vs"] = self.shardings.kv_scale
         self.cache = PagedKVCache(
             n_pool_layers, model_cfg.n_kv_heads, model_cfg.head_dim,
             ecfg.total_blocks, ecfg.block_size, ecfg.blocks_per_seq,
             dtype=kv_dtype,
-            sharding=None if self.shardings is None
-            else self.shardings.kv_layer,
+            sharding=kv_sharding,
             enable_prefix_caching=prefix_caching,
             tier=tier,
+            quant=self._kv_quant,
         )
         self.buckets = BucketRegistry(sorted(ecfg.context_encoding_buckets))
         # chunked-prefill prompt cap: whole bucket-sized chunks only (the
@@ -144,6 +175,11 @@ class LLMEngine:
         tg = [min(-(-t // bs), ecfg.blocks_per_seq)
               for t in ecfg.token_generation_buckets]
         self._ctx_buckets = sorted(set(tg) | {ecfg.blocks_per_seq})
+        if self._ragged:
+            # the ragged kernel owns the FULL window with per-row cost:
+            # the context-bucket ladder collapses to one entry, and no
+            # dispatch ever keys on the longest running sequence again
+            self._ctx_buckets = [ecfg.blocks_per_seq]
         self._decode_fns: Dict[Tuple[int, int], Any] = {}
         # speculative decoding: a host-side prompt-lookup drafter plus one
         # multi-token verify executable per (ctx_bucket, batch_bucket) —
@@ -550,6 +586,7 @@ class LLMEngine:
         Bb = self._batch_bucket(len(running))
         _, decode = self._decode_for(self._max_ctx_blocks(running),
                                      len(running))
+        self._note_dispatch_pad(running, Bb)
         a = self._res.refresh(self, running, Bb)  # tables re-up if grown
         rng = jax.random.fold_in(self._rng, self._step_count * 2)
         tokens_dev, pos_dev = prev.nxt, prev.pos_next
@@ -578,6 +615,7 @@ class LLMEngine:
         n_exec = self.n_executables
         _, decode = self._decode_for(self._max_ctx_blocks(running),
                                      len(running))
+        self._note_dispatch_pad(running, Bb)
         a = self._res.refresh(self, running, Bb)
         tokens = np.zeros((Bb,), np.int32)
         pos = np.zeros((Bb,), np.int32)
@@ -928,6 +966,7 @@ class LLMEngine:
             args += list(self._set_slot_cross(slot, req))
         with annotate("engine.prefill"):
             self.cache.kv, logits = fn(*args)
+        self.obs.count_pad(n, bucket - n)  # prefill bucket tail
         # no register_prefix here: this path only ever admits prefix/cross
         # (vision-conditioned) requests, whose blocks must NOT
         # content-address by tokens alone — and cross engines disable the
@@ -1034,6 +1073,8 @@ class LLMEngine:
                      jnp.full((Kp,), max(self.cross_seq_len, 1), jnp.int32)]
         with annotate("engine.prefill"):
             self.cache.kv, logits = fn(*args)
+        real = sum(len(r.prompt_ids) for r in group)
+        self.obs.count_pad(real, Kp * bucket - real)  # bucket + batch pad
         for req in group:  # batch rows are always plain text
             self.cache.register_prefix(req.prompt_ids,
                                        self.cache.seq(req.req_id).blocks)
@@ -1084,7 +1125,8 @@ class LLMEngine:
         sb = start // self.ecfg.block_size
         if start + chunk_bucket > self.ecfg.max_model_len:
             return False  # chunk executable would overrun blocks_per_seq
-        if self._warmed and ("cont", sb, chunk_bucket) not in self._prefill:
+        if self._warmed and self._cont_key(sb, chunk_bucket) \
+                not in self._prefill:
             return False  # post-ready compiles are the cold-graph bug
         take = max(0, sb - len(cached))
         need_new = self._need_blocks(n_total) - sb
@@ -1113,8 +1155,8 @@ class LLMEngine:
                 sb = start // self.ecfg.block_size
                 if start + chunk_bucket > self.ecfg.max_model_len:
                     return False
-                if self._warmed and ("cont", sb,
-                                     chunk_bucket) not in self._prefill:
+                if self._warmed and self._cont_key(
+                        sb, chunk_bucket) not in self._prefill:
                     return False
         self.waiting.popleft()
         try:
@@ -1132,7 +1174,9 @@ class LLMEngine:
         with annotate("engine.prefill"):
             self.cache.kv, logits = fn(self.params, self.cache.kv,
                                        jnp.asarray(ids),
-                                       jnp.asarray([n], jnp.int32), table)
+                                       jnp.asarray([n], jnp.int32), table,
+                                       *self._cont_args(start))
+        self.obs.count_pad(n, chunk_bucket - n)  # chunk bucket tail
         self.cache.register_prefix(req.prompt_ids, alloc.blocks)
         rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
         tok = int(self._sample1(
@@ -1213,10 +1257,12 @@ class LLMEngine:
         fn = self._cont_for(start // self.ecfg.block_size)
         args = [self.params, self.cache.kv, jnp.asarray(ids),
                 jnp.asarray([n], jnp.int32), table]
+        args += self._cont_args(start)  # ragged: the start rides as data
         if self._cross_kv is not None:
             args += list(self._slot_cross_args(s.slot))
         with annotate("engine.prefill"):
             self.cache.kv, logits = fn(*args)
+        self.obs.count_pad(n, C - n)  # final-chunk tail (full chunks: 0)
         if start + n >= len(req.prompt_ids):
             self.cache.register_prefix(
                 req.prompt_ids, self.cache.seq(req.req_id).blocks)
@@ -1247,6 +1293,20 @@ class LLMEngine:
         from .runner import make_prefill_cont
 
         bucket = self.buckets.max if bucket is None else bucket
+        if self._ragged:
+            # ONE dynamic-start executable per chunk bucket replaces the
+            # whole one-per-start continuation ladder; callers append the
+            # start array to the call args (_cont_args)
+            key = ("rcont", bucket)
+            if key not in self._prefill:
+                _faults.get().raise_at(_faults.COMPILE)
+                if self._warmed:
+                    self.obs.count_recompile("prefill_cont")
+                self._prefill[key] = make_prefill_cont(
+                    self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
+                    bucket, shardings=self.shardings,
+                    kv_quant=self._kv_quant, ragged=True)
+            return self._prefill[key]
         key = ("cont", start_blocks, bucket)
         if key not in self._prefill:
             _faults.get().raise_at(_faults.COMPILE)
@@ -1256,8 +1316,25 @@ class LLMEngine:
                 self.obs.count_recompile("prefill_cont")
             self._prefill[key] = make_prefill_cont(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
-                bucket, start_blocks, shardings=self.shardings)
+                bucket, start_blocks, shardings=self.shardings,
+                kv_quant=self._kv_quant)
         return self._prefill[key]
+
+    def _cont_key(self, start_blocks: int, bucket: int):
+        """The warm-ladder key a continuation dispatch will resolve to —
+        the post-ready compile guards in cached admission check THIS, so
+        the ragged ladder's (start-free) keys gate correctly."""
+        if self._ragged:
+            return ("rcont", bucket)
+        return ("cont", start_blocks, bucket)
+
+    def _cont_args(self, start: int) -> list:
+        """Trailing args a continuation executable takes beyond
+        ``(params, kv, ids, n_text, block_tables)``: the ragged variant
+        carries the chunk start as DATA."""
+        if self._ragged:
+            return [jnp.asarray([start], jnp.int32)]
+        return []
 
     def _cached_starts(self) -> List[int]:
         """THE closed set of continuation starts (token units) — both the
@@ -1292,7 +1369,7 @@ class LLMEngine:
             self._prefill[key] = make_prefill(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
                 bucket, prefix_len=prefix_len, n_seqs=n_seqs,
-                shardings=self.shardings)
+                shardings=self.shardings, kv_quant=self._kv_quant)
         return self._prefill[key]
 
     def _batch_bucket(self, n_active: int) -> int:
@@ -1321,7 +1398,8 @@ class LLMEngine:
             self._decode_fns[key] = make_decode(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
                 bb, ctx_blocks=m, shardings=self.shardings,
-                feedback=self._async)
+                feedback=self._async, ragged=self._ragged,
+                kv_quant=self._kv_quant)
         return bb, self._decode_fns[key]
 
     def _verify_for(self, m_blocks: int, n_active: int = -1):
@@ -1341,7 +1419,8 @@ class LLMEngine:
             self._verify_fns[key] = make_verify(
                 self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
                 bb, self.ecfg.num_speculative_tokens, ctx_blocks=m,
-                shardings=self.shardings)
+                shardings=self.shardings, ragged=self._ragged,
+                kv_quant=self._kv_quant)
         return bb, self._verify_fns[key]
 
     @property
@@ -1456,6 +1535,39 @@ class LLMEngine:
                     if self.slots[s.slot] is not s:
                         break  # s itself was preempted
 
+    def _note_dispatch_pad(self, running, Bb: int,
+                           rows_per_seq: int = 1) -> None:
+        """Pad-waste accounting for ONE decode/verify dispatch: ``real``
+        is the context tokens the rows actually hold, ``padded`` the token
+        slots the executable walks beyond them — batch pad rows plus the
+        context window past each row's live tokens. Bucketed dispatch
+        walks the dispatched context bucket for EVERY row; the ragged
+        kernel walks each row's own blocks (partial-tail slots only).
+        ``rows_per_seq``: the verify executable flattens ``k + 1`` query
+        rows per sequence, each walking the window — both sides scale.
+        Exported as ``shai_engine_pad_tokens_total``/``pad_fraction`` so
+        the ragged win is measurable on a live pod — and a ladder growing
+        back is visible. Pure host arithmetic (hot-path safe)."""
+        bs = self.ecfg.block_size
+        real = 0
+        walked = 0
+        if self._ragged:
+            for s in running:
+                n = self.cache.seq(s.req.req_id).n_tokens
+                real += n
+                walked += self.cache._blocks_needed(n) * bs
+            walked += (Bb - len(running)) * bs  # pad rows walk one block
+        else:
+            m_blocks = 1
+            for s in running:
+                n = self.cache.seq(s.req.req_id).n_tokens
+                real += n
+                m_blocks = max(m_blocks, self.cache._blocks_needed(n))
+            m = next(b for b in self._ctx_buckets if b >= m_blocks)
+            walked = Bb * m * bs
+        self.obs.count_pad(real * rows_per_seq,
+                           (walked - real) * rows_per_seq)
+
     def _running_slots(self) -> List["_Running"]:
         return [s for s in self.slots
                 if s is not None and s.prefill_cursor is None]
@@ -1535,6 +1647,7 @@ class LLMEngine:
         n_exec = self.n_executables
         Bb, verify = self._verify_for(self._max_ctx_blocks(running),
                                       len(running))
+        self._note_dispatch_pad(running, Bb, rows_per_seq=k + 1)
 
         # verify shares the device-resident batch view with decode: same
         # composition, same persistent tables/knob arrays — only the
@@ -1657,6 +1770,7 @@ class LLMEngine:
         n_exec = self.n_executables
         Bb, decode = self._decode_for(self._max_ctx_blocks(running),
                                       len(running))
+        self._note_dispatch_pad(running, Bb)
 
         a = self._marshal_running(running, Bb)
         tokens = np.zeros((Bb,), np.int32)

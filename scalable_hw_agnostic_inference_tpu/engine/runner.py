@@ -67,8 +67,15 @@ class EngineShardings:
             is_leaf=lambda x: isinstance(x, P))
         kvspec = cache_specs(cfg, axis_size=mesh.shape.get("tp", 1))
         self.kv_layer = {n: NamedSharding(mesh, s) for n, s in kvspec.items()}
+        # int8 KV pools (SHAI_KV_QUANT): the per-(block, head) scale arrays
+        # [N, Hkv] split on the same kv-head axis as the blocks they scale
+        self.kv_scale = NamedSharding(mesh, P(None, "tp"))
 
-    def kv_pool(self, n_layers: int):
+    def kv_pool(self, n_layers: int, quant: bool = False):
+        if quant:
+            return [{**self.kv_layer,
+                     "ks": self.kv_scale, "vs": self.kv_scale}
+                    for _ in range(n_layers)]
         return [dict(self.kv_layer) for _ in range(n_layers)]
 
     def cross_pool(self, n_cross: int):
@@ -243,6 +250,32 @@ def _cross_layer(lp: Dict, x: jax.Array, cross_k: jax.Array,
     return x + g_mlp * m * gate
 
 
+def _scatter_blocks(kv_layer: Dict, tbl: jax.Array, k: jax.Array,
+                    v: jax.Array, quant: bool) -> Dict:
+    """Scatter whole fresh KV blocks ``[B, m, Bs, Hkv, Dh]`` into one pool
+    layer. int8 pools (``SHAI_KV_QUANT``) quantize per block x kv-head on
+    the way in (``ops.quant.quantize_kv_blocks``) and scatter the f32
+    scales alongside — THE quantized-write seam every prefill/continuation
+    scatter goes through."""
+    if quant:
+        from ..ops.quant import quantize_kv_blocks
+
+        kq, ksc = quantize_kv_blocks(k)
+        vq, vsc = quantize_kv_blocks(v)
+        return {"k": kv_layer["k"].at[tbl].set(kq),
+                "v": kv_layer["v"].at[tbl].set(vq),
+                "ks": kv_layer["ks"].at[tbl].set(ksc),
+                "vs": kv_layer["vs"].at[tbl].set(vsc)}
+    return {"k": kv_layer["k"].at[tbl].set(k.astype(kv_layer["k"].dtype)),
+            "v": kv_layer["v"].at[tbl].set(v.astype(kv_layer["v"].dtype))}
+
+
+def _pool_scales(kv_layer: Dict):
+    """``(k_scale, v_scale)`` of an int8 pool layer, ``(None, None)`` for a
+    float pool — the read-side twin of :func:`_scatter_blocks`."""
+    return kv_layer.get("ks"), kv_layer.get("vs")
+
+
 def _logits(p: Dict, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
     x = _rmsnorm(x, p["final_norm"]["scale"], cfg.rms_eps)
     if cfg.tie_embeddings:
@@ -252,7 +285,8 @@ def _logits(p: Dict, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
 
 def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                  bucket: int, prefix_len: int = 0, n_seqs: int = 1,
-                 shardings: Optional[EngineShardings] = None):
+                 shardings: Optional[EngineShardings] = None,
+                 kv_quant: bool = False):
     """Compile ``prefill(params, kv, ids, n, block_tables[, prefix])``.
 
     ``n_seqs`` sequences per call: ``ids`` ``[K, bucket - prefix_len]``
@@ -307,14 +341,14 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
             o = _tp_attention(shardings, q, k, v, kv_lengths=n, causal=True)
             x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
             x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
-            # scatter each row's k/v blocks into the pool ([B, m_used] index)
-            kdst = kv[pi]["k"].at[tbl].set(
-                k.reshape(B, m_used, block_size, cfg.n_kv_heads, cfg.head_dim)
-                .astype(kv[pi]["k"].dtype))
-            vdst = kv[pi]["v"].at[tbl].set(
-                v.reshape(B, m_used, block_size, cfg.n_kv_heads, cfg.head_dim)
-                .astype(kv[pi]["v"].dtype))
-            kv[pi] = {"k": kdst, "v": vdst}
+            # scatter each row's k/v blocks into the pool ([B, m_used]
+            # index); int8 pools quantize per block x head on the way in
+            kv[pi] = _scatter_blocks(
+                kv[pi], tbl,
+                k.reshape(B, m_used, block_size, cfg.n_kv_heads,
+                          cfg.head_dim),
+                v.reshape(B, m_used, block_size, cfg.n_kv_heads,
+                          cfg.head_dim), kv_quant)
             pi += 1
         last = jnp.take_along_axis(x, (n - 1).reshape(B, 1, 1), axis=1)
         return kv, _logits(p, last, cfg)[:, 0]  # [B, V]
@@ -339,7 +373,7 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     if shardings is None:
         return jax.jit(prefill, donate_argnums=(1,))
     sh, rep = shardings, shardings.rep
-    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set))
+    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set), quant=kv_quant)
     in_sh = [sh.params, kvsh, rep, rep, rep]
     if cross_set:
         in_sh += [sh.cross_pool(len(cross_set)), rep, rep]
@@ -349,9 +383,71 @@ def make_prefill(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                    in_shardings=tuple(in_sh), out_shardings=(kvsh, rep))
 
 
+def _pool_kernel_call(kernel, shardings: Optional["EngineShardings"],
+                      qf, kpool, vpool, tf, lf, ks=None, vs=None):
+    """THE dispatch seam for a paged/ragged pool kernel on flattened rows:
+    direct call on one device, head-split shard_map under TP (the raw
+    Mosaic kernel cannot be auto-partitioned; attention is head-local so
+    the split needs no collectives). int8 scale arrays ride along when
+    present, split on the same kv-head axis as the blocks they scale.
+    Shared by decode/verify (``_make_token_forward``) and the ragged
+    continuation (``_ragged_pool_attention``) so the sharding specs can
+    never diverge between the two."""
+    if shardings is None:
+        return kernel(qf, kpool, vpool, tf, lf, ks, vs)
+    from jax.experimental.shard_map import shard_map
+
+    heads_q = P(None, "tp", None)
+    heads_kv = P(None, None, "tp", None)
+    if ks is None:
+        return shard_map(
+            lambda q_, k_, v_, t_, l_: kernel(q_, k_, v_, t_, l_),
+            mesh=shardings.mesh,
+            in_specs=(heads_q, heads_kv, heads_kv, P(None, None), P(None)),
+            out_specs=heads_q, check_rep=False,
+        )(qf, kpool, vpool, tf, lf)
+    return shard_map(
+        lambda q_, k_, v_, t_, l_, ks_, vs_: kernel(
+            q_, k_, v_, t_, l_, ks_, vs_),
+        mesh=shardings.mesh,
+        in_specs=(heads_q, heads_kv, heads_kv, P(None, None), P(None),
+                  P(None, "tp"), P(None, "tp")),
+        out_specs=heads_q, check_rep=False,
+    )(qf, kpool, vpool, tf, lf, ks, vs)
+
+
+def _ragged_pool_attention(q: jax.Array, kv_layer: Dict, tables: jax.Array,
+                           positions: jax.Array, block_size: int,
+                           shardings: Optional["EngineShardings"]):
+    """Ragged attention of ``[B, T, H, D]`` queries over the paged pool:
+    the Pallas ragged kernel on TPU platforms (``T`` queries flattened
+    into the row axis, through the shared ``_pool_kernel_call`` dispatch
+    seam), the XLA gather reference elsewhere (which XLA partitions
+    automatically). int8 pool scales ride along either way."""
+    B, T, H, D = q.shape
+    ks, vs = _pool_scales(kv_layer)
+    kpool, vpool = kv_layer["k"], kv_layer["v"]
+    from ..ops.attention import on_tpu_platform, ragged_gather_attention
+
+    if not on_tpu_platform():
+        return ragged_gather_attention(q, kpool, vpool, tables, positions,
+                                       ks, vs)
+    from ..ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention as kern,
+    )
+
+    L = tables.shape[1] * block_size
+    qf = q.reshape(B * T, H, D)
+    tf = jnp.repeat(tables, T, axis=0) if T > 1 else tables
+    lf = jnp.clip(positions + 1, 1, L).reshape(B * T)
+    o = _pool_kernel_call(kern, shardings, qf, kpool, vpool, tf, lf, ks, vs)
+    return o.reshape(B, T, H, D)
+
+
 def make_prefill_cont(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
-                      bucket: int, start_blocks: int,
-                      shardings: Optional[EngineShardings] = None):
+                      bucket: int, start_blocks: int = 0,
+                      shardings: Optional[EngineShardings] = None,
+                      kv_quant: bool = False, ragged: bool = False):
     """Compile a CONTINUATION prefill chunk: ``cont(params, kv, ids, n_text,
     block_tables) -> (kv, next_logits)``.
 
@@ -376,12 +472,77 @@ def make_prefill_cont(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     attend the request's static vision states each chunk (no pool traffic,
     same as ``make_prefill``); the signature gains the
     ``(cross_kv, has_image, cross_len)`` tail.
+
+    ``ragged`` (``SHAI_RAGGED_ATTENTION``): the chunk start becomes DATA —
+    ``cont(params, kv, ids, n_text, block_tables, start)`` — and the
+    chunk's queries attend their prior context *through the pool* via the
+    ragged path (per-query lengths) instead of a static-offset dense
+    gather. ONE executable per chunk bucket replaces the whole
+    one-per-start continuation ladder, killing the pad waste of
+    intermediate chunks compiled for the largest start. Text engines only
+    (the ragged gate excludes cross configs).
+
+    ``kv_quant``: int8 pool — the prior-context gather dequantizes, the
+    chunk scatter quantizes per block x head (``_scatter_blocks``).
     """
-    assert bucket % block_size == 0 and start_blocks >= 1
+    assert bucket % block_size == 0
+    assert ragged or start_blocks >= 1
     start = start_blocks * block_size
     c_blocks = bucket // block_size
-    assert start_blocks + c_blocks <= blocks_per_seq
+    assert ragged or start_blocks + c_blocks <= blocks_per_seq
     cross_set = set(cfg.cross_attention_layers)
+    assert not (ragged and cross_set), \
+        "ragged continuation serves text engines (the engine gate)"
+
+    def _ragged_impl(params, kv, ids, n_text, block_tables, start_arr):
+        p = params["params"]
+        B = ids.shape[0]  # == 1
+        x = p["embed"]["embedding"][ids].astype(jnp.bfloat16)
+        T = x.shape[1]  # == bucket
+        start_arr = start_arr.astype(jnp.int32)
+        positions = start_arr[:, None] + jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32), (B, T))
+        sb = start_arr // block_size                        # [B]
+        tbl_chunk = jnp.take_along_axis(
+            block_tables,
+            sb[:, None] + jnp.arange(c_blocks, dtype=jnp.int32)[None, :],
+            axis=1)                                         # [B, c_blocks]
+        tables = block_tables[:, :blocks_per_seq]
+        pi = 0
+        for li in range(cfg.n_layers):
+            lp = p[f"layer_{li}"]
+            h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
+            q, k, v = _qkv(lp, h, positions, cfg)
+            # scatter the chunk FIRST: its queries then attend their own
+            # freshly-written keys through the pool, exactly like decode —
+            # [prior, chunk] is the pool's table order, no concat needed
+            kv[pi] = _scatter_blocks(
+                kv[pi], tbl_chunk,
+                k.reshape(B, c_blocks, block_size, cfg.n_kv_heads,
+                          cfg.head_dim),
+                v.reshape(B, c_blocks, block_size, cfg.n_kv_heads,
+                          cfg.head_dim), kv_quant)
+            o = _ragged_pool_attention(q, kv[pi], tables, positions,
+                                       block_size, shardings)
+            x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
+            x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"],
+                                      cfg.rms_eps))
+            pi += 1
+        last = jnp.take_along_axis(x, (n_text - 1).reshape(B, 1, 1), axis=1)
+        return kv, _logits(p, last, cfg)[:, 0]  # [B, V]
+
+    if ragged:
+        def cont(params, kv, ids, n_text, block_tables, start):
+            return _ragged_impl(params, kv, ids, n_text, block_tables,
+                                start)
+
+        if shardings is None:
+            return jax.jit(cont, donate_argnums=(1,))
+        sh, rep = shardings, shardings.rep
+        kvsh = sh.kv_pool(cfg.n_layers, quant=kv_quant)
+        return jax.jit(cont, donate_argnums=(1,),
+                       in_shardings=(sh.params, kvsh, rep, rep, rep, rep),
+                       out_shardings=(kvsh, rep))
 
     def _cont_impl(params, kv, ids, n_text, block_tables, cross_kv=None,
                    has_image=None, cross_len=None):
@@ -408,22 +569,34 @@ def make_prefill_cont(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                 continue
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
             q, k, v = _qkv(lp, h, positions, cfg)
-            kflat = kv[pi]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-            vflat = kv[pi]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-            kcat = jnp.concatenate(
-                [kflat[goff].astype(q.dtype), k], axis=1)  # [B, start+T, ...]
-            vcat = jnp.concatenate([vflat[goff].astype(q.dtype), v], axis=1)
+            if kv_quant:
+                # int8 prior context: block-shaped gather so the
+                # per-(block, head) scales broadcast on the dequant
+                from ..ops.quant import dequantize_kv_blocks
+
+                kprior = dequantize_kv_blocks(
+                    kv[pi]["k"][tbl_prior], kv[pi]["ks"][tbl_prior],
+                    q.dtype).reshape(B, start, cfg.n_kv_heads, cfg.head_dim)
+                vprior = dequantize_kv_blocks(
+                    kv[pi]["v"][tbl_prior], kv[pi]["vs"][tbl_prior],
+                    q.dtype).reshape(B, start, cfg.n_kv_heads, cfg.head_dim)
+            else:
+                kflat = kv[pi]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+                vflat = kv[pi]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+                kprior = kflat[goff].astype(q.dtype)
+                vprior = vflat[goff].astype(q.dtype)
+            kcat = jnp.concatenate([kprior, k], axis=1)  # [B, start+T, ...]
+            vcat = jnp.concatenate([vprior, v], axis=1)
             o = _tp_attention(shardings, q, kcat, vcat, kv_lengths=n,
                               causal=True)
             x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
             x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"], cfg.rms_eps))
-            kdst = kv[pi]["k"].at[tbl_chunk].set(
+            kv[pi] = _scatter_blocks(
+                kv[pi], tbl_chunk,
                 k.reshape(B, c_blocks, block_size, cfg.n_kv_heads,
-                          cfg.head_dim).astype(kv[pi]["k"].dtype))
-            vdst = kv[pi]["v"].at[tbl_chunk].set(
+                          cfg.head_dim),
                 v.reshape(B, c_blocks, block_size, cfg.n_kv_heads,
-                          cfg.head_dim).astype(kv[pi]["v"].dtype))
-            kv[pi] = {"k": kdst, "v": vdst}
+                          cfg.head_dim), kv_quant)
             pi += 1
         last = jnp.take_along_axis(x, (n_text - 1).reshape(B, 1, 1), axis=1)
         return kv, _logits(p, last, cfg)[:, 0]  # [B, V]
@@ -441,7 +614,7 @@ def make_prefill_cont(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     if shardings is None:
         return jax.jit(cont, donate_argnums=(1,))
     sh, rep = shardings, shardings.rep
-    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set))
+    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set), quant=kv_quant)
     in_sh = [sh.params, kvsh, rep, rep, rep]
     if cross_set:
         in_sh += [sh.cross_pool(len(cross_set)), rep, rep]
@@ -467,7 +640,8 @@ def _resolve_paged(paged):
 
 def _make_token_forward(cfg: LlamaConfig, block_size: int, m_ctx: int,
                         max_num_seqs: int, T: int,
-                        shardings: Optional[EngineShardings], paged: bool):
+                        shardings: Optional[EngineShardings], paged: bool,
+                        ragged: bool = False, kv_quant: bool = False):
     """THE paged-engine forward for ``T`` new tokens per sequence — decode
     is its ``T=1`` instantiation, speculative verify its ``T=k+1``, so the
     two dispatch paths share one layer stack and cannot drift apart (the
@@ -486,25 +660,24 @@ def _make_token_forward(cfg: LlamaConfig, block_size: int, m_ctx: int,
     L = block_size * m_ctx
     cross_set = set(cfg.cross_attention_layers)
 
-    def paged_attn(qf, kpool, vpool, tablesf, lengthsf):
-        """qf [rows, H, D] over the pool; shard_map'd under TP (the kernel
-        is head-local, so splitting the head axis needs no collectives)."""
-        from ..ops.pallas.paged_attention import paged_decode_attention
+    def paged_attn(qf, kpool, vpool, tablesf, lengthsf, ks=None, vs=None):
+        """qf [rows, H, D] over the pool, through the shared
+        ``_pool_kernel_call`` dispatch seam (head-split shard_map under
+        TP). ``ragged`` swaps in the ragged kernel — same layout, per-row
+        compute skip instead of a caller-side context bucket; ``ks``/``vs``
+        are an int8 pool's per-(block, head) scales, dequantized in-kernel
+        by both."""
+        if ragged:
+            from ..ops.pallas.ragged_paged_attention import (
+                ragged_paged_attention as kernel,
+            )
+        else:
+            from ..ops.pallas.paged_attention import (
+                paged_decode_attention as kernel,
+            )
 
-        if shardings is None:
-            return paged_decode_attention(qf, kpool, vpool, tablesf,
-                                          lengthsf)
-        from jax.experimental.shard_map import shard_map
-
-        return shard_map(
-            lambda q_, k_, v_, t_, l_: paged_decode_attention(
-                q_, k_, v_, t_, l_),
-            mesh=shardings.mesh,
-            in_specs=(P(None, "tp", None), P(None, None, "tp", None),
-                      P(None, None, "tp", None), P(None, None), P(None)),
-            out_specs=P(None, "tp", None),
-            check_rep=False,
-        )(qf, kpool, vpool, tablesf, lengthsf)
+        return _pool_kernel_call(kernel, shardings, qf, kpool, vpool,
+                                 tablesf, lengthsf, ks, vs)
 
     def fwd(params, kv, tokens, positions, tables, cross_kv=None,
             has_image=None, slot_idx=None, cross_len=None):
@@ -520,7 +693,7 @@ def _make_token_forward(cfg: LlamaConfig, block_size: int, m_ctx: int,
                                 axis=1),
             0)
         widx = blk * block_size + positions % block_size
-        if not paged:
+        if not paged and not kv_quant:
             # flat gather offsets for the whole context window: [B, L]
             goff = (tables[:, :, None] * block_size
                     + jnp.arange(block_size)[None, None, :]).reshape(B, L)
@@ -544,27 +717,57 @@ def _make_token_forward(cfg: LlamaConfig, block_size: int, m_ctx: int,
                 continue
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
             q, kk, vv = _qkv(lp, h, positions, cfg)
-            pool_shape = kv[pi]["k"].shape
-            kflat = kv[pi]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-            vflat = kv[pi]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-            kflat = kflat.at[widx].set(kk.astype(kflat.dtype))
-            vflat = vflat.at[widx].set(vv.astype(vflat.dtype))
+            if kv_quant:
+                # int8 pool: one read-modify-write requantize per new token
+                # (T is 1 for decode, k+1 for verify — a tiny unroll); the
+                # block's scale only ever grows, so resident tokens stay
+                # within half a step of the final scale
+                from ..ops.quant import requantize_block_tokens
+
+                kpool, vpool = kv[pi]["k"], kv[pi]["v"]
+                ks, vs = kv[pi]["ks"], kv[pi]["vs"]
+                for t in range(T):
+                    bt = blk[:, t]
+                    pin = positions[:, t] % block_size
+                    kq, ksn = requantize_block_tokens(
+                        kpool[bt], ks[bt], kk[:, t], pin)
+                    vq, vsn = requantize_block_tokens(
+                        vpool[bt], vs[bt], vv[:, t], pin)
+                    kpool = kpool.at[bt].set(kq)
+                    vpool = vpool.at[bt].set(vq)
+                    ks = ks.at[bt].set(ksn)
+                    vs = vs.at[bt].set(vsn)
+                kv[pi] = {"k": kpool, "v": vpool, "ks": ks, "vs": vs}
+            else:
+                pool_shape = kv[pi]["k"].shape
+                kflat = kv[pi]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+                vflat = kv[pi]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+                kflat = kflat.at[widx].set(kk.astype(kflat.dtype))
+                vflat = vflat.at[widx].set(vv.astype(vflat.dtype))
+                kv[pi] = {"k": kflat.reshape(pool_shape),
+                          "v": vflat.reshape(pool_shape)}
+            ksc, vsc = _pool_scales(kv[pi])
             if paged:
-                kpool = kflat.reshape(pool_shape)
-                vpool = vflat.reshape(pool_shape)
                 o = paged_attn(
                     q.reshape(B * T, cfg.n_heads, cfg.head_dim),
-                    kpool, vpool,
+                    kv[pi]["k"], kv[pi]["v"],
                     jnp.repeat(tables, T, axis=0) if T > 1 else tables,
-                    jnp.clip(positions + 1, 1, L).reshape(B * T))
+                    jnp.clip(positions + 1, 1, L).reshape(B * T),
+                    ksc, vsc)
                 o = o.reshape(B, T, cfg.n_heads, cfg.head_dim)
-                kv[pi] = {"k": kpool, "v": vpool}
+            elif kv_quant:
+                # deviceless int8 path: the gather reference dequantizes
+                # right after the block gather (ops.attention)
+                from ..ops.attention import ragged_gather_attention
+
+                o = ragged_gather_attention(q, kv[pi]["k"], kv[pi]["v"],
+                                            tables, positions, ksc, vsc)
             else:
+                kflat = kv[pi]["k"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+                vflat = kv[pi]["v"].reshape(-1, cfg.n_kv_heads, cfg.head_dim)
                 kctx = kflat[goff]  # [B, L, Hkv, Dh]
                 vctx = vflat[goff]
                 o = dot_product_attention(q, kctx, vctx, mask=mask)
-                kv[pi] = {"k": kflat.reshape(pool_shape),
-                          "v": vflat.reshape(pool_shape)}
             pi += 1
             x = x + _proj(o.reshape(B, T, -1), lp["attn"]["o"])
             x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"],
@@ -577,7 +780,8 @@ def _make_token_forward(cfg: LlamaConfig, block_size: int, m_ctx: int,
 def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                 max_num_seqs: int, ctx_blocks: Optional[int] = None,
                 shardings: Optional[EngineShardings] = None,
-                paged: Optional[bool] = None, feedback: bool = False):
+                paged: Optional[bool] = None, feedback: bool = False,
+                ragged: bool = False, kv_quant: bool = False):
     """Compile one decode step for the whole slot batch.
 
     ``decode(params, kv, tokens [B], pos [B], tables [B, M], active [B],
@@ -615,15 +819,30 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     for TPU backends, off elsewhere (the interpreter is test-only); the
     ``SHAI_PAGED_DECODE`` env var (0/1) overrides.
 
+    ``ragged``: one dispatch for mixed context lengths
+    (``SHAI_RAGGED_ATTENTION``) — the attention window is the FULL
+    ``blocks_per_seq`` table, per-row cost following each row's own
+    length (compute skip + fetch elision in
+    ``ops.pallas.ragged_paged_attention``), so the engine compiles ONE
+    context entry instead of the ``token_generation_buckets`` ladder and
+    never dispatches on the longest sequence's bucket.
+
+    ``kv_quant``: int8 KV pool (``SHAI_KV_QUANT=int8``) — writes quantize
+    per block x kv-head, reads dequantize in-kernel; the kv pytree carries
+    ``ks``/``vs`` scale arrays next to the block pools.
+
     The layer stack itself is ``_make_token_forward`` at ``T=1`` — shared
     verbatim with the speculative verify executable.
     """
     m_ctx = blocks_per_seq if ctx_blocks is None else ctx_blocks
     assert 1 <= m_ctx <= blocks_per_seq
+    assert not ragged or m_ctx == blocks_per_seq, \
+        "ragged decode owns the full window; the bucket ladder is gone"
     paged = _resolve_paged(paged)
     cross_set = set(cfg.cross_attention_layers)
     fwd = _make_token_forward(cfg, block_size, m_ctx, max_num_seqs, 1,
-                              shardings, paged)
+                              shardings, paged, ragged=ragged,
+                              kv_quant=kv_quant)
 
     def _decode_impl(params, kv, tokens, pos, tables, active, rng,
                      temperature, top_k, top_p, cross_kv=None, has_image=None,
@@ -658,7 +877,7 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     if shardings is None:
         return jax.jit(decode, donate_argnums=donate)
     sh, rep = shardings, shardings.rep
-    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set))
+    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set), quant=kv_quant)
     in_sh = (sh.params, kvsh) + (rep,) * 8
     if cross_set:
         in_sh += (sh.cross_pool(len(cross_set)), rep, rep, rep)
@@ -670,7 +889,8 @@ def make_decode(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
 def make_verify(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
                 max_num_seqs: int, k: int, ctx_blocks: Optional[int] = None,
                 shardings: Optional[EngineShardings] = None,
-                paged: Optional[bool] = None):
+                paged: Optional[bool] = None, ragged: bool = False,
+                kv_quant: bool = False):
     """Compile one speculative VERIFY step: score ``k + 1`` positions per
     sequence in ONE paged-attention dispatch.
 
@@ -702,11 +922,14 @@ def make_verify(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     assert k >= 1
     m_ctx = blocks_per_seq if ctx_blocks is None else ctx_blocks
     assert 1 <= m_ctx <= blocks_per_seq
+    assert not ragged or m_ctx == blocks_per_seq, \
+        "ragged verify owns the full window; the bucket ladder is gone"
     T = k + 1
     paged = _resolve_paged(paged)
     cross_set = set(cfg.cross_attention_layers)
     fwd = _make_token_forward(cfg, block_size, m_ctx, max_num_seqs, T,
-                              shardings, paged)
+                              shardings, paged, ragged=ragged,
+                              kv_quant=kv_quant)
 
     def _verify_impl(params, kv, tokens, pos0, tables, active, rng,
                      temperature, top_k, top_p, cross_kv=None, has_image=None,
@@ -760,7 +983,7 @@ def make_verify(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     if shardings is None:
         return jax.jit(verify, donate_argnums=(1,))
     sh, rep = shardings, shardings.rep
-    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set))
+    kvsh = sh.kv_pool(cfg.n_layers - len(cross_set), quant=kv_quant)
     in_sh = (sh.params, kvsh) + (rep,) * 8
     if cross_set:
         in_sh += (sh.cross_pool(len(cross_set)), rep, rep, rep)

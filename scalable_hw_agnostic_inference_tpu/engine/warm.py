@@ -39,27 +39,46 @@ def warm_executables(eng, prefix_lens: Sequence[int] = (0,)) -> int:
             elif 0 < p < b and eng._cross_kv is None:
                 eng._prefill_for(b, p)  # prefix path stays single-seq
                 n += 1
-    if eng.ecfg.max_model_len > eng.buckets.max:
-        # chunked-prefill ladder: one continuation executable per chunk
-        # start past the largest bucket (cross engines included — their
-        # cont executables carry the cross-args tail)
-        C = eng.buckets.max
-        start = C
-        while start + C <= eng.ecfg.max_model_len:
-            eng._cont_for(start // eng.ecfg.block_size)
-            n += 1
-            start += C
-    if eng.cache.prefix_caching:
-        # cached-admission ladder: (warm start, chunk bucket) pairs so a
-        # cache hit never compiles post-ready (closed set — the SAME
-        # _cached_starts list admission picks from)
-        for s in eng._cached_starts():
-            for cb in eng.buckets.buckets:
-                if s + cb <= eng.ecfg.max_model_len:
-                    key = ("cont", s // eng.ecfg.block_size, cb)
-                    if key not in eng._prefill:
-                        eng._cont_for(s // eng.ecfg.block_size, cb)
-                        n += 1
+    if eng._ragged:
+        # ragged continuation ladder (SHAI_RAGGED_ATTENTION): the chunk
+        # start is DATA, so ONE executable per chunk bucket covers every
+        # start offset the bucketed ladder compiled one-by-one — the
+        # chunked path and every cached-admission (warm start, bucket)
+        # pair alike
+        want = set()
+        if eng.ecfg.max_model_len > eng.buckets.max:
+            want.add(eng.buckets.max)
+        if eng.cache.prefix_caching:
+            for s in eng._cached_starts():
+                for cb in eng.buckets.buckets:
+                    if s + cb <= eng.ecfg.max_model_len:
+                        want.add(cb)
+        for cb in sorted(want):
+            if ("rcont", cb) not in eng._prefill:
+                eng._cont_for(0, cb)
+                n += 1
+    else:
+        if eng.ecfg.max_model_len > eng.buckets.max:
+            # chunked-prefill ladder: one continuation executable per chunk
+            # start past the largest bucket (cross engines included — their
+            # cont executables carry the cross-args tail)
+            C = eng.buckets.max
+            start = C
+            while start + C <= eng.ecfg.max_model_len:
+                eng._cont_for(start // eng.ecfg.block_size)
+                n += 1
+                start += C
+        if eng.cache.prefix_caching:
+            # cached-admission ladder: (warm start, chunk bucket) pairs so
+            # a cache hit never compiles post-ready (closed set — the SAME
+            # _cached_starts list admission picks from)
+            for s in eng._cached_starts():
+                for cb in eng.buckets.buckets:
+                    if s + cb <= eng.ecfg.max_model_len:
+                        key = ("cont", s // eng.ecfg.block_size, cb)
+                        if key not in eng._prefill:
+                            eng._cont_for(s // eng.ecfg.block_size, cb)
+                            n += 1
     bb = 1
     batch_buckets = []
     while bb < eng.ecfg.max_num_seqs:
@@ -89,6 +108,18 @@ def _run_warm_calls(eng) -> None:
     ecfg = eng.ecfg
     B, M = ecfg.max_num_seqs, ecfg.blocks_per_seq
     for key, fn in list(eng._prefill.items()):
+        if key[0] == "rcont":
+            # dynamic-start ragged continuation: the start rides as data
+            # (a zero start against the null table writes into reserved
+            # block 0 — garbage there is allowed by contract)
+            eng.cache.kv, logits = fn(
+                eng.params, eng.cache.kv,
+                jnp.zeros((1, key[1]), jnp.int32),
+                jnp.ones((1,), jnp.int32),
+                jnp.zeros((1, M), jnp.int32),
+                jnp.zeros((1,), jnp.int32))
+            logits.block_until_ready()
+            continue
         if key[0] == "cont":
             args = [eng.params, eng.cache.kv,
                     jnp.zeros((1, key[2]), jnp.int32),
@@ -158,7 +189,7 @@ def _run_warm_calls(eng) -> None:
         jnp.zeros((1, V), jnp.float32),
         jax.random.PRNGKey(0), 1.0, 0, 1.0).block_until_ready()
     for key in eng._prefill:
-        if key[0] == "cont":
+        if key[0] in ("cont", "rcont"):
             continue
         _, P_, K = key
         if P_ == 0:

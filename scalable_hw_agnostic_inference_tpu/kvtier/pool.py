@@ -48,9 +48,14 @@ COPYOUT_QUEUE_DEPTH = 64
 
 
 def maybe_host_tier(*, n_layers: int, block_size: int, n_kv_heads: int,
-                    head_dim: int, dtype) -> Optional["HostKVTier"]:
+                    head_dim: int, dtype,
+                    quant: bool = False) -> Optional["HostKVTier"]:
     """The ``SHAI_KVTIER`` gate: a configured :class:`HostKVTier`, or None
-    when the knob is off (the default — the tier is opt-in)."""
+    when the knob is off (the default — the tier is opt-in). ``quant``
+    declares an int8 device pool (``SHAI_KV_QUANT``): entries then carry
+    the per-(block, head) f32 scales next to the int8 blocks, and
+    ``block_nbytes`` prices both — the same host RAM holds ~2x the blocks,
+    matching the device pool's capacity doubling."""
     from ..obs.util import env_flag, env_int
 
     if not env_flag("SHAI_KVTIER", False):
@@ -59,7 +64,7 @@ def maybe_host_tier(*, n_layers: int, block_size: int, n_kv_heads: int,
     tier = HostKVTier(
         n_layers=n_layers, block_size=block_size, n_kv_heads=n_kv_heads,
         head_dim=head_dim, dtype=dtype, capacity_bytes=capacity,
-        async_copy=env_flag("SHAI_KVTIER_ASYNC", True))
+        async_copy=env_flag("SHAI_KVTIER_ASYNC", True), quant=quant)
     if tier.block_nbytes > tier.capacity_bytes:
         log.warning(
             "SHAI_KVTIER_BYTES=%d holds zero %d-byte blocks — the tier is "
@@ -95,13 +100,13 @@ class CopyOutWorker:
 
     def _run(self) -> None:
         while True:
-            hashes, k_all, v_all, n = self._q.get()
+            hashes, arrays, n = self._q.get()
             try:
                 # the blocking device->host transfer the engine thread
                 # never pays: the gather outputs are fresh buffers, valid
                 # even after the evicted blocks were re-allocated
-                self._pool._ingest(hashes, np.asarray(k_all),
-                                   np.asarray(v_all), n)
+                self._pool._ingest(hashes,
+                                   tuple(np.asarray(a) for a in arrays), n)
             except Exception:
                 log.warning("kv tier copy-out failed; blocks evicted "
                             "without demotion", exc_info=True)
@@ -115,22 +120,26 @@ class HostKVTier:
 
     def __init__(self, *, n_layers: int, block_size: int, n_kv_heads: int,
                  head_dim: int, dtype, capacity_bytes: int,
-                 async_copy: bool = True):
+                 async_copy: bool = True, quant: bool = False):
         self.n_layers = int(n_layers)
         self.block_size = int(block_size)
         self.n_kv_heads = int(n_kv_heads)
         self.head_dim = int(head_dim)
         self.dtype = np.dtype(dtype)
-        #: host bytes ONE block costs (k + v across every layer) — the
-        #: unit of every capacity/accounting decision in this class
+        self.quant = bool(quant)
+        #: host bytes ONE block costs (k + v across every layer, plus the
+        #: per-(block, head) f32 scales of a quantized pool) — the unit of
+        #: every capacity/accounting decision in this class
         self.block_nbytes = (2 * self.n_layers * self.block_size
                              * self.n_kv_heads * self.head_dim
                              * self.dtype.itemsize)
+        if self.quant:
+            self.block_nbytes += 2 * self.n_layers * self.n_kv_heads * 4
         self.capacity_bytes = int(capacity_bytes)
         self.async_copy = bool(async_copy)
         self._lock = threading.Lock()
-        #: hash -> (k, v) numpy [n_layers, block_size, n_kv_heads, head_dim]
-        self._entries: "OrderedDict[int, Tuple[np.ndarray, np.ndarray]]" = (
+        #: hash -> (k, v[, ks, vs]) numpy, each [n_layers, ...block dims]
+        self._entries: "OrderedDict[int, Tuple[np.ndarray, ...]]" = (
             OrderedDict())
         self._stats: Dict[str, int] = {
             "hits": 0, "misses": 0, "stores": 0, "evictions": 0,
@@ -169,30 +178,33 @@ class HostKVTier:
 
     # -- demotion (engine thread enqueues / worker publishes) --------------
 
-    def store_batch(self, hashes: Sequence[int], k_all: Any, v_all: Any,
-                    n: int) -> None:
-        """Accept ``n`` demoted blocks: ``k_all``/``v_all`` are the gather
-        outputs ``[n_layers, pad, Bs, Hkv, Dh]`` (device arrays in async
-        mode — the worker materializes them; anything numpy-coercible in
-        sync mode), column ``j`` belonging to ``hashes[j]``."""
+    def store_batch(self, hashes: Sequence[int], *arrays_and_n) -> None:
+        """Accept ``n`` demoted blocks: ``arrays_and_n`` is ``(k_all,
+        v_all[, k_scale, v_scale], n)`` — the gather outputs
+        ``[n_layers, pad, ...]`` (device arrays in async mode — the worker
+        materializes them; anything numpy-coercible in sync mode), column
+        ``j`` belonging to ``hashes[j]``. Quantized pools pass the two
+        scale stacks ``[n_layers, pad, Hkv]`` between blocks and count."""
+        *arrays, n = arrays_and_n
+        arrays = tuple(arrays)
         if self.async_copy:
             if self._worker is None:
                 # lazy: engines that never demote never spawn the thread
                 self._worker = CopyOutWorker(self)
-            if not self._worker.submit((list(hashes), k_all, v_all, n)):
+            if not self._worker.submit((list(hashes), arrays, n)):
                 with self._lock:
                     self._stats["dropped"] += n
             return
         try:
-            self._ingest(list(hashes), np.asarray(k_all), np.asarray(v_all),
+            self._ingest(list(hashes), tuple(np.asarray(a) for a in arrays),
                          n)
         except Exception:
             log.warning("kv tier store failed; blocks evicted without "
                         "demotion", exc_info=True)
             self.count_error()
 
-    def _ingest(self, hashes: List[int], k_all: np.ndarray,
-                v_all: np.ndarray, n: int) -> None:
+    def _ingest(self, hashes: List[int],
+                arrays: Tuple[np.ndarray, ...], n: int) -> None:
         """Publish ``n`` materialized blocks, LRU-evicting to capacity."""
         for j, h in enumerate(hashes[:n]):
             with self._lock:
@@ -206,8 +218,8 @@ class HostKVTier:
                        > self.capacity_bytes):
                     self._entries.popitem(last=False)
                     self._stats["evictions"] += 1
-                self._entries[h] = (np.ascontiguousarray(k_all[:, j]),
-                                    np.ascontiguousarray(v_all[:, j]))
+                self._entries[h] = tuple(
+                    np.ascontiguousarray(a[:, j]) for a in arrays)
                 self._stats["stores"] += 1
                 self._stats["bytes"] += self.block_nbytes
 
@@ -235,11 +247,10 @@ class HostKVTier:
                 self._stats["misses"] += 1
             return run
 
-    def get_run(self, hashes: Sequence[int]
-                ) -> List[Tuple[int, np.ndarray, np.ndarray]]:
-        """Leading contiguous resident run as ``(hash, k, v)`` triples
-        (LRU-touched; entries STAY resident — a restored block evicted
-        from the device again re-demotes for free)."""
+    def get_run(self, hashes: Sequence[int]) -> List[Tuple]:
+        """Leading contiguous resident run as ``(hash, k, v[, ks, vs])``
+        tuples (LRU-touched; entries STAY resident — a restored block
+        evicted from the device again re-demotes for free)."""
         with self._lock:
             out = []
             for h in hashes:
@@ -247,7 +258,7 @@ class HostKVTier:
                 if e is None:
                     break
                 self._entries.move_to_end(h)
-                out.append((h, e[0], e[1]))
+                out.append((h,) + tuple(e))
             return out
 
     # -- counters / export -------------------------------------------------

@@ -15,6 +15,12 @@ post-ready demotion or restore never pays an XLA compile:
   Index arrays are padded to a closed set of sizes (``engine/cache.py``'s
   ``_PAD_SIZES``); padding rows target reserved block 0, whose contents
   are garbage by contract.
+
+Quantized pools (``SHAI_KV_QUANT=int8``, ``quant=True``) move the
+per-(block, head) f32 scale rows alongside the int8 blocks in the SAME
+dispatches — a demoted block restores byte-exact (blocks and scales are
+copied, never re-quantized), so content hashes and the differential
+oracles are untouched by a host round-trip.
 """
 
 from __future__ import annotations
@@ -23,25 +29,47 @@ import jax
 import jax.numpy as jnp
 
 
-def make_tier_gather():
+def make_tier_gather(quant: bool = False):
     """Batched demotion gather: ``(kv pytree, idx[n]) -> (k, v)`` stacked
-    ``[n_layers, n, block_size, n_kv_heads, head_dim]``."""
+    ``[n_layers, n, block_size, n_kv_heads, head_dim]`` — plus
+    ``(k_scale, v_scale)`` stacked ``[n_layers, n, n_kv_heads]`` for int8
+    pools."""
 
     def gather(kv, idx):
         k = jnp.stack([lay["k"][idx] for lay in kv])
         v = jnp.stack([lay["v"][idx] for lay in kv])
         return k, v
 
-    return jax.jit(gather)
+    def gather_q(kv, idx):
+        k = jnp.stack([lay["k"][idx] for lay in kv])
+        v = jnp.stack([lay["v"][idx] for lay in kv])
+        ks = jnp.stack([lay["ks"][idx] for lay in kv])
+        vs = jnp.stack([lay["vs"][idx] for lay in kv])
+        return k, v, ks, vs
+
+    return jax.jit(gather_q if quant else gather)
 
 
-def make_tier_restore():
+def make_tier_restore(quant: bool = False):
     """Per-layer restore scatter: ``(pool_k, pool_v, idx[n], host_k, host_v)
     -> (pool_k', pool_v')`` with both pool buffers donated (the caller
-    rebinds them in the same statement — the donate-and-rebind idiom)."""
+    rebinds them in the same statement — the donate-and-rebind idiom).
+    The quantized variant scatters the scale rows in the same call:
+    ``(pool_k, pool_v, pool_ks, pool_vs, idx, host_k, host_v, host_ks,
+    host_vs) -> (pool_k', pool_v', pool_ks', pool_vs')``, all four pool
+    buffers donated."""
 
     def restore(pool_k, pool_v, idx, host_k, host_v):
         return (pool_k.at[idx].set(host_k.astype(pool_k.dtype)),
                 pool_v.at[idx].set(host_v.astype(pool_v.dtype)))
 
+    def restore_q(pool_k, pool_v, pool_ks, pool_vs, idx,
+                  host_k, host_v, host_ks, host_vs):
+        return (pool_k.at[idx].set(host_k.astype(pool_k.dtype)),
+                pool_v.at[idx].set(host_v.astype(pool_v.dtype)),
+                pool_ks.at[idx].set(host_ks.astype(pool_ks.dtype)),
+                pool_vs.at[idx].set(host_vs.astype(pool_vs.dtype)))
+
+    if quant:
+        return jax.jit(restore_q, donate_argnums=(0, 1, 2, 3))
     return jax.jit(restore, donate_argnums=(0, 1))

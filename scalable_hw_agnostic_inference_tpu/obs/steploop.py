@@ -121,6 +121,13 @@ class StepTelemetry:
         # each one is a serialization point the steady path avoids
         self.pipeline_flushes = 0
         self._flush_reasons: Dict[str, int] = {}
+        # pad-waste accounting: per dispatch, how many token slots the
+        # executable walked for REAL context vs shape padding (batch pad
+        # rows + bucket window beyond each row's live tokens + prefill
+        # bucket tails). The ragged kernel's win — and any ladder
+        # regression — shows up as pad_fraction on a live pod.
+        self.pad_tokens = 0
+        self.real_tokens = 0
         self.warmed_executables = 0  # closed-set size at readiness
         # last-step gauges (scraped between steps)
         self._gauges: Dict[str, float] = {}
@@ -149,6 +156,14 @@ class StepTelemetry:
     def flush_reasons(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._flush_reasons)
+
+    def count_pad(self, real: int, padded: int) -> None:
+        """One dispatch's token-slot accounting: ``real`` context/prompt
+        tokens the shapes carried vs ``padded`` slots walked only because
+        of bucketing/batch padding."""
+        with self._lock:
+            self.real_tokens += max(0, real)
+            self.pad_tokens += max(0, padded)
 
     def record_step(self, *, kind: str, duration_s: float, n_running: int,
                     n_waiting: int, n_chunking: int, blocks_free: int,
@@ -245,7 +260,12 @@ class StepTelemetry:
                 "warmed_executables": self.warmed_executables,
                 "kv_blocks_total": self.total_blocks,
                 "pipeline_flushes": self.pipeline_flushes,
+                "pad_tokens": self.pad_tokens,
+                "real_tokens": self.real_tokens,
             }
+            walked = self.pad_tokens + self.real_tokens
+            out["pad_fraction"] = (round(self.pad_tokens / walked, 4)
+                                   if walked else 0.0)
             out.update(self._gauges)
         kvt = self.kvtier
         if kvt is not None:

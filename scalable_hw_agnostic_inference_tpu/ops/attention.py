@@ -241,3 +241,88 @@ def dot_product_attention(
         cm = causal_mask(T, S, offset=S - T)
         mask = cm if mask is None else jnp.logical_and(mask, cm)
     return _xla_attention(q, k, v, mask, bias, scale)
+
+
+# -- ragged paged attention (reference fallback + dispatch) ------------------
+
+
+def ragged_gather_attention(
+    q: jax.Array,           # [B, T, H, D] queries
+    k_pool: jax.Array,      # [N, block_size, Hkv, D] (float or int8 pool)
+    v_pool: jax.Array,
+    tables: jax.Array,      # [B, M] physical block ids (0-padded)
+    positions: jax.Array,   # [B, T] each query's own cache position
+    k_scale: Optional[jax.Array] = None,   # [N, Hkv] f32 (int8 pools)
+    v_scale: Optional[jax.Array] = None,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """XLA gather-based reference for ragged paged attention.
+
+    Every query ``(b, t)`` attends pool positions ``<= positions[b, t]``
+    through row ``b``'s block table — mixed context lengths in one call,
+    no bucketing. This is THE deviceless oracle for the Pallas ragged
+    kernel (``ops.pallas.ragged_paged_attention``): a dense gather of the
+    table window plus a per-query mask, exactly the engine's pre-ragged
+    CPU decode path, so quant-off numerics are bit-identical to it. int8
+    pools dequantize right after the gather (``ops.quant``). Returns
+    ``[B, T, H, D]``.
+    """
+    B, T, H, D = q.shape
+    _N, block_size, Hkv, _ = k_pool.shape
+    M = tables.shape[1]
+    L = M * block_size
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if k_scale is not None:
+        from .quant import dequantize_kv_blocks
+
+        # block-shaped gather so the per-(block, head) scales broadcast;
+        # the reshape lands in the same [B, L, Hkv, D] layout as the flat
+        # gather below
+        kctx = dequantize_kv_blocks(
+            k_pool[tables], k_scale[tables], dtype=q.dtype
+        ).reshape(B, L, Hkv, D)
+        vctx = dequantize_kv_blocks(
+            v_pool[tables], v_scale[tables], dtype=q.dtype
+        ).reshape(B, L, Hkv, D)
+    else:
+        goff = (tables[:, :, None] * block_size
+                + jnp.arange(block_size)[None, None, :]).reshape(B, L)
+        kflat = k_pool.reshape(-1, Hkv, D)
+        vflat = v_pool.reshape(-1, Hkv, D)
+        kctx = kflat[goff]
+        vctx = vflat[goff]
+    mask = (jnp.arange(L)[None, None, :]
+            <= positions[:, :, None])[:, None]         # [B, 1, T, L]
+    return _xla_attention(q, kctx, vctx, mask, None, scale)
+
+
+def ragged_paged_attention(
+    q: jax.Array,           # [rows, H, D] one query per row
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    tables: jax.Array,      # [rows, M]
+    lengths: jax.Array,     # [rows] valid token count per row
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ragged paged attention with implementation dispatch: the Pallas
+    kernel on TPU platforms, the XLA gather reference elsewhere (tier-1
+    runs deviceless). Multi-token callers flatten ``T`` queries into the
+    row axis with per-row ``lengths``, the same layout both impls share
+    with the bucketed kernel."""
+    if on_tpu_platform():
+        from .pallas.ragged_paged_attention import (
+            ragged_paged_attention as _kernel,
+        )
+
+        return _kernel(q, k_pool, v_pool, tables, lengths, k_scale,
+                       v_scale, scale=scale)
+    out = ragged_gather_attention(
+        q[:, None], k_pool, v_pool, tables,
+        (lengths.astype(jnp.int32) - 1)[:, None], k_scale, v_scale,
+        scale=scale)
+    return out[:, 0]

@@ -104,6 +104,8 @@ def paged_decode_attention(
     v_pool: jax.Array,
     tables: jax.Array,      # [B, M] physical block ids (0-padded)
     lengths: jax.Array,     # [B] valid token count per sequence
+    k_scale: Optional[jax.Array] = None,   # [N, Hkv] f32 (int8 pools)
+    v_scale: Optional[jax.Array] = None,
     *,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
@@ -113,8 +115,21 @@ def paged_decode_attention(
     ``tables`` may be pre-truncated to the live context bucket — the grid
     walks exactly ``M = tables.shape[1]`` blocks, and within that, re-fetch
     of dead blocks is elided (their index re-maps to the row's first block).
+
+    ``k_scale``/``v_scale``: per-block x kv-head f32 scales of an int8 pool
+    (``SHAI_KV_QUANT=int8``). The quantized bucketed call shares the ragged
+    kernel body — same online-softmax recurrence with the in-kernel dequant
+    and the per-row compute skip layered on; the bucketing still happens
+    here, through the caller's pre-truncated ``tables``.
     """
     from jax.experimental.pallas import tpu as pltpu
+
+    if k_scale is not None:
+        from .ragged_paged_attention import ragged_paged_attention
+
+        return ragged_paged_attention(
+            q, k_pool, v_pool, tables, lengths, k_scale, v_scale,
+            scale=scale, interpret=interpret)
 
     B, H, D = q.shape
     N, block_size, Hkv, _ = k_pool.shape

@@ -84,6 +84,61 @@ def quantized_kernel_paths(params: Dict[str, Any]) -> set:
     return out
 
 
+# -- KV-block quantization (SHAI_KV_QUANT=int8) ------------------------------
+#
+# Decode batch is bounded by KV bytes, not weight bytes: the paged pool is
+# the denominator of max_num_seqs x max_model_len at a fixed HBM budget.
+# Per-block symmetric int8 halves it — ~2x blocks per HBM byte — with ONE
+# f32 scale per (block, kv head) riding alongside (scale overhead:
+# 4 / (block_size * head_dim * 2) of the saving, <1% at serving geometry).
+# Quantize on pool WRITE (prefill/cont/decode scatter sites in
+# engine/runner.py), dequantize on READ (in-kernel for the pallas paths,
+# pre-gather for the XLA fallbacks) — the pool never holds floats.
+
+
+def quantize_kv_blocks(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """``[..., block_size, Hkv, Dh]`` float KV -> (int8 same shape,
+    ``[..., Hkv]`` f32 scale). Symmetric per block x kv-head: the amax
+    reduces over the block's token and head-dim axes only, so one head's
+    outlier cannot flatten another head's resolution."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=(-3, -1))          # [..., Hkv]
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x32 / scale[..., None, :, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv_blocks(q: jax.Array, scale: jax.Array,
+                         dtype=jnp.bfloat16) -> jax.Array:
+    """Inverse of :func:`quantize_kv_blocks`: ``[..., Bs, Hkv, Dh]`` int8 +
+    ``[..., Hkv]`` f32 scale -> float blocks in ``dtype``."""
+    x = q.astype(jnp.float32) * scale[..., None, :, None].astype(jnp.float32)
+    return x.astype(dtype)
+
+
+def requantize_block_tokens(q_blk: jax.Array, scale: jax.Array,
+                            new_kv: jax.Array, pos_in_block: jax.Array
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """Insert one fresh token's KV into an int8 block and re-quantize.
+
+    ``q_blk`` ``[B, Bs, Hkv, Dh]`` int8 (the gathered target blocks),
+    ``scale`` ``[B, Hkv]``, ``new_kv`` ``[B, Hkv, Dh]`` float, ``pos_in_block``
+    ``[B]`` int32. Decode writes land one token at a time inside a block
+    whose scale was fit to the tokens already there — the write must
+    dequantize the block, place the token, and refit the scale (running
+    max: a block's scale only grows, so earlier tokens lose at most the
+    half-step of the FINAL scale, never compound past it). Returns the
+    re-quantized block and its new scale.
+    """
+    B, Bs, _Hkv, _Dh = q_blk.shape
+    x = dequantize_kv_blocks(q_blk, scale, dtype=jnp.float32)
+    x = x.at[jnp.arange(B), pos_in_block].set(new_kv.astype(jnp.float32))
+    tok_amax = jnp.max(jnp.abs(new_kv.astype(jnp.float32)), axis=-1)
+    new_scale = jnp.maximum(scale, jnp.maximum(tok_amax, 1e-8) / 127.0)
+    q = jnp.clip(jnp.round(x / new_scale[:, None, :, None]), -127, 127)
+    return q.astype(jnp.int8), new_scale
+
+
 def quant_matmul(x: jax.Array, p: Dict[str, jax.Array]) -> jax.Array:
     """``x @ W`` for either a plain or a quantized projection dict."""
     if "kernel_q" in p:

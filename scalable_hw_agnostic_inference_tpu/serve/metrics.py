@@ -71,6 +71,10 @@ _ENGINE_GAUGES = {
     "kv_blocks_free": ("shai_engine_kv_blocks_free", "Free KV pool blocks"),
     "spec_acceptance_rate": ("shai_spec_acceptance_rate",
                              "Speculative draft acceptance rate"),
+    "pad_fraction": ("shai_engine_pad_fraction",
+                     "Fraction of dispatched token slots that were shape "
+                     "padding (bucket windows past live tokens + batch pad "
+                     "rows) — the waste the ragged kernel removes"),
 }
 _ENGINE_COUNTERS = {
     "steps": ("shai_engine_steps", "Engine steps executed"),
@@ -83,6 +87,11 @@ _ENGINE_COUNTERS = {
     "pipeline_flushes": ("shai_engine_pipeline_flushes",
                          "Async-decode lookahead steps retired early by a "
                          "composition/control-flow event"),
+    "pad_tokens": ("shai_engine_pad_tokens_total",
+                   "Padded (wasted) token slots dispatched, cumulative"),
+    "real_tokens": ("shai_engine_real_tokens_total",
+                    "Real context/prompt token slots dispatched, "
+                    "cumulative"),
 }
 #: conformance-layer gauge families: each instrument riding the engine
 #: telemetry object exports its flat numeric snapshot verbatim under a

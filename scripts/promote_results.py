@@ -29,7 +29,11 @@ KEYS = {"sd": "sd21_img_s",
         "llama_spec": "llama_spec_tps",
         # KV tiering (PR 10): cold/warm-host-tier TTFT ratio on prompt
         # replay after eviction pressure (bench.py kvtier)
-        "kvtier": "kvtier_warm_ttft_speedup"}
+        "kvtier": "kvtier_warm_ttft_speedup",
+        # ragged paged attention + int8 KV (PR 11): mixed-length decode
+        # tok/s with ragged+quant on; the line also carries
+        # kv_quant_capacity_ratio (blocks per fixed SHAI_HBM_GIB)
+        "ragged": "ragged_tps"}
 
 
 def _load_results() -> dict:

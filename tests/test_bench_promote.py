@@ -165,3 +165,36 @@ def test_bench_lines_carry_cost_basis():
     assert out["chip_cost_per_hr"] > 0
     assert out["per_dollar"] > 0
     assert out["per_dollar_vs_inf2"] > 0
+
+
+def test_ragged_key_promotes_tokens_per_second():
+    # PR-11 tentpole: the ragged+int8KV bench publishes under its own key
+    # and dispatches as its own variant (never banking as another bench)
+    assert promote.KEYS["ragged"] == "ragged_tps"
+    bspec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(ROOT, "bench.py"))
+    bench = importlib.util.module_from_spec(bspec)
+    bspec.loader.exec_module(bench)
+    assert bench._which_from_argv(["bench.py", "ragged"]) == "ragged"
+    assert bench.UNITS_BY_BENCH["ragged"] == "tokens/sec"
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_ragged_bench_acceptance_on_cpu_tiny():
+    """The PR-11 acceptance numbers, measured: decode executable-ladder
+    entries reduced, pad fraction reduced at mixed lengths, and the int8
+    pool fitting ~2x the KV blocks at the same SHAI_HBM_GIB."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--inner",
+         "ragged", "--cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu"
+    assert out["unit"] == "tokens/sec"
+    on, off = out["ragged_quant"], out["bucketed"]
+    assert on["decode_ladder_entries"] < off["decode_ladder_entries"]
+    assert on["pad_fraction"] < off["pad_fraction"]
+    assert 1.7 <= out["kv_quant_capacity_ratio"] <= 2.1
+    blocks = out["max_kv_blocks_at_hbm"]
+    assert blocks["int8"] > 1.7 * blocks["bf16"]

@@ -1,0 +1,461 @@
+"""Ragged paged attention + int8 KV-block quantization (PR 11).
+
+Two oracles pin the tentpole:
+
+- ``SHAI_RAGGED_ATTENTION=1`` with quant OFF must be TOKEN-EXACT against
+  the bucketed engine (the executable ladder it replaces) — the masked
+  online-softmax over a longer window adds only exact-zero contributions,
+  so tokens, logprobs, stop reasons, and pool balance are identical across
+  greedy/topk/topp, both async disciplines, preemption, chunked prefill,
+  and the speculative fallback.
+- ``SHAI_KV_QUANT=int8`` trades exactness for ~2x KV capacity: the
+  contract is a greedy-token match RATE against the bf16 pool plus exact
+  pool/ledger accounting (device and host tier) — and byte-exact tier
+  round-trips (blocks and scales are copied, never re-quantized).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+from scalable_hw_agnostic_inference_tpu.engine.engine import (
+    LLMEngine,
+    SamplingParams,
+)
+from scalable_hw_agnostic_inference_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+from scalable_hw_agnostic_inference_tpu.ops.attention import (
+    ragged_gather_attention,
+    ragged_paged_attention,
+)
+from scalable_hw_agnostic_inference_tpu.ops.pallas.ragged_paged_attention import (  # noqa: E501
+    ragged_paged_attention as ragged_kernel,
+)
+from scalable_hw_agnostic_inference_tpu.ops.quant import (
+    dequantize_kv_blocks,
+    quantize_kv_blocks,
+    requantize_block_tokens,
+)
+
+
+# ---------------------------------------------------------------------------
+# ops: quantize/dequantize numerics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_kv_block_quantize_roundtrip_bounds(dtype):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(6, 8, 2, 16)), dtype)
+    q, s = quantize_kv_blocks(x)
+    assert q.dtype == jnp.int8 and q.shape == x.shape
+    assert s.dtype == jnp.float32 and s.shape == (6, 2)
+    rt = dequantize_kv_blocks(q, s, jnp.float32)
+    # symmetric per block x head: error bounded by half a quantization
+    # step of each (block, head)'s own scale
+    err = np.abs(np.asarray(rt) - np.asarray(x, np.float32))
+    bound = 0.5 * np.asarray(s)[:, None, :, None] + 1e-6
+    assert (err <= bound).all()
+
+
+def test_kv_block_quantize_scale_is_per_block_and_head():
+    # one outlier in (block 0, head 1) must not move any other scale
+    x = np.ones((3, 4, 2, 8), np.float32)
+    x[0, 2, 1, 3] = 100.0
+    _, s = quantize_kv_blocks(jnp.asarray(x))
+    s = np.asarray(s)
+    assert s[0, 1] == pytest.approx(100.0 / 127.0)
+    assert s[0, 0] == pytest.approx(1.0 / 127.0)
+    assert np.allclose(s[1:], 1.0 / 127.0)
+
+
+def test_kv_block_quantize_zero_block():
+    q, s = quantize_kv_blocks(jnp.zeros((2, 4, 2, 8)))
+    assert np.asarray(q).sum() == 0
+    assert (np.asarray(s) > 0).all()  # epsilon floor, never /0
+    assert np.asarray(dequantize_kv_blocks(q, s, jnp.float32)).sum() == 0
+
+
+def test_requantize_single_token_into_empty_block():
+    # a fresh pool block carries scale 0 (zeros init): the first decode
+    # write must still land within the int8 error bound
+    blk = jnp.zeros((2, 8, 2, 16), jnp.int8)
+    sc = jnp.zeros((2, 2), jnp.float32)
+    tok = jnp.asarray(np.random.default_rng(1).normal(size=(2, 2, 16)),
+                      jnp.float32)
+    q, s = requantize_block_tokens(blk, sc, tok, jnp.asarray([0, 5]))
+    deq = np.asarray(dequantize_kv_blocks(q, s, jnp.float32))
+    got = deq[np.arange(2), np.asarray([0, 5])]
+    bound = 0.5 * np.asarray(s)[:, None, :].transpose(0, 2, 1)
+    assert (np.abs(got - np.asarray(tok))
+            <= bound.transpose(0, 2, 1)[:, :, :] .max() + 1e-6).all()
+    # the scale only ever grows (running max): rewriting a smaller token
+    # keeps earlier residents within the final scale's half step
+    q2, s2 = requantize_block_tokens(q, s, tok * 0.01, jnp.asarray([1, 6]))
+    assert (np.asarray(s2) >= np.asarray(s) - 1e-9).all()
+
+
+# ---------------------------------------------------------------------------
+# ops: ragged kernel (interpret) vs the XLA gather reference
+# ---------------------------------------------------------------------------
+
+def _pool_fixture(quant):
+    rng = np.random.default_rng(3)
+    kp = jnp.asarray(rng.normal(size=(12, 8, 2, 16)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(12, 8, 2, 16)), jnp.float32)
+    tables = jnp.asarray([[1, 2, 3, 4], [5, 6, 0, 0], [7, 0, 0, 0]],
+                         jnp.int32)
+    lengths = jnp.asarray([29, 11, 3], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(3, 4, 16)), jnp.float32)
+    if not quant:
+        return q, kp, vp, None, None, tables, lengths
+    kq, ks = quantize_kv_blocks(kp)
+    vq, vs = quantize_kv_blocks(vp)
+    return q, kq, vq, ks, vs, tables, lengths
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+def test_ragged_kernel_matches_gather_reference(quant):
+    q, kp, vp, ks, vs, tables, lengths = _pool_fixture(quant)
+    ref = ragged_gather_attention(q[:, None], kp, vp, tables,
+                                  (lengths - 1)[:, None], ks, vs)[:, 0]
+    out = ragged_kernel(q, kp, vp, tables, lengths, ks, vs, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_dispatcher_uses_reference_on_cpu():
+    q, kp, vp, ks, vs, tables, lengths = _pool_fixture(False)
+    out = ragged_paged_attention(q, kp, vp, tables, lengths)
+    ref = ragged_gather_attention(q[:, None], kp, vp, tables,
+                                  (lengths - 1)[:, None])[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+def test_bucketed_paged_kernel_accepts_int8_pool():
+    # the bucketed entry point shares the ragged kernel body for int8
+    # pools ("dequantize in-kernel in BOTH ragged and bucketed attention")
+    from scalable_hw_agnostic_inference_tpu.ops.pallas.paged_attention import (  # noqa: E501
+        paged_decode_attention,
+    )
+
+    q, kp, vp, ks, vs, tables, lengths = _pool_fixture(True)
+    out = paged_decode_attention(q, kp, vp, tables, lengths, ks, vs,
+                                 interpret=True)
+    ref = ragged_kernel(q, kp, vp, tables, lengths, ks, vs, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# engine: ragged-on / quant-off is token-exact vs the bucketed oracle
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return cfg, params
+
+
+def make_engine(tiny_model, monkeypatch, *, ragged=False, quant=False,
+                async_on=True, **over):
+    cfg, params = tiny_model
+    monkeypatch.setenv("SHAI_ASYNC_DECODE", "1" if async_on else "0")
+    monkeypatch.setenv("SHAI_RAGGED_ATTENTION", "1" if ragged else "0")
+    monkeypatch.setenv("SHAI_KV_QUANT", "int8" if quant else "")
+    kw = dict(max_model_len=128, max_num_seqs=3, block_size=8,
+              context_encoding_buckets=(16, 32),
+              token_generation_buckets=(32, 64), max_new_tokens=16)
+    kw.update(over)
+    eng = LLMEngine(cfg, params, EngineConfig(**kw))
+    assert eng._ragged is ragged
+    assert eng._kv_quant is quant
+    return eng
+
+
+def pool_balanced(eng) -> bool:
+    return eng.cache.allocator.n_free == eng.ecfg.total_blocks - 1
+
+
+def assert_finished_equal(a, b):
+    assert a.req_id == b.req_id
+    assert a.token_ids == b.token_ids, (a.req_id, a.token_ids, b.token_ids)
+    assert a.stop_reason == b.stop_reason
+    if a.logprobs is None or b.logprobs is None:
+        assert a.logprobs == b.logprobs
+        return
+    assert len(a.logprobs) == len(b.logprobs)
+    for e1, e2 in zip(a.logprobs, b.logprobs):
+        assert e1["token"] == e2["token"]
+        assert e1["logprob"] == pytest.approx(e2["logprob"], abs=1e-5)
+
+
+MIXED = [[1, 5, 9], [2] * 20, [7, 3] * 14, [4]]  # mixed lengths, on purpose
+
+
+@pytest.mark.parametrize("sp", [
+    SamplingParams(temperature=0.0, max_new_tokens=8, logprobs=2),
+    pytest.param(SamplingParams(temperature=0.9, top_k=5, max_new_tokens=8),
+                 marks=pytest.mark.slow),
+    pytest.param(SamplingParams(temperature=0.7, top_p=0.8,
+                                max_new_tokens=8),
+                 marks=pytest.mark.slow),
+], ids=["greedy", "topk", "topp"])
+@pytest.mark.parametrize("async_on", [True, False], ids=["async", "sync"])
+def test_ragged_matches_bucketed_oracle(tiny_model, monkeypatch, sp,
+                                        async_on):
+    a = make_engine(tiny_model, monkeypatch, ragged=True, async_on=async_on)
+    b = make_engine(tiny_model, monkeypatch, ragged=False,
+                    async_on=async_on)
+    fa = a.generate(MIXED, sp)
+    fb = b.generate(MIXED, sp)
+    for x, y in zip(fa, fb):
+        assert_finished_equal(x, y)
+    assert pool_balanced(a) and pool_balanced(b)
+
+
+@pytest.mark.slow
+def test_ragged_preemption_parity(tiny_model, monkeypatch):
+    # a pool too small for the batch forces recompute-preemption mid-run
+    sp = SamplingParams(temperature=0.0, max_new_tokens=12)
+    outs = {}
+    for ragged in (True, False):
+        eng = make_engine(tiny_model, monkeypatch, ragged=ragged,
+                          num_blocks=6)
+        fins = eng.generate([[1, 2, 3, 4, 5, 6], [9, 8, 7, 6, 5]], sp)
+        outs[ragged] = [(f.token_ids, f.stop_reason) for f in fins]
+        assert eng.obs.preemptions >= 1
+        assert pool_balanced(eng)
+    assert outs[True] == outs[False]
+
+
+def test_ragged_chunked_prefill_parity(tiny_model, monkeypatch):
+    # prompt > largest bucket: the ragged engine runs the dynamic-start
+    # continuation executable, the bucketed engine the per-start ladder
+    rng = np.random.default_rng(5)
+    long_prompt = rng.integers(3, 200, 70).tolist()
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    outs = {}
+    for ragged in (True, False):
+        eng = make_engine(tiny_model, monkeypatch, ragged=ragged)
+        [fin] = eng.generate([long_prompt], sp)
+        outs[ragged] = fin.token_ids
+        assert pool_balanced(eng)
+    assert outs[True] == outs[False]
+    # the ragged engine really took the dynamic-start path
+    eng = make_engine(tiny_model, monkeypatch, ragged=True)
+    eng.generate([long_prompt], sp)
+    assert any(k[0] == "rcont" for k in eng._prefill)
+    assert not any(k[0] == "cont" for k in eng._prefill)
+
+
+@pytest.mark.slow
+def test_ragged_speculative_fallback_parity(tiny_model, monkeypatch):
+    sp = SamplingParams(temperature=0.0, max_new_tokens=10)
+    prompts = [[5, 6, 5, 6, 5, 6, 5], [1, 2, 3]]
+    outs = {}
+    for ragged in (True, False):
+        eng = make_engine(tiny_model, monkeypatch, ragged=ragged,
+                          speculative_model="[ngram]",
+                          num_speculative_tokens=3)
+        fins = eng.generate(prompts, sp)
+        outs[ragged] = [f.token_ids for f in fins]
+        assert eng.spec.verify_steps + eng.spec.fallback_steps > 0
+        assert pool_balanced(eng)
+    assert outs[True] == outs[False]
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_ragged_ladder_shrinks_and_stays_closed(tiny_model, monkeypatch):
+    # the measurable tentpole claim: fewer decode executables at warm, and
+    # the warmed set stays closed over a mixed-length run (no post-ready
+    # compiles — the cold-graph-behind-the-LB discipline)
+    kw = dict(max_model_len=128, enable_prefix_caching=True)
+    a = make_engine(tiny_model, monkeypatch, ragged=True, **kw)
+    b = make_engine(tiny_model, monkeypatch, ragged=False, **kw)
+    a.warm_executables()
+    b.warm_executables()
+    assert len(a._ctx_buckets) == 1
+    assert len(a._decode_fns) < len(b._decode_fns)
+    assert a.n_executables < b.n_executables
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    rng = np.random.default_rng(9)
+    a.generate([rng.integers(3, 200, n).tolist()
+                for n in (4, 20, 40, 70)], sp)
+    assert a.obs.recompiles == 0
+    # prefix caching holds registered blocks by design — no LIVE leak
+    assert a.cache.leaked_blocks == 0
+
+
+def test_pad_accounting_ragged_below_bucketed(tiny_model, monkeypatch):
+    sp = SamplingParams(temperature=0.0, max_new_tokens=10)
+    fracs = {}
+    for ragged in (True, False):
+        eng = make_engine(tiny_model, monkeypatch, ragged=ragged)
+        # the ladder claim, cheaply: ragged owns ONE context bucket
+        assert len(eng._ctx_buckets) == (1 if ragged else 3)
+        eng.generate(MIXED, sp)
+        snap = eng.obs.snapshot()
+        assert snap["real_tokens"] > 0
+        assert snap["pad_tokens"] >= 0
+        assert 0.0 <= snap["pad_fraction"] < 1.0
+        fracs[ragged] = snap["pad_fraction"]
+    # mixed lengths are exactly where bucketing pads: ragged dispatches
+    # strictly less dead window
+    assert fracs[True] < fracs[False]
+
+
+# ---------------------------------------------------------------------------
+# engine: int8 KV — match rate + exact accounting
+# ---------------------------------------------------------------------------
+
+def _greedy_match_rate(fa, fb) -> float:
+    agree = total = 0
+    for x, y in zip(fa, fb):
+        for t1, t2 in zip(x.token_ids, y.token_ids):
+            total += 1
+            agree += t1 == t2
+    return agree / max(1, total)
+
+
+@pytest.mark.parametrize("ragged", [
+    pytest.param(False, marks=pytest.mark.slow),  # tier-1 budget
+    True,
+], ids=["bucketed", "ragged"])
+def test_kv_quant_greedy_match_rate(tiny_model, monkeypatch, ragged):
+    sp = SamplingParams(temperature=0.0, max_new_tokens=12)
+    q = make_engine(tiny_model, monkeypatch, ragged=ragged, quant=True)
+    f = make_engine(tiny_model, monkeypatch, ragged=ragged, quant=False)
+    rate = _greedy_match_rate(q.generate(MIXED, sp), f.generate(MIXED, sp))
+    # int8 KV is lossy by design; the serving contract is a HIGH greedy
+    # match rate, not exactness (threshold mirrors the PARITY.md style)
+    assert rate >= 0.8, rate
+    assert pool_balanced(q)
+
+
+def test_kv_quant_pool_bytes_and_ledger_attribution(tiny_model,
+                                                    monkeypatch):
+    q = make_engine(tiny_model, monkeypatch, quant=True)
+    f = make_engine(tiny_model, monkeypatch, quant=False)
+    # int8 blocks halve; the f32 scale rows ride alongside (tiny overhead)
+    blk_f = f.cache.pool_bytes
+    blk_q = q.cache.pool_bytes
+    assert blk_q < 0.6 * blk_f
+    n_layers = len(q.cache.kv)
+    scale_bytes = 2 * n_layers * q.cache.total_blocks * \
+        q.cfg.n_kv_heads * 4
+    assert blk_q == blk_f // 2 + scale_bytes
+    # the HBM ledger attributes the REAL int8 pool, not the bf16 price
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    q.generate([[1, 2, 3]], sp)
+    assert q.obs.hbm.snapshot()["kv_pool_bytes"] == blk_q
+    # and the kv pytree really carries int8 blocks + f32 scales
+    lay = q.cache.kv[0]
+    assert lay["k"].dtype == jnp.int8 and lay["ks"].dtype == jnp.float32
+    assert lay["ks"].shape == (q.cache.total_blocks, q.cfg.n_kv_heads)
+
+
+@pytest.mark.slow
+def test_kv_quant_cancel_evict_fuzz_pool_exact(tiny_model, monkeypatch):
+    # seeded schedule fuzz with quant + ragged + prefix caching + host
+    # tier: every request terminal exactly once, device pool balanced,
+    # host tier accounting exact — the PR's accounting acceptance gate
+    monkeypatch.setenv("SHAI_KVTIER", "1")
+    monkeypatch.setenv("SHAI_KVTIER_ASYNC", "0")
+    eng = make_engine(tiny_model, monkeypatch, ragged=True, quant=True,
+                      enable_prefix_caching=True, num_blocks=20,
+                      max_model_len=128)
+    assert eng.cache.tier is not None
+    rng = np.random.default_rng(42)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    live, done = [], set()
+    for step in range(60):
+        if rng.random() < 0.5 and len(live) < 6:
+            n = int(rng.integers(2, 40))
+            rid = eng.add_request(rng.integers(3, 200, n).tolist(), sp)
+            live.append(rid)
+        if rng.random() < 0.2 and live:
+            victim = live[int(rng.integers(len(live)))]
+            fin = eng.cancel(victim)
+            if fin is not None:
+                assert victim not in done
+                done.add(victim)
+                live.remove(victim)
+        for f in eng.step():
+            assert f.req_id not in done
+            done.add(f.req_id)
+            live.remove(f.req_id)
+    while eng.has_work:
+        for f in eng.step():
+            assert f.req_id not in done
+            done.add(f.req_id)
+            live.remove(f.req_id)
+    assert not live
+    # release every cache hold (prefix cache keeps refs by design): the
+    # evictable count must equal exactly the cached blocks, and live
+    # holds must be zero
+    assert eng.cache.leaked_blocks == 0
+    snap = eng.cache.tier.snapshot()
+    assert snap["used_bytes"] == snap["entries"] * snap["block_nbytes"]
+    assert snap["errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# kvtier: quantized demote -> restore round-trip is byte-exact
+# ---------------------------------------------------------------------------
+
+def test_tier_roundtrip_quant_bytes_exact():
+    from scalable_hw_agnostic_inference_tpu.kvtier.pool import HostKVTier
+
+    rng = np.random.default_rng(8)
+    L, Bs, H, D, n = 2, 8, 2, 16, 3
+    tier = HostKVTier(n_layers=L, block_size=Bs, n_kv_heads=H, head_dim=D,
+                      dtype=np.int8, capacity_bytes=1 << 20,
+                      async_copy=False, quant=True)
+    # block_nbytes prices int8 blocks + f32 scales
+    assert tier.block_nbytes == 2 * L * Bs * H * D * 1 + 2 * L * H * 4
+    k = rng.integers(-127, 127, (L, n, Bs, H, D)).astype(np.int8)
+    v = rng.integers(-127, 127, (L, n, Bs, H, D)).astype(np.int8)
+    ks = rng.random((L, n, H)).astype(np.float32)
+    vs = rng.random((L, n, H)).astype(np.float32)
+    hashes = [101, 202, 303]
+    tier.store_batch(hashes, k, v, ks, vs, n)
+    run = tier.get_run(hashes)
+    assert [e[0] for e in run] == hashes
+    for j, ent in enumerate(run):
+        np.testing.assert_array_equal(ent[1], k[:, j])
+        np.testing.assert_array_equal(ent[2], v[:, j])
+        np.testing.assert_array_equal(ent[3], ks[:, j])
+        np.testing.assert_array_equal(ent[4], vs[:, j])
+
+
+@pytest.mark.slow
+def test_engine_tier_restore_quant_replay_greedy_equal(tiny_model,
+                                                       monkeypatch):
+    # demote a prompt's quantized blocks to the host tier under eviction
+    # pressure, then replay: the restore path must reproduce the SAME
+    # greedy tokens as the original run (byte-exact blocks+scales), and
+    # the tier must actually have been exercised
+    monkeypatch.setenv("SHAI_KVTIER", "1")
+    monkeypatch.setenv("SHAI_KVTIER_ASYNC", "0")
+    eng = make_engine(tiny_model, monkeypatch, quant=True,
+                      enable_prefix_caching=True, num_blocks=14,
+                      max_model_len=128, max_num_seqs=1,
+                      context_encoding_buckets=(16, 32, 64))
+    rng = np.random.default_rng(13)
+    probe = rng.integers(3, 200, 56).tolist()
+    fillers = [rng.integers(3, 200, 56).tolist() for _ in range(3)]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    [first] = eng.generate([probe], sp)
+    for fl in fillers:
+        eng.generate([fl], sp)
+    assert eng.cache.tier.snapshot()["stores"] > 0
+    [replay] = eng.generate([probe], sp)
+    assert replay.token_ids == first.token_ids
+    assert eng.cache.tier.snapshot()["restored"] > 0
