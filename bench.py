@@ -43,7 +43,7 @@ SD_BASELINE_IMG_S = 1.0 / 0.67
 #: one unit mapping for the measurement AND crash paths
 UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
                   "mllama": "tokens/sec", "llama_spec": "tokens/sec",
-                  "vllm": "tokens/sec", "kvtier": "x",
+                  "vllm": "tokens/sec", "kvtier": "x", "qos": "x",
                   "ragged": "tokens/sec",
                   "sd": "images/sec", "sd8": "images/sec",
                   "flux": "images/sec"}
@@ -67,7 +67,8 @@ def _which_from_argv(argv) -> str:
         return "llama_spec"
     if any(a.startswith("llama") for a in argv):
         return "llama"
-    for k in ("vllm", "kvtier", "ragged", "flux", "t5", "mllama", "sd8"):
+    for k in ("vllm", "kvtier", "qos", "ragged", "flux", "t5", "mllama",
+              "sd8"):
         if k in argv:
             return k
     return "sd"
@@ -714,6 +715,123 @@ def bench_ragged(tiny: bool) -> dict:
     return out
 
 
+def bench_qos(tiny: bool) -> dict:
+    """Multi-tenant QoS A/B: high-priority tenant p99 TTFT under a
+    low-priority flood, ``SHAI_QOS=1`` (weighted-fair dequeue + priority
+    preemption) vs ``=0`` (FIFO).
+
+    One engine per mode runs identical seeded rounds: the flood tenant
+    parks a burst of low-priority requests in the queue, then the vip
+    tenant submits ONE high-priority request; the measurement is the vip
+    request's realized TTFT (t_first - t_submit from the obs timeline).
+    ``value`` is ``qos_flood_p99_ratio`` = FIFO flooded p99 / QoS flooded
+    p99 — how many × of the flood-induced TTFT inflation the class-aware
+    dequeue removes (>1 = QoS is protecting the high class). The line
+    carries both modes' p50/p99 plus the no-flood baseline so a
+    regression says whether QoS got worse or the flood got cheaper.
+    """
+    import os
+    import statistics
+
+    import numpy as np
+
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+    from scalable_hw_agnostic_inference_tpu.models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig.tiny()
+        ecfg = EngineConfig(max_model_len=128, max_num_seqs=2, block_size=8,
+                            context_encoding_buckets=(32,),
+                            max_new_tokens=24)
+        n_flood, flood_new, vip_new, rounds = 6, 16, 4, 6
+        prompt_len = 20
+        name = "qos-tiny"
+    else:
+        cfg = llama_mod.LlamaConfig.llama32_1b()
+        ecfg = EngineConfig(max_model_len=1024, max_num_seqs=4,
+                            block_size=16, context_encoding_buckets=(128,),
+                            max_new_tokens=96)
+        n_flood, flood_new, vip_new, rounds = 12, 64, 16, 5
+        prompt_len = 100
+        name = "qos-1b-geometry"
+
+    params = llama_mod.geometry_params(cfg, quant=False)
+
+    def measure(qos_on: bool):
+        os.environ["SHAI_QOS"] = "1" if qos_on else "0"
+        try:
+            eng = LLMEngine(cfg, params, ecfg)
+        finally:
+            os.environ.pop("SHAI_QOS", None)
+        rng = np.random.default_rng(17)  # same schedule both modes
+        sp_flood = SamplingParams(temperature=0.0,
+                                  max_new_tokens=flood_new)
+        sp_vip = SamplingParams(temperature=0.0, max_new_tokens=vip_new)
+
+        def prompt():
+            return rng.integers(3, cfg.vocab_size, prompt_len).tolist()
+
+        def drain(ids):
+            done = {}
+            while set(ids) - set(done):
+                for f in eng.step():
+                    done[f.req_id] = f
+            return done
+
+        drain([eng.add_request(prompt(), sp_vip)])  # warm the ladder
+        # no-flood baseline: the vip tenant alone
+        base = []
+        for _ in range(rounds):
+            rid = eng.add_request(prompt(), sp_vip, priority=0,
+                                  tenant="vip")
+            fin = drain([rid])[rid]
+            base.append(fin.timing["t_first"] - fin.timing["t_submit"])
+        # flooded rounds: the flood queues first, vip arrives last
+        vip = []
+        for _ in range(rounds):
+            flood = [eng.add_request(prompt(), sp_flood, priority=2,
+                                     tenant="flood")
+                     for _ in range(n_flood)]
+            eng.step()  # the flood takes the slots/queue
+            rid = eng.add_request(prompt(), sp_vip, priority=0,
+                                  tenant="vip")
+            done = drain(flood + [rid])
+            fin = done[rid]
+            vip.append(fin.timing["t_first"] - fin.timing["t_submit"])
+
+        def pctl(xs, q):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(q * (len(xs) - 1)))]
+
+        return {
+            "vip_ttft_p50_ms": round(statistics.median(vip) * 1e3, 2),
+            "vip_ttft_p99_ms": round(pctl(vip, 0.99) * 1e3, 2),
+            "vip_ttft_noflood_p50_ms": round(
+                statistics.median(base) * 1e3, 2),
+            "preemptions": eng.obs.preemptions,
+        }
+
+    on = measure(True)
+    off = measure(False)
+    base = _published("qos_flood_p99_ratio")
+    val = (round(off["vip_ttft_p99_ms"] / on["vip_ttft_p99_ms"], 3)
+           if on["vip_ttft_p99_ms"] else 0.0)
+    return {
+        "metric": f"{name} high-priority p99 TTFT under low-priority "
+                  f"flood, FIFO/QoS ratio ({n_flood}-deep flood, "
+                  f"{jax.devices()[0].platform})",
+        "value": val,
+        "unit": "x",
+        "vs_baseline": round(val / base, 3) if base else 1.0,
+        "qos": on,
+        "fifo": off,
+    }
+
+
 def bench_flux(tiny: bool) -> dict:
     """Flux (rectified-flow DiT) txt2img on ONE chip.
 
@@ -975,7 +1093,7 @@ def inner_main() -> None:
         enable_persistent_cache_from_env()
     out = {"llama": bench_llama, "llama_spec": bench_llama_spec,
            "vllm": bench_vllm, "kvtier": bench_kvtier,
-           "ragged": bench_ragged,
+           "qos": bench_qos, "ragged": bench_ragged,
            "flux": bench_flux, "t5": bench_t5,
            "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)

@@ -153,7 +153,15 @@ DEFAULT_CONTRACT = Contract(
             "LLMEngine._decode_dispatch",
             "LLMEngine._dispatch_async",
             "LLMEngine._retire_pipe",
+            # the QoS weighted-fair dequeue runs on every admission step:
+            # it must stay pure host arithmetic — a device sync here would
+            # serialize admission behind the decode pipeline
+            "LLMEngine._schedule_head",
         ),
+        # the scheduler kernel itself (stride select + head rotation):
+        # same discipline, shared by the engine and the property tests
+        "resilience/qos.py": (
+            "WeightedFairScheduler.select", "schedule_rotate"),
         "engine/resident.py": ("*",),
         # the jitted decode/verify bodies: a host sync here would be a
         # trace-time crash on device — and on CPU fallbacks a silent
@@ -250,6 +258,25 @@ DEFAULT_CONTRACT = Contract(
         "CopyOutWorker": ClassPolicy(
             immutable_after_init=("_pool", "_q", "_thread"),
             owning_modules=("kvtier/pool.py",),
+        ),
+        # The tenant ledger takes writes from every serving thread
+        # (admission checks, completion charges) and reads from scrape
+        # threads: bucket state and per-tenant counters move under _lock
+        # at every mutation site.
+        "TenantLedger": ClassPolicy(
+            immutable_after_init=("budgets", "default_budget",
+                                  "max_tenants", "_clock", "_lock"),
+            lock_guarded={"_buckets": "_lock", "_stats": "_lock"},
+            owning_modules=("resilience/qos.py",),
+            instance_markers=(".ledger.", "led."),
+        ),
+        # The scheduler is engine-loop-thread-only by contract (select()
+        # mutates stride state); only the engine and the qos module may
+        # touch it.
+        "WeightedFairScheduler": ClassPolicy(
+            immutable_after_init=("weights", "aging_rounds"),
+            owning_modules=("resilience/qos.py", "engine/engine.py"),
+            instance_markers=("sched.",),
         ),
     },
     dict_guards={

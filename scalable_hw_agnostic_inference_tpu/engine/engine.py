@@ -31,6 +31,7 @@ from ..obs.slo import SloEngine, SloTargets
 from ..obs.steploop import StepTelemetry
 from ..obs.trace import annotate
 from ..resilience import faults as _faults
+from ..resilience import qos as _qos
 from ..ops.sampling import sample_logits
 from .cache import PagedKVCache
 from .config import EngineConfig
@@ -230,6 +231,17 @@ class LLMEngine:
             self._cross_write = make_cross_slot_write(model_cfg)
         self.waiting: deque[Request] = deque()
         self.slots: List[Optional[_Running]] = [None] * ecfg.max_num_seqs
+        # multi-tenant QoS (SHAI_QOS, default off): the weighted-fair
+        # scheduler kernel every admission dequeue routes through. OFF
+        # means _schedule_head never touches the deque — the FIFO engine
+        # stays token-exact vs the pre-QoS baseline (the differential
+        # contract tests/test_qos.py holds across both async disciplines).
+        self._sched = (_qos.WeightedFairScheduler.from_env()
+                       if _qos.qos_enabled() else None)
+        # per-tenant step gauges are computed only once a tenant-tagged
+        # request (or QoS itself) shows up — zero added per-step work on
+        # an untagged FIFO engine
+        self._tenant_seen = self._sched is not None
         self._warmed = False
         # serving-grade latency instruments (vLLM's TTFT/TPOT), exported by
         # the serving layer's /stats — TTFT includes queue time; TPOT is
@@ -267,6 +279,9 @@ class LLMEngine:
         # conformance instruments: /stats, /metrics, and the admission
         # gate all read them off the telemetry object
         self.obs.kvtier = self.cache.tier
+        # the QoS scheduler rides the same seam: /stats -> "qos" reads its
+        # pick/aging counters next to the ledger's per-tenant usage
+        self.obs.qos_sched = self._sched
         from ..obs.util import env_int as _env_int
 
         # ledger cadence: every Nth step (default every step — cheap on
@@ -302,7 +317,9 @@ class LLMEngine:
                     prefix: Optional[np.ndarray] = None,
                     cross_states: Optional[np.ndarray] = None,
                     cross_len: int = 0, on_token=None,
-                    deadline_at: float = 0.0) -> int:
+                    deadline_at: float = 0.0,
+                    priority: int = _qos.PRIORITY_NORMAL,
+                    tenant: str = "") -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -339,11 +356,22 @@ class LLMEngine:
         if len(prompt_ids) > max_prompt:
             prompt_ids = list(prompt_ids)[-max_prompt:]  # keep the tail
         rid = next(self._ids)
+        priority = min(max(int(priority), _qos.PRIORITY_HIGH),
+                       _qos.PRIORITY_LOW)
+        tenant = _qos.sanitize_tenant(tenant)
+        if tenant or priority != _qos.PRIORITY_NORMAL:
+            self._tenant_seen = True
+        if self._tenant_seen:
+            # gated: an untagged FIFO pod never pays the telemetry lock
+            # here and never grows a tenant label set — the shai_tenant_*
+            # families appear only once a tenant tag (or QoS) is live
+            self.obs.count_tenant_request(tenant, _qos.class_name(priority))
         self.waiting.append(Request(rid, list(prompt_ids), params,
                                     prefix=prefix, cross_states=cross_states,
                                     cross_len=cross_len, on_token=on_token,
                                     deadline_at=deadline_at,
-                                    t_submit=time.monotonic()))
+                                    t_submit=time.monotonic(),
+                                    priority=priority, tenant=tenant))
         return rid
 
     def cancel(self, req_id: int) -> Optional[Finished]:
@@ -393,13 +421,35 @@ class LLMEngine:
         or decoding — with stop reason ``"timeout"``. Step-granular: a
         request is at most one engine step late, and its blocks/slot free
         the same step instead of decoding to max_new_tokens for a caller
-        that already gave up."""
+        that already gave up.
+
+        ONE linear pass over the queue: the old shape collected expired
+        ids and re-scanned ``waiting`` once per id through ``_abort`` —
+        O(n^2) exactly when an adversarial tenant floods the queue with
+        short deadlines. The rebuild preserves arrival order within and
+        across priority classes, and it runs BEFORE the weighted-fair
+        head selection, so an expired request's queue slot is visible to
+        the scheduler (and to admission) the very same step."""
         now = time.monotonic()
-        expired = [r.req_id for r in self.waiting
-                   if 0.0 < r.deadline_at <= now]
-        expired += [s.req.req_id for s in self.slots
-                    if s is not None and 0.0 < s.req.deadline_at <= now]
-        for rid in expired:
+        expired: List[Request] = [r for r in self.waiting
+                                  if 0.0 < r.deadline_at <= now]
+        if expired:
+            kept = [r for r in self.waiting if not (0.0 < r.deadline_at
+                                                    <= now)]
+            self.waiting.clear()
+            self.waiting.extend(kept)
+            for r in expired:
+                log.warning("req %d exceeded its deadline "
+                            "(%d tokens generated)", r.req_id,
+                            len(r.already_generated))
+                self._finish(Finished(
+                    r.req_id, list(r.already_generated), r.orig_n_prompt,
+                    "timeout",
+                    logprobs=(list(r.already_lp)
+                              if r.params.logprobs else None),
+                    timing=self._timing_of(r)))
+        for rid in [s.req.req_id for s in self.slots
+                    if s is not None and 0.0 < s.req.deadline_at <= now]:
             fin = self._abort(rid, "timeout")
             if fin is not None:
                 log.warning("req %d exceeded its deadline "
@@ -471,6 +521,15 @@ class LLMEngine:
         self._record_step(time.monotonic() - t0)
         return self._done_this_step
 
+    def _schedule_head(self) -> None:
+        """Weighted-fair head selection (SHAI_QOS): rotate the scheduler-
+        picked class's oldest request to ``waiting[0]`` so every admission
+        path below dequeues class-aware without changing its mechanics.
+        Pure host arithmetic (hot-path safe); a strict no-op with QoS off
+        or a single-class queue — the token-exactness seam."""
+        if self._sched is not None:
+            _qos.schedule_rotate(self.waiting, self._sched)
+
     def _admit_phase(self) -> None:
         """One step's chunk-continuation + admission ladder (shared by the
         lock-step and async step bodies)."""
@@ -480,6 +539,10 @@ class LLMEngine:
             # one continuation chunk per step: the long prompt encodes
             # incrementally while the running batch keeps decoding below
             self._continue_prefill(chunking[0])
+        # class-aware dequeue BEFORE the ladder branches on the head: the
+        # branch taken (prefix/cached/long/cross/batch) must be the branch
+        # for the request fairness actually selected
+        self._schedule_head()
         # admission proceeds even while a long prompt chunks (its slot is
         # untouched) — queued short prompts must not pay k chunk-steps of
         # TTFT; only a SECOND long prompt waits for the active chunker
@@ -708,6 +771,19 @@ class LLMEngine:
         conformance feeds: the perf sentinel's (tokens, busy-seconds)
         sample and one HBM ledger tick."""
         rb = self.cache.rollback_tokens
+        tenants = None
+        if self._tenant_seen:
+            # per-tenant occupancy gauges (waiting, running): bounded by
+            # the queue+slot walk this step already paid; skipped entirely
+            # on engines that never saw a tenant tag
+            tenants = {}
+            for r in self.waiting:
+                t = tenants.setdefault(r.tenant, [0, 0])
+                t[0] += 1
+            for s in self.slots:
+                if s is not None:
+                    t = tenants.setdefault(s.req.tenant, [0, 0])
+                    t[1] += 1
         self.obs.record_step(
             kind=self._step_kind, duration_s=duration_s,
             n_running=self.n_running, n_waiting=self.n_waiting,
@@ -718,7 +794,8 @@ class LLMEngine:
             finished=len(self._done_this_step),
             rollback_tokens=rb - self._last_rollback_tokens,
             spec=self.spec.as_dict() if self.spec is not None else None,
-            finished_ids=[f.req_id for f in self._done_this_step])
+            finished_ids=[f.req_id for f in self._done_this_step],
+            tenants=tenants)
         self._last_rollback_tokens = rb
         # first-use executable builds are warmup, not throughput: a step
         # that compiled must not enter the sentinel's rate window (same
@@ -827,6 +904,11 @@ class LLMEngine:
             ttft = now - req.t_submit
             self.ttft.record(ttft)
             self.obs.ttft.observe(ttft)
+            if self._tenant_seen:
+                # per-tenant TTFT attribution: the fairness number the
+                # qos fuzz/bench read (a flooded tenant's TTFT must not
+                # bleed into the trickle tenant's histogram)
+                self.obs.note_tenant_ttft(req.tenant, ttft)
             if self.obs.slo is not None:
                 self.obs.slo.record_ttft(ttft)
         if not req.t_first:
@@ -1022,7 +1104,18 @@ class LLMEngine:
             kmax &= kmax - 1
         group: List[Request] = []
         bucket = -1
+        first = True
         while self.waiting and len(group) < kmax:
+            if not first:
+                # every pick beyond the (already scheduled) head is a
+                # scheduling decision too: the group ladder must not hand
+                # a whole batch to whichever class queued first — a
+                # cross-class fair pick whose bucket differs simply
+                # flushes the partial group below, fairness over batch
+                # packing. No-op (and stride-state-free) with QoS off or
+                # a single-class queue.
+                self._schedule_head()
+            first = False
             req = self.waiting[0]
             if req.prefix is not None or req.cross_states is not None:
                 break  # multimodal: handled by the single-seq path next step
@@ -1429,13 +1522,24 @@ class LLMEngine:
                 + len(self._verify_fns))
 
     def _preempt_lowest(self) -> None:
-        """Recompute-preempt the most recently admitted sequence."""
+        """Recompute-preempt the lowest-priority, most recently admitted
+        sequence: under pool pressure the low class pays first (kvtier
+        keeps the eviction a demotion, so the victim resumes from restored
+        KV, not recompute). Priority weighs in ONLY under SHAI_QOS: with
+        QoS off the key is exactly the original most-recent-req_id rule —
+        an unauthenticated X-SHAI-Priority header must not become a free
+        anti-preemption lever on a FIFO pod (and the differential oracle
+        stays exact even for tagged traffic)."""
         # defensive: preemption streams/commits the victim's pending token,
         # so the host mirror must be current (the event paths flush before
         # ever reaching the allocator; this covers any future caller)
         self._flush_pipeline("preempt")
         victims = [s for s in self.slots if s is not None]
-        victim = max(victims, key=lambda s: s.req.req_id)
+        if self._sched is not None:
+            victim = max(victims,
+                         key=lambda s: (s.req.priority, s.req.req_id))
+        else:
+            victim = max(victims, key=lambda s: s.req.req_id)
         log.warning("preempting seq %d (block pool exhausted)", victim.req.req_id)
         self.obs.count_preemption()
         if (self.cache.tier is not None and victim.req.prefix is None

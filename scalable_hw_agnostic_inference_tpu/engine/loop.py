@@ -87,7 +87,8 @@ class EngineLoop:
     def submit(self, prompt_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
                prefix=None, cross_states=None, cross_len: int = 0,
-               on_token=None, deadline_at: float = 0.0) -> Future:
+               on_token=None, deadline_at: float = 0.0,
+               priority: int = 1, tenant: str = "") -> Future:
         """Enqueue a request; the future resolves to a :class:`Finished`.
 
         ``prefix``: optional soft-prefix embeddings [P, dim] (vision tokens,
@@ -96,7 +97,9 @@ class EngineLoop:
         streaming callback — called from the loop thread, once per output
         token, in order; must be cheap (a queue put). ``deadline_at``:
         absolute monotonic deadline (0 = none) — the engine expires the
-        request with stop reason ``"timeout"`` once passed.
+        request with stop reason ``"timeout"`` once passed. ``priority``/
+        ``tenant``: QoS class and tenant attribution (``resilience.qos``)
+        for the weighted-fair dequeue and per-tenant accounting.
         """
         if self._stop.is_set():
             raise RuntimeError("engine loop is stopped")
@@ -107,7 +110,8 @@ class EngineLoop:
         fut: Future = Future()
         self._submit_q.put(
             (list(prompt_ids), params or SamplingParams(),
-             (prefix, cross_states, cross_len, on_token, deadline_at), fut))
+             (prefix, cross_states, cross_len, on_token, deadline_at,
+              priority, tenant), fut))
         # close the put-after-drain window: if the loop died between our
         # _stop check and the put, nobody will ever drain this item
         if self._stop.is_set():
@@ -131,14 +135,17 @@ class EngineLoop:
             return
         while True:
             (ids, params,
-             (prefix, cross_states, cross_len, on_token, deadline_at),
+             (prefix, cross_states, cross_len, on_token, deadline_at,
+              priority, tenant),
              fut) = item
             try:
                 rid = self.engine.add_request(ids, params, prefix=prefix,
                                               cross_states=cross_states,
                                               cross_len=cross_len,
                                               on_token=on_token,
-                                              deadline_at=deadline_at)
+                                              deadline_at=deadline_at,
+                                              priority=priority,
+                                              tenant=tenant)
                 with self._futures_lock:
                     self._futures[rid] = fut
             except Exception as e:  # bad request (e.g. empty prompt)

@@ -77,6 +77,13 @@ class Request:
     # logprob entries for tokens emitted before a preemption (mirrors
     # already_generated)
     already_lp: List = dataclasses.field(default_factory=list)
+    # multi-tenant QoS (resilience.qos): priority class (0=high, 1=normal,
+    # 2=low — LOWER is more important) drives the weighted-fair dequeue
+    # and lowest-priority-first preemption; tenant attributes the request
+    # in per-tenant budgets/metrics. Both survive preemption — the
+    # re-queued remainder is the same tenant's same-priority work.
+    priority: int = 1
+    tenant: str = ""
 
     def __post_init__(self):
         if self.orig_n_prompt < 0:

@@ -38,6 +38,14 @@ QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 #: lock-step observes the full marshal+bookkeeping gap every step.
 STEP_GAP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                     0.025, 0.05, 0.1, 0.5)
+#: bounded tenant-label cardinality for the per-tenant instruments: at
+#: most this many distinct tenants get their own label; later arrivals
+#: collapse into "other" so a hostile client minting tenant names cannot
+#: grow the metric series set (or this object) without bound. Matches the
+#: ledger's SHAI_QOS_MAX_TENANTS discipline (resilience.qos).
+MAX_TENANT_LABELS = 32
+_OTHER_TENANT = "other"
+_DEFAULT_TENANT = "default"
 
 
 class BucketHistogram:
@@ -105,6 +113,16 @@ class StepTelemetry:
         # admission gate and /stats see host-pool saturation alongside
         # the device KV gauges
         self.kvtier = None
+        # QoS weighted-fair scheduler (resilience.qos), attached by the
+        # engine when SHAI_QOS is on: its pick/aging counters ride the
+        # same provider seam into /stats -> "qos"
+        self.qos_sched = None
+        # per-tenant attribution (bounded: MAX_TENANT_LABELS + "other"):
+        # cumulative request/finish counts, TTFT histograms, and the
+        # last-step waiting/running gauges the engine feeds when QoS (or
+        # any tenant tag) is live
+        self._tenants: Dict[str, Dict[str, float]] = {}
+        self._tenant_ttft: Dict[str, BucketHistogram] = {}
         self._steps: deque = deque(maxlen=max_steps)
         self.ttft = BucketHistogram(TTFT_BUCKETS)
         self.tpot = BucketHistogram(TPOT_BUCKETS)
@@ -157,6 +175,63 @@ class StepTelemetry:
         with self._lock:
             return dict(self._flush_reasons)
 
+    # -- per-tenant attribution (multi-tenant QoS) -------------------------
+
+    def _tenant_key(self, tenant: str) -> str:
+        """Bounded label for ``tenant`` (callers hold ``_lock``): known
+        tenants keep their label, the table admits new ones up to
+        MAX_TENANT_LABELS, overflow collapses into "other"."""
+        t = tenant or _DEFAULT_TENANT
+        if t in self._tenants or len(self._tenants) < MAX_TENANT_LABELS:
+            return t
+        return _OTHER_TENANT
+
+    def _tenant_ent(self, tenant: str) -> Dict[str, float]:
+        key = self._tenant_key(tenant)
+        ent = self._tenants.get(key)
+        if ent is None:
+            ent = self._tenants[key] = {"requests": 0, "waiting": 0,
+                                        "running": 0}
+        return ent
+
+    def count_tenant_request(self, tenant: str, priority: str = "") -> None:
+        """One request submitted under ``tenant`` (engine ``add_request``);
+        ``priority`` additionally buckets the count per class."""
+        with self._lock:
+            ent = self._tenant_ent(tenant)
+            ent["requests"] += 1
+            if priority:
+                k = f"requests_{priority}"
+                ent[k] = ent.get(k, 0) + 1
+
+    def note_tenant_ttft(self, tenant: str, v: float) -> None:
+        with self._lock:
+            key = self._tenant_key(tenant)
+            h = self._tenant_ttft.get(key)
+            if h is None:
+                h = self._tenant_ttft[key] = BucketHistogram(TTFT_BUCKETS)
+        h.observe(v)  # BucketHistogram has its own lock
+
+    def tenant_snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant cumulative counts + last-step gauges (the ``/stats``
+        -> ``qos.tenants`` engine-side payload; the serve layer merges the
+        budget ledger's view in on top)."""
+        with self._lock:
+            out = {t: dict(ent) for t, ent in self._tenants.items()}
+        for t, h in list(self._tenant_ttft.items()):
+            if t in out:
+                snap = h.snapshot()
+                out[t]["ttft_count"] = snap["count"]
+                if snap["count"]:
+                    out[t]["ttft_mean_ms"] = round(
+                        snap["sum"] / snap["count"] * 1e3, 3)
+        return out
+
+    def tenant_histograms(self) -> Dict[str, Dict[str, Any]]:
+        """tenant -> TTFT histogram snapshot (Prometheus adapter feed for
+        the ``shai_tenant_ttft_seconds`` family)."""
+        return {t: h.snapshot() for t, h in list(self._tenant_ttft.items())}
+
     def count_pad(self, real: int, padded: int) -> None:
         """One dispatch's token-slot accounting: ``real`` context/prompt
         tokens the shapes carried vs ``padded`` slots walked only because
@@ -170,7 +245,9 @@ class StepTelemetry:
                     blocks_evictable: int = 0, finished: int = 0,
                     rollback_tokens: int = 0,
                     spec: Optional[Dict[str, Any]] = None,
-                    finished_ids: Sequence[int] = ()) -> None:
+                    finished_ids: Sequence[int] = (),
+                    tenants: Optional[Dict[str, Sequence[int]]] = None
+                    ) -> None:
         """One engine ``step()`` completed; ``kind`` names the decode path
         taken (``"decode"``, ``"spec"``, ``"idle"``). ``finished_ids`` are
         the engine request ids that reached a terminal state this step —
@@ -222,6 +299,15 @@ class StepTelemetry:
             if spec and "spec_acceptance_rate" in spec:
                 self._gauges["spec_acceptance_rate"] = float(
                     spec["spec_acceptance_rate"])
+            if tenants is not None:
+                # replace-the-gauge semantics: a tenant absent this step
+                # reads 0 queued/running, but keeps its cumulative counts
+                for ent in self._tenants.values():
+                    ent["waiting"] = ent["running"] = 0
+                for t, (n_wait, n_run) in tenants.items():
+                    ent = self._tenant_ent(t)
+                    ent["waiting"] = int(n_wait)
+                    ent["running"] = int(n_run)
             self._last_step_mono = time.monotonic()
 
     # -- readouts ----------------------------------------------------------

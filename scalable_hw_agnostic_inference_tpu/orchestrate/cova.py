@@ -56,6 +56,37 @@ def resolve_service_url(name: str, spec: Dict[str, Any]) -> str:
     return f"http://{name}"
 
 
+def aggregate_tenant_usage(results: Dict[str, Any]
+                           ) -> Dict[str, Dict[str, float]]:
+    """Fleet-wide per-tenant usage (multi-tenant QoS): each backend's
+    /stats ``qos.tenants`` (requests/tokens/inflight/shed, engine queue/
+    slot occupancy) summed per tenant, so ONE ``/fleet`` dump answers
+    "who is eating the fleet" without scraping every pod. Only ADDITIVE
+    fields are summed: budget balances are per-pod bucket state (summing
+    reads as N buckets' worth of credit) and means like ``ttft_mean_ms``
+    are not additive (two pods at 50 ms are not 100 ms) — both are
+    dropped. Pure and deterministic — unit-tested directly; malformed
+    backend payloads are skipped, never fatal."""
+    qos_tenants: Dict[str, Dict[str, float]] = {}
+    for _name, st in results.items():
+        tens = (st.get("qos") or {}).get("tenants") \
+            if isinstance(st, dict) else None
+        if not isinstance(tens, dict):
+            continue
+        for tenant, usage in tens.items():
+            if not isinstance(usage, dict):
+                continue
+            agg = qos_tenants.setdefault(str(tenant), {"backends": 0})
+            agg["backends"] += 1
+            for k, v in usage.items():
+                if (k.startswith(("budget_", "engine_ttft_mean"))
+                        or "_mean_" in k or isinstance(v, bool)
+                        or not isinstance(v, (int, float))):
+                    continue
+                agg[k] = round(agg.get(k, 0) + v, 4)
+    return qos_tenants
+
+
 def load_models_config(path: str) -> Dict[str, Dict[str, Any]]:
     """models.json ConfigMap (``cova/cova-gradio-config.yaml:6-21``)."""
     with open(path) as f:
@@ -270,8 +301,12 @@ class CovaClient:
                 conformance[name] = ent
         slo_breached = sorted(n for n, e in conformance.items()
                               if e.get("slo_breach"))
-        return {"models": results, "overloaded": overloaded,
-                "conformance": conformance, "slo_breached": slo_breached}
+        out = {"models": results, "overloaded": overloaded,
+               "conformance": conformance, "slo_breached": slo_breached}
+        qos_tenants = aggregate_tenant_usage(results)
+        if qos_tenants:
+            out["qos"] = {"tenants": qos_tenants}
+        return out
 
     # -- prefix-affinity routing (kvtier) -----------------------------------
 

@@ -15,7 +15,11 @@ so a degraded replica degrades *gracefully* instead of hanging:
 - :mod:`drain` — SIGTERM graceful drain and the engine-step watchdog that
   fails liveness on a stuck dispatch;
 - :mod:`faults` — a deterministic, env/endpoint-driven fault injector with
-  named sites threaded through the stack (the chaos suite's instrument).
+  named sites threaded through the stack (the chaos suite's instrument);
+- :mod:`qos` — multi-tenant QoS: priority classes (``X-SHAI-Priority``),
+  the weighted-fair scheduler kernel the engine dequeues through, and the
+  per-tenant token-rate budget ledger (``X-SHAI-Tenant``,
+  ``SHAI_TENANT_BUDGETS``) the admission gate enforces.
 
 Layering: everything here is stdlib-only (plus ``orchestrate.
 capacity_checker``'s pure threshold types) so the engine may import it
@@ -33,3 +37,13 @@ from .deadline import (  # noqa: F401
 )
 from .drain import DrainController, StepWatchdog  # noqa: F401
 from .faults import FaultError, FaultInjector  # noqa: F401
+from .qos import (  # noqa: F401
+    PRIORITY_HEADER,
+    TENANT_HEADER,
+    QosTag,
+    TenantLedger,
+    WeightedFairScheduler,
+    current_qos,
+    qos_from_headers,
+    set_current_qos,
+)

@@ -25,6 +25,7 @@ import threading
 from typing import Dict, Optional
 
 from ..orchestrate.capacity_checker import OverloadThresholds, is_overloaded
+from .qos import TenantLedger
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,6 +43,9 @@ class Shed:
             "queue_depth": "admission queue is full, retry later",
             "kv_pressure": "KV pool is at the preemption edge, retry later",
             "inflight": "too many requests in flight, retry later",
+            "tenant_budget": "tenant token-rate budget exhausted, retry "
+                             "after the bucket refills",
+            "tenant_inflight": "tenant in-flight cap reached, retry later",
         }.get(self.reason, self.reason)
 
     @property
@@ -64,11 +68,22 @@ class AdmissionGate:
                  max_inflight: int = 0, retry_after_s: float = 1.0,
                  drain_retry_after_s: float = 5.0,
                  tier_full_utilization: float = 0.95,
-                 tier_full_kv_utilization: float = 0.85):
+                 tier_full_kv_utilization: float = 0.85,
+                 ledger: Optional[TenantLedger] = None,
+                 tenant_max_inflight: int = 0):
         self.thresholds = thresholds or OverloadThresholds()
         self.max_inflight = max_inflight  # 0 = no cap
         self.retry_after_s = retry_after_s
         self.drain_retry_after_s = drain_retry_after_s
+        # multi-tenant QoS (resilience.qos): when a budget ledger is
+        # attached, a tenant in token-bucket debt sheds with 429 +
+        # a Retry-After DERIVED from its refill deficit (finite by
+        # construction) instead of the static hint the structural sheds
+        # keep; tenant_max_inflight optionally caps one tenant's
+        # concurrency (0 = off) so a flooder can't own every lane slot
+        # even inside its token budget.
+        self.ledger = ledger
+        self.tenant_max_inflight = tenant_max_inflight
         # host KV tier pricing (kvtier): while the host pool can absorb
         # demotions, device eviction is cheap (a copy, not lost work) and
         # the normal max_kv_utilization line applies. Once the HOST pool
@@ -84,9 +99,9 @@ class AdmissionGate:
 
     def check(self, engine_stats: Optional[dict] = None, inflight: int = 0,
               draining: bool = False, lane_width: int = 0,
-              lane_pending: int = 0) -> Optional[Shed]:
+              lane_pending: int = 0, tenant: str = "") -> Optional[Shed]:
         shed = self._decide(engine_stats, inflight, draining, lane_width,
-                            lane_pending)
+                            lane_pending, tenant)
         if shed is not None:
             with self._lock:
                 self._shed[shed.reason] = self._shed.get(shed.reason, 0) + 1
@@ -94,9 +109,21 @@ class AdmissionGate:
 
     def _decide(self, stats: Optional[dict], inflight: int,
                 draining: bool, lane_width: int,
-                lane_pending: int) -> Optional[Shed]:
+                lane_pending: int, tenant: str = "") -> Optional[Shed]:
         if draining:
             return Shed(503, "draining", self.drain_retry_after_s)
+        if self.ledger is not None:
+            # per-tenant enforcement BEFORE the structural caps: an
+            # over-budget tenant must shed even on an idle pod, and its
+            # Retry-After is the bucket's actual refill time — the static
+            # hint stays for the structural (non-budget) reasons below
+            ra = self.ledger.admit(tenant)
+            if ra is not None:
+                return Shed(429, "tenant_budget", ra)
+            if (self.tenant_max_inflight
+                    and self.ledger.inflight_of(tenant)
+                    >= self.tenant_max_inflight):
+                return Shed(429, "tenant_inflight", self.retry_after_s)
         if self.max_inflight and inflight >= self.max_inflight:
             return Shed(429, "inflight", self.retry_after_s)
         # Lane backlog: blocking requests beyond the executor's width queue

@@ -35,6 +35,7 @@ from ..obs import FlightRecorder
 from ..obs import trace as obs_trace
 from ..resilience import deadline as rz_deadline
 from ..resilience import faults as rz_faults
+from ..resilience import qos as rz_qos
 from ..resilience.admission import AdmissionGate
 from ..resilience.drain import DrainController
 from ..utils.env import ServeConfig
@@ -207,10 +208,20 @@ def create_app(
     # pod-level 429s and fleet-level failover describe the same line.
     from ..orchestrate.capacity_checker import OverloadThresholds
 
+    # multi-tenant QoS (resilience.qos): the tenant budget ledger rides
+    # the admission gate — an over-budget tenant sheds with a Retry-After
+    # derived from its token-bucket refill deficit while other tenants
+    # keep serving; SHAI_TENANT_MAX_INFLIGHT optionally caps one tenant's
+    # concurrency inside its budget
+    from ..obs.util import env_int as _env_int
+
+    ledger = rz_qos.TenantLedger.from_env()
     gate = AdmissionGate(
         OverloadThresholds(max_queue_depth=cfg.admit_max_queue,
                            max_kv_utilization=cfg.admit_max_kv),
-        max_inflight=cfg.max_inflight)
+        max_inflight=cfg.max_inflight,
+        ledger=ledger,
+        tenant_max_inflight=_env_int("SHAI_TENANT_MAX_INFLIGHT", 0))
     drainer = DrainController(budget_s=cfg.drain_budget_s)
     # flight recorder: every completed request's span timeline rings here
     # (the asgi layer closes each trace and sinks it), joined at dump time
@@ -226,7 +237,8 @@ def create_app(
         max_workers=max(1, service.concurrency), thread_name_prefix="model")
 
     app.state.update(cfg=cfg, service=service, collector=collector, publisher=pub,
-                     status=state, flight=flight, gate=gate, drainer=drainer)
+                     status=state, flight=flight, gate=gate, drainer=drainer,
+                     ledger=ledger)
     # lifecycle probes and scrape surfaces must not ring the flight recorder
     app.trace_exclude |= {"/health/ready", "/debug/faults",
                           "/debug/conformance", "/profile"}
@@ -281,15 +293,19 @@ def create_app(
         except Exception:
             return None
 
-    def _admit():
+    def _admit(tenant: str = ""):
         """Bounded admission: shed (429/503 + Retry-After) BEFORE the
-        request parks a lane thread or enters the engine queue."""
+        request parks a lane thread or enters the engine queue. ``tenant``
+        is the ledger-bounded label — per-tenant budgets/caps shed here
+        with a budget-derived Retry-After, and every shed is attributed
+        per tenant on ``shai_shed_total``."""
         shed = gate.check(_engine_snapshot(), inflight=state["inflight"],
                           draining=drainer.draining,
                           lane_width=max(1, service.concurrency),
-                          lane_pending=state["lane_pending"])
+                          lane_pending=state["lane_pending"],
+                          tenant=tenant)
         if shed is not None:
-            pub.count_shed(shed.reason)
+            pub.count_shed(shed.reason, tenant)
             raise HTTPError(shed.status, shed.detail, headers=shed.headers)
 
     def _deadline_of(request: Request) -> Optional[rz_deadline.Deadline]:
@@ -305,23 +321,58 @@ def create_app(
         return dl
 
     class _InferScope:
-        """Admission + deadline + in-flight accounting around one request.
-        The deadline rides a contextvar so ``_run_model``'s context copy
-        carries it onto the lane thread (and into the engine loop)."""
+        """Admission + deadline + QoS + in-flight accounting around one
+        request. The deadline and the tenant/priority tag ride contextvars
+        so ``_run_model``'s context copy carries them onto the lane thread
+        (and into the engine loop)."""
 
         def __init__(self, request: Request):
             self.request = request
             self._token = None
+            self._qos_token = None
             self._handed_off = False
+            # resolved at __enter__: the ledger-bounded tenant label every
+            # shed/charge/inflight count for this request attributes to
+            self.tenant = ""
 
         def __enter__(self):
-            _admit()
+            raw_tenant, priority = rz_qos.qos_from_headers(
+                self.request.headers)
+            self.tenant = ledger.label_of(raw_tenant)
+            _admit(self.tenant)
             dl = _deadline_of(self.request)
             self._token = rz_deadline.set_current_deadline(dl)
+            # the engine tag carries the RAW (sanitized) tenant, not the
+            # ledger's "default" label: an untagged request must reach
+            # the engine untagged so a single-tenant pod keeps its
+            # zero-cost FIFO path and exports no tenant families
+            self._qos_token = rz_qos.set_current_qos(
+                rz_qos.QosTag(tenant=raw_tenant, priority=priority))
+            ledger.note_start(self.tenant)
             with inflight_lock:
                 state["inflight"] += 1
                 state["lane_pending"] += 1
             return dl
+
+        def charge(self, out) -> None:
+            """Debit the tenant's token budget with the request's actual
+            usage: prompt + generated tokens for engine responses (OpenAI
+            ``usage.total_tokens`` or the /generate fields), a floor of 1
+            unit for token-less services/streams — so budgets degrade to
+            request-rate metering where token counts don't exist."""
+            tokens = 1
+            if isinstance(out, dict):
+                usage = out.get("usage")
+                if isinstance(usage, dict) and isinstance(
+                        usage.get("total_tokens"), (int, float)):
+                    tokens = int(usage["total_tokens"])
+                else:
+                    try:
+                        tokens = (int(out.get("n_tokens") or 0)
+                                  + int(out.get("n_prompt") or 0))
+                    except (TypeError, ValueError):
+                        tokens = 1
+            ledger.charge(self.tenant, max(1, tokens))
 
         def _dec_inflight(self):
             with inflight_lock:
@@ -341,11 +392,17 @@ def create_app(
             with inflight_lock:
                 state["lane_pending"] -= 1
             released = {"v": False}
+            tenant = self.tenant
 
             def release():
                 if not released["v"]:
                     released["v"] = True
                     self._dec_inflight()
+                    # stream drain/abort: the tenant's in-flight slot frees
+                    # and its budget is debited the streaming floor (token
+                    # counts never reach the app layer mid-SSE)
+                    ledger.note_done(tenant)
+                    ledger.charge(tenant, 1)
 
             return release
 
@@ -354,7 +411,9 @@ def create_app(
                 with inflight_lock:
                     state["inflight"] -= 1
                     state["lane_pending"] -= 1
+                ledger.note_done(self.tenant)
             rz_deadline.reset_current_deadline(self._token)
+            rz_qos.reset_current_qos(self._qos_token)
             return False
 
     def _begin_drain(on_done: Optional[Callable[[], None]] = None) -> bool:
@@ -428,12 +487,14 @@ def create_app(
         _require_ready()
         payload = request.json()
         t0 = time.perf_counter()
-        with _InferScope(request):
+        scope = _InferScope(request)
+        with scope:
             # annotation=False: this span is held across an await on the
             # event loop; the device-trace view comes from the engine's own
             # prefill/decode annotations on the lane thread
             with obs_trace.span("model_infer", annotation=False):
                 out = await _run_model(service.infer, payload)
+        scope.charge(out)
         dt = time.perf_counter() - t0
         collector.record(dt)
         pub.publish(dt)
@@ -530,6 +591,29 @@ def create_app(
         aff = service.affinity_digests()
         if aff is not None:
             out.setdefault("kvtier", {})["affinity"] = aff
+        # multi-tenant QoS: one "qos" section joining the budget ledger's
+        # per-tenant usage (requests/tokens/inflight/shed/budget balance)
+        # with the engine's per-tenant queue/slot/TTFT view and the
+        # weighted-fair scheduler's pick counters — what cova /fleet
+        # aggregates fleet-wide per tenant. Engine-side keys are
+        # namespaced `engine_*`: the two sources count different things
+        # ("requests" admitted at the door vs submitted to the engine —
+        # they diverge on n>1 fan-outs) and run different cardinality
+        # caps, so a silent same-key merge would clobber one truth with
+        # the other
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for t, ent in ledger.snapshot().items():
+            tenants.setdefault(t, {}).update(ent)
+        if tele is not None and hasattr(tele, "tenant_snapshot"):
+            for t, ent in tele.tenant_snapshot().items():
+                tenants.setdefault(t, {}).update(
+                    {f"engine_{k}": v for k, v in ent.items()})
+        sched = getattr(tele, "qos_sched", None) if tele is not None \
+            else None
+        if tenants or sched is not None or ledger.metered:
+            out["qos"] = {"metered": ledger.metered, "tenants": tenants}
+            if sched is not None:
+                out["qos"]["scheduler"] = sched.snapshot()
         from ..core.aot import compile_stats
 
         out["aot"] = compile_stats()
@@ -617,6 +701,48 @@ def create_app(
                         yield g
 
         pub.registry.register(_ServiceStatsCollector())
+
+        from prometheus_client.core import CounterMetricFamily
+
+        class _TenantLedgerCollector:
+            """Per-tenant budget/usage gauges off the ledger (bounded
+            cardinality by construction — the ledger collapses overflow
+            tenants into "other"): the live balance is how a dashboard
+            answers "why is this tenant seeing 429s" without log-diving."""
+
+            def collect(self):
+                try:
+                    snap = ledger.snapshot()
+                except Exception:
+                    return
+                if not snap:
+                    return
+                tok = CounterMetricFamily(
+                    "shai_tenant_tokens_total",
+                    "Tokens charged against the tenant budget "
+                    "(prompt + generated; 1/request for token-less "
+                    "services)", labels=["app", "tenant"])
+                infl = GaugeMetricFamily(
+                    "shai_tenant_inflight",
+                    "Requests in flight per tenant", labels=["app", "tenant"])
+                bal = GaugeMetricFamily(
+                    "shai_tenant_budget_balance",
+                    "Live token-bucket balance (negative = in debt, "
+                    "admission refused until refill)",
+                    labels=["app", "tenant"])
+                for tenant, ent in sorted(snap.items()):
+                    tok.add_metric([cfg.app, tenant],
+                                   float(ent.get("tokens", 0)))
+                    infl.add_metric([cfg.app, tenant],
+                                    float(ent.get("inflight", 0)))
+                    if "budget_balance" in ent:
+                        bal.add_metric([cfg.app, tenant],
+                                       float(ent["budget_balance"]))
+                yield tok
+                yield infl
+                yield bal
+
+        pub.registry.register(_TenantLedgerCollector())
 
     # one trace at a time; concurrent POSTs must not corrupt the session.
     # "task" pins the stop coroutine — the event loop holds tasks weakly,
@@ -748,6 +874,7 @@ def create_app(
 
                         out.iterator = timed_iter()
                         return out
+                scope.charge(out)
                 dt = time.perf_counter() - t0
                 collector.record(dt)
                 pub.publish(dt)

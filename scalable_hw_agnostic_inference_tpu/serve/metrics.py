@@ -135,6 +135,23 @@ _KVTIER_GAUGES = {
     "hit_rate": ("shai_kvtier_hit_rate",
                  "Host KV tier: hits / (hits + misses)"),
 }
+#: multi-tenant QoS: per-tenant attribution off the engine telemetry
+#: (bounded label cardinality — obs.steploop.MAX_TENANT_LABELS tenants
+#: plus "other"; the ledger-side gauges export from serve.app)
+_TENANT_COUNTERS = {
+    "requests": ("shai_tenant_requests_total",
+                 "Requests submitted to the engine, per tenant"),
+}
+_TENANT_GAUGES = {
+    "waiting": ("shai_tenant_waiting",
+                "Engine queue depth held by this tenant (last step)"),
+    "running": ("shai_tenant_running",
+                "Decoding slots held by this tenant (last step)"),
+}
+_TENANT_TTFT = ("shai_tenant_ttft_seconds",
+                "Time to first token per tenant (queue wait included) — "
+                "the fairness number: a flooding tenant's queue must not "
+                "move another tenant's TTFT")
 
 
 class EngineTelemetryCollector:
@@ -201,6 +218,31 @@ class EngineTelemetryCollector:
                 g = GaugeMetricFamily(f"{prefix}{k}", doc, labels=["app"])
                 g.add_metric([self.app], float(v))
                 yield g
+        # multi-tenant QoS: per-tenant request counts, queue/slot gauges,
+        # and TTFT histograms — present only once a tenant tag (or QoS)
+        # was seen, absent entirely on single-tenant pods
+        tsnap = tele.tenant_snapshot() if hasattr(tele, "tenant_snapshot") \
+            else {}
+        if tsnap:
+            for key, (name, doc) in _TENANT_COUNTERS.items():
+                c = CounterMetricFamily(name, doc, labels=["app", "tenant"])
+                for tenant, ent in sorted(tsnap.items()):
+                    c.add_metric([self.app, tenant], float(ent.get(key, 0)))
+                yield c
+            for key, (name, doc) in _TENANT_GAUGES.items():
+                g = GaugeMetricFamily(name, doc, labels=["app", "tenant"])
+                for tenant, ent in sorted(tsnap.items()):
+                    g.add_metric([self.app, tenant], float(ent.get(key, 0)))
+                yield g
+            h = HistogramMetricFamily(_TENANT_TTFT[0], _TENANT_TTFT[1],
+                                      labels=["app", "tenant"])
+            for tenant, hs in sorted(tele.tenant_histograms().items()):
+                h.add_metric(
+                    [self.app, tenant],
+                    [(str(le) if le != "+Inf" else "+Inf", float(c))
+                     for le, c in hs["buckets"]],
+                    sum_value=float(hs["sum"]))
+            yield h
         # host KV tier (kvtier): counters with their _total contract +
         # occupancy gauges, from the same telemetry object
         kvt = getattr(tele, "kvtier", None)
@@ -276,7 +318,10 @@ class MetricsPublisher:
             self._prom_shed = Counter(
                 "shai_shed_total",
                 "Requests shed by the admission gate / drain",
-                ["app", "nodepool", "reason"],
+                # tenant label (multi-tenant QoS): bounded upstream — the
+                # serve layer passes ledger-sanitized tenant keys only, so
+                # cardinality is capped at SHAI_QOS_MAX_TENANTS + "other"
+                ["app", "nodepool", "reason", "tenant"],
                 registry=self.registry,
             )
         self._spec_last = {"drafted": 0, "accepted": 0, "committed": 0}
@@ -311,13 +356,17 @@ class MetricsPublisher:
             )
             print(line, file=self._stream, flush=True)
 
-    def count_shed(self, reason: str) -> None:
+    def count_shed(self, reason: str, tenant: str = "") -> None:
         """Record one shed (refused) request under ``reason`` — exported as
-        ``shai_shed_total{reason=...}`` and one JSON line for the push-model
-        path (overloads are exactly when the control plane needs to see
-        per-pod shed rates)."""
+        ``shai_shed_total{reason=...,tenant=...}`` and one JSON line for
+        the push-model path (overloads are exactly when the control plane
+        needs to see per-pod shed rates — and per-tenant shed rates are
+        how a dashboard separates 'the pod is saturated' from 'one tenant
+        is over budget'). ``tenant`` must arrive bounded (the serve layer
+        passes the ledger's sanitized key); empty reads as ``default``."""
         if _HAVE_PROM and self.registry is not None:
-            self._prom_shed.labels(self.app, self.nodepool, reason).inc()
+            self._prom_shed.labels(self.app, self.nodepool, reason,
+                                   tenant or "default").inc()
         if self.emit_json:
             # reason rides in the metric NAME: "data" is a name -> number
             # map for the CloudWatch-style consumer (a string value would

@@ -17,6 +17,7 @@ import numpy as np
 from ...models.registry import register_model
 from ...obs import trace as obs_trace
 from ...resilience import deadline as rz_deadline
+from ...resilience import qos as rz_qos
 from ...resilience.drain import StepWatchdog
 from ...utils.env import ServeConfig
 from ..app import ModelService
@@ -401,7 +402,8 @@ class VllmService(ModelService):
             ids = ids[:max_text]
         out = self._collect(self.loop.submit(
             ids, params, prefix=prefix, cross_states=cross_states,
-            cross_len=cross_len, deadline_at=self._deadline_at()))
+            cross_len=cross_len, deadline_at=self._deadline_at(),
+            **self._qos_kw()))
         if self._engine.cache.prefix_caching:
             # advertise warmth ONLY for the /generate path cova routes,
             # and only after the request actually served: chat-templated
@@ -420,6 +422,18 @@ class VllmService(ModelService):
         carried here by the lane's contextvars copy."""
         dl = rz_deadline.current_deadline()
         return 0.0 if dl is None else dl.at
+
+    @staticmethod
+    def _qos_kw() -> Dict[str, Any]:
+        """The request's tenant/priority tag for ``EngineLoop.submit`` —
+        set by _InferScope from the X-SHAI-Tenant/X-SHAI-Priority headers
+        and carried here the same contextvars way as the deadline. Every
+        submit site forwards it so the weighted-fair dequeue, priority
+        preemption, and per-tenant attribution see the same identity."""
+        tag = rz_qos.current_qos()
+        if tag is None:
+            return {}
+        return {"priority": tag.priority, "tenant": tag.tenant}
 
     @staticmethod
     def _result_timeout() -> float:
@@ -561,7 +575,8 @@ class VllmService(ModelService):
             if not ids:
                 raise HTTPError(400, "empty prompt")
             futs = [self.loop.submit(list(ids), params,
-                                     deadline_at=self._deadline_at())
+                                     deadline_at=self._deadline_at(),
+                                     **self._qos_kw())
                     for _ in range(n)]
             outs = []
             try:
@@ -679,7 +694,8 @@ class VllmService(ModelService):
         stops = [stop] if isinstance(stop, str) else list(stop)
         tokq: "_q.Queue[int]" = _q.Queue()
         fut = self.loop.submit(ids, params, on_token=tokq.put,
-                               deadline_at=self._deadline_at())
+                               deadline_at=self._deadline_at(),
+                               **self._qos_kw())
         # captured HERE (handler context): the chunk generator drains on a
         # stream-pool thread where the request contextvar is absent
         result_timeout = self._result_timeout()

@@ -33,7 +33,10 @@ KEYS = {"sd": "sd21_img_s",
         # ragged paged attention + int8 KV (PR 11): mixed-length decode
         # tok/s with ragged+quant on; the line also carries
         # kv_quant_capacity_ratio (blocks per fixed SHAI_HBM_GIB)
-        "ragged": "ragged_tps"}
+        "ragged": "ragged_tps",
+        # multi-tenant QoS (PR 12): high-priority tenant p99 TTFT under a
+        # low-priority flood, FIFO/QoS ratio (bench.py qos)
+        "qos": "qos_flood_p99_ratio"}
 
 
 def _load_results() -> dict:

@@ -198,3 +198,38 @@ def test_ragged_bench_acceptance_on_cpu_tiny():
     assert 1.7 <= out["kv_quant_capacity_ratio"] <= 2.1
     blocks = out["max_kv_blocks_at_hbm"]
     assert blocks["int8"] > 1.7 * blocks["bf16"]
+
+
+def test_qos_key_promotes_flood_p99_ratio():
+    # PR-12 tentpole: the multi-tenant QoS bench publishes under its own
+    # key and dispatches as its own variant (never banking as another
+    # bench)
+    assert promote.KEYS["qos"] == "qos_flood_p99_ratio"
+    bspec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(ROOT, "bench.py"))
+    bench = importlib.util.module_from_spec(bspec)
+    bspec.loader.exec_module(bench)
+    assert bench._which_from_argv(["bench.py", "qos"]) == "qos"
+    assert bench.UNITS_BY_BENCH["qos"] == "x"
+    assert promote.is_real(_entry(metric="qos flood p99 ratio (tpu)",
+                                  unit="x"))
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_qos_bench_acceptance_on_cpu_tiny():
+    """The PR-12 acceptance number, measured: with a low-priority flood
+    queued ahead, the high-priority tenant's p99 TTFT under QoS beats
+    FIFO (value = fifo_p99/qos_p99 > 1), and both modes ran the same
+    no-flood baseline."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--inner",
+         "qos", "--cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu" and out["unit"] == "x"
+    assert out["value"] > 1.0, out
+    assert out["qos"]["vip_ttft_p99_ms"] < out["fifo"]["vip_ttft_p99_ms"]
+    # the flood actually hurt FIFO (the A has a real B to beat)
+    assert out["fifo"]["vip_ttft_p99_ms"] > \
+        2 * out["fifo"]["vip_ttft_noflood_p50_ms"]
