@@ -1,16 +1,20 @@
-# Repo gates. `make lint` is the one-stop static gate (AST + IR + docs +
-# budget); `make lint-fast` suits pre-commit (pair with
-# `python scripts/shai_lint.py --changed` for diff-scoped AST runs).
+# Repo gates. `make lint` is the one-stop static gate (AST + race + IR +
+# docs + budget); `make lint-fast` suits pre-commit (pair with
+# `python scripts/shai_lint.py --changed` for diff-scoped AST runs and
+# `--race --changed` for diff-scoped race findings).
 
 PY ?= python
 
-.PHONY: lint lint-fast test
+.PHONY: lint lint-fast race test
 
 lint:
 	$(PY) scripts/check_all.py
 
 lint-fast:
 	$(PY) scripts/check_all.py --fast
+
+race:
+	$(PY) scripts/shai_lint.py --race
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

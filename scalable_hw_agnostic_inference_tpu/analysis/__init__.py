@@ -19,6 +19,11 @@ makes them mechanical.
 - ``envknobs``  env reads must use the lenient parsers + appear in README
                 (+ deploy manifests may only set knobs the code reads)
 - ``routes``    GET debug/poll routes must be in ``trace_exclude``
+- ``race``      shai-race: lock-order inversions (acquisition graph +
+                2-level call propagation), unbounded blocking calls
+                under declared hot locks, and unguarded READS of
+                lock-guarded state — a separate pass
+                (``shai_lint.py --race``) with its own baseline rules
 - ``ir/``       jaxpr-lint: IR-level checks on the COMPILED executable
                 factories (donation efficacy, dtype drift, collective
                 schedules, host interop, baked constants) — NOT imported
@@ -43,3 +48,4 @@ from .core import (  # noqa: F401
     save_baseline,
 )
 from .contract import DEFAULT_CONTRACT, Contract  # noqa: F401
+from .race import RACE_RULES, run_race  # noqa: F401

@@ -40,6 +40,42 @@ class ClassPolicy:
     #: the class body (e.g. ``engine.`` / ``eng.`` locals, ``.engine.``
     #: attribute chains). Checked as a prefix or infix of the write path.
     instance_markers: Tuple[str, ...] = ()
+    #: lock attributes this class OWNS. Each becomes a lock IDENTITY
+    #: ``"<Class>.<attr>"`` in the shai-race acquisition graph
+    #: (``analysis/race.py``); defaults to the distinct values of
+    #: ``lock_guarded`` when empty, so a class whose only lock guards
+    #: attributes needs no duplicate declaration.
+    locks: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class RaceSpec:
+    """Declared tables for the shai-race pass (``analysis/race.py``).
+
+    Lock IDENTITIES are ``"<Class>.<attr>"`` for locks owned by a
+    ``thread_contract`` class (``ClassPolicy.locks`` /
+    ``lock_guarded`` values, resolved through ``self.<attr>`` inside the
+    class body and through ``instance_markers`` outside it) plus the
+    module-scope ids declared in :attr:`module_locks` (closure locks
+    like ``serve.app``'s ``inflight_lock``).
+    """
+
+    #: module relpath -> {with-target dotted name: lock identity} for
+    #: locks that live in closures / module scope rather than on a
+    #: contract class
+    module_locks: Dict[str, Dict[str, str]] = dataclasses.field(
+        default_factory=dict)
+    #: lock identities declared HOT: a blocking call (queue get/put,
+    #: Future.result, Thread.join, Event.wait, time.sleep, sockets,
+    #: device fetches) lexically under one of these is a finding —
+    #: every thread in the process eventually serializes behind them
+    hot_locks: Tuple[str, ...] = ()
+    #: the allowed partial order: ``(outer, inner)`` means "``outer`` may
+    #: be held while acquiring ``inner``". Every observed cross-lock
+    #: acquisition edge must appear here (transitively); an edge whose
+    #: REVERSE is derivable, or that is simply undeclared, is a finding.
+    #: The declared set itself must be acyclic — checked every run.
+    lock_order: Tuple[Tuple[str, str], ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,6 +169,9 @@ class Contract:
     #: GET routes (beyond /debug/*) that are poll surfaces and must be
     #: excluded from the flight-recorder trace ring
     poll_routes: Tuple[str, ...] = ()
+
+    # -- race pass (shai-race) ---------------------------------------------
+    race: RaceSpec = dataclasses.field(default_factory=RaceSpec)
 
     # -- IR pass (jaxpr-lint) ----------------------------------------------
     ir: IrSpec = dataclasses.field(default_factory=IrSpec)
@@ -240,6 +279,41 @@ DEFAULT_CONTRACT = Contract(
             lock_guarded={"_requests": "_lock", "_seq": "_lock"},
             owning_modules=("obs/flight.py",),
         ),
+        # The step telemetry is written by the engine-loop thread and read
+        # by scrape/dump threads: the container attrs (step ring, gauge
+        # dict, tenant tables) are lock-guarded on BOTH sides — the
+        # guarded-read rule is what catches a torn /stats snapshot.
+        # Scalar counters (steps, preemptions, ...) stay undeclared: a
+        # torn int read cannot exist under the GIL and declaring them
+        # would bury the structural reads in noise.
+        "StepTelemetry": ClassPolicy(
+            immutable_after_init=("ttft", "tpot", "queue_wait", "step_gap",
+                                  "_lock"),
+            lock_guarded={"_steps": "_lock", "_gauges": "_lock",
+                          "_tenants": "_lock", "_tenant_ttft": "_lock",
+                          "_flush_reasons": "_lock"},
+            owning_modules=("obs/steploop.py",),
+        ),
+        # The admission gate's shed counters take writes from every
+        # request thread and reads from /stats scrapes.
+        "AdmissionGate": ClassPolicy(
+            immutable_after_init=(
+                "thresholds", "max_inflight", "retry_after_s",
+                "drain_retry_after_s", "ledger", "tenant_max_inflight",
+                "tier_full_utilization", "tier_full_kv_utilization",
+                "_lock"),
+            lock_guarded={"_shed": "_lock"},
+            owning_modules=("resilience/admission.py",),
+            instance_markers=("gate.", ".gate."),
+        ),
+        # The drain flag is armed by the SIGTERM handler and read by every
+        # admission/readiness path.
+        "DrainController": ClassPolicy(
+            immutable_after_init=("budget_s", "_clock", "_lock"),
+            lock_guarded={"_started_at": "_lock"},
+            owning_modules=("resilience/drain.py",),
+            instance_markers=("drainer.", ".drainer."),
+        ),
         # The host KV tier is written from TWO threads by design: the
         # engine thread stores/probes/restores, the copy-out worker
         # publishes materialized entries — every mutation of the entry
@@ -249,14 +323,17 @@ DEFAULT_CONTRACT = Contract(
                 "n_layers", "block_size", "n_kv_heads", "head_dim",
                 "dtype", "block_nbytes", "capacity_bytes", "async_copy",
                 "_lock"),
-            lock_guarded={"_entries": "_lock", "_stats": "_lock"},
+            lock_guarded={"_entries": "_lock", "_stats": "_lock",
+                          "_closing": "_lock"},
             owning_modules=("kvtier/pool.py",),
             instance_markers=(".tier.",),
         ),
         # The copy-out worker's queue/thread bindings are fixed at
         # construction; the queue object itself is the cross-thread seam.
         "CopyOutWorker": ClassPolicy(
-            immutable_after_init=("_pool", "_q", "_thread"),
+            immutable_after_init=("_pool", "_q", "_thread", "_closed",
+                                  "_sub_lock"),
+            locks=("_sub_lock",),
             owning_modules=("kvtier/pool.py",),
         ),
         # The tenant ledger takes writes from every serving thread
@@ -302,6 +379,36 @@ DEFAULT_CONTRACT = Contract(
     trace_files=("serve/app.py", "serve/asgi.py"),
     poll_routes=("/profile", "/health", "/readiness", "/health/ready",
                  "/metrics", "/stats"),
+    race=RaceSpec(
+        # serve.app's closure lock guarding the in-flight counters (the
+        # dict_guards entry above names the same lock for the write rule)
+        module_locks={"serve/app.py": {"inflight_lock":
+                                       "app.inflight_lock"}},
+        # the locks every thread in the process eventually serializes
+        # behind: the engine-loop/serve futures seam, the QoS ledger (on
+        # every admission AND completion), the step telemetry + flight
+        # ring (written per step / per request, scraped concurrently),
+        # the host KV pool (engine probes vs worker publishes), and the
+        # request-path in-flight counters. Blocking while holding any of
+        # these stalls request threads fleet-wide, not just one caller.
+        hot_locks=(
+            "EngineLoop._futures_lock",
+            "TenantLedger._lock",
+            "StepTelemetry._lock",
+            "FlightRecorder._lock",
+            "HostKVTier._lock",
+            "AdmissionGate._lock",
+            "DrainController._lock",
+            "app.inflight_lock",
+        ),
+        # The declared partial order is EMPTY on purpose: the control
+        # plane's design rule is "no lock nesting at all" — every
+        # declared lock protects a leaf structure and is released before
+        # any call that could take another. Any observed cross-lock
+        # acquisition (lexical or through the 2-level call graph) is
+        # therefore a finding until a pair is deliberately added here.
+        lock_order=(),
+    ),
     ir=IrSpec(
         # every registered executable-factory variant the engine serves
         # with, built at tiny geometry by analysis/ir/factories.py:

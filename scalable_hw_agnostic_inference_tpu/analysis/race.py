@@ -1,0 +1,579 @@
+"""shai-race: lock-order, blocking-under-lock, and guarded-read checks.
+
+The third analysis leg beside the AST rules (``run_all``) and the IR pass
+(``analysis/ir``). The stack runs a half-dozen long-lived threads
+(engine loop, kvtier copy-out worker, httpd, drain worker, watchdog,
+capacity checker) coordinating through ~25 locks; the ``thread`` rule
+checks that declared state is *written* under its lock, but nothing
+detected lock-order inversions, blocking calls held under hot locks, or
+unguarded *reads* of multi-field snapshots. This module turns those three
+bug classes into findings on the same Finding/baseline/allow machinery.
+
+Rules (``contract.race`` + the ``thread_contract`` ClassPolicy tables are
+the ground truth; a lock's IDENTITY is ``"<Class>.<attr>"`` for locks a
+contract class owns, or a declared module-lock id like
+``app.inflight_lock``):
+
+- ``lock-order`` — builds a lock-acquisition graph from lexical ``with
+  <lock>`` nestings plus two levels of intra-package call-graph
+  propagation (method calls made while a lock is held, the callee
+  resolved through the ClassPolicy ``instance_markers``). Every observed
+  cross-lock edge must be derivable from the declared partial order
+  (``contract.race.lock_order``); an edge whose reverse is derivable, a
+  re-acquisition of a held lock, or any cycle in the observed graph is a
+  potential deadlock. The committed contract declares an EMPTY order —
+  "no lock nesting at all" — so any nesting is a finding until a pair is
+  deliberately added.
+- ``blocking-under-lock`` — unbounded blocking calls (``queue.get/put``
+  with no timeout, ``Future.result()``, ``Thread.join()``,
+  ``Event.wait()``, ``time.sleep``, socket/httpx/requests calls,
+  ``.block_until_ready()`` / ``jax.device_get`` / ``np.asarray`` device
+  fetches) lexically inside a ``with <lock>`` body on a declared HOT
+  lock (``contract.race.hot_locks``): every thread in the process
+  eventually serializes behind those locks, so one blocked holder stalls
+  the request path fleet-wide.
+- ``guarded-read`` — attributes a ClassPolicy declares ``lock_guarded``
+  must also be *read* under that lock (the write-only ``thread`` rule
+  misses torn reads of multi-field snapshots like the ``/stats``
+  collectors). Covers in-class ``self.<attr>`` loads, loads reached
+  through ``instance_markers`` from non-owning modules, and the
+  ``dict_guards`` closure dicts (``serve.app``'s ``state``).
+
+Deliberate exceptions carry the standard grammar, e.g.::
+
+    # shai-lint: allow(guarded-read) caller-holds-lock helper
+
+CLI: ``python scripts/shai_lint.py --race`` (same 0/1/2 exit contract and
+rule-aware baseline staleness as ``--ir``); ``scripts/check_all.py`` runs
+it in the one-exit-code gate. The dynamic twin of these static tables is
+the deterministic interleaving harness in ``tests/schedutil.py`` /
+``tests/test_race.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Module, dotted, resolved_dotted, snippet_of
+from .threads import _MUTATORS, _matches_marker
+
+RULE_ORDER = "lock-order"
+RULE_BLOCK = "blocking-under-lock"
+RULE_READ = "guarded-read"
+RACE_RULES = (RULE_ORDER, RULE_BLOCK, RULE_READ)
+
+#: dotted call targets that block unconditionally
+_BLOCKING_FUNCS = {
+    "time.sleep": "time.sleep()",
+    "jax.device_get": "device fetch jax.device_get(...)",
+    "numpy.asarray": "device fetch numpy.asarray(...)",
+    "numpy.array": "device fetch numpy.array(...)",
+    "numpy.ascontiguousarray": "device copy numpy.ascontiguousarray(...)",
+}
+#: dotted prefixes whose calls are network I/O
+_BLOCKING_PREFIXES = ("socket.", "requests.", "httpx.", "urllib.")
+
+
+#: a lexical lock scope ends at a function boundary: code inside a nested
+#: def/lambda runs LATER, when the enclosing ``with`` has long released
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _policy_locks(policy) -> Set[str]:
+    """The lock attribute names a ClassPolicy owns."""
+    return set(policy.locks) | set(policy.lock_guarded.values())
+
+
+def _scope_walk(root: ast.AST):
+    """Walk ``root``'s body without descending into nested function
+    definitions or lambdas (their bodies execute in a different dynamic
+    scope)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _FUNC_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = getattr(node, "_shai_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = getattr(cur, "_shai_parent", None)
+    return None
+
+
+def _enclosing_callable(node: ast.AST) -> str:
+    """``Class.method`` / function-name context for a finding."""
+    parts: List[str] = []
+    cur = getattr(node, "_shai_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = getattr(cur, "_shai_parent", None)
+    return ".".join(reversed(parts)) or "<module>"
+
+
+def _resolve_lock(module: Module, expr: ast.AST, contract) -> Optional[str]:
+    """The declared lock identity a ``with`` target names, or None for
+    locks outside the contract (ignored by every rule)."""
+    d = dotted(expr)
+    if d is None:
+        return None
+    mod_locks = contract.race.module_locks.get(module.relpath, {})
+    if d in mod_locks:
+        return mod_locks[d]
+    if d.startswith("self."):
+        attr = d[len("self."):]
+        if "." not in attr:
+            cls = _enclosing_class(expr)
+            if cls is not None:
+                policy = contract.thread_contract.get(cls.name)
+                if policy is not None and attr in _policy_locks(policy):
+                    return f"{cls.name}.{attr}"
+            return None
+        # `self.<other>.<lock>` reaches ANOTHER object's lock: resolve
+        # through the instance markers below
+    attr = d.rsplit(".", 1)[-1]
+    for cls_name, policy in contract.thread_contract.items():
+        if attr in _policy_locks(policy) and policy.instance_markers \
+                and _matches_marker(d, policy.instance_markers):
+            return f"{cls_name}.{attr}"
+    return None
+
+
+def _held_locks(node: ast.AST, module: Module, contract) -> List[str]:
+    """Declared locks held lexically at ``node`` (innermost last). A node
+    inside a ``with`` statement's own items (the acquisition expression)
+    does not yet hold that statement's locks, and the walk STOPS at the
+    first enclosing function boundary — a deferred callback defined
+    under a ``with`` runs after the release."""
+    held: List[str] = []
+    child: ast.AST = node
+    cur = getattr(node, "_shai_parent", None)
+    while cur is not None:
+        if isinstance(cur, _FUNC_NODES) and cur is not node:
+            break
+        if isinstance(cur, (ast.With, ast.AsyncWith)) \
+                and not isinstance(child, ast.withitem):
+            for item in cur.items:
+                lock = _resolve_lock(module, item.context_expr, contract)
+                if lock is not None:
+                    held.append(lock)
+        child = cur
+        cur = getattr(cur, "_shai_parent", None)
+    return list(reversed(held))
+
+
+def _finding(module: Module, node: ast.AST, rule: str, context: str,
+             message: str) -> Finding:
+    allowed, reason, problem = module.allow_at(node, rule)
+    if problem:
+        message += f" ({problem})"
+    return Finding(rule=rule, path=module.relpath, line=node.lineno,
+                   context=context, message=message, allowed=allowed,
+                   reason=reason, snippet=snippet_of(module, node))
+
+
+# -- lock-order ---------------------------------------------------------------
+
+def _method_direct_locks(modules: Sequence[Module], contract
+                         ) -> Dict[Tuple[str, str], Set[str]]:
+    """(class, method) -> lock identities the method body acquires
+    directly (``with`` targets resolved through the contract)."""
+    out: Dict[Tuple[str, str], Set[str]] = {}
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef) \
+                    or node.name not in contract.thread_contract:
+                continue
+            for meth in node.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                acquired: Set[str] = set()
+                # same-scope walk: a `with` inside a nested def is NOT
+                # acquired by calling this method
+                for n in _scope_walk(meth):
+                    if isinstance(n, (ast.With, ast.AsyncWith)):
+                        for item in n.items:
+                            lock = _resolve_lock(module, item.context_expr,
+                                                 contract)
+                            if lock is not None:
+                                acquired.add(lock)
+                out[(node.name, meth.name)] = acquired
+    return out
+
+
+def _callees(module: Module, call: ast.Call, contract,
+             methods: Dict[Tuple[str, str], Set[str]]
+             ) -> List[Tuple[str, str]]:
+    """Contract-class methods a call site may dispatch to: ``self.m()``
+    resolves within the enclosing class; ``<marker-path>.m()`` resolves
+    through every ClassPolicy whose instance markers match the receiver."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return []
+    name = f.attr
+    recv = dotted(f.value)
+    out: List[Tuple[str, str]] = []
+    if recv == "self":
+        cls = _enclosing_class(call)
+        if cls is not None and (cls.name, name) in methods:
+            out.append((cls.name, name))
+        return out
+    full = dotted(f)
+    if full is None:
+        return out
+    for cls_name, policy in contract.thread_contract.items():
+        if (cls_name, name) in methods and policy.instance_markers \
+                and _matches_marker(full, policy.instance_markers):
+            out.append((cls_name, name))
+    return out
+
+
+def _transitive_closure(pairs: Sequence[Tuple[str, str]]
+                        ) -> Set[Tuple[str, str]]:
+    closure = set(pairs)
+    changed = True
+    while changed:
+        changed = False
+        for a, b in list(closure):
+            for c, d in list(closure):
+                if b == c and (a, d) not in closure:
+                    closure.add((a, d))
+                    changed = True
+    return closure
+
+
+def _cycle_nodes(edges: Set[Tuple[str, str]]) -> Set[str]:
+    """Lock identities on at least one directed cycle of ``edges``."""
+    reach = _transitive_closure(tuple(edges))
+    return {a for a, b in reach if (b, a) in reach or a == b}
+
+
+def check_lock_order(modules: Sequence[Module], contract) -> List[Finding]:
+    findings: List[Finding] = []
+    declared = _transitive_closure(contract.race.lock_order)
+    if any(a == b for a, b in declared):
+        findings.append(Finding(
+            rule=RULE_ORDER, path="analysis/contract.py", line=1,
+            context="<contract>",
+            message="declared lock_order is cyclic — the partial order "
+                    "must be a DAG", snippet="lock_order"))
+    methods = _method_direct_locks(modules, contract)
+    # depth 2: a method also "acquires" what the contract methods it
+    # calls acquire directly
+    deep: Dict[Tuple[str, str], Set[str]] = {}
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef) \
+                    or node.name not in contract.thread_contract:
+                continue
+            for meth in node.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                extra: Set[str] = set()
+                for n in _scope_walk(meth):
+                    if isinstance(n, ast.Call):
+                        for callee in _callees(module, n, contract,
+                                               methods):
+                            extra |= methods.get(callee, set())
+                deep[(node.name, meth.name)] = \
+                    methods.get((node.name, meth.name), set()) | extra
+    # observed edges, with one representative site each
+    edge_sites: Dict[Tuple[str, str], Tuple[Module, ast.AST, str]] = {}
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                held = _held_locks(node, module, contract)
+                acquired = [lock for item in node.items
+                            for lock in
+                            [_resolve_lock(module, item.context_expr,
+                                           contract)]
+                            if lock is not None]
+                # multi-item `with a, b:` orders left-to-right
+                for i, a in enumerate(acquired):
+                    for h in held + acquired[:i]:
+                        edge_sites.setdefault(
+                            (h, a),
+                            (module, node,
+                             f"acquires `{a}` while holding `{h}`"))
+            elif isinstance(node, ast.Call):
+                held = _held_locks(node, module, contract)
+                if not held:
+                    continue
+                for cls_name, meth_name in _callees(module, node, contract,
+                                                    methods):
+                    for lock in sorted(deep.get((cls_name, meth_name),
+                                                set())):
+                        for h in held:
+                            edge_sites.setdefault(
+                                (h, lock),
+                                (module, node,
+                                 f"calls {cls_name}.{meth_name}() which "
+                                 f"acquires `{lock}` while holding "
+                                 f"`{h}`"))
+    cyclic = _cycle_nodes(set(edge_sites))
+    for (src, dst), (module, node, why) in sorted(
+            edge_sites.items(), key=lambda kv: (kv[1][0].relpath,
+                                                kv[1][1].lineno)):
+        if src == dst:
+            findings.append(_finding(
+                module, node, RULE_ORDER, _enclosing_callable(node),
+                f"{why} — re-acquiring a held non-reentrant lock "
+                f"self-deadlocks"))
+        elif (src, dst) not in declared:
+            if (dst, src) in declared:
+                detail = (f"contradicts the declared order "
+                          f"`{dst}` < `{src}` — potential deadlock")
+            elif src in cyclic and dst in cyclic:
+                detail = ("closes an acquisition cycle — potential "
+                          "deadlock")
+            else:
+                detail = ("undeclared nesting: add the pair to "
+                          "contract.race.lock_order or restructure to "
+                          "release first")
+            findings.append(_finding(
+                module, node, RULE_ORDER, _enclosing_callable(node),
+                f"{why} — {detail}"))
+    return findings
+
+
+# -- blocking-under-lock ------------------------------------------------------
+
+def _bounded_call(call: ast.Call) -> bool:
+    """True when a timeout/block/blocking keyword actually BOUNDS the
+    call: ``timeout=`` anything but a literal None, or ``block=False`` /
+    ``blocking=False``. An explicit ``timeout=None`` or ``block=True``
+    spells the unbounded default out loud — still a finding."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            if not (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None):
+                return True
+        elif kw.arg in ("block", "blocking"):
+            if isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return True
+    return False
+
+
+def _blocking_kind(module: Module, call: ast.Call) -> Optional[str]:
+    """Why this call blocks unboundedly, or None."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        a = f.attr
+        if a == "block_until_ready":
+            return ".block_until_ready()"
+        if _bounded_call(call):
+            return None
+        if a == "result" and not call.args:
+            return ".result() with no timeout"
+        if a == "join" and not call.args:
+            return ".join() with no timeout"
+        if a == "wait" and not call.args:
+            return ".wait() with no timeout"
+        if a == "get" and not call.args:
+            return "blocking .get() with no timeout"
+        if a == "put" and len(call.args) == 1:
+            return "blocking .put() with no timeout"
+        if a == "acquire" and not call.args:
+            return "blocking .acquire() with no timeout"
+    d = resolved_dotted(module, f)
+    if d in _BLOCKING_FUNCS:
+        return _BLOCKING_FUNCS[d]
+    if d is not None and d.startswith(_BLOCKING_PREFIXES):
+        return f"network call {d}(...)"
+    return None
+
+
+def check_blocking(modules: Sequence[Module], contract) -> List[Finding]:
+    findings: List[Finding] = []
+    hot = set(contract.race.hot_locks)
+    if not hot:
+        return findings
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _blocking_kind(module, node)
+            if kind is None:
+                continue
+            held_hot = [h for h in _held_locks(node, module, contract)
+                        if h in hot]
+            if not held_hot:
+                continue
+            findings.append(_finding(
+                module, node, RULE_BLOCK, _enclosing_callable(node),
+                f"{kind} under hot lock `{held_hot[-1]}` — every thread "
+                f"serializing on that lock stalls behind this call"))
+    return findings
+
+
+# -- guarded-read -------------------------------------------------------------
+
+def _holds_lock_scoped(node: ast.AST, lock_names: Set[str]) -> bool:
+    """Like ``threads._holds_lock`` but stops at function boundaries —
+    a deferred callback defined under ``with <lock>`` runs unlocked."""
+    child: ast.AST = node
+    cur = getattr(node, "_shai_parent", None)
+    while cur is not None:
+        if isinstance(cur, _FUNC_NODES):
+            return False
+        if isinstance(cur, (ast.With, ast.AsyncWith)) \
+                and not isinstance(child, ast.withitem):
+            for item in cur.items:
+                if dotted(item.context_expr) in lock_names:
+                    return True
+        child = cur
+        cur = getattr(cur, "_shai_parent", None)
+    return False
+
+
+def _is_mutator_receiver(attr_node: ast.AST) -> bool:
+    """True when the load is the receiver of a mutator call
+    (``self._x.append(...)``) — that's a WRITE site, owned by the
+    ``thread`` rule."""
+    parent = getattr(attr_node, "_shai_parent", None)
+    if not isinstance(parent, ast.Attribute) or parent.value is not attr_node:
+        return False
+    gp = getattr(parent, "_shai_parent", None)
+    return isinstance(gp, ast.Call) and gp.func is parent \
+        and parent.attr in _MUTATORS
+
+
+def _is_store_base(attr_node: ast.AST) -> bool:
+    """True when the load is the base of a subscript STORE/DELETE
+    (``self._x[k] = v`` / ``del self._x[k]``) — write sites."""
+    parent = getattr(attr_node, "_shai_parent", None)
+    return isinstance(parent, ast.Subscript) \
+        and parent.value is attr_node \
+        and isinstance(parent.ctx, (ast.Store, ast.Del))
+
+
+def _holds(node: ast.AST, module: Module, contract, lock_id: str) -> bool:
+    return lock_id in _held_locks(node, module, contract)
+
+
+def check_guarded_reads(modules: Sequence[Module], contract
+                        ) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in modules:
+        # 1) in-class reads of declared lock-guarded attrs
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef) \
+                    or cls.name not in contract.thread_contract:
+                continue
+            policy = contract.thread_contract[cls.name]
+            if not policy.lock_guarded:
+                continue
+            seen: Set[Tuple[int, str]] = set()
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if meth.name in policy.init_methods:
+                    continue
+                for n in ast.walk(meth):
+                    if not (isinstance(n, ast.Attribute)
+                            and isinstance(n.ctx, ast.Load)
+                            and isinstance(n.value, ast.Name)
+                            and n.value.id == "self"
+                            and n.attr in policy.lock_guarded):
+                        continue
+                    if _is_mutator_receiver(n) or _is_store_base(n):
+                        continue
+                    lock = policy.lock_guarded[n.attr]
+                    if _holds(n, module, contract,
+                              f"{cls.name}.{lock}"):
+                        continue
+                    stmt = n
+                    while not isinstance(stmt, ast.stmt) \
+                            and getattr(stmt, "_shai_parent", None) \
+                            is not None:
+                        stmt = stmt._shai_parent  # type: ignore
+                    if (stmt.lineno, n.attr) in seen:
+                        continue  # two loads in one statement: one finding
+                    seen.add((stmt.lineno, n.attr))
+                    findings.append(_finding(
+                        module, stmt, RULE_READ,
+                        f"{cls.name}.{meth.name}",
+                        f"read of lock-guarded attr `{n.attr}` outside "
+                        f"`with self.{lock}` — a concurrent writer can "
+                        f"tear this snapshot"))
+        # 2) marker-resolved reads from non-owning modules
+        for cls_name, policy in contract.thread_contract.items():
+            if not policy.lock_guarded or not policy.instance_markers:
+                continue
+            if module.relpath in policy.owning_modules:
+                continue
+            for n in ast.walk(module.tree):
+                if not (isinstance(n, ast.Attribute)
+                        and isinstance(n.ctx, ast.Load)
+                        and n.attr in policy.lock_guarded):
+                    continue
+                d = dotted(n)
+                if d is None or d.startswith("self.") \
+                        or not _matches_marker(d, policy.instance_markers):
+                    continue
+                if _is_mutator_receiver(n) or _is_store_base(n):
+                    continue
+                findings.append(_finding(
+                    module, n, RULE_READ, _enclosing_callable(n),
+                    f"read of `{d}` — {cls_name}.{n.attr} is "
+                    f"lock-guarded; read it through a snapshot method, "
+                    f"not directly across threads"))
+        # 3) guarded closure dicts (the dict_guards write rule's read twin)
+        guards = contract.dict_guards.get(module.relpath, {})
+        for n in ast.walk(module.tree):
+            if not (isinstance(n, ast.Subscript)
+                    and isinstance(n.ctx, ast.Load)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id in guards):
+                continue
+            keys, lock = guards[n.value.id]
+            key = n.slice
+            if not (isinstance(key, ast.Constant) and key.value in keys):
+                continue
+            mod_locks = contract.race.module_locks.get(module.relpath, {})
+            lock_ids = {lock, mod_locks.get(lock, lock)}
+            if set(_held_locks(n, module, contract)) & lock_ids:
+                continue
+            # fall back to a lexical check on the raw lock name (the
+            # closure lock may not be a declared race lock) — function-
+            # boundary-aware like _held_locks
+            if _holds_lock_scoped(n, {lock}):
+                continue
+            findings.append(_finding(
+                module, n, RULE_READ, _enclosing_callable(n),
+                f"read of `{n.value.id}[\"{key.value}\"]` outside "
+                f"`with {lock}` — a concurrent writer can tear this "
+                f"snapshot"))
+    return findings
+
+
+# -- runner -------------------------------------------------------------------
+
+def run_race(modules: Optional[List[Module]] = None,
+             contract=None) -> List[Finding]:
+    """Run the three race rules; returns ALL findings (allowed included,
+    flagged), sorted like :func:`core.run_all`."""
+    from .contract import DEFAULT_CONTRACT
+    from .core import iter_modules
+
+    contract = contract or DEFAULT_CONTRACT
+    if modules is None:
+        modules = iter_modules()
+    findings: List[Finding] = []
+    findings += check_lock_order(modules, contract)
+    findings += check_blocking(modules, contract)
+    findings += check_guarded_reads(modules, contract)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings

@@ -156,19 +156,24 @@ class EngineLoop:
                 return
 
     def _fail_all(self, err: Exception) -> None:
-        """Fail every queued and in-flight future (loop death / stop)."""
+        """Fail every queued and in-flight future (loop death / stop).
+        Futures resolve OUTSIDE the lock — set_exception wakes waiters
+        and runs done-callbacks inline, and the futures table lock must
+        never be held across foreign code (same discipline as the happy
+        path in ``_run``; shai-race lock-order contract)."""
+        pending: List[Future] = []
         with self._futures_lock:
             while True:
                 try:
                     *_, fut = self._submit_q.get_nowait()
                 except queue.Empty:
                     break
-                if not fut.done():
-                    fut.set_exception(err)
-            for fut in self._futures.values():
-                if not fut.done():
-                    fut.set_exception(err)
+                pending.append(fut)
+            pending.extend(self._futures.values())
             self._futures.clear()
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(err)
 
     def _drain_cancels(self) -> None:
         while True:

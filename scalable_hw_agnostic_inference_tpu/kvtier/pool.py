@@ -32,12 +32,17 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 log = logging.getLogger(__name__)
+
+#: copy-out queue sentinel: the worker exits after draining everything
+#: enqueued before it (bounded shutdown, the SIGTERM path)
+_STOP = object()
 
 #: default host pool capacity (SHAI_KVTIER_BYTES): 256 MiB — a few
 #: thousand blocks at typical small-model geometry; production tiers size
@@ -81,26 +86,77 @@ class CopyOutWorker:
                  max_queue: int = COPYOUT_QUEUE_DEPTH):
         self._pool = pool
         self._q: "queue.Queue[Tuple]" = queue.Queue(max_queue)
+        self._closed = threading.Event()
+        # serializes submit vs close: a batch must never land BEHIND the
+        # shutdown sentinel (it would leak unprocessed with a True return
+        # and wedge a later drain()'s q.join())
+        self._sub_lock = threading.Lock()
+        self._stop_sent = False
         self._thread = threading.Thread(
             target=self._run, name="shai-kvtier-copyout", daemon=True)
         self._thread.start()
 
     def submit(self, item: Tuple) -> bool:
-        """Enqueue one demotion batch; False = queue full (caller counts
-        the drop — the tier never backpressures the engine)."""
-        try:
-            self._q.put_nowait(item)
-            return True
-        except queue.Full:
-            return False
+        """Enqueue one demotion batch; False = queue full or worker closed
+        (caller counts the drop — the tier never backpressures the
+        engine)."""
+        with self._sub_lock:
+            if self._closed.is_set():
+                return False
+            try:
+                self._q.put_nowait(item)
+                return True
+            except queue.Full:
+                return False
 
     def drain(self) -> None:
         """Block until every enqueued batch is published (tests/bench)."""
         self._q.join()
 
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self, timeout: float = 5.0) -> bool:
+        """Bounded shutdown (SIGTERM/drain): refuse new batches, let the
+        in-flight + queued demotions publish, then JOIN the worker thread
+        within ``timeout`` seconds. True = the thread exited (no orphaned
+        device->host copy runs past the drain); False = the budget
+        expired with a copy still in flight (the caller logs and lets the
+        daemon thread die with the process). Idempotent: a repeat call
+        never enqueues a second sentinel — it just re-joins."""
+        with self._sub_lock:
+            # after this, submit() refuses — nothing can land behind the
+            # sentinel enqueued below. The sentinel slot is CLAIMED under
+            # the same lock so concurrent close() calls cannot enqueue
+            # two sentinels (the second would never be consumed and a
+            # later drain()'s q.join() would hang); the blocking put
+            # itself happens outside it so a submit() never stalls
+            # behind a wedged-worker close.
+            self._closed.set()
+            send = self._thread.is_alive() and not self._stop_sent
+            if send:
+                self._stop_sent = True
+        deadline = time.monotonic() + max(0.0, timeout)
+        if send:
+            try:
+                self._q.put(_STOP, timeout=max(0.01, timeout))
+            except queue.Full:
+                # the worker is wedged mid-copy with a full queue: give
+                # the sentinel slot back so a LATER close() retries once
+                # there is room; the join below still bounds the wait
+                with self._sub_lock:
+                    self._stop_sent = False
+        self._thread.join(max(0.0, deadline - time.monotonic()))
+        return not self._thread.is_alive()
+
     def _run(self) -> None:
         while True:
-            hashes, arrays, n = self._q.get()
+            item = self._q.get()
+            if item is _STOP:
+                self._q.task_done()
+                return
+            hashes, arrays, n = item
             try:
                 # the blocking device->host transfer the engine thread
                 # never pays: the gather outputs are fresh buffers, valid
@@ -146,6 +202,9 @@ class HostKVTier:
             "restored": 0, "errors": 0, "dropped": 0, "bytes": 0,
         }
         self._worker: Optional[CopyOutWorker] = None
+        #: latched by close(): a post-close demotion must count a drop,
+        #: never lazily spawn a fresh worker past the drain
+        self._closing = False
 
     # -- capacity / accounting ---------------------------------------------
 
@@ -188,10 +247,19 @@ class HostKVTier:
         *arrays, n = arrays_and_n
         arrays = tuple(arrays)
         if self.async_copy:
-            if self._worker is None:
-                # lazy: engines that never demote never spawn the thread
-                self._worker = CopyOutWorker(self)
-            if not self._worker.submit((list(hashes), arrays, n)):
+            with self._lock:
+                if self._closing:
+                    # closed tier: degrade to a counted drop — a late
+                    # demotion must not resurrect the worker thread the
+                    # drain just joined
+                    self._stats["dropped"] += n
+                    return
+                if self._worker is None:
+                    # lazy: engines that never demote never spawn the
+                    # thread
+                    self._worker = CopyOutWorker(self)
+                w = self._worker
+            if not w.submit((list(hashes), arrays, n)):
                 with self._lock:
                     self._stats["dropped"] += n
             return
@@ -214,12 +282,19 @@ class HostKVTier:
                 if self.block_nbytes > self.capacity_bytes:
                     self._stats["dropped"] += 1
                     continue
+            # the contiguous block copy happens OUTSIDE the lock: the
+            # engine thread probes/restores under the same lock, and a
+            # worker-side demotion copy must never stall admission
+            blk = tuple(np.ascontiguousarray(a[:, j]) for a in arrays)
+            with self._lock:
+                if h in self._entries:  # raced publish: keep the LRU touch
+                    self._entries.move_to_end(h)
+                    continue
                 while ((len(self._entries) + 1) * self.block_nbytes
                        > self.capacity_bytes):
                     self._entries.popitem(last=False)
                     self._stats["evictions"] += 1
-                self._entries[h] = tuple(
-                    np.ascontiguousarray(a[:, j]) for a in arrays)
+                self._entries[h] = blk
                 self._stats["stores"] += 1
                 self._stats["bytes"] += self.block_nbytes
 
@@ -228,6 +303,25 @@ class HostKVTier:
         w = self._worker
         if w is not None:
             w.drain()
+
+    def close(self, timeout: float = 5.0) -> bool:
+        """Bounded copy-out shutdown for the SIGTERM/drain path: latch
+        the tier closed (late demotions become counted drops — never a
+        fresh worker), and join the worker thread within ``timeout``
+        (see :meth:`CopyOutWorker.close`). True when no worker exists or
+        it exited inside the budget. Restores/probes keep working — only
+        the demotion side closes."""
+        with self._lock:
+            self._closing = True
+            w = self._worker
+        if w is None:
+            return True
+        ok = w.close(timeout)
+        if not ok:
+            log.warning("kv tier copy-out worker did not exit within "
+                        "%.1fs — an in-flight demotion copy will die "
+                        "with the process", timeout)
+        return ok
 
     # -- restore-side lookups (engine thread) ------------------------------
 

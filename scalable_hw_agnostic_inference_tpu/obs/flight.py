@@ -60,12 +60,13 @@ class FlightRecorder:
         (when an engine feed exists) the recent step records."""
         with self._lock:
             reqs = list(self._requests)
+            total = self._seq
         if n_requests is not None:
             # explicit zero-guard: reqs[-0:] would be the WHOLE list
             reqs = reqs[max(0, len(reqs) - n_requests):] \
                 if n_requests > 0 else []
         out: Dict[str, Any] = {
-            "recorded_total": self._seq,
+            "recorded_total": total,
             "capacity": {"requests": self.max_requests,
                          "steps": self.max_steps},
             "requests": reqs,

@@ -182,14 +182,19 @@ class StepTelemetry:
         tenants keep their label, the table admits new ones up to
         MAX_TENANT_LABELS, overflow collapses into "other"."""
         t = tenant or _DEFAULT_TENANT
+        # shai-lint: allow(guarded-read) caller-holds-lock helper: every
+        # caller enters under `with self._lock`
         if t in self._tenants or len(self._tenants) < MAX_TENANT_LABELS:
             return t
         return _OTHER_TENANT
 
     def _tenant_ent(self, tenant: str) -> Dict[str, float]:
         key = self._tenant_key(tenant)
+        # shai-lint: allow(guarded-read) caller-holds-lock helper:
+        # every caller enters under `with self._lock`
         ent = self._tenants.get(key)
         if ent is None:
+            # shai-lint: allow(thread) caller-holds-lock helper (above)
             ent = self._tenants[key] = {"requests": 0, "waiting": 0,
                                         "running": 0}
         return ent
@@ -218,7 +223,8 @@ class StepTelemetry:
         budget ledger's view in on top)."""
         with self._lock:
             out = {t: dict(ent) for t, ent in self._tenants.items()}
-        for t, h in list(self._tenant_ttft.items()):
+            hists = list(self._tenant_ttft.items())
+        for t, h in hists:
             if t in out:
                 snap = h.snapshot()
                 out[t]["ttft_count"] = snap["count"]
@@ -230,7 +236,9 @@ class StepTelemetry:
     def tenant_histograms(self) -> Dict[str, Dict[str, Any]]:
         """tenant -> TTFT histogram snapshot (Prometheus adapter feed for
         the ``shai_tenant_ttft_seconds`` family)."""
-        return {t: h.snapshot() for t, h in list(self._tenant_ttft.items())}
+        with self._lock:
+            hists = list(self._tenant_ttft.items())
+        return {t: h.snapshot() for t, h in hists}
 
     def count_pad(self, real: int, padded: int) -> None:
         """One dispatch's token-slot accounting: ``real`` context/prompt

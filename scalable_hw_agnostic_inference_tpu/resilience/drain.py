@@ -41,6 +41,13 @@ class DrainController:
 
     @property
     def draining(self) -> bool:
+        # shai-lint: allow(guarded-read) deliberately LOCK-FREE: this
+        # property runs on the main thread (readiness/admission handlers
+        # on the event loop), and the SIGTERM handler — which also runs
+        # on the main thread, between bytecodes — takes _lock via
+        # begin(); a locked read here could self-deadlock the signal
+        # handler against its own thread. A GIL-atomic is-None check of
+        # a single reference cannot tear.
         return self._started_at is not None
 
     def begin(self) -> bool:

@@ -379,8 +379,11 @@ class TenantLedger:
         full — unless it carries its OWN configured budget, which must
         stay enforceable no matter how many anonymous tenants showed up."""
         t = sanitize_tenant(tenant) or DEFAULT_TENANT
+        # shai-lint: allow(guarded-read) caller-holds-lock helper: every
+        # caller enters under `with self._lock`
         if t in self._stats or t in self.budgets:
             return t
+        # shai-lint: allow(guarded-read) caller-holds-lock helper (above)
         if len(self._stats) >= self.max_tenants:
             return OTHER_TENANT
         return t
@@ -391,6 +394,8 @@ class TenantLedger:
     def _bucket(self, key: str, budget: TenantBudget,
                 now: float) -> Dict[str, float]:
         """Refilled bucket state for ``key`` (callers hold ``_lock``)."""
+        # shai-lint: allow(guarded-read) caller-holds-lock helper: every
+        # caller (admit/charge/snapshot) enters under `with self._lock`
         b = self._buckets.get(key)
         if b is None:
             # shai-lint: allow(thread) caller-holds-lock helper: every
@@ -404,6 +409,9 @@ class TenantLedger:
         return b
 
     def _stat(self, key: str) -> Dict[str, float]:
+        # shai-lint: allow(guarded-read) caller-holds-lock helper: every
+        # caller (admit/charge/note_*/label_of) enters under
+        # `with self._lock`
         s = self._stats.get(key)
         if s is None:
             # shai-lint: allow(thread) caller-holds-lock helper: every

@@ -293,16 +293,24 @@ def create_app(
         except Exception:
             return None
 
+    def _inflight_counts() -> Tuple[int, int]:
+        """One locked read of (inflight, lane_pending) — the lock is
+        RELEASED before the gate/ledger run so the in-flight counters
+        never nest with another lock (shai-race lock-order contract)."""
+        with inflight_lock:
+            return state["inflight"], state["lane_pending"]
+
     def _admit(tenant: str = ""):
         """Bounded admission: shed (429/503 + Retry-After) BEFORE the
         request parks a lane thread or enters the engine queue. ``tenant``
         is the ledger-bounded label — per-tenant budgets/caps shed here
         with a budget-derived Retry-After, and every shed is attributed
         per tenant on ``shai_shed_total``."""
-        shed = gate.check(_engine_snapshot(), inflight=state["inflight"],
+        inflight, lane_pending = _inflight_counts()
+        shed = gate.check(_engine_snapshot(), inflight=inflight,
                           draining=drainer.draining,
                           lane_width=max(1, service.concurrency),
-                          lane_pending=state["lane_pending"],
+                          lane_pending=lane_pending,
                           tenant=tenant)
         if shed is not None:
             pub.count_shed(shed.reason, tenant)
@@ -427,10 +435,10 @@ def create_app(
                     cfg.app, drainer.budget_s)
 
         def _work():
-            clean = drainer.wait(lambda: state["inflight"] == 0)
+            clean = drainer.wait(lambda: _inflight_counts()[0] == 0)
             if not clean:
                 log.warning("%s: drain budget expired with %d requests "
-                            "in flight", cfg.app, state["inflight"])
+                            "in flight", cfg.app, _inflight_counts()[0])
             try:
                 service.drain(max(0.0, drainer.remaining_s))
             except Exception:
@@ -553,12 +561,13 @@ def create_app(
 
     @app.get("/stats")
     def stats(request: Request):
+        inflight, lane_pending = _inflight_counts()
         out = {
             "served": pub.served,
             "latency": collector.report(),
             "count": collector.count,
-            "inflight": state["inflight"],
-            "lane_pending": state["lane_pending"],
+            "inflight": inflight,
+            "lane_pending": lane_pending,
             "draining": drainer.draining,
         }
         if gate.shed_total:

@@ -288,9 +288,19 @@ class VllmService(ModelService):
         """SIGTERM: let queued + running engine requests finish within the
         budget, then stop the loop (outstanding futures fail on the way
         out rather than hanging past the pod's grace period)."""
+        import time as _time
+
+        t0 = _time.monotonic()
         loop = getattr(self, "loop", None)
         if loop is not None:
             loop.drain(budget_s)
+        # bounded copy-out join: an in-flight KV demotion copy publishes
+        # (or is abandoned, logged) INSIDE the grace period instead of the
+        # daemon thread being orphaned until SIGKILL mid-transfer
+        eng = getattr(self, "_engine", None)
+        tier = getattr(getattr(eng, "cache", None), "tier", None)
+        if tier is not None:
+            tier.close(max(0.5, budget_s - (_time.monotonic() - t0)))
 
     def engine_telemetry(self):
         eng = getattr(self, "_engine", None)

@@ -5,11 +5,16 @@ Runs, in order (each in its own subprocess so one crash cannot mask the
 rest):
 
 1. ``scripts/shai_lint.py``            AST invariant checkers (~1.5s)
-2. ``scripts/shai_lint.py --ir``       jaxpr-lint IR pass (lowers the
+2. ``scripts/shai_lint.py --race``     shai-race concurrency pass
+                                       (lock-order, blocking-under-lock,
+                                       guarded-read; ~1.5s — rule-aware
+                                       staleness: a race run touches only
+                                       race-rule baseline entries)
+3. ``scripts/shai_lint.py --ir``       jaxpr-lint IR pass (lowers the
                                        registered executable factories
                                        on virtual CPU devices, ~10s)
-3. ``scripts/check_metrics_docs.py``   every shai_* metric documented
-4. ``scripts/check_tier1_budget.py``   tier-1 selection inside budget
+4. ``scripts/check_metrics_docs.py``   every shai_* metric documented
+5. ``scripts/check_tier1_budget.py``   tier-1 selection inside budget
 
 Exit code is the MAX of the individual codes, so the 0/1/2 contract of
 shai-lint survives aggregation (1 = findings somewhere, 2 = an internal
@@ -35,6 +40,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CHECKS = (
     ("shai-lint (AST)", ["scripts/shai_lint.py"], True),
+    ("shai-race", ["scripts/shai_lint.py", "--race"], True),
     ("jaxpr-lint (IR)", ["scripts/shai_lint.py", "--ir"], False),
     ("metrics docs", ["scripts/check_metrics_docs.py"], True),
     ("tier-1 budget", ["scripts/check_tier1_budget.py"], False),

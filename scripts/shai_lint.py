@@ -11,6 +11,17 @@ stdlib-only, whole tree in ~1.5s):
                      registry rules (deploy/ manifests included)
 - ``trace-exclude``  debug/poll GET routes must stay off the flight ring
 
+Race checkers (``--race``; ``analysis/race.py`` — stdlib-only like the
+AST pass, but a separate pass with its own baseline bookkeeping):
+
+- ``lock-order``           lock-acquisition graph (lexical ``with``
+                           nesting + 2-level call propagation) vs the
+                           declared partial order; cycles/inversions
+- ``blocking-under-lock``  unbounded blocking calls under declared hot
+                           locks
+- ``guarded-read``         lock-guarded attrs must be READ under their
+                           lock too (torn multi-field snapshots)
+
 IR checkers (``--ir``; ``analysis/ir/`` — lowers and, where cheap,
 compiles the registered executable factories on virtual CPU devices):
 
@@ -36,6 +47,7 @@ Staleness is judged only against the rules the invocation actually ran
 (an AST-only run never calls IR debt stale). Refresh with::
 
     python scripts/shai_lint.py --update-baseline          # AST rules
+    python scripts/shai_lint.py --race --update-baseline   # race rules
     python scripts/shai_lint.py --ir --update-baseline     # IR rules
 
 Intentional violations are annotated in source, not baselined::
@@ -50,6 +62,9 @@ Usage::
     python scripts/shai_lint.py                  # AST, human output
     python scripts/shai_lint.py --json           # machine output
     python scripts/shai_lint.py --changed        # only git-changed files
+    python scripts/shai_lint.py --race           # the race pass
+    python scripts/shai_lint.py --race --changed # race findings on diffed
+                                                 # files (whole-tree graph)
     python scripts/shai_lint.py --ir             # the IR pass (needs jax)
     python scripts/shai_lint.py --ir --keys decode,decode_feedback
     python scripts/shai_lint.py --rule env-doc
@@ -77,6 +92,11 @@ from scalable_hw_agnostic_inference_tpu.analysis import (  # noqa: E402
 
 AST_RULES = ("host-sync", "donation", "thread", "env-parse", "env-read",
              "env-doc", "env-deploy", "trace-exclude")
+# the race pass's rule names come from the pass itself — a hand copy here
+# would silently corrupt baseline staleness when a rule is added/renamed
+from scalable_hw_agnostic_inference_tpu.analysis.race import (  # noqa: E402
+    RACE_RULES,
+)
 
 
 def _changed_relpaths() -> set:
@@ -118,6 +138,19 @@ def _run_ast(args) -> list:
     return [f for f in findings if f.path in changed]
 
 
+def _run_race(args) -> list:
+    from scalable_hw_agnostic_inference_tpu.analysis.race import run_race
+
+    findings = run_race()
+    if not args.changed:
+        return findings
+    # lock-order is a whole-graph property (an inversion pairs two files),
+    # so --changed always builds the graph from the FULL tree and only
+    # scopes the REPORT to the diffed files
+    changed = _changed_relpaths()
+    return [f for f in findings if f.path in changed]
+
+
 def _run_ir(args) -> list:
     # the IR pass needs a CPU backend with virtual devices for the
     # @tp2/@sp2 legs — force it BEFORE jax initializes, plus the live
@@ -150,6 +183,10 @@ def main() -> int:
                     help="emit one JSON object instead of human text")
     ap.add_argument("--rule", action="append", default=None,
                     help="only run/report these rule names (repeatable)")
+    ap.add_argument("--race", action="store_true",
+                    help="run the race pass (shai-race) instead of the "
+                         "AST pass: lock-order, blocking-under-lock, "
+                         "guarded-read (stdlib-only, own baseline rules)")
     ap.add_argument("--ir", action="store_true",
                     help="run the IR (jaxpr-lint) pass instead of the "
                          "AST pass — lowers the registered executable "
@@ -158,9 +195,10 @@ def main() -> int:
                     help="--ir only: comma-separated program keys to "
                          "build (default: every registered program)")
     ap.add_argument("--changed", action="store_true",
-                    help="AST only: lint just the files git reports "
-                         "changed vs HEAD (pre-commit speed; staleness "
-                         "reporting is skipped)")
+                    help="AST/race passes: report only findings in files "
+                         "git reports changed vs HEAD (pre-commit speed; "
+                         "staleness reporting is skipped; the race pass "
+                         "still builds its graph from the whole tree)")
     ap.add_argument("--baseline", default=lint_core.BASELINE_PATH,
                     help="findings baseline file")
     ap.add_argument("--update-baseline", action="store_true",
@@ -169,8 +207,13 @@ def main() -> int:
     ap.add_argument("--show-allowed", action="store_true",
                     help="also list allow-annotated findings")
     args = ap.parse_args()
+    if args.race and args.ir:
+        print("--race and --ir are separate passes; run one at a time",
+              file=sys.stderr)
+        return 2
     if args.changed and args.ir:
-        print("--changed applies to the AST pass only", file=sys.stderr)
+        print("--changed applies to the AST and race passes only",
+              file=sys.stderr)
         return 2
     if args.update_baseline and (args.changed or args.keys):
         # a partial view (changed files / a key subset) cannot be allowed
@@ -182,7 +225,9 @@ def main() -> int:
 
     t0 = time.perf_counter()
     try:
-        findings = _run_ir(args) if args.ir else _run_ast(args)
+        findings = (_run_ir(args) if args.ir
+                    else _run_race(args) if args.race
+                    else _run_ast(args))
         baseline = set(lint_core.load_baseline(args.baseline))
     except (OSError, SyntaxError, ValueError, KeyError, RuntimeError) as e:
         # ValueError covers json.JSONDecodeError from a corrupt baseline —
@@ -196,6 +241,8 @@ def main() -> int:
         from scalable_hw_agnostic_inference_tpu.analysis.ir import IR_RULES
 
         own_rules = set(IR_RULES)
+    elif args.race:
+        own_rules = set(RACE_RULES)
     else:
         own_rules = set(AST_RULES)
     all_live = [f for f in findings if not f.allowed]
@@ -225,7 +272,7 @@ def main() -> int:
 
     if args.json:
         print(json.dumps({
-            "pass": "ir" if args.ir else "ast",
+            "pass": "ir" if args.ir else "race" if args.race else "ast",
             "new": [f.to_dict() for f in new],
             "baselined": [f.to_dict() for f in baselined],
             "allowed": [f.to_dict() for f in allowed],
@@ -234,7 +281,8 @@ def main() -> int:
         }, indent=1, sort_keys=True))
         return 1 if new else 0
 
-    what = "jaxpr-lint (IR)" if args.ir else "shai-lint"
+    what = ("jaxpr-lint (IR)" if args.ir
+            else "shai-race" if args.race else "shai-lint")
     print(f"{what}: {len(findings)} finding(s) in {dt:.2f}s "
           f"({len(new)} new, {len(baselined)} baselined, "
           f"{len(allowed)} allow-annotated)")

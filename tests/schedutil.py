@@ -1,0 +1,542 @@
+"""Deterministic cooperative interleaving harness — shai-race's dynamic twin.
+
+The static pass (``analysis/race.py``) checks the declared lock tables
+against the *source*; this harness checks them against *execution*: real
+threads run the real seams (``EngineLoop``, ``CopyOutWorker``,
+``TenantLedger``, ``HostKVTier``), but exactly ONE thread runs at a time
+and the run-token is handed off at every instrumented seam (lock
+acquire/release, queue get/put, event wait/set) according to a seeded or
+boundary policy. The same ``(policy, seed)`` replays the same
+interleaving bit-for-bit, so a fuzz failure is a repro, not a flake.
+
+Pieces:
+
+- :class:`Scheduler` — spawns managed threads, owns the run-token,
+  records the event trace, detects deadlock (every live thread
+  hard-blocked) and runaway schedules (event cap), and aborts all
+  threads cleanly on failure.
+- :class:`TracedLock` / :class:`TracedQueue` / :class:`TracedEvent` —
+  cooperative stand-ins instrumented with yield points. They are
+  VIRTUAL: mutual exclusion comes from the scheduler token itself, so a
+  deadlock is detected and reported instead of hanging real threads.
+  Instances are swapped onto the objects under test after construction
+  (``loop._futures_lock = TracedLock(...)``) — the production code runs
+  unmodified.
+- lock-nesting witness: the scheduler tracks each thread's held-lock
+  stack and records every nested acquisition as an ``(outer, inner)``
+  edge — the dynamic mirror of ``contract.race.lock_order`` (the
+  committed contract declares NO nesting, so tests assert the edge set
+  stays empty).
+
+Scheduling policies: ``random`` (seeded uniform pick among runnable
+threads — the fuzz mode), ``stay`` (run the current thread until it
+blocks — coarse, GIL-like), ``switch`` (rotate on every event — maximal
+interleaving). ``stay``/``switch`` are the boundary schedules; seeds
+explore the middle.
+
+Timeouts on traced primitives are VIRTUAL: a bounded wait yields a fixed
+number of rounds then raises (``queue.Empty`` etc.) instead of sleeping,
+so an interleaving run never waits on wall time.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import random
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: yields a bounded (timeout != None) wait burns before giving up —
+#: virtual time: big enough to let other threads run, small enough that
+#: polls terminate fast
+TIMEOUT_ROUNDS = 3
+
+
+def _rounds_for(timeout: Optional[float]) -> int:
+    """Virtual rounds a bounded wait is worth: ~20 yields per requested
+    second, floored at TIMEOUT_ROUNDS (snappy sub-second polls), capped
+    so a generous budget cannot eat the event cap."""
+    if timeout is None:
+        return TIMEOUT_ROUNDS
+    return max(TIMEOUT_ROUNDS, min(500, int(timeout * 20)))
+
+
+class DeadlockError(AssertionError):
+    """Every live managed thread is hard-blocked on a traced primitive."""
+
+
+class ScheduleExhausted(AssertionError):
+    """The event cap tripped — a livelock or runaway schedule."""
+
+
+class _Abort(BaseException):
+    """Internal: unwind a managed thread after the scheduler failed.
+    BaseException so production ``except Exception`` blocks don't eat it."""
+
+
+class _ThreadState:
+    def __init__(self, name: str):
+        self.name = name
+        self.thread: Optional[threading.Thread] = None
+        self.done = False
+        #: hard-block key (resource identity) or None = runnable
+        self.blocked: Optional[Tuple] = None
+        self.held: List[str] = []      # lock names, acquisition order
+
+
+class _Handle:
+    """Thread-object stand-in for code that joins its worker
+    (``EngineLoop.stop``, ``CopyOutWorker.close``)."""
+
+    def __init__(self, sched: "Scheduler", name: str):
+        self._sched = sched
+        self.name = name
+
+    def start(self) -> None:  # EngineLoop.start() compatibility
+        return None
+
+    def is_alive(self) -> bool:
+        return not self._sched.is_done(self.name)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._sched.join_thread(self.name, timeout)
+
+
+class Scheduler:
+    def __init__(self, seed: int = 0, policy: str = "random",
+                 max_events: int = 60_000):
+        assert policy in ("random", "stay", "switch"), policy
+        self.policy = policy
+        self.seed = seed
+        self.max_events = max_events
+        self._rng = random.Random(seed)
+        self._cv = threading.Condition()
+        self._threads: Dict[str, _ThreadState] = {}
+        self._order: List[str] = []
+        self._current: Optional[str] = None
+        self._abort = False
+        self.failure: Optional[BaseException] = None
+        self.n_events = 0
+        self.trace: List[Tuple[str, str]] = []
+        #: "stay" policy: forced rotation after this many consecutive
+        #: events from one thread — coarse granularity without letting a
+        #: polling loop starve everyone else into a livelock
+        self.stay_burst = 40
+        self._stay_run = 0
+        #: observed nested lock acquisitions: (outer, inner) pairs — the
+        #: dynamic twin of contract.race.lock_order
+        self.nesting_edges: set = set()
+        self._tls = threading.local()
+
+    # -- managed-thread plumbing -------------------------------------------
+
+    def _state(self) -> Optional[_ThreadState]:
+        return getattr(self._tls, "state", None)
+
+    def current_name(self) -> Optional[str]:
+        st = self._state()
+        return st.name if st is not None else None
+
+    def is_done(self, name: str) -> bool:
+        with self._cv:
+            st = self._threads.get(name)
+            return st is None or st.done
+
+    def handle(self, name: str) -> _Handle:
+        return _Handle(self, name)
+
+    def spawn(self, name: str, fn: Callable[[], Any]) -> _Handle:
+        assert name not in self._threads, f"duplicate thread {name!r}"
+        st = _ThreadState(name)
+        self._threads[name] = st
+        self._order.append(name)
+
+        def body():
+            self._tls.state = st
+            with self._cv:
+                while self._current != name and not self._abort:
+                    self._cv.wait(1.0)
+            try:
+                if not self._abort:
+                    fn()
+            except _Abort:
+                pass
+            except BaseException as e:  # noqa: BLE001 — reported via run()
+                self._fail(e)
+            finally:
+                with self._cv:
+                    st.done = True
+                    st.blocked = None
+                    self._unblock_locked(("join", name))
+                    nxt = self._pick_locked(None)
+                    live = [s for s in self._threads.values()
+                            if not s.done]
+                    if nxt is None and live and self.failure is None:
+                        # the exiting thread leaves everyone hard-blocked
+                        self.failure = DeadlockError(
+                            f"deadlock after {name} exited: " + "; ".join(
+                                f"{s.name} blocked on {s.blocked}"
+                                for s in live))
+                        self._abort = True
+                    self._current = nxt.name if nxt is not None else None
+                    self._cv.notify_all()
+
+        st.thread = threading.Thread(target=body, name=f"sched-{name}",
+                                     daemon=True)
+        return _Handle(self, name)
+
+    def run(self, wall_timeout_s: float = 30.0) -> None:
+        """Start every spawned thread, run the schedule to completion,
+        re-raise the first failure (DeadlockError, ScheduleExhausted, or
+        an exception escaping a managed thread)."""
+        for name in self._order:
+            self._threads[name].thread.start()
+        with self._cv:
+            first = self._pick_locked(None)
+            self._current = first.name if first is not None else None
+            self._cv.notify_all()
+        import time as _time
+
+        deadline = _time.monotonic() + wall_timeout_s
+        for name in self._order:
+            t = self._threads[name].thread
+            t.join(max(0.1, deadline - _time.monotonic()))
+        stuck = [n for n in self._order
+                 if self._threads[n].thread.is_alive()]
+        if stuck and self.failure is None:
+            with self._cv:
+                self._abort = True
+                self._cv.notify_all()
+            raise AssertionError(
+                f"harness wall-timeout with threads alive: {stuck}; "
+                f"last events: {self.trace[-30:]}")
+        if self.failure is not None:
+            raise self.failure
+
+    # -- failure / unblock helpers (callers hold _cv unless noted) ---------
+
+    def _fail(self, err: BaseException) -> None:
+        with self._cv:
+            if self.failure is None:
+                self.failure = err
+            self._abort = True
+            self._cv.notify_all()
+
+    def _unblock_locked(self, key: Tuple) -> None:
+        for st in self._threads.values():
+            if st.blocked == key:
+                st.blocked = None
+
+    def unblock(self, key: Tuple) -> None:
+        with self._cv:
+            self._unblock_locked(key)
+
+    def _pick_locked(self, me: Optional[_ThreadState]
+                     ) -> Optional[_ThreadState]:
+        runnable = [self._threads[n] for n in self._order
+                    if not self._threads[n].done
+                    and self._threads[n].blocked is None]
+        if not runnable:
+            return None
+        if self.policy == "stay" and me is not None and me in runnable:
+            self._stay_run += 1
+            if self._stay_run <= self.stay_burst or len(runnable) == 1:
+                return me
+            self._stay_run = 0
+            others = [s for s in runnable if s is not me]
+            return others[0]
+        if self.policy == "switch":
+            others = [s for s in runnable if s is not me]
+            if others:
+                if me is not None and self._current == me.name:
+                    # rotate: the runnable after me in spawn order
+                    idx = self._order.index(me.name)
+                    ordered = sorted(
+                        others, key=lambda s:
+                        (self._order.index(s.name) - idx) % len(self._order))
+                    return ordered[0]
+                return others[0]
+            return runnable[0]
+        return self._rng.choice(runnable)
+
+    # -- the yield point ----------------------------------------------------
+
+    def yield_point(self, tag: str,
+                    blocked: Optional[Tuple] = None) -> None:
+        """Hand the run-token to the next thread per policy. ``blocked``
+        marks this thread hard-blocked on a resource key until
+        :meth:`unblock` — used for deadlock detection."""
+        st = self._state()
+        if st is None:
+            return  # unmanaged thread (the test runner): pass through
+        with self._cv:
+            if self._abort:
+                raise _Abort()
+            self.n_events += 1
+            self.trace.append((st.name, tag))
+            if self.n_events > self.max_events:
+                err = ScheduleExhausted(
+                    f"{self.n_events} events (policy={self.policy}, "
+                    f"seed={self.seed}) — livelock? last: "
+                    f"{self.trace[-30:]}")
+                self.failure = self.failure or err
+                self._abort = True
+                self._cv.notify_all()
+                raise _Abort()
+            st.blocked = blocked
+            live = [s for s in self._threads.values() if not s.done]
+            if live and all(s.blocked is not None for s in live):
+                dump = "; ".join(
+                    f"{s.name}: blocked on {s.blocked[0]}:"
+                    f"{s.blocked[1] if len(s.blocked) > 1 else ''} "
+                    f"holding {s.held or '[]'}" for s in live)
+                err = DeadlockError(
+                    f"deadlock (policy={self.policy}, seed={self.seed}): "
+                    f"{dump}")
+                self.failure = self.failure or err
+                self._abort = True
+                self._cv.notify_all()
+                raise _Abort()
+            nxt = self._pick_locked(st if blocked is None else None)
+            if nxt is not None and nxt is not st:
+                self._current = nxt.name
+                self._cv.notify_all()
+            while self._current != st.name:
+                self._cv.wait(1.0)
+                if self._abort:
+                    raise _Abort()
+
+    def join_thread(self, name: str, timeout: Optional[float]) -> None:
+        rounds = 0
+        budget = _rounds_for(timeout)
+        while not self.is_done(name):
+            if timeout is not None:
+                rounds += 1
+                if rounds > budget:
+                    return
+                self.yield_point(f"join-poll:{name}")
+            else:
+                self.yield_point(f"join:{name}", blocked=("join", name))
+
+    # -- nesting witness ----------------------------------------------------
+
+    def note_attempt(self, lock_name: str) -> None:
+        """Record the nesting edge at the acquisition ATTEMPT — a
+        deadlocked attempt never completes, and it is exactly the edge
+        the witness exists to catch."""
+        st = self._state()
+        if st is not None and st.held:
+            self.nesting_edges.add((st.held[-1], lock_name))
+
+    def note_acquired(self, lock_name: str) -> None:
+        st = self._state()
+        if st is not None:
+            st.held.append(lock_name)
+
+    def note_released(self, lock_name: str) -> None:
+        st = self._state()
+        if st is not None and lock_name in st.held:
+            st.held.remove(lock_name)
+
+
+# -- traced primitives --------------------------------------------------------
+
+class TracedLock:
+    """Cooperative lock: exclusion is provided by the scheduler token, so
+    a cyclic wait is *reported* (DeadlockError) instead of hanging."""
+
+    def __init__(self, sched: Scheduler, name: str):
+        self._sched = sched
+        self.name = name
+        self.owner: Optional[str] = None
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        sched = self._sched
+        me = sched.current_name()
+        if me is None:
+            # unmanaged thread (the test runner, post-run assertions):
+            # no managed thread is running concurrently, so take it flat
+            assert self.owner is None, \
+                f"unmanaged acquire of held lock {self.name!r}"
+            self.owner = "<unmanaged>"
+            return True
+        sched.note_attempt(self.name)
+        sched.yield_point(f"acquire:{self.name}")
+        while self.owner is not None:
+            if not blocking:
+                return False
+            sched.yield_point(f"blocked:{self.name}",
+                              blocked=("lock", self.name))
+        self.owner = me
+        sched.note_acquired(self.name)
+        return True
+
+    def release(self) -> None:
+        me = self._sched.current_name()
+        if me is None and self.owner == "<unmanaged>":
+            self.owner = None
+            return
+        assert self.owner == me, f"{self.name}: released by non-owner"
+        self.owner = None
+        self._sched.note_released(self.name)
+        self._sched.unblock(("lock", self.name))
+        self._sched.yield_point(f"release:{self.name}")
+
+    def locked(self) -> bool:
+        return self.owner is not None
+
+    def __enter__(self) -> "TracedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class TracedEvent:
+    def __init__(self, sched: Scheduler, name: str):
+        self._sched = sched
+        self.name = name
+        self._flag = False
+
+    def is_set(self) -> bool:
+        self._sched.yield_point(f"check:{self.name}")
+        return self._flag
+
+    def set(self) -> None:
+        self._flag = True
+        self._sched.unblock(("event", self.name))
+        self._sched.yield_point(f"set:{self.name}")
+
+    def clear(self) -> None:
+        self._flag = False
+        self._sched.yield_point(f"clear:{self.name}")
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        rounds = 0
+        budget = _rounds_for(timeout)
+        while not self._flag:
+            if timeout is not None:
+                rounds += 1
+                if rounds > budget:
+                    return False
+                self._sched.yield_point(f"wait-poll:{self.name}")
+            else:
+                self._sched.yield_point(f"wait:{self.name}",
+                                        blocked=("event", self.name))
+        return True
+
+
+class TracedQueue:
+    """Cooperative queue.Queue stand-in (put/get/nowait/empty/qsize/
+    task_done/join) with virtual timeouts."""
+
+    def __init__(self, sched: Scheduler, name: str, maxsize: int = 0):
+        self._sched = sched
+        self.name = name
+        self.maxsize = maxsize
+        self._dq: deque = deque()
+        self._unfinished = 0
+
+    def qsize(self) -> int:
+        return len(self._dq)
+
+    def empty(self) -> bool:
+        self._sched.yield_point(f"empty:{self.name}")
+        return not self._dq
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        sched = self._sched
+        sched.yield_point(f"put:{self.name}")
+        rounds = 0
+        budget = _rounds_for(timeout)
+        while self.maxsize and len(self._dq) >= self.maxsize:
+            if not block:
+                raise _queue.Full
+            if timeout is not None:
+                rounds += 1
+                if rounds > budget:
+                    raise _queue.Full
+                sched.yield_point(f"put-poll:{self.name}")
+            else:
+                sched.yield_point(f"put-block:{self.name}",
+                                  blocked=("q-space", self.name))
+        self._dq.append(item)
+        self._unfinished += 1
+        sched.unblock(("q-data", self.name))
+        sched.yield_point(f"enq:{self.name}")
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        sched = self._sched
+        sched.yield_point(f"get:{self.name}")
+        rounds = 0
+        budget = _rounds_for(timeout)
+        while not self._dq:
+            if not block:
+                raise _queue.Empty
+            if timeout is not None:
+                rounds += 1
+                if rounds > budget:
+                    raise _queue.Empty
+                sched.yield_point(f"get-poll:{self.name}")
+            else:
+                sched.yield_point(f"get-block:{self.name}",
+                                  blocked=("q-data", self.name))
+        item = self._dq.popleft()
+        sched.unblock(("q-space", self.name))
+        return item
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def task_done(self) -> None:
+        self._unfinished = max(0, self._unfinished - 1)
+        if self._unfinished == 0:
+            self._sched.unblock(("q-tasks", self.name))
+
+    def join(self) -> None:
+        while self._unfinished:
+            self._sched.yield_point(f"qjoin:{self.name}",
+                                    blocked=("q-tasks", self.name))
+
+
+# -- instrumentation helpers --------------------------------------------------
+
+def instrument_engine_loop(sched: Scheduler, loop, name: str = "engine-loop"
+                           ) -> _Handle:
+    """Swap an un-started ``EngineLoop``'s seams for traced primitives and
+    register its ``_run`` as a managed thread. Call INSTEAD OF
+    ``loop.start()``; the scheduler's ``run()`` starts everything."""
+    loop._futures_lock = TracedLock(sched, "futures")
+    loop._submit_q = TracedQueue(sched, "submit")
+    loop._cancel_q = TracedQueue(sched, "cancel")
+    loop._stop = TracedEvent(sched, "stop")
+    loop._draining = TracedEvent(sched, "draining")
+    loop._thread = _Handle(sched, name)
+    return sched.spawn(name, loop._run)
+
+
+def instrument_tier_worker(sched: Scheduler, pool, max_queue: int = 8,
+                           name: str = "copyout") -> _Handle:
+    """Build the pool's ``CopyOutWorker`` with traced seams and a managed
+    thread (bypassing the lazy spawn), and trace the pool lock itself."""
+    from scalable_hw_agnostic_inference_tpu.kvtier.pool import CopyOutWorker
+
+    pool._lock = TracedLock(sched, "pool")
+    w = CopyOutWorker.__new__(CopyOutWorker)
+    w._pool = pool
+    w._q = TracedQueue(sched, name, maxsize=max_queue)
+    w._closed = TracedEvent(sched, f"{name}-closed")
+    w._sub_lock = TracedLock(sched, f"{name}-sub")
+    w._stop_sent = False
+    w._thread = _Handle(sched, name)
+    pool._worker = w
+    sched.spawn(name, w._run)
+    return _Handle(sched, name)

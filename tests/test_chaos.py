@@ -42,9 +42,9 @@ def _clean_faults():
 
 
 def _build_stack(**cfg_over):
+    cfg_over.setdefault("vllm_config", "/nonexistent.yaml")
     cfg = ServeConfig(app="llm", model_id="tiny", device="cpu",
-                      max_new_tokens=64, vllm_config="/nonexistent.yaml",
-                      **cfg_over)
+                      max_new_tokens=64, **cfg_over)
     service = get_model("vllm")(cfg)
     app = create_app(cfg, service)
     return cfg, service, app
@@ -376,10 +376,31 @@ async def test_admission_gate_sheds_over_inflight_cap():
 
 @pytest.mark.slow  # own engine build: tier-1 budget (check_tier1_budget.py)
 @pytest.mark.asyncio
-async def test_drain_finishes_inflight_rejects_new_then_stops_engine():
-    cfg, service, app = _build_stack(drain_budget_s=20.0)
+async def test_drain_finishes_inflight_rejects_new_then_stops_engine(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv("SHAI_KVTIER", "1")  # drain must also join the
+    monkeypatch.setenv("SHAI_KVTIER_ASYNC", "1")  # copy-out worker
+    # the host tier rides the prefix cache (engine gates it off otherwise)
+    ecfg_yaml = tmp_path / "ecfg.yaml"
+    ecfg_yaml.write_text(
+        "max_model_len: 576\n"
+        "max_num_seqs: 4\n"
+        "block_size: 16\n"
+        "context_encoding_buckets: [128, 512]\n"
+        "max_new_tokens: 64\n"
+        "enable_prefix_caching: true\n")
+    cfg, service, app = _build_stack(drain_budget_s=20.0,
+                                     vllm_config=str(ecfg_yaml))
     async with make_client(app) as c:
         await wait_ready(c, timeout=300.0)
+        # seed one demotion so the lazy copy-out worker thread exists —
+        # the drain contract below must JOIN it, not orphan it
+        import numpy as np
+        tier = service._engine.cache.tier
+        assert tier is not None
+        blk = np.zeros((tier.n_layers, 1, tier.block_size,
+                        tier.n_kv_heads, tier.head_dim), tier.dtype)
+        tier.store_batch([0xDEAD], blk, blk.copy(), 1)
         faults.configure("engine.step=delay(0.05)")  # in-flight ~1s
         task = asyncio.ensure_future(
             c.post("/generate", json={"prompt": "hello world",
@@ -422,6 +443,16 @@ async def test_drain_finishes_inflight_rejects_new_then_stops_engine():
         assert not service.loop._thread.is_alive(), "engine loop still up"
         with pytest.raises(RuntimeError):
             service.loop.submit([1, 2, 3])
+
+        # SIGTERM must not orphan an in-flight demotion copy: the drain
+        # path closes the tier, bounded-joining the copy-out worker
+        w = tier._worker
+        assert w is not None, "demotion never spawned the worker?"
+        deadline = time.monotonic() + 10.0
+        while w.alive and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert not w.alive, "copy-out worker orphaned by drain"
+        assert tier.has(0xDEAD)  # queued work published before the join
 
 
 # ---------------------------------------------------------------------------
