@@ -44,7 +44,7 @@ SD_BASELINE_IMG_S = 1.0 / 0.67
 UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
                   "mllama": "tokens/sec", "llama_spec": "tokens/sec",
                   "vllm": "tokens/sec", "kvtier": "x", "qos": "x",
-                  "ragged": "tokens/sec",
+                  "disagg": "x", "ragged": "tokens/sec",
                   "sd": "images/sec", "sd8": "images/sec",
                   "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
@@ -57,6 +57,13 @@ INF2_COST_HR = 0.7582
 _ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
+def _pctl(xs, q):
+    """Nearest-rank percentile over a small sample (ONE definition —
+    bench_qos and bench_disagg must report p99 with identical math)."""
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * (len(xs) - 1)))]
+
+
 
 def _which_from_argv(argv) -> str:
     """THE argv->bench-key dispatch — one definition for the inner runner,
@@ -67,8 +74,8 @@ def _which_from_argv(argv) -> str:
         return "llama_spec"
     if any(a.startswith("llama") for a in argv):
         return "llama"
-    for k in ("vllm", "kvtier", "qos", "ragged", "flux", "t5", "mllama",
-              "sd8"):
+    for k in ("vllm", "kvtier", "qos", "disagg", "ragged", "flux", "t5",
+              "mllama", "sd8"):
         if k in argv:
             return k
     return "sd"
@@ -803,13 +810,9 @@ def bench_qos(tiny: bool) -> dict:
             fin = done[rid]
             vip.append(fin.timing["t_first"] - fin.timing["t_submit"])
 
-        def pctl(xs, q):
-            xs = sorted(xs)
-            return xs[min(len(xs) - 1, int(q * (len(xs) - 1)))]
-
         return {
             "vip_ttft_p50_ms": round(statistics.median(vip) * 1e3, 2),
-            "vip_ttft_p99_ms": round(pctl(vip, 0.99) * 1e3, 2),
+            "vip_ttft_p99_ms": round(_pctl(vip, 0.99) * 1e3, 2),
             "vip_ttft_noflood_p50_ms": round(
                 statistics.median(base) * 1e3, 2),
             "preemptions": eng.obs.preemptions,
@@ -829,6 +832,159 @@ def bench_qos(tiny: bool) -> dict:
         "vs_baseline": round(val / base, 3) if base else 1.0,
         "qos": on,
         "fifo": off,
+    }
+
+
+def bench_disagg(tiny: bool) -> dict:
+    """Disaggregated prefill/decode A/B: a two-engine prefill/decode split
+    (warm KV shipped through the kvnet frame codec, the in-process stand-in
+    for the socket hop) vs one monolithic engine, under a mixed-length
+    prompt load.
+
+    Each round submits a fresh batch of mixed-length prompts concurrently.
+    The monolithic engine pays every prompt's full prefill inline with its
+    decoding batch; the decode engine receives each round's KV runs the
+    way a handoff delivers them — prefill engine (role=prefill) finishes
+    the prompt, its tier's run crosses ``encode_frames``/``decode_frames``
+    byte-exact into the decode engine's host tier — and admits via the
+    tier restore. ``value`` is ``disagg_ttft_ratio`` = mono TTFT p50 /
+    disagg TTFT p50 on the decode side (>1 = the split is buying TTFT);
+    the line carries p50/p99 TTFT + TPOT p50 for both modes so a
+    regression says whether the restore path or the decode pace moved.
+    Network latency is NOT modeled — the line measures the compute-side
+    win of restoring vs re-prefilling, the same quantity the live socket
+    test exercises end-to-end.
+    """
+    import os
+    import statistics
+
+    import numpy as np
+
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+    from scalable_hw_agnostic_inference_tpu.kvnet import frames
+    from scalable_hw_agnostic_inference_tpu.models import llama as llama_mod
+
+    # the load is LONG mixed-length prompts — past the largest prefill
+    # bucket, so the monolithic pod pays the chunked-prefill ladder
+    # serially inside its decoding batch (THE TTFT/TPOT interference the
+    # split exists to remove), while the decode pod restores the banked
+    # run and computes only the tail chunk
+    if tiny:
+        cfg = llama_mod.LlamaConfig.tiny()
+        kw = dict(max_model_len=256, max_num_seqs=4, block_size=8,
+                  context_encoding_buckets=(32, 64, 128),
+                  max_new_tokens=16, enable_prefix_caching=True)
+        lens, new, rounds = (240, 192, 160, 232), 8, 3
+        name = "disagg-tiny"
+    else:
+        cfg = llama_mod.LlamaConfig.llama32_1b()
+        kw = dict(max_model_len=1024, max_num_seqs=4, block_size=16,
+                  context_encoding_buckets=(128, 256, 512),
+                  max_new_tokens=32, enable_prefix_caching=True)
+        lens, new, rounds = (960, 832, 704, 928), 16, 3
+        name = "disagg-1b-geometry"
+
+    params = llama_mod.geometry_params(cfg, quant=False)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=new)
+    sp1 = SamplingParams(temperature=0.0, max_new_tokens=1)
+
+    def build(role: str, tier: bool) -> LLMEngine:
+        os.environ["SHAI_KVTIER"] = "1" if tier else "0"
+        os.environ["SHAI_KVTIER_ASYNC"] = "0"  # deterministic copies
+        try:
+            return LLMEngine(cfg, params, EngineConfig(role=role, **kw))
+        finally:
+            os.environ.pop("SHAI_KVTIER", None)
+            os.environ.pop("SHAI_KVTIER_ASYNC", None)
+
+    def prompts_for(round_i: int):
+        rng = np.random.default_rng(31 + round_i)  # fresh every round:
+        return [rng.integers(3, cfg.vocab_size, n).tolist()  # no device-
+                for n in lens]                               # cache reuse
+
+    def run_batch(eng, batch, params_):
+        ids = [eng.add_request(list(p), params_) for p in batch]
+        done = {}
+        while set(ids) - set(done):
+            for f in eng.step():
+                done[f.req_id] = f
+        eng.finish_pending()
+        return [done[i] for i in ids]
+
+    def ttfts(fins):
+        return [f.timing["t_first"] - f.timing["t_submit"] for f in fins]
+
+    def tpots(fins):
+        return [f.timing["decode_s"] / max(1, len(f.token_ids) - 1)
+                for f in fins if f.timing and "decode_s" in f.timing]
+
+    def ship(pre: LLMEngine, dec: LLMEngine, batch) -> int:
+        """The handoff wire, in-process: prefill tier run -> frames ->
+        decode tier (byte-exact, same as GET /kv/blocks)."""
+        moved = 0
+        for p in batch:
+            hashes = pre.cache.prefix_hashes(list(p))
+            run = pre.cache.tier.get_run(hashes)
+            if not run:
+                continue
+            entries = frames.decode_frames(frames.encode_frames(run))
+            n_arr = len(entries[0]) - 1
+            stacked = [np.stack([e[1 + ai] for e in entries], axis=1)
+                       for ai in range(n_arr)]
+            dec.cache.tier.store_batch([e[0] for e in entries], *stacked,
+                                       len(entries))
+            moved += len(entries)
+        return moved
+
+    # monolithic oracle: full prefill inline with the decode batch
+    mono = LLMEngine(cfg, params, EngineConfig(**kw))
+    run_batch(mono, prompts_for(99), sp)  # warm every executable
+    mono_fins = []
+    for r in range(rounds):
+        mono_fins += run_batch(mono, prompts_for(r), sp)
+
+    # split: prefill engine banks KV, decode engine restores + generates
+    pre = build("prefill", tier=True)
+    dec = build("decode", tier=True)
+    warm = prompts_for(99)
+    run_batch(pre, warm, sp1)
+    ship(pre, dec, warm)
+    run_batch(dec, warm, sp)              # warm incl. the restore movers
+    dec_fins, shipped = [], 0
+    for r in range(rounds):
+        batch = prompts_for(r)
+        run_batch(pre, batch, sp1)        # the prefill tier's work
+        shipped += ship(pre, dec, batch)  # the wire
+        dec_fins += run_batch(dec, batch, sp)  # the decode tier's TTFT
+
+    mono_ttft, dec_ttft = ttfts(mono_fins), ttfts(dec_fins)
+    val = (round(statistics.median(mono_ttft)
+                 / statistics.median(dec_ttft), 3)
+           if statistics.median(dec_ttft) else 0.0)
+    base = _published("disagg_ttft_ratio")
+    snap = dec.cache.tier.snapshot()
+    return {
+        "metric": f"{name} decode-pod TTFT vs monolithic under mixed "
+                  f"prompt load, p50 ratio (batch {len(lens)}, "
+                  f"{jax.devices()[0].platform})",
+        "value": val,
+        "unit": "x",
+        "vs_baseline": round(val / base, 3) if base else 1.0,
+        "mono_ttft_p50_ms": round(statistics.median(mono_ttft) * 1e3, 3),
+        "mono_ttft_p99_ms": round(_pctl(mono_ttft, 0.99) * 1e3, 3),
+        "disagg_ttft_p50_ms": round(statistics.median(dec_ttft) * 1e3, 3),
+        "disagg_ttft_p99_ms": round(_pctl(dec_ttft, 0.99) * 1e3, 3),
+        "mono_tpot_p50_ms": round(
+            statistics.median(tpots(mono_fins)) * 1e3, 3),
+        "disagg_tpot_p50_ms": round(
+            statistics.median(tpots(dec_fins)) * 1e3, 3),
+        "blocks_shipped": shipped,
+        "decode_tier": {k: snap[k] for k in ("stores", "restored",
+                                             "evictions", "errors")},
     }
 
 
@@ -1093,7 +1249,8 @@ def inner_main() -> None:
         enable_persistent_cache_from_env()
     out = {"llama": bench_llama, "llama_spec": bench_llama_spec,
            "vllm": bench_vllm, "kvtier": bench_kvtier,
-           "qos": bench_qos, "ragged": bench_ragged,
+           "qos": bench_qos, "disagg": bench_disagg,
+           "ragged": bench_ragged,
            "flux": bench_flux, "t5": bench_t5,
            "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)

@@ -251,7 +251,7 @@ DEFAULT_CONTRACT = Contract(
                 "_drafter", "spec", "_spec_rng", "_sample1", "_lp1",
                 "_cross_embed", "_cross_write", "ttft", "tpot", "obs",
                 "_hbm_every", "_hbm_dev", "_async", "_ids", "_res",
-                "_ragged", "_kv_quant"),
+                "_ragged", "_kv_quant", "role", "_prefill_role"),
             owning_modules=(
                 "engine/engine.py", "engine/warm.py", "engine/cross.py",
                 "engine/logprobs.py", "engine/speculative.py",
@@ -336,6 +336,26 @@ DEFAULT_CONTRACT = Contract(
             locks=("_sub_lock",),
             owning_modules=("kvtier/pool.py",),
         ),
+        # The kvnet transport counters take writes from lane threads (the
+        # decode-role fetch) AND the event loop (the /kv/blocks serve
+        # side), reads from scrape threads — all under _lock.
+        "KvNetStats": ClassPolicy(
+            immutable_after_init=("_lock",),
+            lock_guarded={"_counts": "_lock"},
+            owning_modules=("kvnet/client.py",),
+        ),
+        # The kvnet client is shared by every serving-lane thread: the
+        # lazily-built httpx client and the per-peer breaker table move
+        # under _lock; the HTTP call itself runs OUTSIDE it (the
+        # blocking-under-lock rule is what enforces that stays true).
+        "KvNetClient": ClassPolicy(
+            immutable_after_init=(
+                "tier", "stats", "timeout_s", "connect_timeout_s",
+                "connect_retries", "allowed_peers", "_breaker_factory",
+                "_transport", "_lock"),
+            lock_guarded={"_client": "_lock", "_breakers": "_lock"},
+            owning_modules=("kvnet/client.py",),
+        ),
         # The tenant ledger takes writes from every serving thread
         # (admission checks, completion charges) and reads from scrape
         # threads: bucket state and per-tenant counters move under _lock
@@ -378,7 +398,7 @@ DEFAULT_CONTRACT = Contract(
     ),
     trace_files=("serve/app.py", "serve/asgi.py"),
     poll_routes=("/profile", "/health", "/readiness", "/health/ready",
-                 "/metrics", "/stats"),
+                 "/metrics", "/stats", "/kv/blocks"),
     race=RaceSpec(
         # serve.app's closure lock guarding the in-flight counters (the
         # dict_guards entry above names the same lock for the write rule)
@@ -400,6 +420,12 @@ DEFAULT_CONTRACT = Contract(
             "AdmissionGate._lock",
             "DrainController._lock",
             "app.inflight_lock",
+            # the kvnet transport: stats count on every handoff fetch and
+            # every /kv/blocks serve; the client lock fronts every lane
+            # thread's fetch — an HTTP call under either would serialize
+            # the whole decode tier behind one slow peer
+            "KvNetStats._lock",
+            "KvNetClient._lock",
         ),
         # The declared partial order is EMPTY on purpose: the control
         # plane's design rule is "no lock nesting at all" — every

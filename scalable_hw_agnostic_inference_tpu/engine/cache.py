@@ -184,11 +184,26 @@ class PagedKVCache:
     @staticmethod
     def _chain_hashes(tokens, block_size: int):
         """Chain hash per FULL block: h_i commits to every token up to and
-        including block i, so equal hashes mean equal prefixes."""
+        including block i, so equal hashes mean equal prefixes.
+
+        Blake2b-based, NOT Python's builtin hash: since the kvnet
+        transport (``GET /kv/blocks``) keys blocks by these hashes ACROSS
+        pods, the value must be a stable function of the tokens alone —
+        the builtin tuple hash is CPython-build/version-dependent, and a
+        staggered image rollout across interpreter versions would make
+        every cross-pod handoff silently miss. 64-bit signed (fits the
+        frame codec's ``<q`` and the int keys everywhere else)."""
+        import hashlib
+
         out = []
-        h = 0x5351  # fixed seed: process-local python hashes suffice
-        for i in range(len(tokens) // block_size):
-            h = hash((h, tuple(tokens[i * block_size:(i + 1) * block_size])))
+        h = 0x5351  # fixed chain seed
+        n_full = len(tokens) // block_size
+        for i in range(n_full):
+            m = hashlib.blake2b(digest_size=8)
+            m.update(h.to_bytes(8, "little", signed=True))
+            m.update(np.asarray(tokens[i * block_size:(i + 1) * block_size],
+                                dtype="<i8").tobytes())
+            h = int.from_bytes(m.digest(), "little", signed=True)
             out.append(h)
         return out
 
@@ -454,6 +469,44 @@ class PagedKVCache:
                     lay["k"], lay["v"] = self._tier_restore(
                         lay["k"], lay["v"], idx_dev, *host)
             i += n
+
+    def demote_prompt_run(self, seq_id: int, prompt_ids) -> int:
+        """Prefill-role handoff (kvnet): copy the sequence's full prompt
+        blocks into the host tier WITHOUT evicting them from the device —
+        the block data is gathered positionally from the sequence's own
+        allocation (``admit`` lays blocks out in prompt order), so this
+        works whatever admission path built it. Called by the engine at
+        request finish, BEFORE release, so a peer decode pod can pull the
+        run over ``GET /kv/blocks`` the moment the handoff returns.
+        Returns the prompt's full-block count (the handoff's
+        ``hashes_len``); failures degrade to recompute-on-the-peer via the
+        ``_demote`` contract, never raise."""
+        if self.tier is None or not self.prefix_caching:
+            return 0
+        alloc = self._seqs.get(seq_id)
+        if alloc is None:
+            return 0
+        # NO re-hash here — this runs inside the step loop at every
+        # finish on a prefill pod. Every prefill-role admission path has
+        # register_prefix'ed the prompt's full blocks, so each block's
+        # hash is one _block2hash lookup; an unregistered block (a
+        # duplicate prompt whose identical blocks were published under
+        # the FIRST copy's physical blocks) ends the walk — harmless,
+        # the content-addressed tier already holds that run via the
+        # first copy's demotions.
+        n_full = len(prompt_ids) // self.block_size
+        pairs: List[Tuple[int, int]] = []
+        n_run = 0
+        for b in alloc.blocks[:n_full]:
+            h = self._block2hash.get(b)
+            if h is None:
+                break
+            n_run += 1
+            if self.tier.accepts(h):
+                pairs.append((h, b))
+        if pairs:
+            self._demote(pairs)
+        return n_run
 
     def offload_preempt(self, tokens, seq_id: int) -> None:
         """Preemption offload: publish the victim's full blocks to the

@@ -58,6 +58,12 @@ class EngineConfig:
     slo_tpot_ms: float = 0.0
     slo_error_rate: float = 0.0
     perf_projection: str = ""
+    # disaggregated prefill/decode serving (kvnet/): "prefill" pods finish
+    # the prompt, demote its KV to the host tier, and return a handoff
+    # instead of decoding; "decode" pods accept handoffs and pull warm KV
+    # from the peer; "both" (default) is the monolithic pod. The SHAI_ROLE
+    # env knob overrides this config field at boot (kvnet.resolve_role).
+    role: str = "both"
 
     def __post_init__(self):
         if self.block_size < 1:
@@ -114,6 +120,12 @@ class EngineConfig:
         for knob in ("slo_ttft_ms", "slo_tpot_ms", "slo_error_rate"):
             if getattr(self, knob) < 0:
                 raise ValueError(f"{knob} must be >= 0 (0 disables)")
+        if self.role not in ("prefill", "decode", "both"):
+            # the CONFIG field is strict (a deploy manifest typo is a
+            # deploy error); the SHAI_ROLE env override stays lenient
+            raise ValueError(
+                f"unsupported role {self.role!r} "
+                f"(supported: prefill, decode, both)")
 
     @property
     def speculative_enabled(self) -> bool:

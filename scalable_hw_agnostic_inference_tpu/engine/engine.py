@@ -145,6 +145,21 @@ class LLMEngine:
                 head_dim=model_cfg.head_dim,
                 dtype=np.int8 if self._kv_quant else np.dtype(kv_dtype),
                 quant=self._kv_quant)
+        # disaggregated serving role (kvnet): env wins over ecfg.role. A
+        # prefill pod demotes every finished request's full prompt-block
+        # run to its host tier (the handoff the decode pod pulls); that
+        # needs prefix caching + the tier, so a mis-deployed prefill pod
+        # warns loudly and degrades to handing off kv_ready=False.
+        from ..kvnet import resolve_role
+
+        self.role = resolve_role(ecfg.role)
+        self._prefill_role = self.role == "prefill"
+        if self._prefill_role and tier is None:
+            log.warning(
+                "role=prefill but no host KV tier is configured "
+                "(need enable_prefix_caching + SHAI_KVTIER=1, unsharded "
+                "pool) — handoffs will advertise kv_ready=false and "
+                "decode peers will recompute")
         kv_sharding = None
         if self.shardings is not None:
             kv_sharding = dict(self.shardings.kv_layer)
@@ -279,6 +294,13 @@ class LLMEngine:
         # conformance instruments: /stats, /metrics, and the admission
         # gate all read them off the telemetry object
         self.obs.kvtier = self.cache.tier
+        # kvnet transport counters (disaggregated serving): constructed
+        # HERE so they ride the same seam from boot; the serving layer's
+        # KvNetClient and the /kv/blocks route share this one object
+        if self.cache.tier is not None:
+            from ..kvnet.client import KvNetStats
+
+            self.obs.kvnet = KvNetStats()
         # the QoS scheduler rides the same seam: /stats -> "qos" reads its
         # pick/aging counters next to the ledger's per-tenant usage
         self.obs.qos_sched = self._sched
@@ -1943,6 +1965,13 @@ class LLMEngine:
                     logprobs=((s.req.already_lp + s.lps)
                               if p.logprobs else None),
                     timing=self._timing_of(s.req, s.t_first)))
+                if self._prefill_role:
+                    # prefill-role handoff: bank the finished prompt's KV
+                    # in the host tier BEFORE release so a peer decode pod
+                    # can pull it the moment the serving layer returns the
+                    # handoff (kvnet; failures degrade to peer recompute)
+                    self.cache.demote_prompt_run(s.req.req_id,
+                                                 s.req.prompt_ids)
                 self.cache.release(s.req.req_id)
                 self.slots[s.slot] = None
                 self._has_image[s.slot] = 0.0

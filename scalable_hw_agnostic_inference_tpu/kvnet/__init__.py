@@ -1,0 +1,57 @@
+"""kvnet: network KV transport for disaggregated prefill/decode serving.
+
+The host KV tier (``kvtier/``) already stores blocks content-addressed by
+the SAME chain hashes as the device prefix cache; this package adds the
+wire between pods so a *prefill* pod's warm KV can feed a *decode* pod's
+host tier:
+
+- :mod:`.frames` — the length-prefixed binary frame codec moving
+  ``(hash, k, v)`` / quantized ``(hash, k, v, ks, vs)`` block entries
+  byte-exact (a restored block must be indistinguishable from a local
+  demotion, content hashes and the differential oracles untouched);
+- :mod:`.client` — the puller: shared ``httpx`` client, connect-only
+  retries, a per-peer :class:`~..resilience.breaker.CircuitBreaker`, and
+  the ``kvnet.fetch`` fault site; fetched blocks land in
+  ``HostKVTier.store_batch`` and restore through the existing
+  one-donated-scatter-per-layer path (``cache.restore_prefix``);
+- the pod-side ``GET /kv/blocks`` endpoint lives in ``serve/app.py``
+  (probe-class route) and serves the tier's leading resident run.
+
+Failure contract (the kvtier contract, now fleet-wide): every transport
+failure — unreachable peer, open breaker, short run, corrupt frame —
+degrades to local recompute, never to request failure. The degrade signal
+is the ``shai_kvnet_fallbacks_total`` counter.
+
+Roles (``SHAI_ROLE`` / ``EngineConfig.role``): a ``prefill`` pod finishes
+the prompt, demotes the full prefix run to its host tier, and returns a
+``{kv_ready, digest, hashes_len, peer_url}`` handoff instead of decoding;
+a ``decode`` pod accepts the handoff, pulls the run from the peer, and
+generates; ``both`` (the default) is the monolithic pod unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+#: the closed role set: "prefill" warms KV and hands off, "decode" pulls
+#: and generates, "both" is the monolithic default
+ROLES = ("prefill", "decode", "both")
+
+
+def resolve_role(default: str = "both") -> str:
+    """The pod's serving role: ``SHAI_ROLE`` env wins over the engine
+    config's ``role`` field (``default``). Lenient by the env-knob
+    contract — an unrecognized value warns and keeps the config role, a
+    typo must not boot a prefill tier as a silent monolith crash-loop."""
+    from ..obs.util import env_str
+
+    v = env_str("SHAI_ROLE", "").strip().lower()
+    if not v:
+        return default if default in ROLES else "both"
+    if v not in ROLES:
+        log.warning("SHAI_ROLE=%r not recognized (known: %s) — keeping "
+                    "role %r", v, "/".join(ROLES), default)
+        return default if default in ROLES else "both"
+    return v

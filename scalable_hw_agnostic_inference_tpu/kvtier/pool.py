@@ -237,16 +237,24 @@ class HostKVTier:
 
     # -- demotion (engine thread enqueues / worker publishes) --------------
 
-    def store_batch(self, hashes: Sequence[int], *arrays_and_n) -> None:
+    def store_batch(self, hashes: Sequence[int], *arrays_and_n,
+                    sync: bool = False) -> None:
         """Accept ``n`` demoted blocks: ``arrays_and_n`` is ``(k_all,
         v_all[, k_scale, v_scale], n)`` — the gather outputs
         ``[n_layers, pad, ...]`` (device arrays in async mode — the worker
         materializes them; anything numpy-coercible in sync mode), column
         ``j`` belonging to ``hashes[j]``. Quantized pools pass the two
-        scale stacks ``[n_layers, pad, Hkv]`` between blocks and count."""
+        scale stacks ``[n_layers, pad, Hkv]`` between blocks and count.
+
+        ``sync=True`` publishes on THIS thread even when the pool runs
+        the async copy-out worker: the kvnet fetch path hands in blocks
+        that are already host-side numpy — the worker exists only to pay
+        device->host copies, and routing a network pull through its queue
+        would race the very admission the pull exists to warm (or drop
+        the blocks on a full queue while ``fetched`` already counted)."""
         *arrays, n = arrays_and_n
         arrays = tuple(arrays)
-        if self.async_copy:
+        if self.async_copy and not sync:
             with self._lock:
                 if self._closing:
                     # closed tier: degrade to a counted drop — a late
@@ -325,26 +333,14 @@ class HostKVTier:
 
     # -- restore-side lookups (engine thread) ------------------------------
 
-    def probe_run(self, hashes: Sequence[int]) -> int:
-        """Length of the leading contiguous run of resident hashes —
-        the admission ladder's fall-through probe. Counts one hit per
-        resident block and one miss when the walk stops short."""
-        with self._lock:
-            run = 0
-            for h in hashes:
-                if h not in self._entries:
-                    break
-                self._entries.move_to_end(h)
-                run += 1
-            self._stats["hits"] += run
-            if run < len(hashes):
-                self._stats["misses"] += 1
-            return run
-
-    def get_run(self, hashes: Sequence[int]) -> List[Tuple]:
-        """Leading contiguous resident run as ``(hash, k, v[, ks, vs])``
-        tuples (LRU-touched; entries STAY resident — a restored block
-        evicted from the device again re-demotes for free)."""
+    def _run_entries(self, hashes: Sequence[int]) -> List[Tuple]:
+        """THE leading-contiguous-run walk both lookup surfaces share:
+        every visited resident entry is LRU-touched, the walk stops at the
+        first miss. One implementation on purpose — probe (admission) and
+        get (restore AND the ``/kv/blocks`` network serve) must refresh
+        recency identically, or serving a run to a peer would leave the
+        very blocks it just advertised cold and first-in-line for
+        eviction."""
         with self._lock:
             out = []
             for h in hashes:
@@ -352,8 +348,35 @@ class HostKVTier:
                 if e is None:
                     break
                 self._entries.move_to_end(h)
-                out.append((h,) + tuple(e))
+                out.append((h, e))
             return out
+
+    def probe_run(self, hashes: Sequence[int]) -> int:
+        """Length of the leading contiguous run of resident hashes —
+        the admission ladder's fall-through probe. Counts one hit per
+        resident block and one miss when the walk stops short."""
+        run = len(self._run_entries(hashes))
+        with self._lock:
+            self._stats["hits"] += run
+            if run < len(hashes):
+                self._stats["misses"] += 1
+        return run
+
+    def resident_run(self, hashes: Sequence[int]) -> int:
+        """:meth:`probe_run` WITHOUT the hit/miss accounting — the kvnet
+        transport's pre-fetch probe. The exported hit rate must keep
+        measuring the ADMISSION ladder only; a decode fleet's handoff
+        pulls would otherwise blend transport probes into the signal
+        dashboards alert on. Recency is still refreshed (shared walk)."""
+        return len(self._run_entries(hashes))
+
+    def get_run(self, hashes: Sequence[int]) -> List[Tuple]:
+        """Leading contiguous resident run as ``(hash, k, v[, ks, vs])``
+        tuples (LRU-touched exactly like :meth:`probe_run`, via the shared
+        walk; entries STAY resident — a restored block evicted from the
+        device again re-demotes for free, and a network-served run stays
+        warm for the next peer)."""
+        return [(h,) + tuple(e) for h, e in self._run_entries(hashes)]
 
     # -- counters / export -------------------------------------------------
 

@@ -113,6 +113,11 @@ class StepTelemetry:
         # admission gate and /stats see host-pool saturation alongside
         # the device KV gauges
         self.kvtier = None
+        # network KV transport (kvnet.client.KvNetStats): attached by the
+        # serving layer when the pod participates in disaggregated
+        # prefill/decode — the shai_kvnet_* families export through the
+        # same collector seam
+        self.kvnet = None
         # QoS weighted-fair scheduler (resilience.qos), attached by the
         # engine when SHAI_QOS is on: its pick/aging counters ride the
         # same provider seam into /stats -> "qos"

@@ -32,7 +32,9 @@ from ..serve.asgi import App, HTTPError, Request, Response
 log = logging.getLogger(__name__)
 
 #: how long a /fleet snapshot steers routing before it re-polls — warm
-#: prefixes and overload flags move on engine timescales, not per request
+#: prefixes and overload flags move on engine timescales, not per request.
+#: The DEFAULT; each client resolves the SHAI_FLEET_CACHE_TTL_S env knob
+#: at construction (lenient parse via the obs.util seam).
 FLEET_CACHE_TTL_S = 2.0
 
 
@@ -87,6 +89,43 @@ def aggregate_tenant_usage(results: Dict[str, Any]
     return qos_tenants
 
 
+def backend_role(spec: Any, st: Any) -> str:
+    """THE per-backend role triage (disaggregated serving), shared by the
+    /fleet aggregation and the router: the live ``/stats`` advertisement
+    wins (SHAI_ROLE is an env knob — the pod knows best), the models.json
+    ``role:`` (``spec``) covers unreachable pods, anything else reads
+    ``both``."""
+    role = st.get("role") if isinstance(st, dict) else None
+    if role not in ("prefill", "decode", "both"):
+        role = spec.get("role") if isinstance(spec, dict) else None
+    return role if role in ("prefill", "decode", "both") else "both"
+
+
+def aggregate_roles(models: Dict[str, Dict[str, Any]],
+                    results: Dict[str, Any],
+                    overloaded) -> Dict[str, Dict[str, Any]]:
+    """Per-role fleet health (disaggregated serving): each backend's live
+    ``/stats`` role (models.json ``role:`` as the fallback for unreachable
+    pods) bucketed into ``{role: {backends, serving, overloaded}}`` — one
+    ``/fleet`` dump answers "does the prefill tier have capacity" next to
+    the decode tier's, which is exactly what the autoscaler item needs to
+    scale them independently. Pure and deterministic; malformed payloads
+    degrade to the configured role, never fail the dump."""
+    ov = set(overloaded or ())
+    roles: Dict[str, Dict[str, Any]] = {}
+    for name in sorted(results):
+        st = results[name]
+        role = backend_role(models.get(name), st)
+        ent = roles.setdefault(role, {"backends": [], "serving": [],
+                                      "overloaded": []})
+        ent["backends"].append(name)
+        if isinstance(st, dict) and "error" not in st:
+            ent["serving"].append(name)
+        if name in ov:
+            ent["overloaded"].append(name)
+    return roles
+
+
 def load_models_config(path: str) -> Dict[str, Dict[str, Any]]:
     """models.json ConfigMap (``cova/cova-gradio-config.yaml:6-21``)."""
     with open(path) as f:
@@ -135,10 +174,15 @@ class CovaClient:
         # need determinism inject their own seeded rng)
         self._rng = rng or random.Random()
         # short-TTL /fleet snapshot for prefix-affinity routing (one poll
-        # steers many requests; a poll failure degrades to weighted order)
+        # steers many requests; a poll failure degrades to weighted
+        # order). TTL is operator-tunable: a big fleet whose /stats fan-out
+        # is expensive widens it, a routing test shrinks it
+        from ..obs.util import env_float
+
         self._fleet_cache: Optional[Dict[str, Any]] = None
         self._fleet_cache_at = 0.0
-        self.fleet_cache_ttl_s = FLEET_CACHE_TTL_S
+        self.fleet_cache_ttl_s = env_float("SHAI_FLEET_CACHE_TTL_S",
+                                           FLEET_CACHE_TTL_S)
 
     def url_of(self, name: str) -> str:
         if name not in self.models:
@@ -302,7 +346,10 @@ class CovaClient:
         slo_breached = sorted(n for n, e in conformance.items()
                               if e.get("slo_breach"))
         out = {"models": results, "overloaded": overloaded,
-               "conformance": conformance, "slo_breached": slo_breached}
+               "conformance": conformance, "slo_breached": slo_breached,
+               # per-role health (disaggregated serving): prefill vs
+               # decode tier capacity at a glance
+               "roles": aggregate_roles(self.models, results, overloaded)}
         qos_tenants = aggregate_tenant_usage(results)
         if qos_tenants:
             out["qos"] = {"tenants": qos_tenants}
@@ -369,17 +416,114 @@ class CovaClient:
                 cold.append(n)
         return warm + cold, warm
 
+    def _role_of(self, name: str, fleet: Dict[str, Any]) -> str:
+        """A backend's serving role — :func:`backend_role` over this
+        backend's live fleet entry and models.json spec."""
+        return backend_role(self.models.get(name),
+                            (fleet.get("models") or {}).get(name))
+
+    async def _generate_disagg(self, prompt: str, params: Dict[str, Any],
+                               prefill_pods: List[str],
+                               decode_pods: List[str],
+                               fleet: Dict[str, Any]
+                               ) -> Optional[Dict[str, Any]]:
+        """The disaggregated path: prefill on a prefill-role pod (affinity
+        first — a repeat prompt's KV is already banked there), then hand
+        the warm KV reference to a decode pod. Returns None when ANY stage
+        declines (unreachable prefill tier, ``kv_ready: false``, every
+        decode pod failing) — the caller degrades to monolithic routing,
+        never fails the request here."""
+        ranked_p, _warm = self.rank_backends(prompt, prefill_pods, fleet)
+        handoff = None
+        pf_name = None
+        for name in ranked_p:
+            try:
+                h = await self.post(name, "/generate", {"prompt": prompt})
+            except HTTPError:
+                continue  # dead/shedding prefill pod: try the next
+            if isinstance(h, dict) and h.get("kv_ready"):
+                handoff, pf_name = h, name
+                break
+            # kv_ready=false triage: hashes_len is a property of the
+            # PROMPT (full-block count — every pod with the same
+            # tokenizer agrees), so 0 means no pod can do better and we
+            # fall back; a POSITIVE hashes_len with kv_ready=false is a
+            # pod-specific problem (tier-less misdeploy) — one bad
+            # replica must not disable the split, try the next
+            try:
+                hl = int(h.get("hashes_len") or 0) \
+                    if isinstance(h, dict) else 0
+            except (TypeError, ValueError):
+                hl = 0
+            if hl <= 0:
+                break
+        if handoff is None:
+            return None
+        try:
+            body = {
+                "prompt": prompt, **params,
+                # the handoff's advertised pull address wins; empty means
+                # the pod doesn't know its own external URL — substitute
+                # the one this orchestrator already routes it by
+                "kv_peer": str(handoff.get("peer_url")
+                               or self.url_of(pf_name)),
+                "kv_hashes_len": int(handoff.get("hashes_len") or 0),
+                "kv_digest": str(handoff.get("digest") or ""),
+            }
+        except (TypeError, ValueError, KeyError):
+            # a malformed handoff (version-skewed prefill pod) degrades
+            # to monolithic routing — this path never fails the request
+            return None
+        # the decode stage keeps the caller's role-then-weight order
+        # (explicit decode pods first) with overloaded pods demoted to
+        # the back — affinity ranking would move a warm BOTH-pod ahead of
+        # the decode tier, re-mixing decode with that pod's chunked
+        # prefill (the interference the split removes), and warmth is
+        # moot here anyway: the handoff pull warms whichever pod we pick
+        ov = set(fleet.get("overloaded") or ())
+        ranked_d = ([n for n in decode_pods if n not in ov]
+                    + [n for n in decode_pods if n in ov])
+        for name in ranked_d:
+            try:
+                out = await self.post(name, "/generate", body)
+            except HTTPError:
+                continue
+            out["model"] = name
+            out["prefill_model"] = pf_name
+            out["routed_by"] = "disagg"
+            return out
+        return None
+
     async def generate(self, prompt: str, params: Dict[str, Any],
                        names: Optional[List[str]] = None) -> Dict[str, Any]:
-        """Route ONE generation to the best backend: prefix-affinity first
-        (the pod already holding this prompt's warm KV), weighted order as
-        the fallback; a failed backend falls through to the next instead
-        of failing the request."""
+        """Route ONE generation to the best backend. Disaggregated first:
+        with a prefill-role AND a decode-capable backend live, prefill
+        runs on the prefill tier and the warm KV reference hands off to a
+        decode pod (``routed_by: disagg``). Otherwise — or when any disagg
+        stage declines — monolithic routing: prefix-affinity first (the
+        pod already holding this prompt's warm KV), weighted order as the
+        fallback; a failed backend falls through to the next instead of
+        failing the request."""
         order = self.weighted_order(names)
         if not order:
             raise HTTPError(400, "no text-generation models configured")
-        ranked, warm = self.rank_backends(prompt, order,
-                                          await self._fleet_for_routing())
+        fleet = await self._fleet_for_routing()
+        prefill_pods = [n for n in order
+                        if self._role_of(n, fleet) == "prefill"]
+        decodable = [n for n in order
+                     if self._role_of(n, fleet) != "prefill"]
+        # explicit decode pods ahead of monolithic both-pods: the split
+        # exists to keep chunked prefill off the decode tier's TPOT
+        decodable.sort(key=lambda n: self._role_of(n, fleet) != "decode")
+        if prefill_pods and decodable:
+            out = await self._generate_disagg(prompt, params, prefill_pods,
+                                              decodable, fleet)
+            if out is not None:
+                return out
+        if not decodable:
+            raise HTTPError(502, "no decode-capable backend (every "
+                                 "configured backend is prefill-role)")
+        ranked, warm = self.rank_backends(prompt, decodable, fleet)
         last: Optional[HTTPError] = None
         for name in ranked:
             try:
@@ -515,7 +659,8 @@ def create_cova_app(models_path: str) -> App:
         if not prompt:
             raise HTTPError(400, "missing prompt")
         params = {k: body[k] for k in
-                  ("temperature", "top_k", "top_p", "max_new_tokens")
+                  ("temperature", "top_k", "top_p", "max_new_tokens",
+                   "logprobs")
                   if k in body}
         return await client.generate(prompt, params, body.get("models"))
 

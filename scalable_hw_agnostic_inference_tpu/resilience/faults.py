@@ -38,7 +38,11 @@ Sites threaded through the stack (grep for the constant):
 - :data:`COVA_RPC` — cova fan-out client per-call (error -> connect error,
   delay -> added RPC latency);
 - :data:`MIRROR` — multihost leader broadcast (drop -> mirror message
-  lost).
+  lost);
+- :data:`KVNET_FETCH` — the network KV transport's peer fetch
+  (``kvnet.client``): error -> injected connect failure (the decode pod
+  must degrade to recompute, never fail the request), delay -> added
+  transfer latency.
 
 The module-level injector is built once from ``SHAI_FAULTS`` /
 ``SHAI_FAULTS_SEED`` and replaced at runtime via :func:`configure` (the
@@ -62,6 +66,7 @@ KV_RESERVE = "engine.kv_reserve"
 COMPILE = "engine.compile"
 COVA_RPC = "cova.rpc"
 MIRROR = "multihost.mirror"
+KVNET_FETCH = "kvnet.fetch"
 
 KINDS = ("delay", "stall", "error", "drop")
 

@@ -132,6 +132,26 @@ class ModelService:
         (services without an engine or without prefix caching)."""
         return None
 
+    #: disaggregated serving role (kvnet): advertised on ``/stats`` so
+    #: cova can route prefill work to prefill pods and hand warm KV to
+    #: decode pods; engine-backed services set it from
+    #: ``kvnet.resolve_role`` (SHAI_ROLE / EngineConfig.role)
+    role: str = "both"
+
+    def kv_tier(self):
+        """The host KV block pool (``kvtier.pool.HostKVTier``) backing the
+        ``GET /kv/blocks`` transport endpoint, or None when this pod has
+        no tier (the route then 404s — peers count a fallback and
+        recompute)."""
+        return None
+
+    def kvnet_stats(self):
+        """The pod's :class:`~..kvnet.client.KvNetStats` counters
+        (``shai_kvnet_*``), shared by the serve side (``/kv/blocks``) and
+        the fetch side (the decode-role handoff pull); None on pods
+        without a tier — the families then never export."""
+        return None
+
     def spec_counters(self) -> Optional[Dict[str, int]]:
         """Cumulative speculative-decoding counters
         (``{"drafted", "accepted", "committed"}``) for
@@ -239,9 +259,11 @@ def create_app(
     app.state.update(cfg=cfg, service=service, collector=collector, publisher=pub,
                      status=state, flight=flight, gate=gate, drainer=drainer,
                      ledger=ledger)
-    # lifecycle probes and scrape surfaces must not ring the flight recorder
+    # lifecycle probes and scrape surfaces must not ring the flight
+    # recorder; /kv/blocks is probe-class too — a decode fleet pulling KV
+    # runs would otherwise evict real request timelines from the ring
     app.trace_exclude |= {"/health/ready", "/debug/faults",
-                          "/debug/conformance", "/profile"}
+                          "/debug/conformance", "/profile", "/kv/blocks"}
 
     def _do_load_and_warm():
         t0 = time.perf_counter()
@@ -600,6 +622,16 @@ def create_app(
         aff = service.affinity_digests()
         if aff is not None:
             out.setdefault("kvtier", {})["affinity"] = aff
+        # disaggregated serving (kvnet): the pod's role — what cova's
+        # disagg router partitions the fleet by — plus the transport
+        # counters when the pod participates in the network KV plane
+        out["role"] = service.role
+        kn = service.kvnet_stats()
+        if kn is not None:
+            try:
+                out["kvnet"] = kn.snapshot()
+            except Exception:
+                pass
         # multi-tenant QoS: one "qos" section joining the budget ledger's
         # per-tenant usage (requests/tokens/inflight/shed/budget balance)
         # with the engine's per-tenant queue/slot/TTFT view and the
@@ -627,6 +659,50 @@ def create_app(
 
         out["aot"] = compile_stats()
         return out
+
+    @app.get("/kv/blocks")
+    async def kv_blocks(request: Request):
+        """Network KV transport (kvnet): serve this pod's host-tier blocks
+        by chain hash. ``?hashes=`` is a comma-joined list; the response
+        is the LEADING contiguous resident run as length-prefixed binary
+        frames (``kvnet.frames``) — ``(k, v)`` per block, or the quant
+        4-tuple ``(k, v, ks, vs)``, byte-exact. Probe-class route: no
+        admission gate (GET), excluded from the flight ring, bounded by
+        ``MAX_BLOCKS_PER_REQUEST``; a pod without a tier 404s and the
+        peer degrades to recompute. The copy-and-encode runs on the
+        DEFAULT executor, not the event loop (a full-cap pull at real
+        geometry is tens of MB of tobytes+crc — on the loop it would
+        stall /health and /readiness) and not the model lane (a KV pull
+        must never queue behind a denoise/decode holding the device)."""
+        from ..kvnet import client as kvnet_client
+        from ..kvnet import frames as kvnet_frames
+
+        tier = service.kv_tier()
+        if tier is None:
+            raise HTTPError(404, "no host KV tier on this pod")
+        raw = request.query.get("hashes", "")
+        try:
+            hashes = [int(h) for h in raw.split(",") if h.strip()]
+        except ValueError:
+            raise HTTPError(400, "hashes must be comma-joined integers")
+        if not hashes:
+            raise HTTPError(400, "missing hashes")
+        if len(hashes) > kvnet_client.MAX_BLOCKS_PER_REQUEST:
+            raise HTTPError(
+                400, f"at most {kvnet_client.MAX_BLOCKS_PER_REQUEST} "
+                     f"hashes per request")
+
+        def _gather() -> Tuple[int, bytes]:
+            run = tier.get_run(hashes)
+            return len(run), kvnet_frames.encode_frames(run)
+
+        n_run, body = await asyncio.get_running_loop().run_in_executor(
+            None, _gather)
+        stats = service.kvnet_stats()
+        if stats is not None:
+            stats.count_served(n_run, len(body))
+        return Response(body, media_type="application/octet-stream",
+                        headers={"x-shai-kv-blocks": str(n_run)})
 
     @app.get("/debug/conformance")
     def debug_conformance(request: Request):

@@ -123,6 +123,26 @@ _KVTIER_COUNTERS = {
     "dropped": ("shai_kvtier_dropped_total",
                 "Host KV tier: demotions dropped (queue full / no capacity)"),
 }
+#: network KV transport (kvnet.client.KvNetStats snapshot keys): the
+#: disaggregated-serving counters — fetched/served block flow, transport
+#: bytes, and the degrade signal (fallbacks = fetches that fell back to
+#: local recompute)
+_KVNET_COUNTERS = {
+    "fetched": ("shai_kvnet_fetched_total",
+                "kvnet: KV blocks pulled from peer pods into the host "
+                "tier"),
+    "served": ("shai_kvnet_served_total",
+               "kvnet: host-tier KV blocks served to peers over "
+               "/kv/blocks"),
+    "bytes": ("shai_kvnet_bytes_total",
+              "kvnet: frame bytes moved through this pod's transport "
+              "(served out + fetched in)"),
+    "errors": ("shai_kvnet_errors_total",
+               "kvnet: transport failures (connect/read/corrupt frames)"),
+    "fallbacks": ("shai_kvnet_fallbacks_total",
+                  "kvnet: fetches degraded to local recompute (open "
+                  "breaker, transport failure, rejected frames)"),
+}
 _KVTIER_GAUGES = {
     "used_bytes": ("shai_kvtier_used_bytes",
                    "Host KV tier: bytes resident in the host pool"),
@@ -243,6 +263,20 @@ class EngineTelemetryCollector:
                      for le, c in hs["buckets"]],
                     sum_value=float(hs["sum"]))
             yield h
+        # network KV transport (kvnet): the disaggregated-serving counter
+        # families, riding the same telemetry object — absent entirely on
+        # pods outside the network KV plane
+        kvn = getattr(tele, "kvnet", None)
+        if kvn is not None:
+            try:
+                snap = kvn.snapshot()
+            except Exception:
+                snap = None
+            if snap is not None:
+                for key, (name, doc) in _KVNET_COUNTERS.items():
+                    c = CounterMetricFamily(name, doc, labels=["app"])
+                    c.add_metric([self.app], float(snap.get(key, 0)))
+                    yield c
         # host KV tier (kvtier): counters with their _total contract +
         # occupancy gauges, from the same telemetry object
         kvt = getattr(tele, "kvtier", None)

@@ -70,6 +70,15 @@ class VllmService(ModelService):
         from ...kvtier.affinity import AffinityTracker
 
         self._affinity = AffinityTracker()
+        # disaggregated serving (kvnet): the pod's role (SHAI_ROLE wins
+        # over the ConfigMap's `role:`) — advertised on /stats pre-load so
+        # cova can partition the fleet before the engine finishes warmup;
+        # the transport client/stats attach in load() once the tier exists
+        from ...kvnet import resolve_role
+
+        self.role = resolve_role(self.ecfg.role if self.ecfg else "both")
+        self._kvnet = None
+        self._kvnet_stats = None
 
     @staticmethod
     def _resolve_ecfg(cfg: ServeConfig):
@@ -174,7 +183,8 @@ class VllmService(ModelService):
                 speculative_model=ecfg.speculative_model,
                 num_speculative_tokens=ecfg.num_speculative_tokens,
                 ngram_prompt_lookup_max=ecfg.ngram_prompt_lookup_max,
-                ngram_prompt_lookup_min=ecfg.ngram_prompt_lookup_min)
+                ngram_prompt_lookup_min=ecfg.ngram_prompt_lookup_min,
+                role=ecfg.role)
 
         self.ecfg = ecfg
         if ecfg.quantization == "int8":
@@ -260,6 +270,18 @@ class VllmService(ModelService):
         n = engine.warm_executables(prefix_lens)
         log.info("engine: warmed %d executables (buckets=%s, prefixes=%s)",
                  n, list(engine.buckets.buckets), prefix_lens)
+        # network KV transport (kvnet): with a host tier attached this pod
+        # joins the network KV plane — /kv/blocks serves its tier, and a
+        # decode-role handoff can pull a peer's run before admission. ONE
+        # stats object (built by the engine, riding its telemetry seam)
+        # feeds both directions, so the shai_kvnet_* families export with
+        # zero new plumbing.
+        self.role = engine.role   # env-resolved; engine + serve must agree
+        if engine.cache.tier is not None:
+            from ...kvnet.client import KvNetClient
+
+            self._kvnet_stats = engine.obs.kvnet
+            self._kvnet = KvNetClient(engine.cache.tier, self._kvnet_stats)
         self.loop = EngineLoop(engine).start()
         # step watchdog (liveness): a wedged dispatch — work pending but no
         # step completing for N x the p99 step time — fails /health so
@@ -301,10 +323,21 @@ class VllmService(ModelService):
         tier = getattr(getattr(eng, "cache", None), "tier", None)
         if tier is not None:
             tier.close(max(0.5, budget_s - (_time.monotonic() - t0)))
+        kn = getattr(self, "_kvnet", None)
+        if kn is not None:
+            kn.close()  # the shared transport client's sockets
 
     def engine_telemetry(self):
         eng = getattr(self, "_engine", None)
         return None if eng is None else eng.obs
+
+    def kv_tier(self):
+        eng = getattr(self, "_engine", None)
+        cache = getattr(eng, "cache", None)
+        return getattr(cache, "tier", None)
+
+    def kvnet_stats(self):
+        return getattr(self, "_kvnet_stats", None)
 
     def _encode(self, text: str, add_special: bool = True):
         # the engine's true capacity, not the largest bucket — prompts past
@@ -370,6 +403,24 @@ class VllmService(ModelService):
         if not ids:
             raise HTTPError(400, "empty prompt")
         params = self._sampling_from(payload)
+        if self.role == "prefill":
+            # disaggregated serving: a prefill pod finishes the prompt and
+            # hands the warm KV REFERENCE back instead of decoding (params
+            # stay validated above — a bad request 400s the same on every
+            # role). Sampling happens on the decode pod; greedy exactness
+            # holds because token 1 is re-derived there from the same
+            # logits the warm continuation chunk produces.
+            return self._prefill_handoff(prompt, ids)
+        if payload.get("kv_peer") and self._kvnet is not None:
+            # decode side of the handoff: pull the prompt's full-block KV
+            # run from the peer into the LOCAL host tier before admission;
+            # the ordinary tier fall-through then restores it via the
+            # donated scatter. Shortfall or transport failure degrades to
+            # recompute — never to request failure.
+            self._pull_handoff(str(payload["kv_peer"]),
+                               payload.get("kv_hashes_len"), ids,
+                               prompt=prompt,
+                               digest=str(payload.get("kv_digest") or ""))
         prefix = None
         cross_states = None
         cross_len = 0
@@ -424,6 +475,84 @@ class VllmService(ModelService):
 
             self._affinity.note(prompt_affinity(prompt))
         return out
+
+    def _prefill_handoff(self, prompt: str, ids) -> Dict[str, Any]:
+        """Prefill-role ``/generate``: run the prompt through the engine
+        (one generated token, discarded — prefill yields token 1 but the
+        decode pod re-derives it), let the engine's finish path demote the
+        full prefix run to the host tier, and return the handoff
+        reference. ``kv_ready: false`` (tier-less pod / sub-block prompt)
+        tells cova to fall back to monolithic routing."""
+        from ...kvtier.affinity import prompt_affinity
+        from ...obs.util import env_str
+
+        eng = self._engine
+        tier = eng.cache.tier
+        hashes_len = (len(ids) // eng.ecfg.block_size
+                      if eng.cache.prefix_caching else 0)
+        kv_ready = tier is not None and hashes_len > 0
+        sp = self._SamplingParams(temperature=0.0, max_new_tokens=1,
+                                  eos_id=self.eos_id)
+        out = self._collect(self.loop.submit(
+            list(ids), sp, deadline_at=self._deadline_at(),
+            **self._qos_kw()))
+        if kv_ready:
+            try:
+                # async copy-outs publish before the peer's pull lands —
+                # bounded by the queued copies; a failure just means the
+                # peer sees a shorter run and recomputes the rest
+                tier.drain()
+            except Exception:
+                log.warning("kvnet: tier drain after prefill failed",
+                            exc_info=True)
+        if eng.cache.prefix_caching:
+            self._affinity.note(prompt_affinity(prompt))
+        return {
+            "kv_ready": bool(kv_ready),
+            "digest": prompt_affinity(prompt),
+            "hashes_len": hashes_len,
+            # the pull address peers should use; empty = let the
+            # orchestrator substitute the URL it already routes this pod by
+            "peer_url": env_str("SHAI_KVNET_PEER_URL", ""),
+            "n_prompt": out.get("n_prompt", len(ids)),
+            "role": "prefill",
+        }
+
+    def _pull_handoff(self, peer: str, hashes_len, ids, prompt: str = "",
+                      digest: str = "") -> int:
+        """Decode-role handoff pull: make the local host tier hold the
+        prompt's leading full-block run by fetching missing blocks from
+        ``peer``. Never raises — every failure path inside the client
+        degrades to recompute and counts a fallback. A handoff whose
+        ``kv_digest`` does not match THIS prompt's affinity digest is a
+        mis-routed reference (an orchestrator bug, or a retried request
+        re-paired with a stale handoff) — the pull is skipped entirely:
+        the fetch would only move blocks the admission walk can never
+        match."""
+        if digest and prompt:
+            from ...kvtier.affinity import prompt_affinity
+
+            if digest != prompt_affinity(prompt):
+                log.warning("kvnet: handoff digest %s does not match the "
+                            "request's prompt — skipping the pull "
+                            "(recompute)", digest)
+                return 0
+        try:
+            hl = int(hashes_len or 0)
+        except (TypeError, ValueError):
+            hl = 0
+        hashes = self._engine.cache.prefix_hashes(list(ids))
+        if hl > 0:
+            hashes = hashes[:hl]
+        if not hashes:
+            return 0
+        # the pull's aggregate budget is bounded by the request deadline
+        # where one exists: a drip-feeding peer must not eat the whole
+        # deadline the generation still has to fit inside
+        dl = rz_deadline.current_deadline()
+        budget = None if dl is None else max(0.0, dl.remaining_s)
+        with obs_trace.span("kvnet_fetch", annotation=False):
+            return self._kvnet.fetch_run(peer, hashes, budget_s=budget)
 
     @staticmethod
     def _deadline_at() -> float:
@@ -548,6 +677,7 @@ class VllmService(ModelService):
                          kind: str, add_special: bool = True) -> Dict[str, Any]:
         import time as _time
 
+        self._require_decode_role()
         n = self._openai_n(body)
         # 16 is the legacy /v1/completions default; chat has none — an SDK
         # chat client omitting max_tokens gets the engine cap, not a stub
@@ -686,6 +816,7 @@ class VllmService(ModelService):
 
         from ..asgi import StreamingResponse
 
+        self._require_decode_role()
         if self._openai_n(body) != 1:
             raise HTTPError(400, "n > 1 is not supported with stream: true")
         if body.get("logprobs"):
@@ -794,6 +925,16 @@ class VllmService(ModelService):
                     self.loop.cancel(fut)
 
         return StreamingResponse(chunks())
+
+    def _require_decode_role(self) -> None:
+        """The OpenAI surface returns TEXT — on a prefill-role pod (whose
+        ``/generate`` returns KV handoffs, not completions) a routed SDK
+        client is a deploy/routing error, surfaced as a client error
+        rather than a kv_ready dict masquerading as a completion."""
+        if self.role == "prefill":
+            raise HTTPError(
+                400, "this pod serves prefill handoffs only (role="
+                     "prefill); route completion requests to a decode pod")
 
     def _chat_prompt(self, messages):
         """Messages → (prompt text, templated) — templated text carries its

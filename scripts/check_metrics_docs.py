@@ -46,6 +46,9 @@ SCAN_FILES = (
     # the host KV tier's shai_kvtier_* family (exported via serve/metrics;
     # scanned here too so a counter added pool-side can't go undocumented)
     os.path.join(PKG, "kvtier", "pool.py"),
+    # the network KV transport's shai_kvnet_* family (same contract: a
+    # counter added client-side must reach the README runbook)
+    os.path.join(PKG, "kvnet", "client.py"),
 )
 README = os.path.join(ROOT, "README.md")
 

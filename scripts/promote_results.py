@@ -36,7 +36,11 @@ KEYS = {"sd": "sd21_img_s",
         "ragged": "ragged_tps",
         # multi-tenant QoS (PR 12): high-priority tenant p99 TTFT under a
         # low-priority flood, FIFO/QoS ratio (bench.py qos)
-        "qos": "qos_flood_p99_ratio"}
+        "qos": "qos_flood_p99_ratio",
+        # disaggregated prefill/decode (PR 14): decode-pod TTFT p50 vs the
+        # monolithic pod under mixed prompt load, KV shipped through the
+        # kvnet frame codec (bench.py disagg)
+        "disagg": "disagg_ttft_ratio"}
 
 
 def _load_results() -> dict:

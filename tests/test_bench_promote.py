@@ -233,3 +233,38 @@ def test_qos_bench_acceptance_on_cpu_tiny():
     # the flood actually hurt FIFO (the A has a real B to beat)
     assert out["fifo"]["vip_ttft_p99_ms"] > \
         2 * out["fifo"]["vip_ttft_noflood_p50_ms"]
+
+
+def test_disagg_key_promotes_ttft_ratio():
+    # PR-14 tentpole: the disaggregated prefill/decode bench publishes
+    # under its own key and dispatches as its own variant (never banking
+    # as another bench)
+    assert promote.KEYS["disagg"] == "disagg_ttft_ratio"
+    bspec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(ROOT, "bench.py"))
+    bench = importlib.util.module_from_spec(bspec)
+    bspec.loader.exec_module(bench)
+    assert bench._which_from_argv(["bench.py", "disagg"]) == "disagg"
+    assert bench.UNITS_BY_BENCH["disagg"] == "x"
+    assert promote.is_real(_entry(metric="disagg ttft ratio (tpu)",
+                                  unit="x"))
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_disagg_bench_acceptance_on_cpu_tiny():
+    """The PR-14 acceptance number, measured: under the long mixed-prompt
+    load, the decode pod generating from handed-off KV (shipped through
+    the kvnet frame codec) beats the monolithic pod's TTFT (value =
+    mono_p50/disagg_p50 > 1), and blocks actually moved over the wire."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--inner",
+         "disagg", "--cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu" and out["unit"] == "x"
+    assert out["value"] > 1.0, out
+    assert out["disagg_ttft_p50_ms"] < out["mono_ttft_p50_ms"]
+    assert out["blocks_shipped"] > 0
+    assert out["decode_tier"]["restored"] > 0
+    assert out["decode_tier"]["errors"] == 0

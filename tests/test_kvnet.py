@@ -1,0 +1,1146 @@
+"""Disaggregated prefill/decode serving (kvnet/): network KV transport.
+
+THE invariant, one layer up from kvtier's: the WIRE changes where KV
+bytes come from — never what gets generated. Frame roundtrips are
+byte-exact (bf16 and the int8 quant 4-tuple alike, truncation/corruption
+rejected); a decode engine generating from network-restored KV is greedy
+token-exact vs the same prompt served end-to-end on one monolithic
+engine (both async disciplines, int8 byte-exact transport); injected
+transport faults (``SHAI_FAULTS`` site ``kvnet.fetch``) degrade to
+recompute with pool-exact accounting on both pods; and the live socket
+suite drives cova's prefill-pod → decode-pod handoff end to end
+(``routed_by: disagg``, all ``shai_kvnet_*`` families on /metrics).
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+from scalable_hw_agnostic_inference_tpu.engine.engine import (
+    LLMEngine,
+    SamplingParams,
+)
+from scalable_hw_agnostic_inference_tpu.kvnet import frames, resolve_role
+from scalable_hw_agnostic_inference_tpu.kvnet.client import (
+    KvNetClient,
+    KvNetStats,
+)
+from scalable_hw_agnostic_inference_tpu.kvtier.pool import HostKVTier
+from scalable_hw_agnostic_inference_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+from scalable_hw_agnostic_inference_tpu.resilience import faults as rz_faults
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, params
+
+
+def make_engine(tiny_model, monkeypatch, role="both", tier=True, quant=False,
+                async_decode=None, **over):
+    cfg, _, params = tiny_model
+    monkeypatch.setenv("SHAI_KVTIER", "1" if tier else "0")
+    monkeypatch.setenv("SHAI_KVTIER_ASYNC", "0")
+    monkeypatch.setenv("SHAI_KV_QUANT", "int8" if quant else "")
+    if async_decode is not None:
+        monkeypatch.setenv("SHAI_ASYNC_DECODE", "1" if async_decode else "0")
+    kw = dict(max_model_len=128, max_num_seqs=3, block_size=8,
+              context_encoding_buckets=(16, 32), max_new_tokens=16,
+              enable_prefix_caching=True, role=role)
+    kw.update(over)
+    return LLMEngine(cfg, params, EngineConfig(**kw))
+
+
+def _prompt(seed, length=40):
+    rng = np.random.default_rng(seed)
+    return [int(x) for x in rng.integers(2, 500, length)]
+
+
+def _run_all(eng, prompts, sp):
+    ids = [eng.add_request(list(p), sp) for p in prompts]
+    done = {}
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    eng.finish_pending()
+    return [done[i] for i in ids]
+
+
+def _assert_pool_exact(eng):
+    cache = eng.cache
+    assert cache.active == []
+    used = (cache.total_blocks - 1) - cache.allocator.n_free
+    assert used == len(cache._block2hash)
+    assert cache.leaked_blocks == 0
+    tier = cache.tier
+    if tier is not None:
+        tier.drain()
+        snap = tier.snapshot()
+        assert snap["used_bytes"] == snap["entries"] * snap["block_nbytes"]
+        assert snap["used_bytes"] <= snap["capacity_bytes"]
+
+
+def _ship(src_tier, dst_tier, hashes) -> int:
+    """The wire, in-process: leading run -> frames -> peer tier."""
+    run = src_tier.get_run(hashes)
+    if not run:
+        return 0
+    entries = frames.decode_frames(frames.encode_frames(run))
+    n_arr = len(entries[0]) - 1
+    stacked = [np.stack([e[1 + ai] for e in entries], axis=1)
+               for ai in range(n_arr)]
+    dst_tier.store_batch([e[0] for e in entries], *stacked, len(entries))
+    return len(entries)
+
+
+# -- frame codec: byte-exact property tests -----------------------------------
+
+def _rand_entry(rng, h, dtypes, shapes):
+    arrays = []
+    for dt, shp in zip(dtypes, shapes):
+        a = rng.standard_normal(shp)
+        if np.dtype(dt) == np.int8:
+            a = (a * 20).astype(np.int8)
+        else:
+            a = a.astype(dt)
+        arrays.append(a)
+    return (h, *arrays)
+
+
+def test_frame_roundtrip_bf16_property():
+    """Seeded randomized roundtrips: bf16 and f32 block entries decode
+    byte-exact (dtype, shape, and raw bytes all preserved)."""
+    bf16 = jnp.bfloat16.dtype
+    rng = np.random.default_rng(11)
+    for trial in range(8):
+        entries = []
+        for j in range(rng.integers(1, 5)):
+            L, bs, hk, dh = (int(rng.integers(1, 4)) for _ in range(4))
+            dt = bf16 if trial % 2 == 0 else np.float32
+            entries.append(_rand_entry(
+                rng, int(rng.integers(-2**62, 2**62)), (dt, dt),
+                ((L, bs, hk, dh), (L, bs, hk, dh))))
+        out = frames.decode_frames(frames.encode_frames(entries))
+        assert len(out) == len(entries)
+        for want, got in zip(entries, out):
+            assert got[0] == want[0]
+            assert len(got) == len(want)
+            for aw, ag in zip(want[1:], got[1:]):
+                assert ag.dtype == aw.dtype and ag.shape == aw.shape
+                assert ag.tobytes() == aw.tobytes()
+
+
+def test_frame_roundtrip_int8_quant_four_tuple():
+    """The quant entry — int8 blocks + f32 scale rows — crosses the codec
+    byte-exact, all four buffers."""
+    rng = np.random.default_rng(7)
+    ent = _rand_entry(rng, -12345, (np.int8, np.int8, np.float32,
+                                    np.float32),
+                      ((2, 4, 2, 3), (2, 4, 2, 3), (2, 2), (2, 2)))
+    [got] = frames.decode_frames(frames.encode_frames([ent]))
+    assert got[0] == -12345 and len(got) == 5
+    for aw, ag in zip(ent[1:], got[1:]):
+        assert ag.dtype == aw.dtype and ag.tobytes() == aw.tobytes()
+
+
+def test_frame_truncation_rejected_at_every_cut():
+    """A truncated stream NEVER yields a half-parsed frame: every proper
+    prefix of a valid stream either raises FrameError or decodes to a
+    strict prefix of whole frames (a cut exactly at a frame boundary IS a
+    shorter stream — the leading-run contract; the hash-prefix check in
+    the client handles run semantics). Empty input is the empty run."""
+    rng = np.random.default_rng(3)
+    e1 = _rand_entry(rng, 5, (np.float32, np.float32),
+                     ((1, 2, 1, 2), (1, 2, 1, 2)))
+    e2 = _rand_entry(rng, 6, (np.float32, np.float32),
+                     ((1, 2, 1, 2), (1, 2, 1, 2)))
+    frame1 = frames.encode_frames([e1])
+    data = frame1 + frames.encode_frames([e2])
+    assert frames.decode_frames(b"") == []
+    boundary = len(frame1)
+    for cut in range(1, len(data)):
+        if cut == boundary:
+            out = frames.decode_frames(data[:cut])
+            assert len(out) == 1 and out[0][0] == 5
+            continue
+        with pytest.raises(frames.FrameError):
+            frames.decode_frames(data[:cut])
+
+
+def test_frame_corruption_rejected():
+    """Flipped bits anywhere in the stream are caught (CRC over the body,
+    strict header/length validation around it)."""
+    rng = np.random.default_rng(4)
+    data = bytearray(frames.encode_frames([
+        _rand_entry(rng, 9, (np.float32, np.float32),
+                    ((2, 3, 2, 2), (2, 3, 2, 2)))]))
+    for pos in rng.integers(0, len(data), 24):
+        mutated = bytearray(data)
+        mutated[pos] ^= 0x41
+        try:
+            out = frames.decode_frames(bytes(mutated))
+        except frames.FrameError:
+            continue
+        # astronomically unlikely; tolerate only a decode that round-trips
+        # to something — never a silent half-parse
+        assert len(out) == 1
+    with pytest.raises(frames.FrameError):
+        frames.decode_frames(b"garbage that is not a frame stream")
+
+
+# -- host pool recency (satellite): get_run == probe_run ----------------------
+
+def _tier(capacity_blocks=4, quant=False, async_copy=False):
+    t = HostKVTier(n_layers=2, block_size=4, n_kv_heads=2, head_dim=4,
+                   dtype=np.int8 if quant else np.float32,
+                   capacity_bytes=0, async_copy=async_copy, quant=quant)
+    t.capacity_bytes = capacity_blocks * t.block_nbytes
+    return t
+
+
+def _blockdata(tier, n, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (tier.n_layers, n, tier.block_size, tier.n_kv_heads,
+             tier.head_dim)
+    if tier.quant:
+        sc = (tier.n_layers, n, tier.n_kv_heads)
+        return ((rng.standard_normal(shape) * 20).astype(np.int8),
+                (rng.standard_normal(shape) * 20).astype(np.int8),
+                rng.standard_normal(sc).astype(np.float32),
+                rng.standard_normal(sc).astype(np.float32))
+    return (rng.standard_normal(shape).astype(tier.dtype),
+            rng.standard_normal(shape).astype(tier.dtype))
+
+
+def test_get_run_refreshes_recency_like_probe():
+    """A network-served run (get_run, the /kv/blocks path) must refresh
+    LRU recency exactly like an admission probe — otherwise the blocks a
+    pod just advertised to a peer are first in line for eviction and the
+    peer's pull lands on a shortfall."""
+    t = _tier(capacity_blocks=4)
+    t.store_batch([1, 2, 3, 4], *_blockdata(t, 4), 4)
+    # serve 1, 2 to a peer: they become most-recent
+    assert [e[0] for e in t.get_run([1, 2])] == [1, 2]
+    # pressure: two more stores must evict the UNTOUCHED 3, 4
+    t.store_batch([5, 6], *_blockdata(t, 2, seed=1), 2)
+    assert t.has(1) and t.has(2)
+    assert not t.has(3) and not t.has(4)
+    # and probe_run after the same sequence behaves identically
+    t2 = _tier(capacity_blocks=4)
+    t2.store_batch([1, 2, 3, 4], *_blockdata(t2, 4), 4)
+    assert t2.probe_run([1, 2]) == 2
+    t2.store_batch([5, 6], *_blockdata(t2, 2, seed=1), 2)
+    assert t2.has(1) and t2.has(2) and not t2.has(3) and not t2.has(4)
+
+
+# -- role resolution ----------------------------------------------------------
+
+def test_resolve_role_env_wins_and_is_lenient(monkeypatch):
+    monkeypatch.delenv("SHAI_ROLE", raising=False)
+    assert resolve_role("prefill") == "prefill"
+    assert resolve_role() == "both"
+    monkeypatch.setenv("SHAI_ROLE", "decode")
+    assert resolve_role("prefill") == "decode"
+    monkeypatch.setenv("SHAI_ROLE", "prefil")  # typo: keep the config role
+    assert resolve_role("prefill") == "prefill"
+    assert resolve_role("bogus") == "both"
+
+
+def test_engine_config_role_validated():
+    EngineConfig(role="prefill")
+    with pytest.raises(ValueError):
+        EngineConfig(role="prefetch")
+
+
+# -- client units (hermetic: httpx.MockTransport) -----------------------------
+
+def _mock_client(src_tier, dst_tier, stats=None, handler=None,
+                 connect_retries=0, **kw):
+    httpx = pytest.importorskip("httpx")
+
+    def default_handler(request):
+        hashes = [int(h) for h in
+                  request.url.params["hashes"].split(",")]
+        return httpx.Response(
+            200, content=frames.encode_frames(src_tier.get_run(hashes)))
+
+    transport = httpx.MockTransport(handler or default_handler)
+    return KvNetClient(dst_tier, stats or KvNetStats(),
+                       transport=transport,
+                       connect_retries=connect_retries, **kw)
+
+
+def test_client_fetch_publishes_leading_run():
+    src, dst = _tier(8), _tier(8)
+    src.store_batch([1, 2, 3], *_blockdata(src, 3), 3)
+    c = _mock_client(src, dst)
+    # 4 is absent on the peer: the leading run lands, the tail recomputes
+    assert c.fetch_run("http://peer", [1, 2, 3, 4]) == 3
+    assert dst.has(1) and dst.has(2) and dst.has(3) and not dst.has(4)
+    snap = c.stats.snapshot()
+    assert snap["fetched"] == 3 and snap["bytes"] > 0
+    assert snap["errors"] == 0 and snap["fallbacks"] == 0
+    # the published bytes are BYTE-exact vs the source entries
+    for (hs, *src_arrays) in src.get_run([1, 2, 3]):
+        got = dst.get_run([hs])[0][1:]
+        for aw, ag in zip(src_arrays, got):
+            assert ag.tobytes() == aw.tobytes()
+    # already-resident run: no second fetch
+    assert c.fetch_run("http://peer", [1, 2, 3]) == 3
+    assert c.stats.snapshot()["fetched"] == 3
+
+
+def test_client_fetch_quant_four_tuple_byte_exact():
+    src, dst = _tier(8, quant=True), _tier(8, quant=True)
+    src.store_batch([11, 12], *_blockdata(src, 2), 2)
+    c = _mock_client(src, dst)
+    assert c.fetch_run("http://peer", [11, 12]) == 2
+    for (hs, *src_arrays) in src.get_run([11, 12]):
+        got = dst.get_run([hs])[0][1:]
+        assert len(got) == 4
+        for aw, ag in zip(src_arrays, got):
+            assert ag.dtype == aw.dtype and ag.tobytes() == aw.tobytes()
+
+
+def test_client_connect_error_degrades_and_breaker_opens():
+    httpx = pytest.importorskip("httpx")
+    src, dst = _tier(4), _tier(4)
+
+    def dead(request):
+        raise httpx.ConnectError("refused")
+
+    stats = KvNetStats()
+    c = _mock_client(src, dst, stats=stats, handler=dead)
+    for _ in range(4):  # past the breaker threshold (3)
+        assert c.fetch_run("http://peer", [1, 2]) == 0
+    snap = stats.snapshot()
+    assert snap["fallbacks"] >= 4 and snap["errors"] >= 3
+    assert c.breaker_of("http://peer").state != "closed"
+    # open breaker: fail-fast fallback, no transport attempt
+    errs = snap["errors"]
+    assert c.fetch_run("http://peer", [1, 2]) == 0
+    assert stats.snapshot()["errors"] == errs
+
+
+def test_client_recovered_retry_does_not_accumulate_breaker_failures():
+    """A transient connect blip that the bounded retry recovers must
+    reset the breaker — three recovered blips across fetches previously
+    accumulated consecutive_failures and opened the circuit on a healthy
+    peer (review finding, regression-pinned)."""
+    httpx = pytest.importorskip("httpx")
+    src = _tier(8)
+    src.store_batch([1, 2], *_blockdata(src, 2), 2)
+    state = {"calls": 0}
+
+    def flaky(request):
+        state["calls"] += 1
+        if state["calls"] % 2 == 1:  # every FIRST attempt blips
+            raise httpx.ConnectError("blip")
+        hashes = [int(h) for h in request.url.params["hashes"].split(",")]
+        return httpx.Response(
+            200, content=frames.encode_frames(src.get_run(hashes)))
+
+    for round_i in range(4):  # past the breaker threshold if it leaked
+        dst = _tier(8)
+        c = _mock_client(src, dst, handler=flaky, connect_retries=1)
+        assert c.fetch_run("http://peer", [1, 2]) == 2, round_i
+        assert c.breaker_of("http://peer").state == "closed"
+
+
+def test_client_rejects_dtype_drift():
+    """A peer on a different KV dtype (mixed-dtype rollout) must be
+    rejected: the local pool prices used_bytes off its OWN dtype, and a
+    silently-cast block breaks the byte-exact restore contract."""
+    src = HostKVTier(n_layers=2, block_size=4, n_kv_heads=2, head_dim=4,
+                     dtype=np.float64, capacity_bytes=1 << 20,
+                     async_copy=False)
+    src.store_batch([1], *_blockdata(src, 1), 1)
+    dst = _tier(8)  # float32 pool, identical dims
+    c = _mock_client(src, dst)
+    assert c.fetch_run("http://peer", [1]) == 0
+    assert not dst.has(1)
+    assert c.stats.snapshot()["fallbacks"] == 1
+
+
+def test_client_rejects_corrupt_and_mismatched_frames():
+    httpx = pytest.importorskip("httpx")
+    src, dst = _tier(8), _tier(8)
+    src.store_batch([1, 2], *_blockdata(src, 2), 2)
+
+    c = _mock_client(src, dst, handler=lambda r: httpx.Response(
+        200, content=b"not frames at all"))
+    assert c.fetch_run("http://peer", [1, 2]) == 0
+    assert c.stats.snapshot()["fallbacks"] == 1
+
+    # frames for hashes we did not ask for (a confused peer): rejected,
+    # nothing published
+    def wrong_hashes(request):
+        return httpx.Response(200,
+                              content=frames.encode_frames(
+                                  src.get_run([2, 1][:1])))
+
+    c2 = _mock_client(src, dst, handler=wrong_hashes)
+    assert c2.fetch_run("http://peer", [1, 2]) == 0
+    assert not dst.has(2)
+
+    # geometry drift (peer built at another shape): rejected
+    big = HostKVTier(n_layers=2, block_size=8, n_kv_heads=2, head_dim=4,
+                     dtype=np.float32, capacity_bytes=1 << 20,
+                     async_copy=False)
+    big.store_batch([1], *_blockdata(big, 1), 1)
+    c3 = _mock_client(big, dst)
+    assert c3.fetch_run("http://peer", [1]) == 0
+    assert not dst.has(1)
+
+    # non-200 (tier-less peer): a counted fallback, never a raise
+    c4 = _mock_client(src, dst, handler=lambda r: httpx.Response(
+        404, content=b""))
+    assert c4.fetch_run("http://peer", [1]) == 0
+
+
+def test_client_budget_and_peer_validation():
+    """Review hardening, regression-pinned: (a) a zero/spent aggregate
+    budget degrades without touching the wire; (b) non-http(s) and
+    non-allowlisted peers are refused (the payload names the fetch
+    target); (c) the per-peer breaker table is bounded."""
+    from scalable_hw_agnostic_inference_tpu.kvnet.client import (
+        MAX_PEER_BREAKERS,
+    )
+
+    src, dst = _tier(8), _tier(8)
+    src.store_batch([1, 2], *_blockdata(src, 2), 2)
+    c = _mock_client(src, dst)
+    # (a) budget spent before the first chunk: counted fallback, no fetch
+    assert c.fetch_run("http://peer", [1, 2], budget_s=0.0) == 0
+    assert c.stats.snapshot()["fallbacks"] == 1
+    assert not dst.has(1)
+    # (b) scheme validation
+    assert c.fetch_run("ftp://169.254.169.254/x", [1, 2]) == 0
+    assert c.stats.snapshot()["fallbacks"] == 2
+    # (b) allowlist pins the reachable set
+    c2 = _mock_client(src, dst)
+    c2.allowed_peers = ("http://trusted",)
+    assert c2.fetch_run("http://attacker", [1, 2]) == 0
+    assert c2.stats.snapshot()["fallbacks"] == 1
+    assert c2.fetch_run("http://trusted:8000", [1, 2]) == 2
+    # (c) breaker table bounded under a peer-per-request flood
+    c3 = _mock_client(src, dst)
+    for i in range(MAX_PEER_BREAKERS + 40):
+        c3.breaker_of(f"http://p{i}")
+    with c3._lock:
+        assert len(c3._breakers) <= MAX_PEER_BREAKERS
+
+
+def test_client_publish_is_synchronous_on_async_tiers():
+    """Fetched blocks are host numpy already: they must be RESIDENT the
+    moment fetch_run returns, even on the default async-copy-out tier —
+    routing them through the worker queue raced the admission probe the
+    pull exists to warm (review finding, regression-pinned; the worker
+    exists only to pay device->host copies)."""
+    src = _tier(8)
+    src.store_batch([1, 2, 3], *_blockdata(src, 3), 3)
+    dst = _tier(8, async_copy=True)       # the shipped default
+    c = _mock_client(src, dst)
+    assert c.fetch_run("http://peer", [1, 2, 3]) == 3
+    # resident NOW, without any drain, and no worker thread was spawned
+    assert dst.has(1) and dst.has(2) and dst.has(3)
+    assert dst._worker is None
+
+
+def test_peer_allowed_boundary_and_userinfo():
+    """Allowlist matching is boundary-anchored and userinfo URLs are
+    refused outright — raw startswith waved http://kv.internal.evil.com
+    and credential-trick URLs through (review finding)."""
+    src, dst = _tier(4), _tier(4)
+    c = _mock_client(src, dst)
+    c.allowed_peers = ("http://kv.internal",)
+    assert c.peer_allowed("http://kv.internal")
+    assert c.peer_allowed("http://kv.internal/")
+    assert c.peer_allowed("http://kv.internal:8000")
+    assert c.peer_allowed("http://kv.internal/kv/blocks")
+    assert not c.peer_allowed("http://kv.internal.evil.com")
+    assert not c.peer_allowed("http://kv.internal@evil.com")
+    assert not c.peer_allowed("http://kv.internal:80@evil.com")
+    assert not c.peer_allowed("https://kv.internal")  # scheme is part of it
+    c.allowed_peers = ()
+    assert c.peer_allowed("http://anything")           # cluster default
+    assert not c.peer_allowed("http://user@anything")  # userinfo never
+
+
+def test_chain_hashes_stable_across_interpreter_hash_seeds():
+    """The chain hashes are a cross-pod wire protocol now (/kv/blocks is
+    keyed by them): they must be a stable function of the tokens alone,
+    not of the interpreter's hash state (review finding — the builtin
+    tuple hash is CPython-build-dependent)."""
+    import subprocess
+    import sys
+
+    from scalable_hw_agnostic_inference_tpu.engine.cache import PagedKVCache
+
+    tokens = list(range(100, 164))
+    local = PagedKVCache._chain_hashes(tokens, 16)
+    assert len(local) == 4
+    code = (
+        "import sys; sys.path.insert(0, {root!r})\n"
+        "from scalable_hw_agnostic_inference_tpu.engine.cache import "
+        "PagedKVCache\n"
+        "print(PagedKVCache._chain_hashes(list(range(100, 164)), 16))\n"
+    ).format(root=os.path.join(os.path.dirname(__file__), os.pardir))
+    for seed in ("0", "12345"):
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env={**os.environ, "PYTHONHASHSEED": seed,
+                 "JAX_PLATFORMS": "cpu"}, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert eval(r.stdout.strip()) == local, seed
+
+
+def test_client_caps_oversized_peer_responses():
+    """The response is size-checked WHILE streaming: a hostile peer
+    pushing a huge body is cut off at the chunk cap and counted as a
+    degrade — never buffered whole (review finding: OOM via kv_peer)."""
+    httpx = pytest.importorskip("httpx")
+    src, dst = _tier(8), _tier(8)
+
+    def huge(request):
+        # far past len(chunk) * block_nbytes * 2 + 64KiB for this tiny
+        # geometry (block_nbytes = 512)
+        return httpx.Response(200, content=b"\x00" * (2 << 20))
+
+    c = _mock_client(src, dst, handler=huge)
+    assert c.fetch_run("http://peer", [1, 2]) == 0
+    snap = c.stats.snapshot()
+    assert snap["fallbacks"] == 1 and snap["errors"] == 1
+    assert dst.n_entries == 0
+
+
+def test_client_probe_does_not_skew_admission_hit_rate():
+    """The transport's pre-fetch probe is stat-free: a decode fleet's
+    pulls must not blend into the shai_kvtier hit-rate the admission
+    ladder exports (review finding)."""
+    src, dst = _tier(8), _tier(8)
+    src.store_batch([1, 2], *_blockdata(src, 2), 2)
+    c = _mock_client(src, dst)
+    assert c.fetch_run("http://peer", [1, 2]) == 2
+    snap = dst.snapshot()
+    assert snap["hits"] == 0 and snap["misses"] == 0
+    # the engine's own admission probe still counts
+    assert dst.probe_run([1, 2]) == 2
+    assert dst.snapshot()["hits"] == 2
+
+
+def test_client_fault_site_kvnet_fetch_degrades():
+    """SHAI_FAULTS site kvnet.fetch: an injected transport fault degrades
+    to recompute (short return + fallback counters), never raises."""
+    src, dst = _tier(4), _tier(4)
+    src.store_batch([1, 2], *_blockdata(src, 2), 2)
+    rz_faults.configure("kvnet.fetch=error", 0)
+    try:
+        c = _mock_client(src, dst)
+        assert c.fetch_run("http://peer", [1, 2]) == 0
+        snap = c.stats.snapshot()
+        assert snap["fallbacks"] == 1 and snap["errors"] == 1
+        assert not dst.has(1)
+    finally:
+        rz_faults.reset()
+
+
+# -- engine-level differential: handoff == monolithic -------------------------
+
+def _handoff_differential(tiny_model, monkeypatch, quant=False,
+                          async_decode=None, length=40):
+    sp1 = SamplingParams(temperature=0.0, max_new_tokens=1)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    prompt = _prompt(5, length)
+    pre = make_engine(tiny_model, monkeypatch, role="prefill", quant=quant,
+                      async_decode=async_decode)
+    dec = make_engine(tiny_model, monkeypatch, role="decode", quant=quant,
+                      async_decode=async_decode)
+    mono = make_engine(tiny_model, monkeypatch, role="both", tier=False,
+                       quant=quant, async_decode=async_decode)
+    # prefill pod: finish the prompt; the engine demotes the full run
+    _run_all(pre, [prompt], sp1)
+    hashes = pre.cache.prefix_hashes(prompt)
+    assert pre.cache.tier.n_entries == len(hashes) > 0, \
+        "prefill role did not bank the prompt's full-block run"
+    # the wire (byte-exact: encode -> decode -> peer store)
+    assert _ship(pre.cache.tier, dec.cache.tier, hashes) == len(hashes)
+    if quant:
+        # int8 transport is BYTE-exact: all four buffers identical on
+        # both pods' tiers
+        for (hs, *src_arrays) in pre.cache.tier.get_run(hashes):
+            got = dec.cache.tier.get_run([hs])[0][1:]
+            assert len(got) == 4
+            for aw, ag in zip(src_arrays, got):
+                assert ag.tobytes() == aw.tobytes()
+    # decode pod generates from the network-restored KV
+    [fd] = _run_all(dec, [prompt], sp)
+    [fm] = _run_all(mono, [prompt], sp)
+    assert fd.token_ids == fm.token_ids, \
+        "network-restored decode diverged from the monolithic oracle"
+    assert dec.cache.tier.snapshot()["restored"] > 0, \
+        "decode admission never used the fetched run"
+    _assert_pool_exact(pre)
+    _assert_pool_exact(dec)
+    return dec
+
+
+def test_handoff_differential_greedy(tiny_model, monkeypatch):
+    _handoff_differential(tiny_model, monkeypatch)
+
+
+def test_handoff_differential_lockstep_discipline(tiny_model, monkeypatch):
+    _handoff_differential(tiny_model, monkeypatch, async_decode=False)
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_handoff_differential_async_discipline(tiny_model, monkeypatch):
+    _handoff_differential(tiny_model, monkeypatch, async_decode=True)
+
+
+def test_handoff_differential_int8_byte_exact(tiny_model, monkeypatch):
+    _handoff_differential(tiny_model, monkeypatch, quant=True)
+
+
+def test_handoff_fetch_fault_degrades_to_recompute(tiny_model, monkeypatch):
+    """The fetch fails (injected kvnet.fetch fault): the decode pod's tier
+    stays cold, generation recomputes, tokens still match the monolithic
+    oracle, and both pools stay exact — terminal exactly once."""
+    httpx = pytest.importorskip("httpx")
+    del httpx
+    sp1 = SamplingParams(temperature=0.0, max_new_tokens=1)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    prompt = _prompt(6, 40)
+    pre = make_engine(tiny_model, monkeypatch, role="prefill")
+    dec = make_engine(tiny_model, monkeypatch, role="decode")
+    mono = make_engine(tiny_model, monkeypatch, role="both", tier=False)
+    _run_all(pre, [prompt], sp1)
+    hashes = pre.cache.prefix_hashes(prompt)
+    stats = KvNetStats()
+    rz_faults.configure("kvnet.fetch=error", 0)
+    try:
+        c = _mock_client(pre.cache.tier, dec.cache.tier, stats=stats)
+        assert c.fetch_run("http://peer", hashes) == 0
+    finally:
+        rz_faults.reset()
+    assert stats.snapshot()["fallbacks"] == 1
+    assert dec.cache.tier.n_entries == 0
+    [fd] = _run_all(dec, [prompt], sp)      # pure recompute
+    [fm] = _run_all(mono, [prompt], sp)
+    assert fd.token_ids == fm.token_ids
+    assert fd.stop_reason in ("length", "eos")
+    _assert_pool_exact(pre)
+    _assert_pool_exact(dec)
+
+
+def test_engine_role_env_override(tiny_model, monkeypatch):
+    monkeypatch.setenv("SHAI_ROLE", "prefill")
+    eng = make_engine(tiny_model, monkeypatch, role="both")
+    assert eng.role == "prefill" and eng._prefill_role
+    monkeypatch.setenv("SHAI_ROLE", "nonsense")
+    eng2 = make_engine(tiny_model, monkeypatch, role="decode")
+    assert eng2.role == "decode"
+
+
+# -- metrics export -----------------------------------------------------------
+
+def test_metrics_collector_exports_kvnet_family():
+    prom = pytest.importorskip("prometheus_client")
+    del prom
+    from scalable_hw_agnostic_inference_tpu.obs.steploop import StepTelemetry
+    from scalable_hw_agnostic_inference_tpu.serve.metrics import (
+        EngineTelemetryCollector,
+    )
+
+    tele = StepTelemetry(total_blocks=8)
+    tele.kvnet = KvNetStats()
+    tele.kvnet.count_served(2, 100)
+    tele.kvnet.count_fetched(1, 50)
+    tele.kvnet.count_fallback()
+    fams = {m.name: m for m in
+            EngineTelemetryCollector(lambda: tele, "t").collect()}
+    # prometheus strips _total from counter FAMILY names
+    for fam in ("shai_kvnet_fetched", "shai_kvnet_served",
+                "shai_kvnet_bytes", "shai_kvnet_errors",
+                "shai_kvnet_fallbacks"):
+        assert fam in fams, fam
+    assert fams["shai_kvnet_bytes"].samples[0].value == 150.0
+    # tier-less pods export nothing
+    bare = StepTelemetry(total_blocks=8)
+    assert not any(n.startswith("shai_kvnet")
+                   for n in {m.name for m in EngineTelemetryCollector(
+                       lambda: bare, "t").collect()})
+
+
+# -- cova: disagg routing (hermetic fakes) ------------------------------------
+
+def _cova_client(roles, fail=(), kv_ready=True, models=None):
+    """A CovaClient with faked transport: prefill pods answer handoffs,
+    decode pods answer text; ``fail`` names backends that 502."""
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        CovaClient,
+    )
+    from scalable_hw_agnostic_inference_tpu.serve.asgi import HTTPError
+
+    models = models or {n: {"weight": w}
+                        for n, w in zip(roles, range(len(roles), 0, -1))}
+    c = CovaClient(models)
+    calls = []
+
+    async def fake_post(name, route, payload):
+        calls.append((name, dict(payload)))
+        if name in fail:
+            raise HTTPError(502, "down")
+        if roles.get(name) == "prefill":
+            return {"kv_ready": kv_ready, "digest": "d" * 16,
+                    "hashes_len": 5, "peer_url": "", "n_prompt": 40,
+                    "role": "prefill"}
+        return {"generated_text": f"text-from-{name}", "n_tokens": 4,
+                "n_prompt": 40, "stop_reason": "length"}
+
+    async def fake_fleet():
+        return {"models": {n: {"role": r} for n, r in roles.items()},
+                "overloaded": []}
+
+    c.post = fake_post
+    c._fleet_for_routing = fake_fleet
+    return c, calls
+
+
+def test_cova_disagg_routes_prefill_then_decode():
+    c, calls = _cova_client({"pf": "prefill", "dec": "decode",
+                             "mono": "both"})
+    out = asyncio.run(c.generate("the prompt", {"max_new_tokens": 4}))
+    assert out["routed_by"] == "disagg"
+    assert out["prefill_model"] == "pf" and out["model"] == "dec"
+    # the decode call carried the handoff reference, peer resolved to the
+    # prefill backend's own URL (peer_url was empty)
+    names = [n for n, _ in calls]
+    assert names == ["pf", "dec"]
+    dec_payload = calls[1][1]
+    assert dec_payload["kv_peer"] == c.url_of("pf")
+    assert dec_payload["kv_hashes_len"] == 5
+    # explicit decode pods beat both-pods for the handoff even at lower
+    # weight (mono has the higher weight here)
+    assert out["model"] == "dec"
+
+
+def test_cova_disagg_decode_stage_ignores_both_pod_warmth():
+    """A warm BOTH-pod must not jump ahead of the decode tier for the
+    handoff (review finding): warmth is moot — the pull warms whichever
+    pod is picked — and landing on the monolithic pod re-mixes decode
+    with its chunked prefill."""
+    from scalable_hw_agnostic_inference_tpu.kvtier.affinity import (
+        prompt_affinity,
+    )
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        CovaClient,
+    )
+    from scalable_hw_agnostic_inference_tpu.serve.asgi import HTTPError
+
+    prompt = "a previously-monolithically-served prompt"
+    models = {"pf": {"weight": 1}, "dec": {"weight": 1},
+              "mono": {"weight": 3}}
+    c = CovaClient(models)
+    calls = []
+
+    async def fake_post(name, route, payload):
+        calls.append(name)
+        if name == "pf":
+            return {"kv_ready": True, "digest": "d" * 16, "hashes_len": 3,
+                    "peer_url": "", "role": "prefill"}
+        return {"generated_text": "t", "n_tokens": 2, "n_prompt": 10,
+                "stop_reason": "length"}
+
+    async def fake_fleet():
+        return {"models": {
+            "pf": {"role": "prefill"},
+            "dec": {"role": "decode"},
+            # the both-pod advertises THIS prompt's warm prefix
+            "mono": {"role": "both",
+                     "kvtier": {"affinity": [prompt_affinity(prompt)]}}},
+            "overloaded": []}
+
+    c.post = fake_post
+    c._fleet_for_routing = fake_fleet
+    out = asyncio.run(c.generate(prompt, {}))
+    assert out["routed_by"] == "disagg" and out["model"] == "dec"
+    assert calls == ["pf", "dec"]
+
+
+def test_cova_disagg_dead_prefill_falls_back_to_monolithic():
+    c, calls = _cova_client({"pf": "prefill", "mono": "both"},
+                            fail=("pf",))
+    out = asyncio.run(c.generate("p", {}))
+    assert out["routed_by"] in ("weighted", "affinity")
+    assert out["model"] == "mono" and "prefill_model" not in out
+
+
+def test_cova_disagg_tierless_prefill_replica_tries_next():
+    """kv_ready=false with a POSITIVE hashes_len is a pod-specific
+    problem (tier-less misdeploy): the router must try the next prefill
+    replica instead of letting one bad pod disable the split (review
+    finding). hashes_len=0 (sub-block prompt) still short-circuits —
+    every pod would agree."""
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        CovaClient,
+    )
+
+    models = {"pf1": {"weight": 2}, "pf2": {"weight": 1}, "dec": {}}
+    c = CovaClient(models)
+    calls = []
+
+    async def fake_post(name, route, payload):
+        calls.append(name)
+        if name == "pf1":  # misdeployed: long prompt, no tier
+            return {"kv_ready": False, "hashes_len": 5, "peer_url": ""}
+        if name == "pf2":
+            return {"kv_ready": True, "digest": "d" * 16, "hashes_len": 5,
+                    "peer_url": "", "role": "prefill"}
+        return {"generated_text": "t", "n_tokens": 2, "n_prompt": 10,
+                "stop_reason": "length"}
+
+    async def fake_fleet():
+        return {"models": {"pf1": {"role": "prefill"},
+                           "pf2": {"role": "prefill"},
+                           "dec": {"role": "decode"}}, "overloaded": []}
+
+    c.post = fake_post
+    c._fleet_for_routing = fake_fleet
+    out = asyncio.run(c.generate("p", {}))
+    assert out["routed_by"] == "disagg"
+    assert out["prefill_model"] == "pf2"
+    assert calls == ["pf1", "pf2", "dec"]
+
+    # prompt-specific decline (hashes_len 0): no second prefill attempt
+    calls.clear()
+
+    async def fake_post2(name, route, payload):
+        calls.append(name)
+        if name in ("pf1", "pf2"):
+            return {"kv_ready": False, "hashes_len": 0, "peer_url": ""}
+        return {"generated_text": "t", "n_tokens": 2, "n_prompt": 4,
+                "stop_reason": "length"}
+
+    c.post = fake_post2
+    out = asyncio.run(c.generate("p", {}))
+    assert out["routed_by"] in ("weighted", "affinity")
+    assert calls == ["pf1", "dec"]
+
+
+def test_cova_disagg_malformed_handoff_falls_back():
+    """A version-skewed prefill pod returning a non-numeric hashes_len
+    must degrade to monolithic routing, never 500 the request (review
+    finding)."""
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        CovaClient,
+    )
+
+    c = CovaClient({"pf": {}, "mono": {}})
+
+    async def fake_post(name, route, payload):
+        if name == "pf":
+            return {"kv_ready": True, "hashes_len": "n/a", "digest": "d"}
+        return {"generated_text": "t", "n_tokens": 2, "n_prompt": 4,
+                "stop_reason": "length"}
+
+    async def fake_fleet():
+        return {"models": {"pf": {"role": "prefill"},
+                           "mono": {"role": "both"}}, "overloaded": []}
+
+    c.post = fake_post
+    c._fleet_for_routing = fake_fleet
+    out = asyncio.run(c.generate("p", {}))
+    assert out["model"] == "mono"
+    assert out["routed_by"] in ("weighted", "affinity")
+
+
+def test_cova_disagg_kv_not_ready_falls_back():
+    c, calls = _cova_client({"pf": "prefill", "mono": "both"},
+                            kv_ready=False)
+    out = asyncio.run(c.generate("p", {}))
+    assert out["routed_by"] in ("weighted", "affinity")
+    assert out["model"] == "mono"
+
+
+def test_cova_disagg_dead_decode_falls_back_then_errors():
+    from scalable_hw_agnostic_inference_tpu.serve.asgi import HTTPError
+
+    c, calls = _cova_client({"pf": "prefill", "dec": "decode"},
+                            fail=("dec",))
+    with pytest.raises(HTTPError):
+        asyncio.run(c.generate("p", {}))
+
+
+def test_cova_all_prefill_is_502():
+    from scalable_hw_agnostic_inference_tpu.serve.asgi import HTTPError
+
+    c, _ = _cova_client({"pf": "prefill"})
+    with pytest.raises(HTTPError) as ei:
+        asyncio.run(c.generate("p", {}))
+    assert ei.value.status == 502
+
+
+def test_cova_monolithic_fleet_unchanged():
+    """No prefill-role backend: the pre-disagg routing contract holds
+    verbatim (weighted order, no handoff calls)."""
+    c, calls = _cova_client({"a": "both", "b": "both"})
+    out = asyncio.run(c.generate("p", {}))
+    assert out["routed_by"] == "weighted"
+    assert all("kv_peer" not in p for _, p in calls)
+
+
+def test_aggregate_roles_pure():
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        aggregate_roles,
+    )
+
+    models = {"pf": {"role": "prefill"}, "dec": {}, "down": {}}
+    results = {"pf": {"role": "prefill"},
+               "dec": {"role": "decode"},
+               "down": {"error": "unreachable"}}
+    roles = aggregate_roles(models, results, ["dec"])
+    assert roles["prefill"]["backends"] == ["pf"]
+    assert roles["decode"] == {"backends": ["dec"], "serving": ["dec"],
+                               "overloaded": ["dec"]}
+    # unreachable pod without a /stats role: the models.json role (none
+    # here) degrades to "both", and it is not "serving"
+    assert roles["both"] == {"backends": ["down"], "serving": [],
+                             "overloaded": []}
+
+
+def test_fleet_cache_ttl_env_knob(monkeypatch):
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        CovaClient,
+    )
+
+    monkeypatch.setenv("SHAI_FLEET_CACHE_TTL_S", "0.25")
+    assert CovaClient({}).fleet_cache_ttl_s == 0.25
+    monkeypatch.setenv("SHAI_FLEET_CACHE_TTL_S", "bogus")  # lenient
+    assert CovaClient({}).fleet_cache_ttl_s == 2.0
+
+
+# -- live: two pods + cova over real sockets ----------------------------------
+
+def _write_vllm_yaml(path, role):
+    path.write_text(
+        "model: tiny\nmax_model_len: 256\nblock_size: 16\n"
+        "max_num_seqs: 4\ncontext_encoding_buckets: [32, 64, 128]\n"
+        "enable_prefix_caching: true\nmax_new_tokens: 16\n"
+        f"role: {role}\n")
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def disagg_pods(tmp_path_factory):
+    """A real prefill pod + decode pod on loopback sockets (tiny vllm,
+    host tiers on, synchronous copy-out for determinism)."""
+    from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+    from scalable_hw_agnostic_inference_tpu.serve.httpd import Server
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    httpx = pytest.importorskip("httpx")
+    from test_serve_http import wait_ready_sync
+
+    saved = {k: os.environ.get(k)
+             for k in ("SHAI_KVTIER", "SHAI_KVTIER_ASYNC", "SHAI_ROLE",
+                       "SHAI_KVNET_PEER_URL")}
+    os.environ["SHAI_KVTIER"] = "1"
+    os.environ["SHAI_KVTIER_ASYNC"] = "0"
+    os.environ.pop("SHAI_ROLE", None)          # roles come from the yaml
+    os.environ.pop("SHAI_KVNET_PEER_URL", None)
+    tmp = tmp_path_factory.mktemp("disagg")
+    servers, services, urls = [], {}, {}
+    try:
+        for name, role in (("pf", "prefill"), ("dec", "decode")):
+            cfg = ServeConfig(
+                app=name, model_id="tiny", device="cpu", max_new_tokens=16,
+                vllm_config=_write_vllm_yaml(tmp / f"{name}.yaml", role))
+            svc = get_model("vllm")(cfg)
+            srv = Server(create_app(cfg, svc), port=0)
+            srv.start_background()
+            servers.append(srv)
+            services[name] = svc
+            urls[name] = f"http://127.0.0.1:{srv.port}"
+        for u in urls.values():
+            with httpx.Client(base_url=u) as c:
+                r = wait_ready_sync(c, timeout=300.0)
+                assert r.status_code == 200, r.text
+        yield urls, services
+    finally:
+        for s in servers:
+            s.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+@pytest.mark.asyncio
+async def test_disagg_live_over_sockets(disagg_pods, tmp_path):
+    """THE acceptance run: cova routes a prompt prefill-pod → decode-pod
+    over real sockets (`routed_by: disagg`), the generation matches the
+    same pod serving without a handoff (greedy), every shai_kvnet_*
+    family is live on /metrics, injected kvnet.fetch faults degrade to
+    recompute, and both pods' pools stay exact."""
+    import httpx
+
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        create_cova_app,
+    )
+    from test_serve_http import make_client
+
+    urls, services = disagg_pods
+    models = {"pf": {"url": urls["pf"], "weight": 2},
+              "dec": {"url": urls["dec"], "weight": 1}}
+    p = tmp_path / "models.json"
+    p.write_text(json.dumps({"models": models}))
+    app = create_cova_app(str(p))
+    prompt = ("tell me a long and winding story about a bicycle "
+              "that learned to serve large language models quickly")
+    async with make_client(app) as c:
+        # the roles are live on /fleet
+        r = await c.get("/fleet")
+        roles = r.json()["roles"]
+        assert roles["prefill"]["serving"] == ["pf"]
+        assert roles["decode"]["serving"] == ["dec"]
+        # disaggregated routing end to end (logprobs ride along so the
+        # oracle below compares TOKEN IDS, not just decoded text — the
+        # tiny byte tokenizer can decode real tokens to "")
+        r = await c.post("/generate", json={"prompt": prompt,
+                                            "temperature": 0.0,
+                                            "logprobs": 1,
+                                            "max_new_tokens": 8})
+        assert r.status_code == 200, r.text
+        out = r.json()
+        assert out["routed_by"] == "disagg"
+        assert out["prefill_model"] == "pf" and out["model"] == "dec"
+        assert out["n_tokens"] == 8
+        disagg_toks = [e["token"] for e in out["logprobs"]]
+        assert len(disagg_toks) == 8
+
+        # greedy oracle: the decode pod serving the same prompt directly
+        # (device cache warm now, no handoff) must produce the same tokens
+        async with httpx.AsyncClient(base_url=urls["dec"]) as dc:
+            direct = await dc.post("/generate", json={
+                "prompt": prompt, "temperature": 0.0, "logprobs": 1,
+                "max_new_tokens": 8})
+        assert [e["token"] for e in direct.json()["logprobs"]] \
+            == disagg_toks
+        assert direct.json()["generated_text"] == out["generated_text"]
+
+        # transport counters moved on both sides; every family is live
+        async with httpx.AsyncClient(base_url=urls["pf"]) as pc:
+            pf_metrics = (await pc.get("/metrics")).text
+            pf_stats = (await pc.get("/stats")).json()
+        async with httpx.AsyncClient(base_url=urls["dec"]) as dc:
+            dec_metrics = (await dc.get("/metrics")).text
+            dec_stats = (await dc.get("/stats")).json()
+        for fam in ("shai_kvnet_fetched_total", "shai_kvnet_served_total",
+                    "shai_kvnet_bytes_total", "shai_kvnet_errors_total",
+                    "shai_kvnet_fallbacks_total"):
+            assert fam in pf_metrics, fam
+            assert fam in dec_metrics, fam
+        assert pf_stats["role"] == "prefill"
+        assert dec_stats["role"] == "decode"
+        assert pf_stats["kvnet"]["served"] > 0
+        assert dec_stats["kvnet"]["fetched"] > 0
+        assert dec_stats["kvtier"]["restored"] > 0
+
+        # injected transport fault: the NEXT disagg request's fetch dies,
+        # the decode pod recomputes, the request still succeeds. The
+        # prompt must share NO prefix with the one above — a shared
+        # leading run is already tier-resident on the decode pod and a
+        # fully-resident fetch never touches the wire (correctly: no
+        # fault drawn, no fallback)
+        rz_faults.configure("kvnet.fetch=error", 0)
+        try:
+            r2 = await c.post("/generate", json={
+                "prompt": "an entirely different request whose blocks "
+                          "the decode pod has never seen before at all",
+                "temperature": 0.0, "max_new_tokens": 8})
+            assert r2.status_code == 200, r2.text
+            assert r2.json()["routed_by"] == "disagg"
+            assert r2.json()["n_tokens"] == 8
+        finally:
+            rz_faults.reset()
+        async with httpx.AsyncClient(base_url=urls["dec"]) as dc:
+            snap = (await dc.get("/stats")).json()["kvnet"]
+        assert snap["fallbacks"] > 0
+
+        # a mis-routed handoff (digest for a DIFFERENT prompt) skips the
+        # pull entirely: no new fetch, still a served 200 via recompute
+        fetched_before = snap["fetched"]
+        async with httpx.AsyncClient(base_url=urls["dec"]) as dc:
+            r3 = await dc.post("/generate", json={
+                "prompt": "yet another never-seen prompt long enough to "
+                          "span blocks for the digest-mismatch check",
+                "temperature": 0.0, "max_new_tokens": 4,
+                "kv_peer": urls["pf"], "kv_hashes_len": 4,
+                "kv_digest": "0" * 16})
+            assert r3.status_code == 200 and r3.json()["n_tokens"] == 4
+            snap2 = (await dc.get("/stats")).json()["kvnet"]
+        assert snap2["fetched"] == fetched_before
+
+    # pool-exact on BOTH pods once the dust settles (terminal exactly
+    # once held implicitly: every request above returned one terminal)
+    for name in ("pf", "dec"):
+        eng = services[name]._engine
+        assert eng.n_running == 0 and eng.n_waiting == 0
+        assert eng.cache.leaked_blocks == 0
+        tier = eng.cache.tier
+        snap = tier.snapshot()
+        assert snap["used_bytes"] == snap["entries"] * snap["block_nbytes"]
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+@pytest.mark.asyncio
+async def test_kv_blocks_route_serves_leading_run(disagg_pods):
+    """GET /kv/blocks over a real socket: byte-exact frames for the
+    resident leading run, 400 on malformed queries."""
+    import httpx
+
+    urls, services = disagg_pods
+    pf = services["pf"]
+    tier = pf.kv_tier()
+    ids = pf._encode("a prompt that spans at least a couple of kv blocks "
+                     "so the tier holds a run")
+    async with httpx.AsyncClient(base_url=urls["pf"]) as c:
+        r = await c.post("/generate", json={"prompt":
+                                            "a prompt that spans at least "
+                                            "a couple of kv blocks so the "
+                                            "tier holds a run"})
+        assert r.status_code == 200 and r.json()["kv_ready"]
+        hashes = pf._engine.cache.prefix_hashes(ids)
+        assert hashes
+        r = await c.get("/kv/blocks", params={
+            "hashes": ",".join(str(h) for h in hashes)})
+        assert r.status_code == 200
+        assert r.headers["content-type"] == "application/octet-stream"
+        entries = frames.decode_frames(r.content)
+        assert [e[0] for e in entries] == hashes
+        for (hs, *want) in tier.get_run(hashes):
+            got = next(e for e in entries if e[0] == hs)[1:]
+            for aw, ag in zip(want, got):
+                assert ag.tobytes() == aw.tobytes()
+        assert int(r.headers["x-shai-kv-blocks"]) == len(entries)
+        # malformed / oversized queries are client errors
+        assert (await c.get("/kv/blocks?hashes=abc")).status_code == 400
+        assert (await c.get("/kv/blocks")).status_code == 400
+        big = ",".join(["1"] * 300)
+        assert (await c.get(f"/kv/blocks?hashes={big}")).status_code == 400
