@@ -45,6 +45,7 @@ UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
                   "mllama": "tokens/sec", "llama_spec": "tokens/sec",
                   "vllm": "tokens/sec", "kvtier": "x", "qos": "x",
                   "disagg": "x", "ragged": "tokens/sec",
+                  "migrate": "ms",
                   "sd": "images/sec", "sd8": "images/sec",
                   "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
@@ -74,8 +75,8 @@ def _which_from_argv(argv) -> str:
         return "llama_spec"
     if any(a.startswith("llama") for a in argv):
         return "llama"
-    for k in ("vllm", "kvtier", "qos", "disagg", "ragged", "flux", "t5",
-              "mllama", "sd8"):
+    for k in ("vllm", "kvtier", "qos", "disagg", "ragged", "migrate",
+              "flux", "t5", "mllama", "sd8"):
         if k in argv:
             return k
     return "sd"
@@ -988,6 +989,198 @@ def bench_disagg(tiny: bool) -> dict:
     }
 
 
+def bench_migrate(tiny: bool) -> dict:
+    """Live migration A/B: drain-with-migration vs drain-with-recompute
+    under a mid-decode drain cut (the in-process stand-in for a
+    mid-stream SIGTERM — the engines' migrate/resume path IS the one the
+    socket drain drives).
+
+    Each round decodes a batch on pod A, cuts it mid-decode (the drain's
+    migrate sweep: ``migrate_out`` snapshots + banks KV), and resumes
+    every request on pod B. The **migrate** arm ships the banked KV run
+    through the MIGRATE envelope codec (byte-exact, same as
+    ``POST /kv/migrate``) so B restores instead of re-prefilling; the
+    **recompute** arm ships the manifest only (the drain-without-
+    migration world: the replay pays full prefill over prompt+generated).
+    ``value`` is ``migrate_resume_p50_ms`` — the migrated arm's p50
+    added latency from the drain CUT to each resumed request's next
+    token (snapshot + envelope + publish + restore-vs-reprefill: the
+    whole stall a client sees; the decode tail past it is identical in
+    both arms) — and the line carries the recompute arm's p50, the
+    recompute/migrate ratio (>1 = migration is buying resume latency),
+    and the REQUIRED ``errors`` count (0: every cut request completes,
+    token-exact vs an uninterrupted oracle — the ladder's no-failure
+    contract, measured).
+    """
+    import os
+    import statistics
+    import time as _time
+
+    import numpy as np
+
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+    from scalable_hw_agnostic_inference_tpu.kvnet import migrate as migmod
+    from scalable_hw_agnostic_inference_tpu.kvnet.client import publish_run
+    from scalable_hw_agnostic_inference_tpu.models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig.tiny()
+        kw = dict(max_model_len=256, max_num_seqs=4, block_size=8,
+                  context_encoding_buckets=(32, 64, 128),
+                  max_new_tokens=64, enable_prefix_caching=True)
+        # LONG prompts: the resume's cost split is restore-vs-reprefill,
+        # so the arm gap is the prompt's prefill cost (the same quantity
+        # bench_kvtier's warm-replay line measures)
+        lens, new, cut_steps, rounds = (240, 192, 160, 232), 12, 14, 3
+        name = "migrate-tiny"
+    else:
+        cfg = llama_mod.LlamaConfig.llama32_1b()
+        kw = dict(max_model_len=1024, max_num_seqs=4, block_size=16,
+                  context_encoding_buckets=(128, 256, 512),
+                  max_new_tokens=64, enable_prefix_caching=True)
+        lens, new, cut_steps, rounds = (960, 832, 704, 928), 24, 18, 3
+        name = "migrate-1b-geometry"
+
+    params = llama_mod.geometry_params(cfg, quant=False)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=new)
+
+    def build() -> LLMEngine:
+        os.environ["SHAI_KVTIER"] = "1"
+        os.environ["SHAI_KVTIER_ASYNC"] = "0"  # deterministic copies
+        try:
+            return LLMEngine(cfg, params, EngineConfig(**kw))
+        finally:
+            os.environ.pop("SHAI_KVTIER", None)
+            os.environ.pop("SHAI_KVTIER_ASYNC", None)
+
+    def prompts_for(round_i: int):
+        rng = np.random.default_rng(47 + round_i)
+        return [rng.integers(3, cfg.vocab_size, n).tolist() for n in lens]
+
+    def run_batch(eng, batch, params_):
+        ids = [eng.add_request(list(p), params_) for p in batch]
+        done = {}
+        while set(ids) - set(done):
+            for f in eng.step():
+                done[f.req_id] = f
+        eng.finish_pending()
+        return [done[i] for i in ids]
+
+    def drain_to_done(eng, done):
+        while eng.has_work:
+            for f in eng.step():
+                done[f.req_id] = (f, _time.monotonic())
+        eng.finish_pending()
+
+    # the uninterrupted oracle outputs, per round (token-exactness is an
+    # ACCEPTANCE condition of this line, not just a latency number)
+    oracle = build()
+    run_batch(oracle, prompts_for(99), sp)  # warm every executable
+    want = {r: [f.token_ids for f in run_batch(oracle, prompts_for(r), sp)]
+            for r in range(rounds)}
+
+    def arm(ship_kv: bool):
+        A, B = build(), build()
+        run_batch(A, prompts_for(99), sp)   # warm both pods' ladders
+        run_batch(B, prompts_for(99), sp)
+        lat, shipped, errors = [], 0, 0
+        # one UNMEASURED cut+resume cycle first: the resume's warm
+        # admission dispatches continuation executables at (start,
+        # bucket) keys the plain warm batch never reaches — their
+        # first-use compiles are warmup, not resume latency
+        for r in [98] + list(range(rounds)):
+            measured = r != 98
+            batch = prompts_for(r)
+            rids = [A.add_request(list(p), sp) for p in batch]
+            early = {}
+            for _ in range(cut_steps):     # mid-decode: the drain cut
+                for f in A.step():
+                    early[f.req_id] = f
+            t_cut = _time.monotonic()
+            resumes = []
+            for i, rid in enumerate(rids):
+                if rid in early:           # finished before the cut
+                    continue
+                fin = A.migrate_out(rid)
+                if fin is None or fin.stop_reason != "migrated":
+                    continue               # pending token completed it
+                man = fin.migration
+                entries = (A.cache.tier.get_run(man["hashes"])
+                           if ship_kv and man["hashes"] else [])
+                # the wire: envelope encode/decode, byte-exact
+                man2, ent2 = migmod.decode_migration(
+                    migmod.encode_migration(man, entries))
+                if ent2:
+                    shipped += publish_run(
+                        B.cache.tier, [int(h) for h in man2["hashes"]],
+                        ent2)
+                pr = man2["params"]
+                sp2 = SamplingParams(
+                    temperature=pr["temperature"], top_k=pr["top_k"],
+                    top_p=pr["top_p"],
+                    max_new_tokens=pr["max_new_tokens"],
+                    eos_id=pr["eos_id"])
+                rid2 = B.add_request(
+                    man2["prompt_ids"], sp2,
+                    already_generated=man2["generated"],
+                    orig_n_prompt=man2["n_prompt"])
+                resumes.append((rid2, i))
+            A.finish_pending()
+            done = {}
+            drain_to_done(B, done)
+            if not measured:
+                continue
+            for rid2, i in resumes:
+                if rid2 not in done:
+                    errors += 1
+                    continue
+                fin, t_done = done[rid2]
+                del t_done
+                if (fin.stop_reason not in ("length", "eos")
+                        or fin.token_ids != want[r][i]):
+                    errors += 1
+                    continue
+                # the ADDED latency a client sees: from the drain CUT to
+                # the resumed stream's next token. Measured from t_cut,
+                # not the resume's submit — the migrate arm's snapshot/
+                # envelope/publish cost happens between the two and is
+                # part of the migration bill (excluding it would bias
+                # the promoted ratio toward migration); the decode tail
+                # after t_first is identical in both arms and excluded.
+                lat.append(max(0.0, fin.timing["t_first"] - t_cut))
+        return lat, shipped, errors
+
+    mig_lat, blocks_shipped, mig_errors = arm(ship_kv=True)
+    rec_lat, _, rec_errors = arm(ship_kv=False)
+    mig_p50 = statistics.median(mig_lat) * 1e3 if mig_lat else 0.0
+    rec_p50 = statistics.median(rec_lat) * 1e3 if rec_lat else 0.0
+    base = _published("migrate_resume_p50_ms")
+    return {
+        "metric": f"{name} resumed-request added latency p50 after a "
+                  f"mid-decode drain cut, migrate vs recompute "
+                  f"({jax.devices()[0].platform})",
+        "value": round(mig_p50, 3),
+        "unit": "ms",
+        # latency metric: smaller is better, vs_baseline inverts
+        "vs_baseline": round(base / mig_p50, 3) if base and mig_p50
+        else 1.0,
+        "migrate_resume_p50_ms": round(mig_p50, 3),
+        "migrate_resume_p99_ms": round(_pctl(mig_lat, 0.99) * 1e3, 3)
+        if mig_lat else 0.0,
+        "recompute_resume_p50_ms": round(rec_p50, 3),
+        "recompute_over_migrate_ratio": round(rec_p50 / mig_p50, 3)
+        if mig_p50 else 0.0,
+        "resumed_requests": len(mig_lat),
+        "blocks_shipped": blocks_shipped,
+        "errors": mig_errors + rec_errors,  # MUST be 0: the ladder's
+        # no-request-failure contract, measured
+    }
+
+
 def bench_flux(tiny: bool) -> dict:
     """Flux (rectified-flow DiT) txt2img on ONE chip.
 
@@ -1250,7 +1443,7 @@ def inner_main() -> None:
     out = {"llama": bench_llama, "llama_spec": bench_llama_spec,
            "vllm": bench_vllm, "kvtier": bench_kvtier,
            "qos": bench_qos, "disagg": bench_disagg,
-           "ragged": bench_ragged,
+           "ragged": bench_ragged, "migrate": bench_migrate,
            "flux": bench_flux, "t5": bench_t5,
            "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)

@@ -268,7 +268,8 @@ DEFAULT_CONTRACT = Contract(
         "EngineLoop": ClassPolicy(
             immutable_after_init=("engine", "_poll_s", "_submit_q",
                                   "_cancel_q", "_futures_lock", "_stop",
-                                  "_draining", "_thread"),
+                                  "_draining", "_thread",
+                                  "_migrate_evt", "_migrate_done"),
             lock_guarded={"_futures": "_futures_lock"},
             owning_modules=("engine/loop.py",),
             instance_markers=(".loop.",),
@@ -356,6 +357,24 @@ DEFAULT_CONTRACT = Contract(
             lock_guarded={"_client": "_lock", "_breakers": "_lock"},
             owning_modules=("kvnet/client.py",),
         ),
+        # Live migration (kvnet/migrate.py): the counters take writes
+        # from the drain thread (ship), the event loop (accept), and
+        # lane threads (resume); the inbox takes puts from the accept
+        # path and pops from replay lanes — all under their _lock. The
+        # SNAPSHOT itself happens on the engine loop thread; the SHIP
+        # runs on a serving thread outside every declared lock (the
+        # hot_locks entries below make blocking-under-lock enforce that
+        # mechanically — the PR-14 httpx-under-lock lesson).
+        "MigrateStats": ClassPolicy(
+            immutable_after_init=("_lock",),
+            lock_guarded={"_counts": "_lock"},
+            owning_modules=("kvnet/migrate.py",),
+        ),
+        "MigrationInbox": ClassPolicy(
+            immutable_after_init=("capacity", "_lock"),
+            lock_guarded={"_entries": "_lock"},
+            owning_modules=("kvnet/migrate.py",),
+        ),
         # The tenant ledger takes writes from every serving thread
         # (admission checks, completion charges) and reads from scrape
         # threads: bucket state and per-tenant counters move under _lock
@@ -426,6 +445,11 @@ DEFAULT_CONTRACT = Contract(
             # the whole decode tier behind one slow peer
             "KvNetStats._lock",
             "KvNetClient._lock",
+            # live migration: stats count on every ship/accept/resume and
+            # the inbox fronts every replay — an HTTP ship under either
+            # would serialize the whole drain behind one slow peer
+            "MigrateStats._lock",
+            "MigrationInbox._lock",
         ),
         # The declared partial order is EMPTY on purpose: the control
         # plane's design rule is "no lock nesting at all" — every

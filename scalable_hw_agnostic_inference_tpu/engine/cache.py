@@ -508,6 +508,47 @@ class PagedKVCache:
             self._demote(pairs)
         return n_run
 
+    def demote_token_run(self, seq_id: int,
+                         tokens) -> Tuple[int, List[int]]:
+        """Live-migration bank (kvnet.migrate): copy the sequence's full
+        blocks over ``tokens`` — prompt AND generated alike — into the
+        host tier without evicting them from the device. Unlike
+        :meth:`demote_prompt_run` (the per-finish prefill-handoff hot
+        path, which walks only already-registered blocks), this PUBLISHES
+        the run first: a mid-decode sequence's generated full blocks have
+        never been content-addressed, and the migration manifest needs
+        their chain hashes on the wire. Migration is a drain-time event,
+        so the extra hash pass is off every hot path. Returns
+        ``(n_run, hashes[:n_run])`` — the leading run actually banked;
+        failures degrade through the ``_demote`` contract (the peer
+        recomputes the shortfall), never raise."""
+        if self.tier is None or not self.prefix_caching:
+            return 0, []
+        alloc = self._seqs.get(seq_id)
+        if alloc is None:
+            return 0, []
+        hashes = self.prefix_hashes(tokens)
+        if not hashes:
+            return 0, []
+        # publish prompt+generated full blocks (register_prefix no-ops
+        # per-block where an identical block is already cached)
+        self.register_prefix(tokens, alloc.blocks)
+        pairs: List[Tuple[int, int]] = []
+        n_run = 0
+        for h, b in zip(hashes, alloc.blocks):
+            # a duplicate prompt's blocks may be registered under ANOTHER
+            # physical block — content-addressing means the tier run is
+            # still intact through that first copy, keep walking by hash
+            if self._hash2block.get(h) is None:
+                break
+            n_run += 1
+            src = self._hash2block[h]
+            if self.tier.accepts(h):
+                pairs.append((h, src))
+        if pairs:
+            self._demote(pairs)
+        return n_run, hashes[:n_run]
+
     def offload_preempt(self, tokens, seq_id: int) -> None:
         """Preemption offload: publish the victim's full blocks to the
         prefix cache (free — one incref per block) so re-admission reuses

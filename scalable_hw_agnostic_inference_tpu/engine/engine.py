@@ -301,6 +301,13 @@ class LLMEngine:
             from ..kvnet.client import KvNetStats
 
             self.obs.kvnet = KvNetStats()
+        # live-migration counters (kvnet.migrate): built unconditionally —
+        # even a tier-less pod participates in the ladder's cold rung
+        # (manifest-only migration), and the shai_migrate_* families must
+        # export wherever a drain can ship or a peer can resume
+        from ..kvnet.migrate import MigrateStats
+
+        self.obs.migrate = MigrateStats()
         # the QoS scheduler rides the same seam: /stats -> "qos" reads its
         # pick/aging counters next to the ledger's per-tenant usage
         self.obs.qos_sched = self._sched
@@ -341,7 +348,10 @@ class LLMEngine:
                     cross_len: int = 0, on_token=None,
                     deadline_at: float = 0.0,
                     priority: int = _qos.PRIORITY_NORMAL,
-                    tenant: str = "") -> int:
+                    tenant: str = "",
+                    already_generated: Optional[Sequence[int]] = None,
+                    already_lp: Optional[list] = None,
+                    orig_n_prompt: int = -1) -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -388,12 +398,20 @@ class LLMEngine:
             # here and never grows a tenant label set — the shai_tenant_*
             # families appear only once a tenant tag (or QoS) is live
             self.obs.count_tenant_request(tenant, _qos.class_name(priority))
+        # resume support (live migration, kvnet.migrate): a request that
+        # migrated in from a peer carries its pre-migration output — the
+        # same prompt-suffix semantics a preemption resume uses, so the
+        # admission ladder needs nothing new
         self.waiting.append(Request(rid, list(prompt_ids), params,
                                     prefix=prefix, cross_states=cross_states,
                                     cross_len=cross_len, on_token=on_token,
                                     deadline_at=deadline_at,
                                     t_submit=time.monotonic(),
-                                    priority=priority, tenant=tenant))
+                                    priority=priority, tenant=tenant,
+                                    already_generated=list(
+                                        already_generated or []),
+                                    already_lp=list(already_lp or []),
+                                    orig_n_prompt=orig_n_prompt))
         return rid
 
     def cancel(self, req_id: int) -> Optional[Finished]:
@@ -404,6 +422,181 @@ class LLMEngine:
         whose client disconnected — the engine would otherwise decode to
         max_new_tokens for nobody."""
         return self._abort(req_id, "cancelled")
+
+    # -- live migration (kvnet.migrate) ------------------------------------
+
+    def _release_slot(self, s: "_Running") -> None:
+        """THE slot teardown triple — release the sequence's blocks and
+        clear the slot — shared by every path that retires a running
+        slot (finish, abort, preempt, speculative finish, migrate), so
+        the teardown contract cannot drift between them."""
+        self.cache.release(s.req.req_id)
+        self.slots[s.slot] = None
+        self._has_image[s.slot] = 0.0
+
+    def _manifest_of(self, req: Request, resume_prompt, emitted,
+                     remaining: int, lps, hashes) -> Dict[str, Any]:
+        """The resumable-state manifest a peer pod re-admits from: plain
+        ints/floats/strings only (it crosses pods as JSON). ``rng_step``
+        is the origin engine's fold step at capture — informational: the
+        greedy oracle is fold-free, and a sampled resume re-derives its
+        stream from the peer's own seed by design."""
+        p = req.params
+        now = time.monotonic()
+        man: Dict[str, Any] = {
+            "v": 1,
+            "prompt_ids": [int(t) for t in resume_prompt],
+            "generated": [int(t) for t in emitted],
+            "n_prompt": int(req.orig_n_prompt),
+            "params": {
+                "temperature": float(p.temperature),
+                "top_k": int(p.top_k), "top_p": float(p.top_p),
+                "max_new_tokens": int(remaining),
+                "eos_id": int(p.eos_id), "logprobs": int(p.logprobs),
+            },
+            "priority": int(req.priority), "tenant": req.tenant,
+            "deadline_ms": (max(0.0, (req.deadline_at - now) * 1000.0)
+                            if req.deadline_at else 0.0),
+            "rng_step": int(self._step_count),
+            "hashes": [int(h) for h in hashes],
+        }
+        if p.logprobs and lps is not None:
+            man["lps"] = list(lps)
+        return man
+
+    def snapshot_sequence(self, req_id: int) -> Optional[Dict[str, Any]]:
+        """Capture a request's resumable state (the live-migration seam):
+        prompt + generated token ids, remaining sampling budget, QoS
+        identity, deadline remainder, and the chain hashes of the
+        full-block KV run this call BANKS in the host tier — generated
+        blocks included, via :meth:`~.cache.PagedKVCache.demote_token_run`
+        (the ``demote_prompt_run`` positional gather, extended past the
+        prompt). Loop-thread only: the snapshot happens under the
+        engine's single-owner discipline; the SHIP happens on a serving
+        thread outside it. Read-only with respect to the request's
+        lifecycle — :meth:`migrate_out` is snapshot + finish."""
+        for r in self.waiting:
+            if r.req_id == req_id:
+                # queued: no KV exists yet — a pure prompt replay (the
+                # cold rung; the peer recomputes from scratch)
+                return self._manifest_of(
+                    r, r.prompt_ids, r.already_generated,
+                    r.params.max_new_tokens,
+                    r.already_lp if r.params.logprobs else None, [])
+        for s in self.slots:
+            if s is None or s.req.req_id != req_id:
+                continue
+            req, p = s.req, s.req.params
+            if s.prefill_cursor is not None:
+                # mid-chunk: nothing generated this segment; bank the
+                # chunks already encoded (registered per chunk) so the
+                # peer's warm admission skips them
+                _, hashes = self.cache.demote_token_run(
+                    req_id, req.prompt_ids[:s.prefill_cursor])
+                return self._manifest_of(
+                    req, req.prompt_ids, req.already_generated,
+                    p.max_new_tokens,
+                    req.already_lp if p.logprobs else None, hashes)
+            committed = s.generated + [s.pending_token]
+            # KV exists for prompt+generated only — the pending token's
+            # write lands with the NEXT dispatch, which never runs here
+            _, hashes = self.cache.demote_token_run(
+                req_id, req.prompt_ids + s.generated)
+            lps = None
+            if p.logprobs:
+                lps = req.already_lp + s.lps[:len(committed)]
+            return self._manifest_of(
+                req, req.prompt_ids + committed,
+                req.already_generated + committed,
+                p.max_new_tokens - len(committed), lps, hashes)
+        return None
+
+    def migrate_out(self, req_id: int) -> Optional[Finished]:
+        """Finish a request with stop reason ``"migrated"``, its
+        :meth:`snapshot_sequence` manifest attached: the serving layer
+        ships the manifest + the banked KV run to a healthy peer and the
+        request CONTINUES there. A pending token that already completes
+        the request finishes normally instead (``eos``/``length`` — there
+        is nothing left to migrate). Loop-thread only. Returns None for
+        an unknown/finished id."""
+        if any(((r.prefix is not None or r.cross_states is not None)
+                and r.req_id == req_id)
+               for r in self.waiting) or any(
+                   s is not None and s.req.req_id == req_id
+                   and (s.req.prefix is not None
+                        or s.req.cross_states is not None)
+                   for s in self.slots):
+            # multimodal state (soft prefix / cross states) does not
+            # serialize into the manifest — not migratable; the drain
+            # path falls back to the legacy wait-then-stop for these
+            return None
+        for i, r in enumerate(self.waiting):
+            if r.req_id == req_id:
+                man = self.snapshot_sequence(req_id)
+                del self.waiting[i]
+                return Finished(
+                    req_id, list(r.already_generated), r.orig_n_prompt,
+                    "migrated",
+                    logprobs=(list(r.already_lp)
+                              if r.params.logprobs else None),
+                    timing=self._timing_of(r), migration=man)
+        if not any(s is not None and s.req.req_id == req_id
+                   for s in self.slots):
+            return None
+        # the in-flight lookahead may hold an extra sampled token for
+        # this slot: retire it first so the snapshot sees current host
+        # mirrors (the extra token is the discarded lookahead, exactly
+        # the _abort contract)
+        self._flush_pipeline("migrate")
+        for s in self.slots:
+            if s is None or s.req.req_id != req_id:
+                continue
+            req, p = s.req, s.req.params
+            if s.prefill_cursor is None:
+                committed = s.generated + [s.pending_token]
+                if (s.pending_token == p.eos_id
+                        or len(committed) >= p.max_new_tokens):
+                    # the sampled pending token already ends the request
+                    # — finish it here (the _preempt_lowest close-out
+                    # semantics), nothing resumable remains
+                    if (req.on_token is not None
+                            and s.pending_token != p.eos_id):
+                        req.on_token(s.pending_token)
+                    emitted = req.already_generated + committed
+                    lps = (req.already_lp + s.lps) if p.logprobs else None
+                    if emitted and emitted[-1] == p.eos_id:
+                        emitted = emitted[:-1]
+                        if lps:
+                            lps = lps[:-1]
+                        reason = "eos"
+                    else:
+                        reason = "length"
+                    self._record_tpot(s)
+                    self._release_slot(s)
+                    return Finished(req_id, emitted, req.orig_n_prompt,
+                                    reason, logprobs=lps,
+                                    timing=self._timing_of(req, s.t_first))
+                if req.on_token is not None:
+                    # the pending token WILL be in the final output (the
+                    # peer resumes past it) — stream it now, exactly-once
+                    # -per-output-token (the preemption contract)
+                    req.on_token(s.pending_token)
+            man = self.snapshot_sequence(req_id)
+            self._record_tpot(s)
+            emitted = req.already_generated + (
+                [] if s.prefill_cursor is not None
+                else s.generated + [s.pending_token])
+            lps = None
+            if p.logprobs:
+                lps = req.already_lp + (
+                    [] if s.prefill_cursor is not None
+                    else s.lps[:len(s.generated) + 1])
+            self._release_slot(s)
+            return Finished(req_id, emitted, req.orig_n_prompt,
+                            "migrated", logprobs=lps,
+                            timing=self._timing_of(req, s.t_first),
+                            migration=man)
+        return None
 
     def _abort(self, req_id: int, reason: str) -> Optional[Finished]:
         """THE teardown for a request leaving early (``cancelled`` /
@@ -427,9 +620,7 @@ class LLMEngine:
         for s in self.slots:
             if s is not None and s.req.req_id == req_id:
                 self._record_tpot(s)
-                self.cache.release(req_id)
-                self.slots[s.slot] = None
-                self._has_image[s.slot] = 0.0
+                self._release_slot(s)
                 return Finished(
                     req_id, s.req.already_generated + s.generated,
                     s.req.orig_n_prompt, reason,
@@ -1577,9 +1768,7 @@ class LLMEngine:
                          if victim.prefill_cursor is not None
                          else victim.req.prompt_ids + victim.generated)
             self.cache.offload_preempt(kv_tokens, victim.req.req_id)
-        self.cache.release(victim.req.req_id)
-        self.slots[victim.slot] = None
-        self._has_image[victim.slot] = 0.0
+        self._release_slot(victim)
         if victim.prefill_cursor is not None:
             # mid-prefill victim: nothing generated — the prompt simply
             # re-queues and re-chunks from the start when blocks free up
@@ -1857,9 +2046,7 @@ class LLMEngine:
                         logprobs=((s.req.already_lp + s.lps)
                                   if p.logprobs else None),
                         timing=self._timing_of(s.req, s.t_first)))
-                    self.cache.release(s.req.req_id)
-                    self.slots[s.slot] = None
-                    self._has_image[s.slot] = 0.0
+                    self._release_slot(s)
                     finished = True
                     break
                 if p.logprobs:
@@ -1972,9 +2159,7 @@ class LLMEngine:
                     # handoff (kvnet; failures degrade to peer recompute)
                     self.cache.demote_prompt_run(s.req.req_id,
                                                  s.req.prompt_ids)
-                self.cache.release(s.req.req_id)
-                self.slots[s.slot] = None
-                self._has_image[s.slot] = 0.0
+                self._release_slot(s)
 
     def _apply_sampled(self, running, nxt, top_ids, top_lp, tok_lp) -> None:
         """Mirror a decode dispatch's sampled tokens into the surviving

@@ -36,6 +36,13 @@ class EngineLoop:
         self._poll_s = poll_s
         self._stop = threading.Event()
         self._draining = threading.Event()
+        # live migration (kvnet.migrate): the drain thread arms
+        # _migrate_evt; the LOOP thread performs the snapshot+finish for
+        # every live request (the engine is single-owner — a snapshot off
+        # the loop thread would race the step), then sets _migrate_done.
+        self._migrate_evt = threading.Event()
+        self._migrate_done = threading.Event()
+        self._migrate_count = 0  # loop-thread write, read after _done
         self._thread = threading.Thread(target=self._run, name="engine-loop",
                                         daemon=True)
 
@@ -88,7 +95,10 @@ class EngineLoop:
                params: Optional[SamplingParams] = None,
                prefix=None, cross_states=None, cross_len: int = 0,
                on_token=None, deadline_at: float = 0.0,
-               priority: int = 1, tenant: str = "") -> Future:
+               priority: int = 1, tenant: str = "",
+               already_generated: Optional[Sequence[int]] = None,
+               already_lp: Optional[list] = None,
+               orig_n_prompt: int = -1) -> Future:
         """Enqueue a request; the future resolves to a :class:`Finished`.
 
         ``prefix``: optional soft-prefix embeddings [P, dim] (vision tokens,
@@ -111,12 +121,56 @@ class EngineLoop:
         self._submit_q.put(
             (list(prompt_ids), params or SamplingParams(),
              (prefix, cross_states, cross_len, on_token, deadline_at,
-              priority, tenant), fut))
+              priority, tenant, already_generated, already_lp,
+              orig_n_prompt), fut))
         # close the put-after-drain window: if the loop died between our
         # _stop check and the put, nobody will ever drain this item
         if self._stop.is_set():
             self._fail_all(RuntimeError("engine loop is stopped"))
         return fut
+
+    def migrate_all(self, timeout: float = 10.0) -> int:
+        """Drain-time live migration: refuse new submissions, then have
+        the LOOP thread finish every queued + running request with stop
+        reason ``"migrated"`` (manifest attached — the serving-layer
+        waiters ship it to a peer). Blocks until the loop thread has
+        processed the sweep or ``timeout`` expires; callable from the
+        drain thread. Returns how many requests migrated. Requests the
+        engine declines to migrate (multimodal state) keep running — the
+        ordinary drain wait covers them."""
+        self._draining.set()
+        if not self.alive:
+            return 0
+        self._migrate_done.clear()
+        self._migrate_evt.set()
+        if not self._migrate_done.wait(max(0.0, timeout)):
+            return 0
+        return self._migrate_count
+
+    def _do_migrate_all(self) -> None:
+        """Loop-thread half of :meth:`migrate_all`: snapshot-and-finish
+        every live request, resolving its future with the migrated
+        Finished. Runs after the submit queue drained and with
+        ``_draining`` set, so no request can slip in behind the sweep."""
+        n = 0
+        with self._futures_lock:
+            rids = list(self._futures)
+        for rid in rids:
+            try:
+                fin = self.engine.migrate_out(rid)
+            except Exception:
+                log.exception("migrate_out(%d) failed — request keeps "
+                              "running under the ordinary drain", rid)
+                continue
+            if fin is None:
+                continue  # unknown/unmigratable: the drain wait covers it
+            if fin.stop_reason == "migrated":
+                n += 1
+            with self._futures_lock:
+                fut = self._futures.pop(rid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(fin)
+        self._migrate_count = n
 
     def cancel(self, fut: Future) -> None:
         """Request cancellation of a submitted request (async: the loop
@@ -136,16 +190,17 @@ class EngineLoop:
         while True:
             (ids, params,
              (prefix, cross_states, cross_len, on_token, deadline_at,
-              priority, tenant),
+              priority, tenant, already_generated, already_lp,
+              orig_n_prompt),
              fut) = item
             try:
-                rid = self.engine.add_request(ids, params, prefix=prefix,
-                                              cross_states=cross_states,
-                                              cross_len=cross_len,
-                                              on_token=on_token,
-                                              deadline_at=deadline_at,
-                                              priority=priority,
-                                              tenant=tenant)
+                rid = self.engine.add_request(
+                    ids, params, prefix=prefix,
+                    cross_states=cross_states, cross_len=cross_len,
+                    on_token=on_token, deadline_at=deadline_at,
+                    priority=priority, tenant=tenant,
+                    already_generated=already_generated,
+                    already_lp=already_lp, orig_n_prompt=orig_n_prompt)
                 with self._futures_lock:
                     self._futures[rid] = fut
             except Exception as e:  # bad request (e.g. empty prompt)
@@ -199,6 +254,12 @@ class EngineLoop:
                 # block for work only when idle; never between engine steps
                 self._drain_submissions(block=not self.engine.has_work)
                 self._drain_cancels()
+                if self._migrate_evt.is_set():
+                    self._migrate_evt.clear()
+                    try:
+                        self._do_migrate_all()
+                    finally:
+                        self._migrate_done.set()
                 if not self.engine.has_work:
                     # async decode: going idle can leave the final lookahead
                     # step in flight (every slot finished at its commit) —

@@ -99,7 +99,7 @@ class Finished:
     req_id: int
     token_ids: List[int]        # generated tokens, EOS excluded
     n_prompt: int
-    # "eos" | "length" | "rejected" | "cancelled" | "timeout"
+    # "eos" | "length" | "rejected" | "cancelled" | "timeout" | "migrated"
     stop_reason: str
     # one entry per token_ids element when the request asked for logprobs:
     # {"token", "logprob", "top_ids", "top_logprobs"}
@@ -109,6 +109,13 @@ class Finished:
     # layer turns these into request-trace spans and bench.py aggregates
     # them into per-phase report fields
     timing: Optional[Dict[str, float]] = None
+    # live migration (kvnet.migrate): stop_reason "migrated" carries the
+    # sequence's resumable manifest — prompt+generated token ids, remaining
+    # sampling budget, QoS identity, deadline remainder, and the chain
+    # hashes of the KV run banked in the host tier. The serving layer ships
+    # it to a peer and the request CONTINUES there; a "migrated" Finished
+    # is a handoff, not a terminal outcome.
+    migration: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass

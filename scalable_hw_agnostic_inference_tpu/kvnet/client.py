@@ -102,6 +102,56 @@ class KvNetStats:
             return {k: float(v) for k, v in self._counts.items()}
 
 
+def publish_run(tier, want: Sequence[int], entries: Sequence[Tuple]) -> int:
+    """Validate a decoded block run against the requested hash order and
+    the LOCAL tier geometry, then publish it (synchronously — the blocks
+    are host numpy already and the caller is about to admit against
+    them). THE one validation+store implementation, shared by the fetch
+    path (``KvNetClient._publish``) and the live-migration restore
+    (``kvnet.migrate``). Returns blocks published; raises ``ValueError``
+    on any mismatch — callers degrade to recompute."""
+    if not entries:
+        return 0
+    want = list(want)
+    if len(entries) > len(want):
+        raise ValueError(f"peer sent {len(entries)} frames for a "
+                         f"{len(want)}-hash request")
+    got = [e[0] for e in entries]
+    if got != want[:len(entries)]:
+        raise ValueError("frame hashes are not the requested leading run")
+    t = tier
+    n_arr = 4 if t.quant else 2
+    blk_shape = (t.n_layers, t.block_size, t.n_kv_heads, t.head_dim)
+    sc_shape = (t.n_layers, t.n_kv_heads)
+    for e in entries:
+        if len(e) - 1 != n_arr:
+            raise ValueError(f"entry carries {len(e) - 1} arrays, "
+                             f"pool expects {n_arr}")
+        if any(a.shape != blk_shape for a in e[1:3]) or (
+                t.quant and any(a.shape != sc_shape for a in e[3:5])):
+            raise ValueError("frame block geometry does not match the "
+                             "local pool")
+        # dtype must match too: the pool prices used_bytes off its OWN
+        # block_nbytes, so a peer on a different KV dtype (mixed-dtype
+        # rollout) would publish mis-sized blocks that break both the
+        # byte accounting and the byte-exact restore contract
+        if any(a.dtype != t.dtype for a in e[1:3]) or (
+                t.quant and any(a.dtype != np.float32 for a in e[3:5])):
+            raise ValueError("frame block dtype does not match the "
+                             "local pool")
+    n = len(entries)
+    # entry arrays are [L, ...block dims]; store_batch wants stacked
+    # [L, n, ...] columns — the same layout a local demotion gather
+    # produces. sync=True: the blocks are already host numpy, and the
+    # run must be RESIDENT before the caller submits to the engine —
+    # the async copy-out queue would race the admission probe (and a
+    # full queue would silently drop what `fetched` had counted)
+    stacked = [np.stack([e[1 + ai] for e in entries], axis=1)
+               for ai in range(n_arr)]
+    tier.store_batch(got, *stacked, n, sync=True)
+    return n
+
+
 class KvNetClient:
     """Pull KV block runs from peer pods into the local host tier."""
 
@@ -372,45 +422,8 @@ class KvNetClient:
 
     def _publish(self, chunk: List[int], entries: List[Tuple]) -> int:
         """Validate a decoded chunk against the request and the local tier
-        geometry, then publish it. Returns blocks published; raises
-        ``ValueError`` on any mismatch (the caller degrades)."""
-        if not entries:
-            return 0
-        if len(entries) > len(chunk):
-            raise ValueError(f"peer sent {len(entries)} frames for a "
-                             f"{len(chunk)}-hash request")
-        got = [e[0] for e in entries]
-        if got != chunk[:len(entries)]:
-            raise ValueError("frame hashes are not the requested "
-                             "leading run")
-        t = self.tier
-        n_arr = 4 if t.quant else 2
-        blk_shape = (t.n_layers, t.block_size, t.n_kv_heads, t.head_dim)
-        sc_shape = (t.n_layers, t.n_kv_heads)
-        for e in entries:
-            if len(e) - 1 != n_arr:
-                raise ValueError(f"entry carries {len(e) - 1} arrays, "
-                                 f"pool expects {n_arr}")
-            if any(a.shape != blk_shape for a in e[1:3]) or (
-                    t.quant and any(a.shape != sc_shape for a in e[3:5])):
-                raise ValueError("frame block geometry does not match the "
-                                 "local pool")
-            # dtype must match too: the pool prices used_bytes off its OWN
-            # block_nbytes, so a peer on a different KV dtype (mixed-dtype
-            # rollout) would publish mis-sized blocks that break both the
-            # byte accounting and the byte-exact restore contract
-            if any(a.dtype != t.dtype for a in e[1:3]) or (
-                    t.quant and any(a.dtype != np.float32 for a in e[3:5])):
-                raise ValueError("frame block dtype does not match the "
-                                 "local pool")
-        n = len(entries)
-        # entry arrays are [L, ...block dims]; store_batch wants stacked
-        # [L, n, ...] columns — the same layout a local demotion gather
-        # produces. sync=True: the blocks are already host numpy, and the
-        # run must be RESIDENT before the caller submits to the engine —
-        # the async copy-out queue would race the admission probe (and a
-        # full queue would silently drop what `fetched` just counted)
-        stacked = [np.stack([e[1 + ai] for e in entries], axis=1)
-                   for ai in range(n_arr)]
-        self.tier.store_batch(got, *stacked, n, sync=True)
-        return n
+        geometry, then publish it — delegates to the shared
+        :func:`publish_run` (ONE validation implementation for the fetch
+        path AND the live-migration restore). Raises ``ValueError`` on
+        any mismatch (the caller degrades)."""
+        return publish_run(self.tier, chunk, entries)

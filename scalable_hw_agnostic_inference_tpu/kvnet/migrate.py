@@ -1,0 +1,394 @@
+"""Live request migration: the MIGRATE envelope + ship/restore plumbing.
+
+A SIGTERM'd (or preempted) pod must not turn its in-flight sequences into
+errors. The engine snapshots each running sequence's resumable state
+(``LLMEngine.snapshot_sequence`` — prompt + generated token ids, remaining
+sampling budget, QoS identity, deadline remainder, and the chain hashes of
+the KV run it banks in the host tier, generated blocks included); this
+module moves that state to a healthy peer, which restores the KV through
+the existing donated-scatter path and re-admits the sequence
+mid-generation.
+
+Wire format (``POST /kv/migrate``, content-type
+``application/x-shai-migrate``)::
+
+    envelope := magic "KVMG" | u8 version | u64 manifest_len
+                | u32 crc32(manifest) | manifest JSON | frame*
+
+``frame*`` is the EXISTING CRC-checked block frame stream
+(``kvnet.frames``) — bf16 and int8+scales blocks cross byte-exact, so a
+migrated sequence's greedy continuation is TOKEN-exact vs the
+never-migrated engine. A manifest-only envelope (no frames) is legal: the
+peer then warm-pulls the run from ``manifest["source_url"]`` over
+``GET /kv/blocks`` (the draining pod holds that route open), or recomputes.
+
+The degradation ladder — every rung lands on a completed request, never a
+failure, while any capable pod exists:
+
+1. **ship**: manifest + blocks POSTed to the peer; the peer restores and
+   resumes warm;
+2. **warm-recompute-on-peer**: the restore (or the blocks) didn't land —
+   the peer pulls what it can over ``/kv/blocks`` and recomputes the rest;
+3. **cold-recompute**: no peer accepted the ship — the client/cova replays
+   the request (prompt replay) against any serving pod;
+4. **fail**: only when no capable pod exists.
+
+Chaos hooks: ``migrate.ship`` (the POST never leaves the pod → rung 3)
+and ``migrate.restore`` (the peer refuses the blocks → rung 2), both in
+``resilience.faults``.
+
+Counters (``shai_migrate_*``, exported via the engine-telemetry seam):
+``shipped``/``received``/``resumed`` move on the happy path;
+``failed`` counts ship attempts that never landed; ``fallbacks`` counts
+ladder degradations (no peer, refused restore, budget exhausted).
+
+Thread contract (``analysis/contract.py``): :class:`MigrateStats` counters
+and the :class:`MigrationInbox` entry map are lock-guarded (lane threads
+ship/resume, the event loop accepts, scrape threads snapshot); the
+snapshot happens on the ENGINE loop thread, the ship on a serving thread
+OUTSIDE every declared lock — the blocking-under-lock rule enforces it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import struct
+import threading
+import uuid
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..resilience import faults as rz_faults
+from . import frames
+from .client import KvNetClient, publish_run
+
+log = logging.getLogger(__name__)
+
+#: the receiving pod's endpoint (serve/app.py registers it)
+MIGRATE_ROUTE = "/kv/migrate"
+MAGIC = b"KVMG"
+VERSION = 1
+#: manifests are token-id lists + scalars; anything bigger is hostile
+MAX_MANIFEST_BYTES = 1 << 22
+#: bounded resume inbox: un-replayed migrations evict FIFO past this —
+#: a peer flood must not grow the map without limit
+MAX_INBOX_ENTRIES = 64
+
+_HEAD = struct.Struct("<4sBQI")  # magic, version, manifest_len, crc32
+
+#: the exported counter families (serve.metrics maps snapshot keys onto
+#: these names; scripts/check_metrics_docs.py scans them here)
+METRIC_FAMILIES = (
+    "shai_migrate_shipped_total", "shai_migrate_received_total",
+    "shai_migrate_resumed_total", "shai_migrate_failed_total",
+    "shai_migrate_fallbacks_total",
+)
+
+
+class MigrateError(ValueError):
+    """Malformed / truncated / corrupt migration envelope."""
+
+
+class MigrateStats:
+    """The ``shai_migrate_*`` counters, shared by the ship side (drain),
+    the accept side (``POST /kv/migrate``), and the resume path; exported
+    through the engine-telemetry collector seam and the ``/stats``
+    ``"migrate"`` section."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {
+            "shipped": 0, "received": 0, "resumed": 0, "failed": 0,
+            "fallbacks": 0,
+        }
+
+    def count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] += n
+
+    def count_fallback(self) -> None:
+        self.count("fallbacks")
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {k: float(v) for k, v in self._counts.items()}
+
+
+# -- envelope codec -----------------------------------------------------------
+
+def encode_migration(manifest: Dict[str, Any],
+                     entries: Sequence[Tuple] = ()) -> bytes:
+    """Manifest + block entries (``HostKVTier.get_run`` tuples) → one
+    MIGRATE envelope. The manifest must be JSON-serializable (the engine's
+    ``snapshot_sequence`` emits plain ints/floats/strings only)."""
+    body = json.dumps(manifest, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_MANIFEST_BYTES:
+        raise MigrateError(f"manifest of {len(body)} bytes over limit")
+    return (_HEAD.pack(MAGIC, VERSION, len(body), zlib.crc32(body))
+            + body + frames.encode_frames(entries))
+
+
+def decode_migration(data: bytes) -> Tuple[Dict[str, Any], List[Tuple]]:
+    """Strict envelope decode: bad magic/version, truncation, CRC
+    mismatch, over-limit or non-dict manifest, or any malformed block
+    frame raises — a half-parsed migration is never accepted."""
+    if len(data) < _HEAD.size:
+        raise MigrateError("envelope shorter than its header")
+    magic, version, mlen, crc = _HEAD.unpack_from(data, 0)
+    if magic != MAGIC:
+        raise MigrateError(f"bad envelope magic {magic!r}")
+    if version != VERSION:
+        raise MigrateError(f"unsupported envelope version {version}")
+    if mlen > MAX_MANIFEST_BYTES:
+        raise MigrateError(f"manifest length {mlen} over limit")
+    off = _HEAD.size
+    if off + mlen > len(data):
+        raise MigrateError("truncated manifest")
+    body = bytes(data[off:off + mlen])
+    if zlib.crc32(body) != crc:
+        raise MigrateError("manifest CRC mismatch")
+    try:
+        manifest = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise MigrateError(f"manifest is not JSON: {e}")
+    if not isinstance(manifest, dict):
+        raise MigrateError("manifest must be a JSON object")
+    try:
+        entries = frames.decode_frames(data[off + mlen:])
+    except frames.FrameError as e:
+        raise MigrateError(f"bad block frames: {e}")
+    return manifest, entries
+
+
+# -- resume inbox (receiving pod) ---------------------------------------------
+
+class MigrationInbox:
+    """Bounded store of accepted-but-not-yet-replayed manifests, keyed by
+    the resume handle the ship ack carries. ``pop`` is the
+    exactly-once gate: the first replay consumes the entry, a duplicate
+    replay (a retried handoff) reads as unknown and degrades to a cold
+    replay instead of double-generating."""
+
+    def __init__(self, capacity: int = MAX_INBOX_ENTRIES):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+    def put(self, manifest: Dict[str, Any]) -> str:
+        rid = uuid.uuid4().hex[:16]
+        with self._lock:
+            while len(self._entries) >= self.capacity:
+                self._entries.popitem(last=False)
+            self._entries[rid] = manifest
+        return rid
+
+    def pop(self, rid: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._entries.pop(rid, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+# -- ship (draining pod) ------------------------------------------------------
+
+class MigrateClient(KvNetClient):
+    """The kvnet transport plus :meth:`ship` — one shared httpx client,
+    the same SSRF guard / per-peer breaker / connect-only retry contract
+    as the fetch side. A pod in the network KV plane builds ONE of these
+    (it replaces the plain :class:`KvNetClient`)."""
+
+    def __init__(self, tier, stats=None, mstats: Optional[MigrateStats]
+                 = None, **kw):
+        super().__init__(tier, stats, **kw)
+        self.mstats = mstats or MigrateStats()
+
+    def ship(self, peer_url: str, manifest: Dict[str, Any],
+             entries: Sequence[Tuple] = ()) -> Optional[Dict[str, Any]]:
+        """POST one MIGRATE envelope to ``peer_url``. Returns the peer's
+        ack (``{"accepted": true, "resume": ..., "restored": n}``) or
+        None — NEVER raises; every failure counts ``failed`` and the
+        caller degrades down the ladder (the client/cova replays cold).
+        Runs on a serving thread, outside every declared lock (the
+        snapshot already happened on the engine loop thread)."""
+        import httpx
+
+        if not peer_url or not self.peer_allowed(peer_url):
+            if peer_url:
+                log.warning("migrate: refusing ship to disallowed peer %r",
+                            peer_url[:120])
+            self.mstats.count_fallback()
+            return None
+        try:
+            payload = encode_migration(manifest, entries)
+        except Exception:
+            # unencodable blocks: retry manifest-only — the peer pulls or
+            # recomputes (rung 2), the manifest itself must still land
+            log.warning("migrate: block entries unencodable — shipping "
+                        "manifest-only", exc_info=True)
+            self.mstats.count_fallback()
+            try:
+                payload = encode_migration(manifest, ())
+            except Exception:
+                self.mstats.count("failed")
+                return None
+        br = self.breaker_of(peer_url)
+        if not br.allow():
+            self.mstats.count("failed")
+            return None
+        url = f"{peer_url.rstrip('/')}{MIGRATE_ROUTE}"
+        inj = rz_faults.get()
+        attempt = 0
+        try:
+            while True:
+                try:
+                    if inj.active:
+                        # chaos site: the ship never leaves the pod —
+                        # forces the ladder down to the cold-replay rung
+                        inj.sleep_at(rz_faults.MIGRATE_SHIP)
+                        if inj.should_fail(rz_faults.MIGRATE_SHIP):
+                            raise httpx.ConnectError(
+                                "injected migrate.ship fault")
+                    r = self._http().post(
+                        url, content=payload,
+                        headers={"content-type":
+                                 "application/x-shai-migrate"})
+                except (httpx.ConnectError, httpx.ConnectTimeout):
+                    br.record_failure()
+                    if attempt < self.connect_retries and br.allow():
+                        attempt += 1
+                        continue
+                    self.mstats.count("failed")
+                    log.warning("migrate: peer %s unreachable — falling "
+                                "back to client replay", peer_url)
+                    return None
+                except Exception:
+                    # read phase: reachable but failed — never retried
+                    br.release_probe()
+                    self.mstats.count("failed")
+                    log.warning("migrate: ship to %s failed mid-exchange",
+                                peer_url, exc_info=True)
+                    return None
+                break
+            br.record_success()
+            if r.status_code != 200:
+                self.mstats.count("failed")
+                log.warning("migrate: %s%s -> %d", peer_url, MIGRATE_ROUTE,
+                            r.status_code)
+                return None
+            try:
+                ack = r.json()
+            except Exception:
+                self.mstats.count("failed")
+                return None
+            if not isinstance(ack, dict) or not ack.get("accepted"):
+                self.mstats.count("failed")
+                return None
+            self.mstats.count("shipped")
+            return ack
+        except BaseException:
+            br.release_probe()
+            raise
+
+
+# -- restore (receiving pod) --------------------------------------------------
+
+def restore_entries(tier, manifest: Dict[str, Any],
+                    entries: Sequence[Tuple], stats: MigrateStats,
+                    kvnet: Optional[KvNetClient] = None) -> int:
+    """Make the local tier hold the manifest's KV run: publish the shipped
+    blocks (validated byte-exact, sync — the resume admits against them),
+    or warm-pull from ``manifest["source_url"]`` when the envelope came
+    manifest-only. Returns blocks resident; every failure degrades to
+    recompute-on-resume (counted), never raises — the manifest is already
+    accepted, only the warmth is at stake."""
+    hashes = [int(h) for h in (manifest.get("hashes") or [])]
+    if not hashes or tier is None:
+        return 0
+    inj = rz_faults.get()
+    if inj.active and inj.should_fail(rz_faults.MIGRATE_RESTORE):
+        # chaos site: the restore rung is refused outright — the resumed
+        # request recomputes (ladder rung 2, deterministic)
+        log.warning("migrate: injected migrate.restore fault — resume "
+                    "will recompute")
+        stats.count_fallback()
+        return 0
+    restored = 0
+    if entries:
+        try:
+            restored = publish_run(tier, hashes, entries)
+        except Exception:
+            log.warning("migrate: shipped blocks rejected — resume "
+                        "degrades toward recompute", exc_info=True)
+            stats.count_fallback()
+    if restored < len(hashes):
+        src = str(manifest.get("source_url") or "")
+        if src and kvnet is not None:
+            # warm-recompute-on-peer rung: the draining pod holds
+            # /kv/blocks open until its budget expires — pull what it
+            # still serves (fetch_run never raises, counts its own
+            # kvnet fallbacks)
+            restored = max(restored, kvnet.fetch_run(src, hashes))
+    return restored
+
+
+# -- peer selection (draining pod) --------------------------------------------
+
+def migration_enabled() -> bool:
+    """Is the drain's migrate phase armed on this pod? Explicit
+    ``SHAI_MIGRATE=1``, a pinned peer, or a fleet URL all arm it; the
+    default is off — a pod outside a migration-aware fleet keeps the
+    legacy wait-then-stop drain exactly."""
+    from ..obs.util import env_flag, env_str
+
+    return bool(env_flag("SHAI_MIGRATE", False)
+                or env_str("SHAI_MIGRATE_PEER_URL", "").strip()
+                or env_str("SHAI_MIGRATE_FLEET_URL", "").strip())
+
+
+def resolve_migrate_peer(own_url: str = "") -> str:
+    """The ship target: ``SHAI_MIGRATE_PEER_URL`` wins (operator-pinned);
+    otherwise ask the cova ``/fleet`` named by ``SHAI_MIGRATE_FLEET_URL``
+    for a serving, non-overloaded, decode-capable backend that is not
+    this pod. Empty string = no peer (the ladder's cold rung)."""
+    from ..obs.util import env_str
+
+    peer = env_str("SHAI_MIGRATE_PEER_URL", "").strip()
+    if peer:
+        return peer
+    fleet_url = env_str("SHAI_MIGRATE_FLEET_URL", "").strip()
+    if not fleet_url:
+        return ""
+    try:
+        import httpx
+
+        r = httpx.get(f"{fleet_url.rstrip('/')}/fleet", timeout=5.0)
+        if r.status_code != 200:
+            return ""
+        snap = r.json()
+        urls = snap.get("urls") or {}
+        overloaded = set(snap.get("overloaded") or ())
+        roles = snap.get("roles") or {}
+        own = own_url.rstrip("/")
+        for role in ("decode", "both"):
+            for name in (roles.get(role) or {}).get("serving") or []:
+                u = str(urls.get(name) or "")
+                if u and name not in overloaded and u.rstrip("/") != own:
+                    return u
+    except Exception:
+        log.warning("migrate: fleet peer discovery failed", exc_info=True)
+    return ""
+
+
+def migrate_reserve_s(budget_s: float) -> float:
+    """Seconds of the drain budget reserved for the migrate phase: the
+    drain waits ``budget - reserve`` for natural completion first, so
+    short requests still finish in place and only the long tail ships.
+    ``SHAI_MIGRATE_RESERVE_S`` (lenient), capped at half the budget."""
+    from ..obs.util import env_float
+
+    return max(0.0, min(env_float("SHAI_MIGRATE_RESERVE_S", 5.0),
+                        budget_s * 0.5))

@@ -118,6 +118,11 @@ class StepTelemetry:
         # prefill/decode — the shai_kvnet_* families export through the
         # same collector seam
         self.kvnet = None
+        # live-migration counters (kvnet.migrate.MigrateStats): attached
+        # by the engine unconditionally — the shai_migrate_* families
+        # export through the same collector seam (ship/accept/resume all
+        # count onto the one object)
+        self.migrate = None
         # QoS weighted-fair scheduler (resilience.qos), attached by the
         # engine when SHAI_QOS is on: its pick/aging counters ride the
         # same provider seam into /stats -> "qos"

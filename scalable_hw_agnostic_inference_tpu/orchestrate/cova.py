@@ -349,7 +349,12 @@ class CovaClient:
                "conformance": conformance, "slo_breached": slo_breached,
                # per-role health (disaggregated serving): prefill vs
                # decode tier capacity at a glance
-               "roles": aggregate_roles(self.models, results, overloaded)}
+               "roles": aggregate_roles(self.models, results, overloaded),
+               # resolved base URLs (live migration): a draining pod
+               # picking a migrate peer off this dump needs an address,
+               # not a backend name (SHAI_MIGRATE_FLEET_URL)
+               "urls": {n: resolve_service_url(n, self.models[n])
+                        for n in self.models}}
         qos_tenants = aggregate_tenant_usage(results)
         if qos_tenants:
             out["qos"] = {"tenants": qos_tenants}
@@ -488,11 +493,101 @@ class CovaClient:
                 out = await self.post(name, "/generate", body)
             except HTTPError:
                 continue
+            if isinstance(out, dict) and out.get("migrated"):
+                # the decode pod migrated mid-drain: follow the handoff
+                # (warm resume on its peer, cold replay otherwise)
+                followed = await self._follow_migration(
+                    prompt, params, out, {name}, fleet)
+                followed["routed_by"] = "migrated"
+                followed.setdefault("prefill_model", pf_name)
+                return followed
             out["model"] = name
             out["prefill_model"] = pf_name
             out["routed_by"] = "disagg"
             return out
         return None
+
+    def _name_of_url(self, url: str) -> Optional[str]:
+        """The configured backend whose resolved base URL is ``url`` —
+        how a migration handoff's peer address maps back onto the
+        breaker/retry machinery; None for an address outside the
+        configured fleet."""
+        u = url.rstrip("/")
+        for n in self.models:
+            if resolve_service_url(n, self.models[n]) == u:
+                return n
+        return None
+
+    async def _post_url(self, url: str, route: str,
+                        payload: Dict) -> Dict:
+        """POST to a raw peer URL (a migration handoff naming a pod this
+        orchestrator does not route by name). http(s) only; failures are
+        HTTPError 502 — the caller degrades down the replay ladder."""
+        import httpx
+
+        if not url.startswith(("http://", "https://")):
+            raise HTTPError(502, f"refusing non-http migration peer "
+                                 f"{url[:80]!r}")
+        try:
+            r = await self._http().post(f"{url.rstrip('/')}{route}",
+                                        json=payload)
+        except httpx.HTTPError as e:
+            raise HTTPError(502, f"{url}{route} failed: "
+                                 f"{type(e).__name__}: {e}")
+        if r.status_code != 200:
+            raise HTTPError(502, f"{url}{route} -> {r.status_code}: "
+                                 f"{r.text[:200]}")
+        return r.json()
+
+    async def _follow_migration(self, prompt: str, params: Dict[str, Any],
+                                handoff: Dict[str, Any], exclude,
+                                fleet: Dict[str, Any]) -> Dict[str, Any]:
+        """Follow a ``migrated`` handoff (the draining pod shipped the
+        request's state to a peer): replay the resume handle against the
+        peer — the warm rung, KV restored from the migrated blocks — and
+        degrade to a cold prompt replay against any remaining
+        decode-capable backend. The request fails only when NO capable
+        pod exists (the ladder's last rung)."""
+        peer = str(handoff.get("peer") or "")
+        resume = handoff.get("resume")
+        if peer and resume:
+            name = self._name_of_url(peer)
+            try:
+                if name is not None:
+                    out = await self.post(name, "/generate",
+                                          {"resume": resume})
+                    out["model"] = name
+                else:
+                    out = await self._post_url(peer, "/generate",
+                                               {"resume": resume})
+                    out.setdefault("model", peer)
+                if not (isinstance(out, dict) and out.get("migrated")):
+                    return out
+                # the peer's OWN drain re-migrated the replay: a raw
+                # handoff must never reach the client — degrade to the
+                # cold replay below (same guard the cold rung runs)
+                log.warning("migration resume against %s re-migrated — "
+                            "replaying cold", peer)
+            except HTTPError:
+                log.warning("migration resume against %s failed — "
+                            "replaying cold", peer)
+        # cold rung: full prompt replay, the draining pod excluded
+        last: Optional[HTTPError] = None
+        for name in self.weighted_order():
+            if name in exclude or self._role_of(name, fleet) == "prefill":
+                continue
+            try:
+                out = await self.post(name, "/generate",
+                                      {"prompt": prompt, **params})
+            except HTTPError as e:
+                last = e
+                continue
+            if isinstance(out, dict) and out.get("migrated"):
+                continue  # that pod is draining too — keep walking
+            out["model"] = name
+            return out
+        raise last if last is not None else HTTPError(
+            502, "request migrated but no peer could resume or replay it")
 
     async def generate(self, prompt: str, params: Dict[str, Any],
                        names: Optional[List[str]] = None) -> Dict[str, Any]:
@@ -532,6 +627,13 @@ class CovaClient:
             except HTTPError as e:
                 last = e
                 continue
+            if isinstance(out, dict) and out.get("migrated"):
+                # the pod is draining and shipped this request's state to
+                # a peer — follow the handoff (resume warm, replay cold)
+                followed = await self._follow_migration(
+                    prompt, params, out, {name}, fleet)
+                followed["routed_by"] = "migrated"
+                return followed
             out["model"] = name
             out["routed_by"] = "affinity" if name in warm else "weighted"
             return out

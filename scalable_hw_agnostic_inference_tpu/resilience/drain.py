@@ -67,13 +67,18 @@ class DrainController:
             return max(0.0, self.budget_s - (self._clock() - self._started_at))
 
     def wait(self, idle_fn: Callable[[], bool],
-             poll_s: float = 0.05) -> bool:
+             poll_s: float = 0.05, min_remaining: float = 0.0) -> bool:
         """Block until ``idle_fn()`` or the budget runs out; True = drained
-        clean, False = budget exhausted with work still in flight."""
+        clean, False = budget exhausted with work still in flight.
+
+        ``min_remaining``: stop waiting while that much budget is still
+        left — the migrate phase's reservation (live migration ships the
+        long tail with budget to spare, instead of discovering at the
+        deadline that nothing can ship anymore)."""
         while True:
             if idle_fn():
                 return True
-            if self.remaining_s <= 0.0:
+            if self.remaining_s <= max(0.0, min_remaining):
                 return False
             time.sleep(poll_s)
 

@@ -42,7 +42,15 @@ Sites threaded through the stack (grep for the constant):
 - :data:`KVNET_FETCH` — the network KV transport's peer fetch
   (``kvnet.client``): error -> injected connect failure (the decode pod
   must degrade to recompute, never fail the request), delay -> added
-  transfer latency.
+  transfer latency;
+- :data:`MIGRATE_SHIP` — the live-migration ship (``kvnet.migrate``):
+  error -> the MIGRATE POST never leaves the pod, forcing the ladder
+  down to the cold-replay rung (the client/cova replays against a peer
+  without a resume handle), delay -> added ship latency;
+- :data:`MIGRATE_RESTORE` — the receiving pod's KV restore
+  (``kvnet.migrate.publish_entries``): error -> the migrated blocks are
+  refused, forcing the warm-resume rung down to recompute-on-peer (the
+  manifest is still accepted; the resumed request re-prefills).
 
 The module-level injector is built once from ``SHAI_FAULTS`` /
 ``SHAI_FAULTS_SEED`` and replaced at runtime via :func:`configure` (the
@@ -67,6 +75,8 @@ COMPILE = "engine.compile"
 COVA_RPC = "cova.rpc"
 MIRROR = "multihost.mirror"
 KVNET_FETCH = "kvnet.fetch"
+MIGRATE_SHIP = "migrate.ship"
+MIGRATE_RESTORE = "migrate.restore"
 
 KINDS = ("delay", "stall", "error", "drop")
 

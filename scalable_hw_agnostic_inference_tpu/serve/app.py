@@ -152,6 +152,40 @@ class ModelService:
         without a tier — the families then never export."""
         return None
 
+    # -- live migration (kvnet.migrate) ------------------------------------
+
+    def wants_migration(self) -> bool:
+        """True when the drain should run a migrate phase before the
+        budget expires (engine-backed services with migration armed —
+        ``SHAI_MIGRATE`` / a configured peer). Default False: plain
+        services keep the legacy wait-then-stop drain exactly."""
+        return False
+
+    def migrate_inflight(self) -> int:
+        """Ship every in-flight request that survived the drain's
+        natural-completion window to a healthy peer (the engine snapshots
+        each sequence; the waiters ship the manifests and return/stream
+        ``migrated`` handoffs). Returns how many requests entered
+        migration; 0 on services without an engine."""
+        return 0
+
+    def accept_migration(self, manifest, entries):
+        """Accept one MIGRATE envelope (``POST /kv/migrate``): restore the
+        KV run into the local tier and bank the manifest for its replay.
+        Returns the ack dict, or None when this pod cannot accept
+        migrations (the route then 404s and the shipper degrades to the
+        cold-replay rung)."""
+        return None
+
+    def pending_handoff(self) -> bool:
+        """True while this pod still holds banked KV a peer may want to
+        pull (``GET /kv/blocks``). The drain holds the server open —
+        probe-class GET routes keep serving — until the budget expires
+        while this is true: a prefill pod exiting the moment its own
+        in-flight count hits zero would strand every handoff run its
+        tier banked (the PR-15 drain bugfix)."""
+        return False
+
     def spec_counters(self) -> Optional[Dict[str, int]]:
         """Cumulative speculative-decoding counters
         (``{"drafted", "accepted", "committed"}``) for
@@ -263,7 +297,8 @@ def create_app(
     # recorder; /kv/blocks is probe-class too — a decode fleet pulling KV
     # runs would otherwise evict real request timelines from the ring
     app.trace_exclude |= {"/health/ready", "/debug/faults",
-                          "/debug/conformance", "/profile", "/kv/blocks"}
+                          "/debug/conformance", "/profile", "/kv/blocks",
+                          "/kv/migrate"}
 
     def _do_load_and_warm():
         t0 = time.perf_counter()
@@ -457,7 +492,25 @@ def create_app(
                     cfg.app, drainer.budget_s)
 
         def _work():
-            clean = drainer.wait(lambda: _inflight_counts()[0] == 0)
+            idle = lambda: _inflight_counts()[0] == 0  # noqa: E731
+            # migrate phase (kvnet.migrate): give natural completion the
+            # budget MINUS a reservation, then ship what's still running
+            # to a healthy peer — pod death becomes a latency event for
+            # the long tail instead of an error event at the deadline
+            if service.wants_migration():
+                from ..kvnet.migrate import migrate_reserve_s
+
+                if not drainer.wait(idle, min_remaining=migrate_reserve_s(
+                        drainer.budget_s)):
+                    try:
+                        n = service.migrate_inflight()
+                        if n:
+                            log.warning("%s: drain migrated %d in-flight "
+                                        "request(s) to a peer", cfg.app, n)
+                    except Exception:
+                        log.exception("drain migrate phase failed — "
+                                      "falling back to the budget wait")
+            clean = drainer.wait(idle)
             if not clean:
                 log.warning("%s: drain budget expired with %d requests "
                             "in flight", cfg.app, _inflight_counts()[0])
@@ -465,6 +518,16 @@ def create_app(
                 service.drain(max(0.0, drainer.remaining_s))
             except Exception:
                 log.exception("service drain failed")
+            # prefill-handoff hold (the PR-15 drain bugfix): a pod whose
+            # host tier still banks handoff KV keeps its probe-class GET
+            # routes (/kv/blocks) serving until the budget expires, so
+            # peers can pull the runs this pod warmed — exiting at
+            # inflight==0 stranded them
+            try:
+                while service.pending_handoff() and drainer.remaining_s > 0:
+                    time.sleep(0.05)
+            except Exception:
+                log.exception("pending-handoff hold failed")
             if on_done is not None:
                 on_done()
 
@@ -610,7 +673,8 @@ def create_app(
             for sec, obj in (("slo", getattr(tele, "slo", None)),
                              ("hbm", getattr(tele, "hbm", None)),
                              ("perf", getattr(tele, "sentinel", None)),
-                             ("kvtier", getattr(tele, "kvtier", None))):
+                             ("kvtier", getattr(tele, "kvtier", None)),
+                             ("migrate", getattr(tele, "migrate", None))):
                 if obj is not None:
                     try:
                         out[sec] = obj.snapshot()
@@ -703,6 +767,59 @@ def create_app(
             stats.count_served(n_run, len(body))
         return Response(body, media_type="application/octet-stream",
                         headers={"x-shai-kv-blocks": str(n_run)})
+
+    @app.post("/kv/migrate")
+    async def kv_migrate(request: Request):
+        """Live migration accept (kvnet.migrate): one MIGRATE envelope —
+        manifest + CRC-checked block frames — restores into this pod's
+        host tier and banks the manifest for its replay. Infrastructure
+        route: no admission gate or tenant ledger (the request already
+        paid admission on the dying pod; the resumed replay pays this
+        pod's gate normally), trace-excluded, refused while draining (a
+        dying pod must not accept hand-me-downs it would immediately
+        re-ship). Decode + restore run on the default executor — an
+        envelope is potentially tens of MB of frames and must not stall
+        /health."""
+        from ..kvnet import migrate as kv_migrate_mod
+        from ..kvnet.client import MAX_BLOCKS_PER_REQUEST
+
+        _require_ready()
+        if drainer.draining:
+            raise HTTPError(503, "pod is draining; pick another peer",
+                            headers={"retry-after": "1"})
+        body = request.body
+        if not body:
+            raise HTTPError(400, "empty migration envelope")
+        # cheap size bound BEFORE any frame decode (the PR-14 fetch-side
+        # lesson, applied to the accept side): an envelope larger than a
+        # full legitimate ship — manifest cap + the served block cap at
+        # this pod's block size — is refused without paying the decode
+        # (which roughly doubles the allocation). Tier-less pods accept
+        # manifest-only envelopes, so their bound is the manifest cap.
+        tier = service.kv_tier()
+        max_body = kv_migrate_mod.MAX_MANIFEST_BYTES + (1 << 16)
+        if tier is not None:
+            max_body += MAX_BLOCKS_PER_REQUEST * tier.block_nbytes * 2
+        if len(body) > max_body:
+            raise HTTPError(400, f"migration envelope of {len(body)} "
+                                 f"bytes exceeds the {max_body}-byte cap")
+
+        def _accept():
+            manifest, entries = kv_migrate_mod.decode_migration(body)
+            if len(entries) > MAX_BLOCKS_PER_REQUEST:
+                raise kv_migrate_mod.MigrateError(
+                    f"envelope carries {len(entries)} blocks, cap is "
+                    f"{MAX_BLOCKS_PER_REQUEST}")
+            return service.accept_migration(manifest, entries)
+
+        try:
+            ack = await asyncio.get_running_loop().run_in_executor(
+                None, _accept)
+        except kv_migrate_mod.MigrateError as e:
+            raise HTTPError(400, f"bad migration envelope: {e}")
+        if ack is None:
+            raise HTTPError(404, "this pod does not accept migrations")
+        return ack
 
     @app.get("/debug/conformance")
     def debug_conformance(request: Request):

@@ -143,6 +143,25 @@ _KVNET_COUNTERS = {
                   "kvnet: fetches degraded to local recompute (open "
                   "breaker, transport failure, rejected frames)"),
 }
+#: live migration (kvnet.migrate.MigrateStats snapshot keys): the drain
+#: ladder's counters — shipped/received/resumed move on the happy path,
+#: failed counts ships that never landed, fallbacks counts ladder
+#: degradations (no peer, refused restore)
+_MIGRATE_COUNTERS = {
+    "shipped": ("shai_migrate_shipped_total",
+                "migrate: in-flight requests shipped to a peer at drain"),
+    "received": ("shai_migrate_received_total",
+                 "migrate: migration envelopes accepted from peers"),
+    "resumed": ("shai_migrate_resumed_total",
+                "migrate: migrated sequences re-admitted and completed "
+                "on this pod"),
+    "failed": ("shai_migrate_failed_total",
+               "migrate: ship attempts that never landed on a peer"),
+    "fallbacks": ("shai_migrate_fallbacks_total",
+                  "migrate: ladder degradations (no peer, refused "
+                  "restore, unencodable blocks) — each one recomputed "
+                  "instead of failing"),
+}
 _KVTIER_GAUGES = {
     "used_bytes": ("shai_kvtier_used_bytes",
                    "Host KV tier: bytes resident in the host pool"),
@@ -274,6 +293,19 @@ class EngineTelemetryCollector:
                 snap = None
             if snap is not None:
                 for key, (name, doc) in _KVNET_COUNTERS.items():
+                    c = CounterMetricFamily(name, doc, labels=["app"])
+                    c.add_metric([self.app], float(snap.get(key, 0)))
+                    yield c
+        # live migration (kvnet.migrate): the drain ladder's counter
+        # families — attached by the engine, absent on engine-less pods
+        mig = getattr(tele, "migrate", None)
+        if mig is not None:
+            try:
+                snap = mig.snapshot()
+            except Exception:
+                snap = None
+            if snap is not None:
+                for key, (name, doc) in _MIGRATE_COUNTERS.items():
                     c = CounterMetricFamily(name, doc, labels=["app"])
                     c.add_metric([self.app], float(snap.get(key, 0)))
                     yield c

@@ -277,11 +277,23 @@ class VllmService(ModelService):
         # feeds both directions, so the shai_kvnet_* families export with
         # zero new plumbing.
         self.role = engine.role   # env-resolved; engine + serve must agree
-        if engine.cache.tier is not None:
-            from ...kvnet.client import KvNetClient
+        from ...kvnet.migrate import MigrateClient, MigrationInbox
 
-            self._kvnet_stats = engine.obs.kvnet
-            self._kvnet = KvNetClient(engine.cache.tier, self._kvnet_stats)
+        # ONE transport client for the whole network KV plane: the fetch
+        # side (decode-role handoff pulls), the migration ship, and —
+        # via the same breaker/SSRF/retry contract — nothing else. Built
+        # tier-less too: a pod without a tier still ships manifest-only
+        # migrations (the cold rung) and resumes them by recompute.
+        self._kvnet_stats = engine.obs.kvnet
+        self._kvnet = MigrateClient(engine.cache.tier, self._kvnet_stats,
+                                    mstats=engine.obs.migrate)
+        # bounded resume inbox: accepted-but-unreplayed manifests,
+        # exactly-once pop on replay
+        self._migrate_inbox = MigrationInbox()
+        # latched when a drain ship leaves blocks a peer may still PULL
+        # (source_url attached, restore short) — the only migration case
+        # the drain's handoff hold must wait for
+        self._pending_pull = False
         self.loop = EngineLoop(engine).start()
         # step watchdog (liveness): a wedged dispatch — work pending but no
         # step completing for N x the p99 step time — fails /health so
@@ -395,6 +407,11 @@ class VllmService(ModelService):
         return params
 
     def infer(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        if payload.get("resume"):
+            # live-migration replay (kvnet.migrate): the client/cova
+            # replays a `migrated` handoff here — the manifest carries
+            # the prompt, so no 'prompt' field is required
+            return self._resume_migrated(str(payload["resume"]))
         if "prompt" not in payload and "text" not in payload:
             raise HTTPError(400, "missing 'prompt'")
         prompt = str(payload.get("prompt", payload.get("text", "")))
@@ -554,6 +571,173 @@ class VllmService(ModelService):
         with obs_trace.span("kvnet_fetch", annotation=False):
             return self._kvnet.fetch_run(peer, hashes, budget_s=budget)
 
+    # -- live migration (kvnet.migrate) ------------------------------------
+
+    def wants_migration(self) -> bool:
+        from ...kvnet.migrate import migration_enabled
+
+        return getattr(self, "loop", None) is not None \
+            and migration_enabled()
+
+    def migrate_inflight(self) -> int:
+        """Drain migrate phase: the engine loop snapshots-and-finishes
+        every live request ('migrated' Finished, manifest attached); the
+        lane/stream threads blocked on those futures then SHIP the
+        manifests and return/stream the handoff records — outside every
+        engine structure, the shai-race contract."""
+        loop = getattr(self, "loop", None)
+        if loop is None:
+            return 0
+        return loop.migrate_all(timeout=10.0)
+
+    def _migrated_handoff(self, fin) -> Dict[str, Any]:
+        """Ship one migrated sequence to a peer and shape the handoff
+        record the caller returns/streams. Every failure degrades DOWN
+        the ladder — a record without a ``resume`` handle tells the
+        client/cova to replay cold — and is counted; this method never
+        raises the request into an error."""
+        from ...kvnet import migrate as migmod
+        from ...obs.util import env_str
+
+        eng = self._engine
+        mstats = eng.obs.migrate
+        man = dict(fin.migration or {})
+        own = env_str("SHAI_KVNET_PEER_URL", "").strip()
+        peer = ""
+        ack = None
+        try:
+            peer = migmod.resolve_migrate_peer(own)
+            if man and peer:
+                if own:
+                    # the warm-pull rung: this pod holds /kv/blocks open
+                    # through the drain, so a peer missing blocks can
+                    # still pull them while the budget lasts
+                    man.setdefault("source_url", own)
+                entries = []
+                tier = eng.cache.tier
+                if tier is not None and man.get("hashes"):
+                    try:
+                        # async copy-outs from the snapshot's demotion
+                        # must publish before the read (bounded by the
+                        # queued copies)
+                        tier.drain()
+                    except Exception:
+                        pass
+                    entries = tier.get_run(
+                        [int(h) for h in man["hashes"]])
+                with obs_trace.span("migrate_ship", annotation=False):
+                    ack = self._kvnet.ship(peer, man, entries)
+        except Exception:
+            log.exception("migrate ship failed — degrading to client "
+                          "replay")
+            ack = None
+        if ack is None:
+            # cold rung: no peer landed the manifest — the client/cova
+            # replays the prompt against any serving pod
+            mstats.count_fallback()
+        elif (own and man.get("hashes")
+                and int(ack.get("restored") or 0) < len(man["hashes"])):
+            # the peer took the manifest but not (all of) the blocks and
+            # knows our /kv/blocks address: hold the drain's server open
+            # so its warm-pull rung can still land (pending_handoff)
+            self._pending_pull = True
+        return {
+            "migrated": True,
+            "peer": peer or "",
+            "resume": (ack or {}).get("resume"),
+            "restored": int((ack or {}).get("restored") or 0),
+            "n_sent": len(fin.token_ids),
+            "generated_text": self._decode(fin.token_ids),
+            "n_prompt": fin.n_prompt,
+            "stop_reason": "migrated",
+        }
+
+    def _resume_migrated(self, rid: str) -> Dict[str, Any]:
+        """Replay of a migrated sequence (``{"resume": <handle>}`` on
+        ``/generate``): pop the banked manifest (exactly-once — a retried
+        handoff reads 404 and the caller replays cold), re-admit with the
+        preemption-resume semantics (prompt+generated as prompt suffix),
+        and return the COMPLETE output — pre-migration tokens included,
+        so the caller's view is identical to an uninterrupted request."""
+        import time as _time
+
+        inbox = getattr(self, "_migrate_inbox", None)
+        man = inbox.pop(rid) if inbox is not None else None
+        if man is None:
+            raise HTTPError(404, "unknown or already-resumed migration "
+                                 "handle; replay the original prompt")
+        pr = man.get("params") or {}
+        try:
+            params = self._SamplingParams(
+                temperature=float(pr.get("temperature", 0.0)),
+                top_k=int(pr.get("top_k", 0)),
+                top_p=float(pr.get("top_p", 1.0)),
+                max_new_tokens=max(1, int(pr.get("max_new_tokens", 1))),
+                eos_id=int(pr.get("eos_id", self.eos_id)),
+                logprobs=int(pr.get("logprobs", 0)))
+            ids = [int(t) for t in man.get("prompt_ids") or []]
+            already = [int(t) for t in man.get("generated") or []]
+            priority = int(man.get("priority", 1))
+            n_prompt = int(man.get("n_prompt", -1))
+            dl_ms = float(man.get("deadline_ms") or 0.0)
+        except (TypeError, ValueError) as e:
+            raise HTTPError(400, f"bad migration manifest: {e}")
+        if not ids:
+            raise HTTPError(400, "migration manifest has no prompt")
+        deadline_at = (_time.monotonic() + dl_ms / 1000.0
+                       if dl_ms > 0 else self._deadline_at())
+        out = self._collect(self.loop.submit(
+            ids, params, deadline_at=deadline_at, priority=priority,
+            tenant=str(man.get("tenant") or ""),
+            already_generated=already,
+            already_lp=man.get("lps"), orig_n_prompt=n_prompt))
+        if isinstance(out, dict) and out.get("migrated"):
+            # this pod's OWN drain re-migrated the replay: it did not
+            # complete here — the handoff must not read as a resume
+            # (the runbook's shipped:resumed 1:1 diagnostic)
+            return out
+        self._engine.obs.migrate.count("resumed")
+        out["resumed"] = True
+        return out
+
+    def accept_migration(self, manifest, entries):
+        """``POST /kv/migrate``: restore the shipped KV run into the
+        local tier (or warm-pull it from the manifest's ``source_url``)
+        and bank the manifest for its replay. The restore is best-effort
+        — a refused/failed restore still ACCEPTS the manifest, the
+        resumed request simply recomputes (ladder rung 2)."""
+        from ...kvnet import migrate as migmod
+
+        eng = getattr(self, "_engine", None)
+        inbox = getattr(self, "_migrate_inbox", None)
+        if eng is None or inbox is None or getattr(self, "loop", None) \
+                is None:
+            return None
+        if not isinstance(manifest, dict) or not manifest.get("prompt_ids"):
+            raise migmod.MigrateError("manifest has no prompt_ids")
+        restored = migmod.restore_entries(
+            eng.cache.tier, manifest, entries, eng.obs.migrate,
+            kvnet=self._kvnet)
+        rid = inbox.put(manifest)
+        eng.obs.migrate.count("received")
+        return {"accepted": True, "resume": rid, "restored": int(restored)}
+
+    def pending_handoff(self) -> bool:
+        """Hold the drain's server open while the host tier still banks
+        KV a peer may actually PULL over ``/kv/blocks``: prefill-role
+        pods (the handoff strand bugfix — a prefill pod's OWN requests
+        finish fast, but its whole job is the banked runs) and pods
+        whose migrate sweep shipped a manifest the peer must still pull
+        blocks for (``source_url`` attached, restore short). Gated on
+        real banked state, NOT the migration feature flag — an armed pod
+        that drained clean must exit promptly, not wait out the budget."""
+        eng = getattr(self, "_engine", None)
+        tier = getattr(getattr(eng, "cache", None), "tier", None)
+        if tier is None or tier.n_entries == 0:
+            return False
+        return self.role == "prefill" or getattr(self, "_pending_pull",
+                                                 False)
+
     @staticmethod
     def _deadline_at() -> float:
         """The request deadline as an absolute monotonic instant for the
@@ -589,6 +773,11 @@ class VllmService(ModelService):
         from Finished to the serving dict (rejected → 503, deadline →
         504), shared by infer and the OpenAI n>1 fan-out."""
         fin = fut.result(timeout=self._result_timeout())
+        if fin.stop_reason == "migrated":
+            # drain migrate phase: ship the snapshot and hand the caller
+            # the handoff record — cova (or the client) replays it
+            # against the peer; this is a continuation, not a failure
+            return self._migrated_handoff(fin)
         # graft the engine's per-phase timeline onto the request trace:
         # queue/prefill/decode become spans of THIS request even though the
         # engine loop ran them on its own thread
@@ -729,6 +918,18 @@ class VllmService(ModelService):
                     if not fut.done():
                         self.loop.cancel(fut)
                 raise
+        for out in outs:
+            if isinstance(out, dict) and out.get("migrated"):
+                # the pod migrated this request mid-drain: the OpenAI
+                # shape has no handoff vocabulary — surface a retryable
+                # 503 naming the peer instead of a silently-truncated
+                # completion (the bespoke /generate returns the handoff
+                # record itself, which cova follows)
+                raise HTTPError(
+                    503, "request migrated to a peer mid-drain; retry "
+                         "against it",
+                    headers={"retry-after": "1",
+                             "x-shai-migrate-peer": out.get("peer") or ""})
         stop = body.get("stop")
         # filter falsy: '' would truncate everything at position 0 (and the
         # SSE assembler already filters them — the paths must agree)
@@ -892,6 +1093,20 @@ class VllmService(ModelService):
                     req_trace.add_phase_spans(fin.timing)
                     req_trace.root.attrs.setdefault("engine_req_id",
                                                     fin.req_id)
+                if fin.stop_reason == "migrated":
+                    # drain migrate phase mid-stream: every token emitted
+                    # so far stands; the in-band `migrated` record names
+                    # the peer + resume handle the client (or cova)
+                    # replays against — the continuation streams from
+                    # the new pod, token-identical to an uninterrupted
+                    # run (the live-migration contract)
+                    handoff = self._migrated_handoff(fin)
+                    yield ("data: " + _json.dumps({"migrated": {
+                        "peer": handoff["peer"],
+                        "resume": handoff["resume"],
+                        "n_sent": handoff["n_sent"]}}) + "\n\n")
+                    yield "data: [DONE]\n\n"
+                    return
                 if fin.stop_reason == "rejected":
                     # headers already went out as 200 — signal in-band
                     yield ("data: " + _json.dumps({"error": {

@@ -49,6 +49,9 @@ SCAN_FILES = (
     # the network KV transport's shai_kvnet_* family (same contract: a
     # counter added client-side must reach the README runbook)
     os.path.join(PKG, "kvnet", "client.py"),
+    # live migration's shai_migrate_* family (METRIC_FAMILIES literals —
+    # a counter added to the ladder must reach the README runbook)
+    os.path.join(PKG, "kvnet", "migrate.py"),
 )
 README = os.path.join(ROOT, "README.md")
 

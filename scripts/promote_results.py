@@ -40,7 +40,11 @@ KEYS = {"sd": "sd21_img_s",
         # disaggregated prefill/decode (PR 14): decode-pod TTFT p50 vs the
         # monolithic pod under mixed prompt load, KV shipped through the
         # kvnet frame codec (bench.py disagg)
-        "disagg": "disagg_ttft_ratio"}
+        "disagg": "disagg_ttft_ratio",
+        # live migration (PR 15): resumed-request added latency p50 after
+        # a mid-decode drain cut, KV shipped through the MIGRATE envelope
+        # vs manifest-only recompute; errors REQUIRED 0 (bench.py migrate)
+        "migrate": "migrate_resume_p50_ms"}
 
 
 def _load_results() -> dict:

@@ -1,0 +1,1144 @@
+"""Live request migration (kvnet/migrate.py): in-flight sequences survive
+pod drain, preemption, and crash.
+
+THE invariant, composed from kvtier's and kvnet's: a sequence migrated
+MID-DECODE produces TOKEN-exact greedy output vs the never-migrated
+engine (across both async disciplines and int8 KV transport, KV crossing
+byte-exact), and every rung of the migration ladder — ship, warm-pull,
+cold replay — lands on a completed request with pool-exact accounting on
+BOTH pods, never on a request failure. The MIGRATE envelope is strict
+(truncation/corruption rejected), the resume inbox is exactly-once, the
+drain holds `/kv/blocks` open for banked handoff KV (the PR-15 drain
+bugfix), and cova follows `migrated` handoffs end to end.
+"""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+from scalable_hw_agnostic_inference_tpu.engine.engine import (
+    LLMEngine,
+    SamplingParams,
+)
+from scalable_hw_agnostic_inference_tpu.kvnet import migrate as migmod
+from scalable_hw_agnostic_inference_tpu.kvnet.client import (
+    KvNetStats,
+    publish_run,
+)
+from scalable_hw_agnostic_inference_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+from scalable_hw_agnostic_inference_tpu.resilience import faults as rz_faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    rz_faults.reset()
+    yield
+    rz_faults.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, params
+
+
+def make_engine(tiny_model, monkeypatch, tier=True, quant=False,
+                async_decode=None, **over):
+    cfg, _, params = tiny_model
+    monkeypatch.setenv("SHAI_KVTIER", "1" if tier else "0")
+    monkeypatch.setenv("SHAI_KVTIER_ASYNC", "0")
+    monkeypatch.setenv("SHAI_KV_QUANT", "int8" if quant else "")
+    if async_decode is not None:
+        monkeypatch.setenv("SHAI_ASYNC_DECODE", "1" if async_decode else "0")
+    kw = dict(max_model_len=128, max_num_seqs=3, block_size=8,
+              context_encoding_buckets=(16, 32), max_new_tokens=24,
+              enable_prefix_caching=True)
+    kw.update(over)
+    return LLMEngine(cfg, params, EngineConfig(**kw))
+
+
+def _prompt(seed, length=40):
+    rng = np.random.default_rng(seed)
+    return [int(x) for x in rng.integers(2, 500, length)]
+
+
+def _run_all(eng, prompts, sp, **kw):
+    ids = [eng.add_request(list(p), sp, **kw) for p in prompts]
+    done = {}
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    eng.finish_pending()
+    return [done[i] for i in ids]
+
+
+def _drain_to_done(eng, done):
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    eng.finish_pending()
+
+
+def _assert_pool_exact(eng):
+    cache = eng.cache
+    assert cache.active == []
+    used = (cache.total_blocks - 1) - cache.allocator.n_free
+    assert used == len(cache._block2hash)
+    assert cache.leaked_blocks == 0
+    tier = cache.tier
+    if tier is not None:
+        tier.drain()
+        snap = tier.snapshot()
+        assert snap["used_bytes"] == snap["entries"] * snap["block_nbytes"]
+        assert snap["used_bytes"] <= snap["capacity_bytes"]
+
+
+def _resume_on(eng, man, stream=None):
+    """Re-admit a decoded manifest on ``eng`` — the serve layer's
+    `_resume_migrated`, deviceless."""
+    pr = man["params"]
+    sp = SamplingParams(
+        temperature=pr["temperature"], top_k=pr["top_k"],
+        top_p=pr["top_p"], max_new_tokens=pr["max_new_tokens"],
+        eos_id=pr["eos_id"], logprobs=pr.get("logprobs", 0))
+    return eng.add_request(
+        man["prompt_ids"], sp, already_generated=man["generated"],
+        already_lp=man.get("lps"), orig_n_prompt=man["n_prompt"],
+        on_token=stream)
+
+
+def _migrate_wire(src_eng, man):
+    """The wire: tier run -> MIGRATE envelope -> decode, byte-exact."""
+    entries = []
+    if src_eng.cache.tier is not None and man["hashes"]:
+        entries = src_eng.cache.tier.get_run(man["hashes"])
+    return migmod.decode_migration(migmod.encode_migration(man, entries))
+
+
+# -- envelope codec -----------------------------------------------------------
+
+def test_envelope_roundtrip_and_strictness():
+    rng = np.random.default_rng(0)
+    man = {"v": 1, "prompt_ids": [1, 2, 3], "generated": [7],
+           "hashes": [11, 22], "params": {"max_new_tokens": 4}}
+    entries = [(11, rng.standard_normal((2, 8, 2, 4)).astype(np.float32),
+                rng.standard_normal((2, 8, 2, 4)).astype(np.float32))]
+    blob = migmod.encode_migration(man, entries)
+    man2, ent2 = migmod.decode_migration(blob)
+    assert man2 == man
+    assert ent2[0][0] == 11
+    for a, b in zip(entries[0][1:], ent2[0][1:]):
+        assert b.tobytes() == a.tobytes()
+    # manifest-only envelopes are legal (the warm-pull / cold rungs)
+    m3, e3 = migmod.decode_migration(migmod.encode_migration(man, ()))
+    assert m3 == man and e3 == []
+    # strictness: truncation at every cut inside the header+manifest
+    for cut in range(1, min(len(blob), 40)):
+        with pytest.raises(migmod.MigrateError):
+            migmod.decode_migration(blob[:cut])
+    # corrupt manifest byte -> CRC mismatch
+    bad = bytearray(blob)
+    bad[migmod._HEAD.size + 2] ^= 0xFF
+    with pytest.raises(migmod.MigrateError):
+        migmod.decode_migration(bytes(bad))
+    # bad magic / version
+    with pytest.raises(migmod.MigrateError):
+        migmod.decode_migration(b"XXXX" + blob[4:])
+    with pytest.raises(migmod.MigrateError):
+        migmod.decode_migration(blob[:4] + b"\x09" + blob[5:])
+    # non-dict manifest refused
+    import zlib
+    body = json.dumps([1, 2]).encode()
+    hdr = migmod._HEAD.pack(migmod.MAGIC, migmod.VERSION, len(body),
+                            zlib.crc32(body))
+    with pytest.raises(migmod.MigrateError):
+        migmod.decode_migration(hdr + body)
+    # corrupt block frames after a valid manifest are refused too
+    with pytest.raises(migmod.MigrateError):
+        migmod.decode_migration(
+            migmod.encode_migration(man, entries)[:-3])
+
+
+def test_inbox_exactly_once_and_bounded():
+    inbox = migmod.MigrationInbox(capacity=3)
+    rids = [inbox.put({"i": i}) for i in range(5)]
+    assert len(inbox) == 3
+    # the two oldest evicted FIFO
+    assert inbox.pop(rids[0]) is None and inbox.pop(rids[1]) is None
+    assert inbox.pop(rids[4]) == {"i": 4}
+    # exactly-once: a duplicate pop reads unknown
+    assert inbox.pop(rids[4]) is None
+    assert len(inbox) == 2
+
+
+def test_metrics_collector_exports_migrate_family():
+    prom = pytest.importorskip("prometheus_client")
+    del prom
+    from scalable_hw_agnostic_inference_tpu.obs.steploop import StepTelemetry
+    from scalable_hw_agnostic_inference_tpu.serve.metrics import (
+        EngineTelemetryCollector,
+    )
+
+    tele = StepTelemetry(total_blocks=8)
+    tele.migrate = migmod.MigrateStats()
+    tele.migrate.count("shipped")
+    tele.migrate.count("resumed", 2)
+    fams = {m.name: m for m in
+            EngineTelemetryCollector(lambda: tele, "t").collect()}
+    for fam in ("shai_migrate_shipped", "shai_migrate_received",
+                "shai_migrate_resumed", "shai_migrate_failed",
+                "shai_migrate_fallbacks"):
+        assert fam in fams, fam
+    assert fams["shai_migrate_resumed"].samples[0].value == 2.0
+    # engine-less telemetry exports nothing
+    bare = StepTelemetry(total_blocks=8)
+    assert not any(n.startswith("shai_migrate")
+                   for n in {m.name for m in EngineTelemetryCollector(
+                       lambda: bare, "t").collect()})
+    # every family name in METRIC_FAMILIES is what metrics.py exports
+    assert set(migmod.METRIC_FAMILIES) == {
+        "shai_migrate_shipped_total", "shai_migrate_received_total",
+        "shai_migrate_resumed_total", "shai_migrate_failed_total",
+        "shai_migrate_fallbacks_total"}
+
+
+# -- engine-level differential: THE oracle ------------------------------------
+
+def _migrate_differential(tiny_model, monkeypatch, quant=False,
+                          async_decode=None, steps=7, length=40,
+                          restore_fault=False):
+    sp = SamplingParams(temperature=0.0, max_new_tokens=16)
+    prompt = _prompt(5, length)
+    oracle = make_engine(tiny_model, monkeypatch, tier=False, quant=quant,
+                         async_decode=async_decode)
+    [fo] = _run_all(oracle, [prompt], sp)
+
+    A = make_engine(tiny_model, monkeypatch, quant=quant,
+                    async_decode=async_decode)
+    B = make_engine(tiny_model, monkeypatch, quant=quant,
+                    async_decode=async_decode)
+    rid = A.add_request(list(prompt), sp)
+    for _ in range(steps):
+        A.step()
+    fin = A.migrate_out(rid)
+    assert fin is not None and fin.stop_reason == "migrated"
+    man = fin.migration
+    assert man["hashes"], "mid-decode snapshot banked no KV"
+    assert len(man["prompt_ids"]) > len(prompt), \
+        "resume prompt must carry the generated suffix"
+    A.finish_pending()
+    _assert_pool_exact(A)
+
+    man2, entries2 = _migrate_wire(A, man)
+    assert man2 == man
+    if quant:
+        # int8 transport is BYTE-exact: all four buffers identical
+        for (h, *src) in A.cache.tier.get_run(man["hashes"]):
+            got = next(e for e in entries2 if e[0] == h)[1:]
+            assert len(got) == 4
+            for aw, ag in zip(src, got):
+                assert ag.tobytes() == aw.tobytes()
+    stats = migmod.MigrateStats()
+    if restore_fault:
+        rz_faults.configure("migrate.restore=error", 0)
+        n = migmod.restore_entries(B.cache.tier, man2, entries2, stats)
+        assert n == 0 and stats.snapshot()["fallbacks"] == 1
+        rz_faults.reset()
+    else:
+        n = publish_run(B.cache.tier, [int(h) for h in man2["hashes"]],
+                        entries2)
+        assert n == len(man2["hashes"])
+
+    done = {}
+    rid2 = _resume_on(B, man2)
+    _drain_to_done(B, done)
+    assert done[rid2].token_ids == fo.token_ids, \
+        "migrated resume diverged from the never-migrated oracle"
+    assert done[rid2].stop_reason in ("length", "eos")
+    if not restore_fault:
+        assert B.cache.tier.snapshot()["restored"] > 0, \
+            "resume never used the migrated run"
+    _assert_pool_exact(B)
+    return fin, done[rid2]
+
+
+def test_migrate_differential_greedy(tiny_model, monkeypatch):
+    _migrate_differential(tiny_model, monkeypatch, async_decode=False)
+
+
+def test_migrate_differential_int8_byte_exact(tiny_model, monkeypatch):
+    _migrate_differential(tiny_model, monkeypatch, quant=True,
+                          async_decode=False)
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_migrate_differential_async_discipline(tiny_model, monkeypatch):
+    _migrate_differential(tiny_model, monkeypatch, async_decode=True)
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_migrate_differential_async_int8(tiny_model, monkeypatch):
+    _migrate_differential(tiny_model, monkeypatch, quant=True,
+                          async_decode=True)
+
+
+def test_migrate_restore_fault_degrades_to_recompute(tiny_model,
+                                                     monkeypatch):
+    """`migrate.restore=error` forces the recompute-on-peer rung: the
+    manifest is accepted, the blocks are refused, the resumed request is
+    STILL token-exact — the ladder never reaches request failure."""
+    _migrate_differential(tiny_model, monkeypatch, async_decode=False,
+                          restore_fault=True)
+
+
+def test_migrate_out_finishes_when_pending_completes(tiny_model,
+                                                     monkeypatch):
+    """A pending token that already ends the request finishes normally
+    ('length'/'eos') instead of migrating a sequence with nothing left."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=3)
+    eng = make_engine(tiny_model, monkeypatch, async_decode=False)
+    rid = eng.add_request(_prompt(6), sp)
+    eng.step()  # prefill + first sample
+    eng.step()
+    eng.step()  # generated=[t1,t2], pending=t3 -> committed == max_new
+    fin = eng.migrate_out(rid)
+    assert fin is not None and fin.stop_reason in ("length", "eos")
+    assert fin.migration is None
+    assert len(fin.token_ids) <= 3
+    _assert_pool_exact(eng)
+
+
+def test_migrate_queued_request_is_cold_manifest(tiny_model, monkeypatch):
+    """A queued (never admitted) request migrates as a pure prompt replay:
+    no KV, empty hashes — the cold rung, still token-exact."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    eng = make_engine(tiny_model, monkeypatch, async_decode=False)
+    rid = eng.add_request(_prompt(7), sp)  # never stepped
+    fin = eng.migrate_out(rid)
+    assert fin.stop_reason == "migrated" and fin.migration["hashes"] == []
+    assert fin.migration["prompt_ids"] == _prompt(7)
+    assert not eng.has_work
+    oracle = make_engine(tiny_model, monkeypatch, tier=False,
+                         async_decode=False)
+    [fo] = _run_all(oracle, [_prompt(7)], sp)
+    B = make_engine(tiny_model, monkeypatch, async_decode=False)
+    done = {}
+    rid2 = _resume_on(B, fin.migration)
+    _drain_to_done(B, done)
+    assert done[rid2].token_ids == fo.token_ids
+
+
+def test_migrate_multimodal_is_declined(tiny_model, monkeypatch):
+    """Soft-prefix state does not serialize — migrate_out declines and
+    the request keeps running (the legacy drain covers it)."""
+    cfg, _, _ = tiny_model
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    eng = make_engine(tiny_model, monkeypatch, tier=False,
+                      async_decode=False)
+    prefix = np.zeros((4, cfg.dim), np.float32)
+    rid = eng.add_request(_prompt(8, 10), sp, prefix=prefix)
+    assert eng.migrate_out(rid) is None
+    done = {}
+    _drain_to_done(eng, done)
+    assert done[rid].stop_reason in ("length", "eos")
+
+
+def test_migrate_preserves_qos_and_deadline(tiny_model, monkeypatch):
+    """Tenant/priority and the deadline REMAINDER cross in the manifest
+    (absolute monotonic instants do not cross pods)."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=16)
+    eng = make_engine(tiny_model, monkeypatch, async_decode=False)
+    rid = eng.add_request(_prompt(9), sp, priority=2, tenant="acme",
+                          deadline_at=time.monotonic() + 30.0)
+    for _ in range(4):
+        eng.step()
+    man = eng.migrate_out(rid).migration
+    assert man["tenant"] == "acme" and man["priority"] == 2
+    assert 0.0 < man["deadline_ms"] <= 30_000.0
+    assert man["params"]["max_new_tokens"] < 16  # the REMAINING budget
+
+
+def test_migrate_logprobs_survive(tiny_model, monkeypatch):
+    """Logprob entries emitted before the migration ride the manifest;
+    the resumed Finished carries one entry per output token, matching
+    the never-migrated oracle's entries."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8, logprobs=1)
+    prompt = _prompt(10)
+    oracle = make_engine(tiny_model, monkeypatch, tier=False,
+                         async_decode=False)
+    [fo] = _run_all(oracle, [prompt], sp)
+    A = make_engine(tiny_model, monkeypatch, async_decode=False)
+    rid = A.add_request(list(prompt), sp)
+    for _ in range(4):
+        A.step()
+    man = A.migrate_out(rid).migration
+    assert man.get("lps"), "pre-migration logprob entries missing"
+    B = make_engine(tiny_model, monkeypatch, async_decode=False)
+    man2, entries2 = _migrate_wire(A, man)
+    publish_run(B.cache.tier, [int(h) for h in man2["hashes"]], entries2)
+    done = {}
+    rid2 = _resume_on(B, man2)
+    _drain_to_done(B, done)
+    fin = done[rid2]
+    assert fin.token_ids == fo.token_ids
+    assert [e["token"] for e in fin.logprobs] \
+        == [e["token"] for e in fo.logprobs]
+
+
+def test_migrate_streams_exactly_once(tiny_model, monkeypatch):
+    """on_token fires exactly once per output token across the migration:
+    the dying engine streams through the pending token, the resumed
+    engine streams only NEW tokens."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=12)
+    prompt = _prompt(11)
+    oracle = make_engine(tiny_model, monkeypatch, tier=False,
+                         async_decode=False)
+    [fo] = _run_all(oracle, [prompt], sp)
+    streamed = []
+    A = make_engine(tiny_model, monkeypatch, async_decode=False)
+    rid = A.add_request(list(prompt), sp, on_token=streamed.append)
+    for _ in range(5):
+        A.step()
+    fin = A.migrate_out(rid)
+    n_sent = len(streamed)
+    assert streamed == fin.token_ids[:n_sent] == fo.token_ids[:n_sent]
+    B = make_engine(tiny_model, monkeypatch, async_decode=False)
+    man2, entries2 = _migrate_wire(A, fin.migration)
+    publish_run(B.cache.tier, [int(h) for h in man2["hashes"]], entries2)
+    done = {}
+    rid2 = _resume_on(B, man2, stream=streamed.append)
+    _drain_to_done(B, done)
+    assert streamed == fo.token_ids, \
+        "concatenated stream is not token-identical to the oracle"
+    assert done[rid2].token_ids == fo.token_ids
+
+
+# -- ship client / peer selection ---------------------------------------------
+
+def _mock_ship_client(handler, tier=None, mstats=None):
+    httpx = pytest.importorskip("httpx")
+    return migmod.MigrateClient(
+        tier, KvNetStats(), mstats=mstats or migmod.MigrateStats(),
+        timeout_s=2.0, connect_timeout_s=0.5, connect_retries=1,
+        transport=httpx.MockTransport(handler))
+
+
+def test_ship_posts_envelope_and_parses_ack():
+    httpx = pytest.importorskip("httpx")
+    seen = {}
+
+    def handler(request):
+        seen["url"] = str(request.url)
+        seen["manifest"], seen["entries"] = migmod.decode_migration(
+            request.content)
+        return httpx.Response(200, json={"accepted": True, "resume": "r1",
+                                         "restored": 2})
+
+    c = _mock_ship_client(handler)
+    man = {"prompt_ids": [1, 2], "hashes": []}
+    ack = c.ship("http://peer", man, ())
+    assert ack == {"accepted": True, "resume": "r1", "restored": 2}
+    assert seen["url"].endswith(migmod.MIGRATE_ROUTE)
+    assert seen["manifest"] == man and seen["entries"] == []
+    assert c.mstats.snapshot()["shipped"] == 1
+
+
+def test_ship_fault_degrades_cold():
+    """`migrate.ship=error` never leaves the pod: ship() returns None,
+    `failed` counts — the caller's handoff record carries no resume
+    handle and the client replays cold."""
+    httpx = pytest.importorskip("httpx")
+
+    def handler(request):  # pragma: no cover - must not be reached
+        return httpx.Response(200, json={"accepted": True})
+
+    c = _mock_ship_client(handler)
+    rz_faults.configure("migrate.ship=error", 0)
+    try:
+        assert c.ship("http://peer", {"prompt_ids": [1]}, ()) is None
+    finally:
+        rz_faults.reset()
+    snap = c.mstats.snapshot()
+    assert snap["failed"] == 1 and snap["shipped"] == 0
+
+
+def test_ship_rejections_and_refusals():
+    httpx = pytest.importorskip("httpx")
+
+    def refuse(request):
+        return httpx.Response(503, json={"error": "draining"})
+
+    c = _mock_ship_client(refuse)
+    assert c.ship("http://peer", {"p": 1}, ()) is None
+    assert c.mstats.snapshot()["failed"] == 1
+    # non-http peers are refused before any socket work
+    c2 = _mock_ship_client(refuse)
+    assert c2.ship("file:///etc/passwd", {"p": 1}, ()) is None
+    assert c2.mstats.snapshot()["fallbacks"] == 1
+
+    def not_accepted(request):
+        return httpx.Response(200, json={"accepted": False})
+
+    c3 = _mock_ship_client(not_accepted)
+    assert c3.ship("http://peer", {"p": 1}, ()) is None
+    assert c3.mstats.snapshot()["failed"] == 1
+
+
+def test_resolve_migrate_peer_and_enabled(monkeypatch):
+    monkeypatch.delenv("SHAI_MIGRATE", raising=False)
+    monkeypatch.delenv("SHAI_MIGRATE_PEER_URL", raising=False)
+    monkeypatch.delenv("SHAI_MIGRATE_FLEET_URL", raising=False)
+    assert not migmod.migration_enabled()
+    assert migmod.resolve_migrate_peer() == ""
+    monkeypatch.setenv("SHAI_MIGRATE_PEER_URL", "http://peer:8000")
+    assert migmod.migration_enabled()
+    assert migmod.resolve_migrate_peer() == "http://peer:8000"
+    monkeypatch.delenv("SHAI_MIGRATE_PEER_URL")
+    monkeypatch.setenv("SHAI_MIGRATE", "1")
+    assert migmod.migration_enabled()
+    # reserve is capped at half the budget, lenient parse
+    monkeypatch.setenv("SHAI_MIGRATE_RESERVE_S", "99")
+    assert migmod.migrate_reserve_s(8.0) == 4.0
+    monkeypatch.setenv("SHAI_MIGRATE_RESERVE_S", "nonsense")
+    assert migmod.migrate_reserve_s(30.0) == 5.0  # default
+
+
+def test_resolve_migrate_peer_from_fleet(monkeypatch):
+    """Fleet discovery: a serving, non-overloaded, decode-capable backend
+    that is not this pod."""
+    httpx = pytest.importorskip("httpx")
+    monkeypatch.delenv("SHAI_MIGRATE_PEER_URL", raising=False)
+    monkeypatch.setenv("SHAI_MIGRATE_FLEET_URL", "http://cova:8080")
+    snap = {
+        "roles": {"decode": {"serving": ["d1", "d2"]},
+                  "both": {"serving": ["m1"]},
+                  "prefill": {"serving": ["pf"]}},
+        "overloaded": ["d1"],
+        "urls": {"d1": "http://d1", "d2": "http://d2", "m1": "http://m1",
+                 "pf": "http://pf"},
+    }
+
+    def fake_get(url, timeout=None):
+        assert url == "http://cova:8080/fleet"
+        return httpx.Response(200, json=snap,
+                              request=httpx.Request("GET", url))
+
+    monkeypatch.setattr(httpx, "get", fake_get)
+    # d1 is overloaded, d2 wins; "own" pod excluded
+    assert migmod.resolve_migrate_peer() == "http://d2"
+    assert migmod.resolve_migrate_peer(own_url="http://d2") == "http://m1"
+
+
+# -- drain: migrate phase + the /kv/blocks hold (PR-15 bugfix) ----------------
+
+def _stub_app(service, budget_s):
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    cfg = ServeConfig(app="stub", model_id="tiny", device="cpu",
+                      drain_budget_s=budget_s)
+    return create_app(cfg, service)
+
+
+def _stub_service(handoff=False, wants=False, migrated=0):
+    from scalable_hw_agnostic_inference_tpu.serve.app import ModelService
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    class _Stub(ModelService):
+        def __init__(self):
+            super().__init__(ServeConfig(app="stub", model_id="tiny",
+                                         device="cpu"))
+            self.calls = []
+
+        def load(self):
+            pass
+
+        def infer(self, payload):
+            return {}
+
+        def wants_migration(self):
+            return wants
+
+        def migrate_inflight(self):
+            self.calls.append("migrate")
+            return migrated
+
+        def pending_handoff(self):
+            return handoff
+
+        def drain(self, budget_s):
+            self.calls.append(("drain", round(budget_s, 2)))
+
+    return _Stub()
+
+
+def test_drain_holds_kv_blocks_until_budget_for_banked_handoffs():
+    """THE PR-15 drain bugfix regression: a pod with banked handoff KV
+    must NOT exit at inflight==0 — it holds (GET routes keep serving)
+    until the budget expires so peers can still pull /kv/blocks."""
+    svc = _stub_service(handoff=True)
+    app = _stub_app(svc, budget_s=0.8)
+    done_at = {}
+    t0 = time.monotonic()
+    assert app.state["begin_drain"](
+        on_done=lambda: done_at.setdefault("t", time.monotonic()))
+    for _ in range(100):
+        if "t" in done_at:
+            break
+        time.sleep(0.05)
+    assert "t" in done_at, "drain never completed"
+    held = done_at["t"] - t0
+    assert held >= 0.6, f"exited after {held:.2f}s — handoff KV stranded"
+
+    # control: no banked handoffs -> the drain exits promptly
+    svc2 = _stub_service(handoff=False)
+    app2 = _stub_app(svc2, budget_s=5.0)
+    done2 = {}
+    t0 = time.monotonic()
+    app2.state["begin_drain"](
+        on_done=lambda: done2.setdefault("t", time.monotonic()))
+    for _ in range(100):
+        if "t" in done2:
+            break
+        time.sleep(0.05)
+    assert done2["t"] - t0 < 2.0, "idle drain must not wait out the budget"
+
+
+def test_drain_runs_migrate_phase_when_armed(monkeypatch):
+    """With migration armed and work in flight past the reserve, the
+    drain calls migrate_inflight() before the budget wait."""
+    monkeypatch.setenv("SHAI_MIGRATE_RESERVE_S", "5")
+    svc = _stub_service(wants=True, migrated=2)
+    app = _stub_app(svc, budget_s=1.0)  # reserve caps to 0.5
+    # one fake in-flight request so the natural-completion wait times out
+    app.state["status"]["inflight"] = 1
+    done = {}
+    app.state["begin_drain"](on_done=lambda: done.setdefault("t", 1))
+    for _ in range(100):
+        if "migrate" in svc.calls:
+            break
+        time.sleep(0.05)
+    assert "migrate" in svc.calls, "migrate phase never ran"
+    app.state["status"]["inflight"] = 0
+    for _ in range(100):
+        if "t" in done:
+            break
+        time.sleep(0.05)
+    assert "t" in done
+    # unarmed control: migrate_inflight is never called
+    svc2 = _stub_service(wants=False)
+    app2 = _stub_app(svc2, budget_s=0.3)
+    done2 = {}
+    app2.state["begin_drain"](on_done=lambda: done2.setdefault("t", 1))
+    for _ in range(100):
+        if "t" in done2:
+            break
+        time.sleep(0.05)
+    assert "migrate" not in svc2.calls
+
+
+# -- cova: following migrated handoffs ----------------------------------------
+
+def _cova_with_migration(behavior):
+    """CovaClient with faked transport. ``behavior[name]`` is a callable
+    (payload -> response dict) or an exception to raise."""
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        CovaClient,
+    )
+    from scalable_hw_agnostic_inference_tpu.serve.asgi import HTTPError
+
+    models = {n: {"url": f"http://{n}", "weight": w}
+              for w, n in enumerate(reversed(list(behavior)), 1)}
+    c = CovaClient(models)
+    calls = []
+
+    async def fake_post(name, route, payload):
+        calls.append((name, dict(payload)))
+        b = behavior[name]
+        if isinstance(b, Exception):
+            raise b
+        return b(dict(payload))
+
+    async def fake_fleet():
+        return {"models": {n: {"role": "both"} for n in behavior},
+                "overloaded": []}
+
+    c.post = fake_post
+    c._fleet_for_routing = fake_fleet
+    del HTTPError
+    return c, calls
+
+
+def test_cova_follows_migrated_handoff_warm():
+    """Backend A returns a migrated handoff naming backend B's URL + a
+    resume handle: cova replays {"resume": ...} against B and marks the
+    response routed_by=migrated."""
+    def a(payload):
+        return {"migrated": True, "peer": "http://b", "resume": "r42",
+                "n_sent": 3}
+
+    def b(payload):
+        if "resume" in payload:
+            return {"generated_text": "resumed!", "n_tokens": 8,
+                    "n_prompt": 5, "stop_reason": "length",
+                    "resumed": True}
+        return {"generated_text": "cold", "n_tokens": 8, "n_prompt": 5,
+                "stop_reason": "length"}
+
+    c, calls = _cova_with_migration({"a": a, "b": b})
+    out = asyncio.run(c.generate("prompt", {"max_new_tokens": 8}))
+    assert out["routed_by"] == "migrated"
+    assert out["generated_text"] == "resumed!"
+    assert out["model"] == "b"
+    assert calls[-1] == ("b", {"resume": "r42"})
+
+
+def test_cova_migrated_handoff_cold_replay_when_no_resume():
+    """A handoff without a resume handle (the ship failed — cold rung):
+    cova replays the PROMPT against a remaining backend, the draining
+    pod excluded; the request never fails while a pod exists."""
+    def a(payload):
+        return {"migrated": True, "peer": "", "resume": None, "n_sent": 2}
+
+    def b(payload):
+        assert payload.get("prompt") == "prompt"
+        return {"generated_text": "replayed", "n_tokens": 4, "n_prompt": 5,
+                "stop_reason": "length"}
+
+    c, calls = _cova_with_migration({"a": a, "b": b})
+    out = asyncio.run(c.generate("prompt", {"max_new_tokens": 4}))
+    assert out["routed_by"] == "migrated"
+    assert out["generated_text"] == "replayed" and out["model"] == "b"
+
+
+def test_cova_migrated_resume_failure_degrades_to_cold():
+    """The resume against the named peer 404s (inbox already popped /
+    peer restarted): cova falls to the cold replay instead of failing."""
+    from scalable_hw_agnostic_inference_tpu.serve.asgi import HTTPError
+
+    state = {"resumes": 0}
+
+    def a(payload):
+        return {"migrated": True, "peer": "http://b", "resume": "gone",
+                "n_sent": 1}
+
+    def b(payload):
+        if "resume" in payload:
+            state["resumes"] += 1
+            raise HTTPError(404, "unknown handle")
+        return {"generated_text": "cold-replay", "n_tokens": 2,
+                "n_prompt": 5, "stop_reason": "length"}
+
+    c, calls = _cova_with_migration({"a": a, "b": b})
+    out = asyncio.run(c.generate("prompt", {}))
+    assert state["resumes"] == 1
+    assert out["routed_by"] == "migrated"
+    assert out["generated_text"] == "cold-replay"
+
+
+# -- migrate-storm fuzz -------------------------------------------------------
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+@pytest.mark.parametrize("seed", [0, 1])
+def test_migrate_storm_fuzz(tiny_model, monkeypatch, seed):
+    """Seeded storm: random migrations mid-decode x cancels x deadlines
+    across two pods. Invariants: every request reaches EXACTLY one
+    client-visible terminal (a 'migrated' Finished is a handoff, its
+    resume is the continuation), migrated+resumed greedy outputs match
+    the oracle, and both pools stay exact."""
+    rng = np.random.default_rng(100 + seed)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=12)
+    N = 8
+    prompts = [_prompt(200 + seed * 50 + i, int(rng.integers(12, 56)))
+               for i in range(N)]
+    oracle = make_engine(tiny_model, monkeypatch, tier=False,
+                         async_decode=False)
+    want = {i: f.token_ids
+            for i, f in enumerate(_run_all(oracle, prompts, sp))}
+
+    A = make_engine(tiny_model, monkeypatch, async_decode=False,
+                    max_num_seqs=3)
+    B = make_engine(tiny_model, monkeypatch, async_decode=False,
+                    max_num_seqs=3)
+    rids = {}
+    deadlined = set()
+    for i, p in enumerate(prompts):
+        dl = 0.0
+        if rng.random() < 0.2:
+            # a short deadline that may fire mid-storm: its terminal is
+            # "timeout", still exactly-once
+            dl = time.monotonic() + float(rng.uniform(0.05, 0.4))
+            deadlined.add(i)
+        rids[A.add_request(list(p), sp, deadline_at=dl)] = i
+    terminal = {}     # prompt index -> list of terminal stop reasons
+    outputs = {}
+    cancelled = set()
+
+    def note(i, fin):
+        terminal.setdefault(i, []).append(fin.stop_reason)
+        outputs[i] = fin.token_ids
+
+    for step_i in range(200):
+        if not A.has_work:
+            break
+        for f in A.step():
+            note(rids[f.req_id], f)
+        live = [s.req.req_id for s in A.slots if s is not None] + \
+               [r.req_id for r in A.waiting]
+        if live and rng.random() < 0.35:
+            rid = int(rng.choice(live))
+            roll = rng.random()
+            if roll < 0.2:
+                fin = A.cancel(rid)
+                if fin is not None:
+                    i = rids[rid]
+                    cancelled.add(i)
+                    note(i, fin)
+            else:
+                fin = A.migrate_out(rid)
+                if fin is None:
+                    continue
+                i = rids[rid]
+                if fin.stop_reason != "migrated":
+                    note(i, fin)   # pending token completed it in place
+                    continue
+                man, entries = _migrate_wire(A, fin.migration)
+                if man["hashes"] and rng.random() < 0.8:
+                    # the other 20% ship manifest-only: the resume
+                    # recomputes (the cold rung inside the storm)
+                    publish_run(B.cache.tier,
+                                [int(h) for h in man["hashes"]],
+                                entries)
+                rid2 = _resume_on(B, man)
+                done = {}
+                _drain_to_done(B, done)
+                note(i, done[rid2])
+    A.finish_pending()
+    _assert_pool_exact(A)
+    _assert_pool_exact(B)
+    for i in range(N):
+        assert i in terminal, f"request {i} never reached a terminal"
+        assert len(terminal[i]) == 1, \
+            f"request {i} terminals: {terminal[i]}"
+        reason = terminal[i][0]
+        if i in cancelled:
+            assert reason == "cancelled"
+        elif reason == "timeout":
+            assert i in deadlined, f"request {i} timed out without one"
+        else:
+            assert reason in ("length", "eos")
+            assert outputs[i] == want[i], \
+                f"request {i} diverged from the oracle"
+
+
+# -- live over real sockets (THE acceptance run) ------------------------------
+
+def _write_vllm_yaml(path, role="both"):
+    path.write_text(
+        "model: tiny\nmax_model_len: 256\nblock_size: 16\n"
+        "max_num_seqs: 4\ncontext_encoding_buckets: [32, 64, 128]\n"
+        "enable_prefix_caching: true\nmax_new_tokens: 64\n"
+        f"role: {role}\n")
+    return str(path)
+
+
+@pytest.fixture()
+def migrate_pods(tmp_path, monkeypatch):
+    """Two tier-enabled tiny vllm pods on loopback sockets; pod A's drain
+    ships to pod B (SHAI_MIGRATE_PEER_URL)."""
+    from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+    from scalable_hw_agnostic_inference_tpu.serve.httpd import Server
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    httpx = pytest.importorskip("httpx")
+    from test_serve_http import wait_ready_sync
+
+    monkeypatch.setenv("SHAI_KVTIER", "1")
+    monkeypatch.setenv("SHAI_KVTIER_ASYNC", "0")
+    monkeypatch.setenv("SHAI_ASYNC_DECODE", "0")
+    monkeypatch.setenv("SHAI_MIGRATE_RESERVE_S", "99")  # capped: budget/2
+    monkeypatch.delenv("SHAI_ROLE", raising=False)
+    monkeypatch.delenv("SHAI_MIGRATE_PEER_URL", raising=False)
+    servers, services, apps, urls = [], {}, {}, {}
+    try:
+        for name in ("a", "b"):
+            cfg = ServeConfig(
+                app=name, model_id="tiny", device="cpu",
+                max_new_tokens=64, drain_budget_s=8.0,
+                vllm_config=_write_vllm_yaml(tmp_path / f"{name}.yaml"))
+            svc = get_model("vllm")(cfg)
+            app = create_app(cfg, svc)
+            srv = Server(app, port=0)
+            srv.start_background()
+            servers.append(srv)
+            services[name], apps[name] = svc, app
+            urls[name] = f"http://127.0.0.1:{srv.port}"
+        for u in urls.values():
+            with httpx.Client(base_url=u) as c:
+                r = wait_ready_sync(c, timeout=300.0)
+                assert r.status_code == 200, r.text
+        monkeypatch.setenv("SHAI_MIGRATE_PEER_URL", urls["b"])
+        yield urls, services, apps
+    finally:
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_live_migration_over_sockets(migrate_pods, tmp_path):
+    """THE acceptance run, over real sockets: SIGTERM semantics
+    (begin_drain — the exact path the signal handler takes) on the
+    serving pod mid-SSE-stream; the stream ends with an in-band
+    `migrated` record; the replay against the peer resumes from the
+    MIGRATED KV and the concatenated stream is token-identical to an
+    uninterrupted run; cova follows non-streaming handoffs with
+    routed_by=migrated; every shai_migrate_* family is live on /metrics;
+    both pods' pools stay exact."""
+    import httpx
+
+    urls, services, apps = migrate_pods
+    prompt = ("tell me a long and winding story about a bicycle that "
+              "learned to serve large language models quickly")
+
+    # the uninterrupted oracle, BEFORE any migration warms pod B's
+    # device cache for this prompt (tier restore must be observable)
+    oracle_ids = services["b"]._encode(prompt)
+    oracle_eng = services["a"]._engine  # greedy: any pod is the oracle
+    del oracle_eng
+
+    # -- mid-SSE drain: the stream hands off in-band --------------------
+    rz_faults.configure("engine.step=delay(0.12)", 0)
+    events = []
+    got_text = []
+    stream_done = threading.Event()
+
+    def consume():
+        try:
+            with httpx.Client(base_url=urls["a"], timeout=90) as c:
+                with c.stream("POST", "/v1/completions", json={
+                        "model": "tiny", "prompt": prompt,
+                        "temperature": 0.0, "max_tokens": 48,
+                        "stream": True}) as r:
+                    for line in r.iter_lines():
+                        if not line.startswith("data: "):
+                            continue
+                        if line == "data: [DONE]":
+                            break
+                        ev = json.loads(line[6:])
+                        events.append(ev)
+                        for ch in ev.get("choices", []):
+                            got_text.append(ch.get("text") or "")
+        finally:
+            stream_done.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(1.2)  # a handful of tokens have streamed
+    assert apps["a"].state["begin_drain"]()
+    assert stream_done.wait(60), "stream never terminated under drain"
+    t.join(10)
+    rz_faults.reset()
+    migrated_evs = [e for e in events if "migrated" in e]
+    assert migrated_evs, f"no migrated record in {events[-3:]}"
+    rec = migrated_evs[-1]["migrated"]
+    assert rec["peer"].rstrip("/") == urls["b"].rstrip("/")
+    assert rec["resume"], "ship did not land a resume handle"
+    assert rec["n_sent"] >= 1
+    received = "".join(got_text)
+
+    # -- replay against the peer: warm resume, full output --------------
+    b_eng = services["b"]._engine
+    restored_before = b_eng.cache.tier.snapshot()["restored"]
+    with httpx.Client(base_url=urls["b"], timeout=90) as c:
+        resumed = c.post("/generate", json={"resume": rec["resume"]})
+        assert resumed.status_code == 200, resumed.text
+        resumed = resumed.json()
+        assert resumed.get("resumed") is True
+        assert resumed["n_tokens"] == 48
+
+        # the oracle: the SAME pod, uninterrupted (greedy, cache warm or
+        # cold is token-irrelevant)
+        oracle = c.post("/generate", json={
+            "prompt": prompt, "temperature": 0.0,
+            "max_new_tokens": 48}).json()
+    assert resumed["generated_text"] == oracle["generated_text"], \
+        "migrated+resumed output diverged from the uninterrupted run"
+    # the SSE bytes the client already has are a PREFIX of the full
+    # output: received + the resume's tail == one uninterrupted stream
+    assert oracle["generated_text"].startswith(received)
+    assert b_eng.cache.tier.snapshot()["restored"] > restored_before, \
+        "the resume never restored the migrated KV"
+
+    # -- counters + families on both pods -------------------------------
+    with httpx.Client(base_url=urls["a"]) as c:
+        a_stats = c.get("/stats").json()
+        a_metrics = c.get("/metrics").text
+    with httpx.Client(base_url=urls["b"]) as c:
+        b_stats = c.get("/stats").json()
+        b_metrics = c.get("/metrics").text
+    for fam in migmod.METRIC_FAMILIES:
+        assert fam in a_metrics, fam
+        assert fam in b_metrics, fam
+    assert a_stats["migrate"]["shipped"] >= 1
+    assert b_stats["migrate"]["received"] >= 1
+    assert b_stats["migrate"]["resumed"] >= 1
+
+    # -- a draining pod refuses incoming migrations ---------------------
+    blob = migmod.encode_migration({"prompt_ids": [1, 2, 3],
+                                    "hashes": []}, ())
+    with httpx.Client(base_url=urls["a"]) as c:
+        r = c.post("/kv/migrate", content=blob,
+                   headers={"content-type": "application/x-shai-migrate"})
+        assert r.status_code == 503
+    # the duplicate replay is exactly-once: 404, caller replays cold
+    with httpx.Client(base_url=urls["b"]) as c:
+        assert c.post("/generate",
+                      json={"resume": rec["resume"]}).status_code == 404
+
+    # -- pool-exact on both pods ----------------------------------------
+    for name in ("a", "b"):
+        eng = services[name]._engine
+        assert eng.n_running == 0 and eng.n_waiting == 0
+        assert eng.cache.leaked_blocks == 0
+        snap = eng.cache.tier.snapshot()
+        assert snap["used_bytes"] == snap["entries"] * snap["block_nbytes"]
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_hard_kill_mid_sse_replay_on_peer(migrate_pods):
+    """Hard pod kill mid-SSE (no drain, no handoff record — the crash
+    rung): the client replays the prompt against the live peer, which
+    resumes from BANKED KV (the prompt's run was banked on the peer
+    beforehand — the prefill-handoff/migration bank path), and the full
+    replayed output is token-identical to an uninterrupted run with the
+    received bytes as its prefix. Zero request errors."""
+    import httpx
+
+    urls, services, apps = migrate_pods
+    prompt = ("an entirely different resilient prompt that must survive "
+              "a hard pod kill without a single error at all")
+
+    # uninterrupted oracle from the PEER (greedy; also pre-banks the
+    # prompt's KV run on B — the 'banked KV' the replay resumes from)
+    with httpx.Client(base_url=urls["b"], timeout=90) as c:
+        oracle = c.post("/generate", json={
+            "prompt": prompt, "temperature": 0.0,
+            "max_new_tokens": 48}).json()
+
+    rz_faults.configure("engine.step=delay(0.12)", 0)
+    got_text = []
+    errors = []
+    stream_done = threading.Event()
+
+    def consume():
+        try:
+            with httpx.Client(base_url=urls["a"], timeout=90) as c:
+                with c.stream("POST", "/v1/completions", json={
+                        "model": "tiny", "prompt": prompt,
+                        "temperature": 0.0, "max_tokens": 48,
+                        "stream": True}) as r:
+                    for line in r.iter_lines():
+                        if not line.startswith("data: ") \
+                                or line == "data: [DONE]":
+                            continue
+                        ev = json.loads(line[6:])
+                        for ch in ev.get("choices", []):
+                            got_text.append(ch.get("text") or "")
+        except Exception as e:
+            errors.append(e)  # the kill severs the socket — expected
+        finally:
+            stream_done.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(1.0)
+    # HARD KILL: the server dies mid-stream, no drain, no ship
+    for srv_attr in ("a",):
+        apps[srv_attr].state  # the app survives; kill the engine loop
+    services["a"].loop.stop(timeout=1.0)
+    assert stream_done.wait(60)
+    t.join(10)
+    rz_faults.reset()
+    received = "".join(got_text)
+
+    # client-side replay against the live peer: full prompt, full budget
+    with httpx.Client(base_url=urls["b"], timeout=90) as c:
+        replay = c.post("/generate", json={
+            "prompt": prompt, "temperature": 0.0, "max_new_tokens": 48})
+        assert replay.status_code == 200, replay.text
+        replay = replay.json()
+    # token-identical to the uninterrupted run; what the client already
+    # received is a prefix — the concatenated stream is seamless
+    assert replay["generated_text"] == oracle["generated_text"]
+    assert replay["generated_text"].startswith(received)
+    # the replay resumed warm from banked KV, not a cold prefill
+    b_eng = services["b"]._engine
+    assert len(b_eng.cache._hash2block) > 0
+    assert b_eng.cache.leaked_blocks == 0
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+@pytest.mark.asyncio
+async def test_cova_follows_live_migration_over_sockets(migrate_pods,
+                                                        tmp_path):
+    """cova + two pods: a non-streaming /generate routed to the draining
+    pod comes back routed_by=migrated with the COMPLETE text — the
+    handoff followed to the peer live; with migrate.ship faulted the
+    ladder degrades to the cold replay, still 200."""
+    import httpx
+
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        create_cova_app,
+    )
+    from test_serve_http import make_client
+
+    urls, services, apps = migrate_pods
+    models = {"a": {"url": urls["a"], "weight": 2},
+              "b": {"url": urls["b"], "weight": 1}}
+    p = tmp_path / "models.json"
+    p.write_text(json.dumps({"models": models}))
+    app = create_cova_app(str(p))
+    prompt = ("yet another story prompt that will be interrupted by a "
+              "rolling update and must not notice")
+    async with make_client(app) as c:
+        # /fleet advertises resolved URLs (the migrate-peer discovery
+        # input)
+        fleet = (await c.get("/fleet")).json()
+        assert fleet["urls"]["a"].rstrip("/") == urls["a"].rstrip("/")
+
+        # NOTE: the uninterrupted oracle is fetched AFTER the migration
+        # case — serving it first would warm B's affinity advertisement
+        # and cova would steer the request straight to B, never touching
+        # the draining pod (greedy determinism makes the order free)
+        rz_faults.configure("engine.step=delay(0.12)", 0)
+        task = asyncio.ensure_future(c.post("/generate", json={
+            "prompt": prompt, "temperature": 0.0, "max_new_tokens": 48}))
+        await asyncio.sleep(1.2)
+        apps["a"].state["begin_drain"]()
+        r = await task
+        rz_faults.reset()
+        assert r.status_code == 200, r.text
+        out = r.json()
+        assert out["routed_by"] == "migrated"
+        assert out["n_tokens"] == 48
+        async with httpx.AsyncClient(base_url=urls["b"],
+                                     timeout=90) as bc:
+            oracle = (await bc.post("/generate", json={
+                "prompt": prompt, "temperature": 0.0,
+                "max_new_tokens": 48})).json()
+        assert out["generated_text"] == oracle["generated_text"]
