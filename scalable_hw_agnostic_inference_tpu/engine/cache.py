@@ -170,6 +170,15 @@ class PagedKVCache:
         self.rollback_tokens = 0
         self.rollback_calls = 0
         self.rollback_blocks = 0
+        # copy-on-write fan-out (SHAI_KV_COW): forks share blocks via the
+        # same refcounts prefix caching uses; the first divergent write
+        # into a shared partial tail block pays ONE device block copy
+        self.cow_forks = 0
+        self.cow_copies = 0
+        # one jitted whole-block copy per (shape, dtype) leaf; src/dst ride
+        # as DATA so every fork reuses the same compiled copy
+        self._cow_copy = jax.jit(
+            lambda arr, s, d: arr.at[d].set(arr[s]), donate_argnums=(0,))
         # host KV tier (kvtier/): eviction demotes cached blocks to a
         # bounded host-RAM pool instead of destroying them; admission
         # misses fall through to it and restore via a scatter-write
@@ -593,6 +602,54 @@ class PagedKVCache:
         self._seqs[seq_id] = alloc
         return alloc
 
+    def fork_sequence(self, parent_id: int, child_id: int) -> SeqAllocation:
+        """Copy-on-write fan-out seam (SHAI_KV_COW): admit ``child_id``
+        sharing every block of ``parent_id`` (one incref each — the same
+        refcounts prefix caching stacks on). Divergence is lazy: the first
+        write into a shared partial tail block forks a private copy inside
+        :meth:`extend`. Full shared blocks are never written again (prefill
+        writes only fresh blocks, decode writes past ``n_tokens`` — the
+        read-only-sharing contract above), so only the tail can ever need
+        the copy; release/eviction need no special casing because a forked
+        block simply carries refcount >= 2 until each holder lets go."""
+        if child_id in self._seqs:
+            raise ValueError(f"seq {child_id} already admitted")
+        parent = self._seqs[parent_id]
+        for b in parent.blocks:
+            self.allocator.incref(b)
+        alloc = SeqAllocation(child_id, list(parent.blocks), parent.n_tokens)
+        self._seqs[child_id] = alloc
+        self.cow_forks += 1
+        return alloc
+
+    def _cow_block(self, alloc: SeqAllocation, idx: int) -> None:
+        """Fork a private copy of shared block ``alloc.blocks[idx]`` before
+        the first divergent write lands in it. Allocates BEFORE dropping
+        the shared reference (a MemoryError here leaves the fork intact for
+        the caller's preempt-and-retry ladder), copies every pool leaf —
+        int8 blocks and their scale rows byte-exactly — then swaps the
+        sequence's table entry. The LAST holder never copies: its refcount
+        is 1 by then, so n writers pay exactly n - 1 copies."""
+        src = alloc.blocks[idx]
+        [dst] = self._alloc(1)
+        s = jnp.asarray(src, jnp.int32)
+        d = jnp.asarray(dst, jnp.int32)
+        for lay in self.kv:
+            for name in list(lay):
+                lay[name] = self._cow_copy(lay[name], s, d)
+        self.allocator.free([src])
+        alloc.blocks[idx] = dst
+        self.cow_copies += 1
+
+    def _cow_pending(self, alloc: SeqAllocation) -> bool:
+        """True when growing ``alloc`` would write into a partial tail
+        block some OTHER holder still references — the one block layout
+        where extend must fork first."""
+        idx = alloc.n_tokens // self.block_size
+        return (alloc.n_tokens % self.block_size != 0
+                and idx < len(alloc.blocks)
+                and self.allocator.refcount(alloc.blocks[idx]) > 1)
+
     def blocks_to_extend(self, seq_id: int, n_new: int = 1) -> int:
         """Fresh blocks :meth:`extend` would need to grow ``seq_id`` by
         ``n_new`` tokens (0 when the current tail block still has room).
@@ -601,15 +658,28 @@ class PagedKVCache:
         BEFORE touching the allocator: the steady (lookahead) path must
         never trigger a recompute-preemption mid-dispatch — when the summed
         need exceeds ``n_available`` it flushes and lets the lock-step
-        grow-with-preemption path handle the pressure instead.
+        grow-with-preemption path handle the pressure instead. A pending
+        copy-on-write fork (shared partial tail about to be written) prices
+        its +1 copy block HERE so every caller stays consistent with what
+        extend will actually allocate.
         """
         alloc = self._seqs[seq_id]
-        return max(0, self._blocks_needed(alloc.n_tokens + n_new)
+        need = max(0, self._blocks_needed(alloc.n_tokens + n_new)
                    - len(alloc.blocks))
+        if n_new > 0 and self._cow_pending(alloc):
+            need += 1
+        return need
 
     def extend(self, seq_id: int, n_new: int = 1) -> SeqAllocation:
-        """Grow a sequence by ``n_new`` tokens, allocating blocks as needed."""
+        """Grow a sequence by ``n_new`` tokens, allocating blocks as needed.
+
+        When the write range opens inside a shared partial tail block (a
+        :meth:`fork_sequence` sibling that is about to diverge), the block
+        is copy-on-write forked first — only that one block can ever be
+        both shared and written (see the read-only-sharing contract)."""
         alloc = self._seqs[seq_id]
+        if n_new > 0 and self._cow_pending(alloc):
+            self._cow_block(alloc, alloc.n_tokens // self.block_size)
         need = self._blocks_needed(alloc.n_tokens + n_new) - len(alloc.blocks)
         if need > 0:
             if len(alloc.blocks) + need > self.blocks_per_seq:

@@ -214,6 +214,34 @@ class LLMEngine:
             # host-side, own stream — device rng folds stay byte-identical
             # to vanilla decode
             self._spec_rng = np.random.default_rng(ecfg.seed + 0x5EC)
+        # fused mixed-phase step (SHAI_FUSED_STEP, default off): decode and
+        # the chunked-prefill continuation share ONE ragged executable per
+        # batch bucket — the decode (ctx x batch), ragged-continuation, and
+        # cached-admission-continuation ladders all collapse into it. Rides
+        # the ragged kernel (rows fuse by pure layout, the kernel never
+        # learns phases) and stays out of speculative engines (verify owns
+        # multi-token dispatch there). Off keeps the laddered engine as the
+        # token-exact oracle the fused differential tests compare against.
+        self._fused = bool(_env_flag("SHAI_FUSED_STEP", False)
+                           and self._ragged
+                           and not ecfg.speculative_enabled)
+        self._fused_fns: Dict[int, Any] = {}
+        # deferred continuation window: an intermediate chunk parks its
+        # (ids, n_text, table, start) here and rides the NEXT decode
+        # dispatch as the fused executable's chunk section instead of
+        # paying its own dispatch; consumed by _take_chunk_args, flushed
+        # by every path that would skip or reorder around that dispatch
+        self._pending_chunk: Optional[tuple] = None
+        self._null_chunk: Optional[list] = None
+        # copy-on-write KV fan-out (SHAI_KV_COW, default off): an n>1
+        # sampling group admits ONE shared prefill and every sibling forks
+        # the prompt blocks copy-on-write (cache.fork_sequence); the first
+        # divergent decode write pays the one block copy
+        self._kv_cow = bool(_env_flag("SHAI_KV_COW", False))
+        # fan-out bookkeeping: parent request id -> live sibling rids (the
+        # serving layer cancels/deadlines the group as one unit)
+        self._fanout_groups: Dict[int, set] = {}
+        self._rid_parent: Dict[int, int] = {}
         self._sample1 = jax.jit(sample_logits)
         from .runner import token_logprobs
 
@@ -351,7 +379,8 @@ class LLMEngine:
                     tenant: str = "",
                     already_generated: Optional[Sequence[int]] = None,
                     already_lp: Optional[list] = None,
-                    orig_n_prompt: int = -1) -> int:
+                    orig_n_prompt: int = -1,
+                    parent_rid: int = -1) -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -388,6 +417,15 @@ class LLMEngine:
         if len(prompt_ids) > max_prompt:
             prompt_ids = list(prompt_ids)[-max_prompt:]  # keep the tail
         rid = next(self._ids)
+        # n>1 sampling fan-out (SHAI_KV_COW): siblings share one parent id
+        # so the group cancels/expires as a unit and _admit_fanout can
+        # recognize a fully-queued group. -2 marks the group leader — its
+        # OWN rid becomes the parent (the submitter can't know rids yet).
+        if parent_rid == -2:
+            parent_rid = rid
+        if parent_rid >= 0:
+            self._rid_parent[rid] = parent_rid
+            self._fanout_groups.setdefault(parent_rid, set()).add(rid)
         priority = min(max(int(priority), _qos.PRIORITY_HIGH),
                        _qos.PRIORITY_LOW)
         tenant = _qos.sanitize_tenant(tenant)
@@ -411,8 +449,20 @@ class LLMEngine:
                                     already_generated=list(
                                         already_generated or []),
                                     already_lp=list(already_lp or []),
-                                    orig_n_prompt=orig_n_prompt))
+                                    orig_n_prompt=orig_n_prompt,
+                                    parent_rid=parent_rid))
         return rid
+
+    def fanout_siblings(self, rid: int) -> List[int]:
+        """Live request ids of the fan-out group containing ``rid`` (always
+        includes ``rid`` itself). The engine loop cancels through this so a
+        client disconnect on an n>1 request aborts the WHOLE group — the n
+        choices serve one HTTP response; decoding orphaned siblings would
+        burn pool blocks for nobody."""
+        parent = self._rid_parent.get(rid)
+        if parent is None:
+            return [rid]
+        return sorted(self._fanout_groups.get(parent, {rid}) | {rid})
 
     def cancel(self, req_id: int) -> Optional[Finished]:
         """Abort a request wherever it is (queue, mid-prefill, or decoding),
@@ -731,6 +781,7 @@ class LLMEngine:
         self._admit_phase()
         if any(s is not None for s in self.slots):
             self._decode_step()
+        self._flush_chunk()  # a deferred window never outlives its step
         self._record_step(time.monotonic() - t0)
         return self._done_this_step
 
@@ -761,6 +812,10 @@ class LLMEngine:
         # TTFT; only a SECOND long prompt waits for the active chunker
         if self.waiting and self.waiting[0].prefix is not None:
             self._admit_one()       # soft-prefix: bucket-bound single-seq
+        elif (self._kv_cow and self.waiting
+              and self.waiting[0].parent_rid >= 0
+              and self._admit_fanout()):
+            pass                    # CoW fan-out: one prefill, K forks
         elif (self.cache.prefix_caching and self.waiting
               and self._admit_cached()):
             pass                    # cached-prefix admission handled it
@@ -826,6 +881,7 @@ class LLMEngine:
             self._admit_phase()
             if any(s is not None for s in self.slots):
                 self._decode_dispatch()
+            self._flush_chunk()  # deferred window never outlives its step
         self._record_step(time.monotonic() - t0)
         return self._done_this_step
 
@@ -886,6 +942,9 @@ class LLMEngine:
         self._grow_running(lambda s: 1)
         running = self._running_slots()
         if not running:
+            # chunk-only step (every live slot is mid-prefill): nothing
+            # rides the decode dispatch, so the window pays its own
+            self._flush_chunk()
             return
         Bb = self._batch_bucket(len(running))
         n_exec = self.n_executables
@@ -1106,6 +1165,13 @@ class LLMEngine:
     def _finish(self, fin: Finished) -> None:
         self.finished.append(fin)
         self._done_this_step.append(fin)
+        parent = self._rid_parent.pop(fin.req_id, None)
+        if parent is not None:
+            group = self._fanout_groups.get(parent)
+            if group is not None:
+                group.discard(fin.req_id)
+                if not group:
+                    del self._fanout_groups[parent]
         if self.obs.slo is not None:
             self.obs.slo.record_outcome(fin.stop_reason)
 
@@ -1261,7 +1327,7 @@ class LLMEngine:
             args += list(self._set_slot_cross(slot, req))
         with annotate("engine.prefill"):
             self.cache.kv, logits = fn(*args)
-        self.obs.count_pad(n, bucket - n)  # prefill bucket tail
+        self.obs.count_pad(n, bucket - n, phase="prefill")  # bucket tail
         # no register_prefix here: this path only ever admits prefix/cross
         # (vision-conditioned) requests, whose blocks must NOT
         # content-address by tokens alone — and cross engines disable the
@@ -1380,7 +1446,8 @@ class LLMEngine:
         with annotate("engine.prefill"):
             self.cache.kv, logits = fn(*args)
         real = sum(len(r.prompt_ids) for r in group)
-        self.obs.count_pad(real, Kp * bucket - real)  # bucket + batch pad
+        self.obs.count_pad(real, Kp * bucket - real,
+                           phase="prefill")  # bucket + batch pad
         for req in group:  # batch rows are always plain text
             self.cache.register_prefix(req.prompt_ids,
                                        self.cache.seq(req.req_id).blocks)
@@ -1409,6 +1476,14 @@ class LLMEngine:
         n_total = len(req.prompt_ids)
         if n_total <= self.ecfg.block_size:
             return False  # no full block to share
+        if self._fused and self._kv_quant:
+            # int8 pools re-quantize a written block over EVERYTHING in it:
+            # the fused C-sized window writes pad garbage past the cached
+            # remainder that the laddered chunk_bucket never touched, so
+            # the tail block's scale (and every real token quantized under
+            # it) would diverge from the oracle — fall through to plain
+            # admission, which prefills from scratch and stays exact
+            return False
         slot = self._free_slot()
         if slot is None:
             # probe NOTHING while blocked on a slot: a waiting request
@@ -1427,12 +1502,11 @@ class LLMEngine:
             n_total, (len(cached) + n_tier) * self.ecfg.block_size)
         if start == 0:
             return False
-        chunk_bucket = self.buckets.bucket_for(n_total - start)
+        chunk_bucket = self._cached_chunk_bucket(n_total - start)
         sb = start // self.ecfg.block_size
         if start + chunk_bucket > self.ecfg.max_model_len:
             return False  # chunk executable would overrun blocks_per_seq
-        if self._warmed and self._cont_key(sb, chunk_bucket) \
-                not in self._prefill:
+        if self._cont_cold(sb, chunk_bucket):
             return False  # post-ready compiles are the cold-graph bug
         take = max(0, sb - len(cached))
         need_new = self._need_blocks(n_total) - sb
@@ -1457,12 +1531,11 @@ class LLMEngine:
                     n_total, len(cached) * self.ecfg.block_size)
                 if start == 0:
                     return False
-                chunk_bucket = self.buckets.bucket_for(n_total - start)
+                chunk_bucket = self._cached_chunk_bucket(n_total - start)
                 sb = start // self.ecfg.block_size
                 if start + chunk_bucket > self.ecfg.max_model_len:
                     return False
-                if self._warmed and self._cont_key(
-                        sb, chunk_bucket) not in self._prefill:
+                if self._cont_cold(sb, chunk_bucket):
                     return False
         self.waiting.popleft()
         try:
@@ -1476,13 +1549,23 @@ class LLMEngine:
         n = n_total - start
         ids = np.zeros((1, chunk_bucket), np.int32)
         ids[0, :n] = req.prompt_ids[start:]
-        fn = self._cont_for(sb, chunk_bucket)
-        with annotate("engine.prefill"):
-            self.cache.kv, logits = fn(self.params, self.cache.kv,
-                                       jnp.asarray(ids),
-                                       jnp.asarray([n], jnp.int32), table,
-                                       *self._cont_args(start))
-        self.obs.count_pad(n, chunk_bucket - n)  # chunk bucket tail
+        if self._fused:
+            # a deferred window must not reorder behind this admission's
+            # own window (the admission may reuse blocks the deferred
+            # chunk is still due to write)
+            self._flush_chunk()
+            logits = self._fused_chunk_call(
+                jnp.asarray(ids), jnp.asarray([n], jnp.int32), table,
+                jnp.asarray([start], jnp.int32))
+        else:
+            fn = self._cont_for(sb, chunk_bucket)
+            with annotate("engine.prefill"):
+                self.cache.kv, logits = fn(self.params, self.cache.kv,
+                                           jnp.asarray(ids),
+                                           jnp.asarray([n], jnp.int32),
+                                           table, *self._cont_args(start))
+        self.obs.count_pad(n, chunk_bucket - n,
+                           phase="prefill")  # chunk bucket tail
         self.cache.register_prefix(req.prompt_ids, alloc.blocks)
         rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
         tok = int(self._sample1(
@@ -1493,6 +1576,96 @@ class LLMEngine:
         if req.params.logprobs:
             self._record_admission_lps(logits, [tok],
                                        [(0, self.slots[slot])])
+        return True
+
+    def _admit_fanout(self) -> bool:
+        """Admit an n>1 sampling fan-out group (SHAI_KV_COW) as ONE shared
+        prefill: the group's prompt prefills once, every sibling beyond the
+        first forks the prompt blocks copy-on-write (``cache.
+        fork_sequence`` — the first divergent decode write pays one block
+        copy), and all K rows sample their first token from the SAME tiled
+        logits row under the batch-admission fold. Token-exact vs K
+        independent admissions because ``sample_logits``' per-row gumbel
+        depends only on the row index — tiling the one logits row to the
+        batch layout reproduces exactly what K identical prompt rows of a
+        Kp-batch prefill would have sampled. Returns False with NOTHING
+        consumed when the group isn't fully queued or doesn't fit — the
+        siblings then admit independently through the normal ladder
+        (correct, just without the sharing)."""
+        head = self.waiting[0]
+        parent = self._rid_parent.get(head.req_id)
+        if parent is None:
+            return False
+        group = [r for r in self.waiting
+                 if self._rid_parent.get(r.req_id) == parent]
+        if len(group) < 2 or group[0] is not head:
+            return False  # partial group (or mid-requeue): normal ladder
+        n = len(head.prompt_ids)
+        if n > self.buckets.max:
+            return False  # chunk-length prompts fan out independently
+        if any(r.prompt_ids != head.prompt_ids or r.prefix is not None
+               or r.cross_states is not None or r.already_generated
+               for r in group):
+            # a preempted/migrated sibling carries generated suffix — the
+            # group no longer shares one prompt; admit independently
+            return False
+        K = len(group)
+        if sum(s is None for s in self.slots) < K:
+            return False  # all-or-nothing: the group decodes together
+        # price the group before touching anything: one prompt's blocks
+        # plus one CoW-copy block of headroom per sibling (each fork's
+        # first divergent write may need its private tail copy)
+        if self._need_blocks(n) + K > self.cache.n_available:
+            return False
+        bucket = self.buckets.bucket_for(n)
+        Kp = 1 << (K - 1).bit_length()
+        if self._warmed and (bucket, 0, 1) not in self._prefill:
+            return False  # post-ready compiles are the cold-graph bug
+        try:
+            alloc = self.cache.admit(head.req_id, n)
+        except MemoryError:
+            return False  # raced estimate: normal paths own wait-or-reject
+        # the all-or-nothing point is passed — dequeue the WHOLE group (by
+        # identity: fairness rotation may have interleaved other requests)
+        members = {id(r) for r in group}
+        self.waiting = deque(r for r in self.waiting
+                             if id(r) not in members)
+        for r in group:
+            self._note_admitted(r)
+        for r in group[1:]:
+            self.cache.fork_sequence(head.req_id, r.req_id)
+        table = jnp.asarray(alloc.table(self.ecfg.blocks_per_seq))[None]
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = head.prompt_ids
+        fn = self._prefill_for(bucket, 0, 1)
+        with annotate("engine.prefill"):
+            self.cache.kv, logits = fn(self.params, self.cache.kv,
+                                       jnp.asarray(ids),
+                                       jnp.asarray([n], jnp.int32), table)
+        self.obs.count_pad(n, bucket - n, phase="prefill")
+        self.cache.register_prefix(head.prompt_ids, alloc.blocks)
+        temp = np.ones((Kp,), np.float32)
+        topk = np.zeros((Kp,), np.int32)
+        topp = np.ones((Kp,), np.float32)
+        for i, r in enumerate(group):
+            temp[i] = r.params.temperature
+            topk[i] = r.params.top_k
+            topp[i] = r.params.top_p
+        tiled = jnp.broadcast_to(logits[0], (Kp,) + logits.shape[1:])
+        rng = jax.random.fold_in(self._rng, self._step_count * 2 + 1)
+        toks = np.asarray(self._sample1(
+            tiled, rng, jnp.asarray(temp), jnp.asarray(topk),
+            jnp.asarray(topp)))
+        lp_rows = []
+        for i, r in enumerate(group):
+            slot = self._free_slot()
+            self._has_image[slot] = 0.0
+            self._start_slot(slot, r, int(toks[i]))
+            if r.params.logprobs:
+                lp_rows.append((i, self.slots[slot]))
+        if lp_rows:
+            self._record_admission_lps(tiled, [int(t) for t in toks],
+                                       lp_rows)
         return True
 
     def _admit_long(self) -> None:
@@ -1560,16 +1733,44 @@ class LLMEngine:
         ids[0, :n] = chunk
         table = jnp.asarray(
             self.cache.seq(req.req_id).table(self.ecfg.blocks_per_seq))[None]
-        fn = self._cont_for(start // self.ecfg.block_size)
-        args = [self.params, self.cache.kv, jnp.asarray(ids),
-                jnp.asarray([n], jnp.int32), table]
-        args += self._cont_args(start)  # ragged: the start rides as data
-        if self._cross_kv is not None:
-            args += list(self._slot_cross_args(s.slot))
-        with annotate("engine.prefill"):
-            self.cache.kv, logits = fn(*args)
-        self.obs.count_pad(n, C - n)  # final-chunk tail (full chunks: 0)
-        if start + n >= len(req.prompt_ids):
+        final = start + n >= len(req.prompt_ids)
+        if self._fused and not final:
+            # intermediate chunk: DEFER the window — it rides this step's
+            # decode dispatch as the fused executable's chunk section (one
+            # dispatch where the ladder paid two; THE interference win).
+            # Its logits are discarded exactly as the laddered oracle
+            # discards intermediate-chunk logits; registration and the
+            # cursor advance keep the oracle's timing.
+            self._flush_chunk()  # never stack two windows
+            self._pending_chunk = (jnp.asarray(ids),
+                                   jnp.asarray([n], jnp.int32), table,
+                                   jnp.asarray([start], jnp.int32))
+            self.obs.count_pad(n, C - n, phase="chunk")
+            self.cache.register_prefix(
+                req.prompt_ids[:start + n],
+                self.cache.seq(req.req_id).blocks)
+            s.prefill_cursor = start + C
+            return
+        if self._fused:
+            # final chunk: its sampled token joins THIS step's decode
+            # batch — that circular dependency forbids sharing the decode
+            # dispatch, so the window runs chunk-only (null decode rows);
+            # 2 dispatches, the laddered oracle's own structure
+            self._flush_chunk()
+            logits = self._fused_chunk_call(
+                jnp.asarray(ids), jnp.asarray([n], jnp.int32), table,
+                jnp.asarray([start], jnp.int32))
+        else:
+            fn = self._cont_for(start // self.ecfg.block_size)
+            args = [self.params, self.cache.kv, jnp.asarray(ids),
+                    jnp.asarray([n], jnp.int32), table]
+            args += self._cont_args(start)  # ragged: start rides as data
+            if self._cross_kv is not None:
+                args += list(self._slot_cross_args(s.slot))
+            with annotate("engine.prefill"):
+                self.cache.kv, logits = fn(*args)
+        self.obs.count_pad(n, C - n, phase="chunk")  # final-chunk tail
+        if final:
             self.cache.register_prefix(
                 req.prompt_ids, self.cache.seq(req.req_id).blocks)
             # own stream: admission may also sample this step (fold 2s+1),
@@ -1634,6 +1835,27 @@ class LLMEngine:
             return ("rcont", bucket)
         return ("cont", start_blocks, bucket)
 
+    def _cached_chunk_bucket(self, remainder: int) -> int:
+        """Window the cached-admission continuation dispatches: the fused
+        step's chunk section is pinned to the largest prefill bucket (one
+        executable per batch bucket — sizing it per remainder would grow
+        the ladder back); the laddered engine keeps the smallest covering
+        bucket."""
+        if self._fused:
+            return self.buckets.max
+        return self.buckets.bucket_for(remainder)
+
+    def _cont_cold(self, sb: int, chunk_bucket: int) -> bool:
+        """Post-ready compile guard for a continuation dispatch: True when
+        the executable it would resolve to was never warmed (the cold-
+        graph-behind-the-LB bug). The fused step dispatches chunk-only
+        windows through the bb=1 fused executable."""
+        if not self._warmed:
+            return False
+        if self._fused:
+            return 1 not in self._fused_fns
+        return self._cont_key(sb, chunk_bucket) not in self._prefill
+
     def _cont_args(self, start: int) -> list:
         """Trailing args a continuation executable takes beyond
         ``(params, kv, ids, n_text, block_tables)``: the ragged variant
@@ -1690,6 +1912,8 @@ class LLMEngine:
     def _decode_for(self, m_blocks: int, n_active: int = -1):
         """Decode executable for the smallest (context, batch) buckets
         covering the running set."""
+        if self._fused:
+            return self._fused_decode_for(n_active)
         m = next(b for b in self._ctx_buckets if b >= m_blocks)
         bb = (self.ecfg.max_num_seqs if n_active < 0
               else self._batch_bucket(n_active))
@@ -1707,6 +1931,94 @@ class LLMEngine:
                 feedback=self._async, ragged=self._ragged,
                 kv_quant=self._kv_quant)
         return bb, self._decode_fns[key]
+
+    # -- fused mixed-phase step (SHAI_FUSED_STEP) --------------------------
+
+    def _fused_for(self, n_active: int = -1):
+        """Fused mixed-phase executable for the smallest batch bucket
+        covering the running set: the decode rows plus ONE continuation-
+        chunk window in a single ragged dispatch (runner.make_fused_step).
+        Mirrors ``_decode_for``'s ladder discipline — one entry per batch
+        bucket; the context ladder is already collapsed by ragged, and the
+        chunk window is pinned to the largest prefill bucket."""
+        bb = (self.ecfg.max_num_seqs if n_active < 0
+              else self._batch_bucket(n_active))
+        if bb not in self._fused_fns:
+            from .runner import make_fused_step
+
+            _faults.get().raise_at(_faults.COMPILE)
+            if self._warmed:
+                self.obs.count_recompile("fused")
+            self._fused_fns[bb] = make_fused_step(
+                self.cfg, self.ecfg.block_size, self.ecfg.blocks_per_seq,
+                bb, self.buckets.max, shardings=self.shardings,
+                feedback=self._async, kv_quant=self._kv_quant)
+        return bb, self._fused_fns[bb]
+
+    def _fused_decode_for(self, n_active: int = -1):
+        """The decode-shaped view of the fused executable: append the
+        pending (or null) chunk-window args and drop the trailing chunk
+        logits, so ``_decode_for``'s callers dispatch it unchanged. An
+        intermediate chunk deferred by ``_continue_prefill`` rides THIS
+        dispatch; its logits are discarded exactly as the laddered oracle
+        discards intermediate-chunk logits."""
+        bb, fused = self._fused_for(n_active)
+
+        def decode(*args):
+            out = fused(*args, *self._take_chunk_args())
+            return out[:-1]
+
+        return bb, decode
+
+    def _null_chunk_args(self) -> list:
+        """Device-cached null chunk window: zero ids over null block 0
+        with ``n_text=1`` — a pure-decode fused dispatch carries it so
+        the executable signature never changes. Its writes land in
+        reserved block 0, outside every live window; nothing reads them."""
+        if self._null_chunk is None:
+            self._null_chunk = [
+                jnp.zeros((1, self.buckets.max), jnp.int32),
+                jnp.ones((1,), jnp.int32),
+                jnp.zeros((1, self.ecfg.blocks_per_seq), jnp.int32),
+                jnp.zeros((1,), jnp.int32)]
+        return self._null_chunk
+
+    def _take_chunk_args(self) -> list:
+        """Consume the deferred continuation window (or hand out nulls)."""
+        pc, self._pending_chunk = self._pending_chunk, None
+        if pc is None:
+            return self._null_chunk_args()
+        return list(pc)
+
+    def _fused_chunk_call(self, ids_dev, n_dev, table, start_dev):
+        """Chunk-only fused dispatch (bb=1): the decode section runs null
+        rows (active all-false; their block-0 writes are harmless) while
+        the chunk window does the real work. Used for final chunks and
+        cached admission, whose sampled token feeds the SAME step's decode
+        batch — a circular dependency that forbids sharing that dispatch.
+        Returns the chunk's last-real-position logits ``[1, V]``. The
+        decode tokens/pos are SEPARATE zero buffers: the feedback variant
+        donates the position argument, so aliasing them would donate the
+        token buffer too."""
+        _, fused = self._fused_for(1)
+        args = [self.params, self.cache.kv,
+                jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+                jnp.zeros((1, self.ecfg.blocks_per_seq), jnp.int32),
+                jnp.zeros((1,), bool), self._rng,
+                jnp.ones((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+                jnp.ones((1,), jnp.float32),
+                ids_dev, n_dev, table, start_dev]
+        with annotate("engine.prefill"):
+            out = fused(*args)
+        self.cache.kv = out[0]
+        return out[-1]
+
+    def _flush_chunk(self) -> None:
+        """Dispatch any deferred continuation window NOW (no-op when
+        none): paths that skip the decode dispatch — or would reorder KV
+        writes around it — must not leave a window parked."""
+        if self._pending_chunk is not None:
+            self._fused_chunk_call(*self._take_chunk_args())
 
     def _verify_for(self, m_blocks: int, n_active: int = -1):
         """Speculative verify executable for the smallest (context, batch)
@@ -1732,7 +2044,7 @@ class LLMEngine:
     @property
     def n_executables(self) -> int:
         return (len(self._prefill) + len(self._decode_fns)
-                + len(self._verify_fns))
+                + len(self._verify_fns) + len(self._fused_fns))
 
     def _preempt_lowest(self) -> None:
         """Recompute-preempt the lowest-priority, most recently admitted
@@ -1881,7 +2193,8 @@ class LLMEngine:
             m = next(b for b in self._ctx_buckets if b >= m_blocks)
             walked = Bb * m * bs
         self.obs.count_pad(real * rows_per_seq,
-                           (walked - real) * rows_per_seq)
+                           (walked - real) * rows_per_seq,
+                           phase="verify" if rows_per_seq > 1 else "decode")
 
     def _running_slots(self) -> List["_Running"]:
         return [s for s in self.slots
@@ -1911,6 +2224,14 @@ class LLMEngine:
             "has_image": np.zeros((Bb,), np.float32),
             "cross_len": np.full((Bb,), max(self.cross_seq_len, 1),
                                  np.int32),
+            # mixed-phase row metadata (SHAI_FUSED_STEP / obs): each row's
+            # decode start (its prompt boundary in cache tokens — stable
+            # per decode segment, so the tables-only refresh path never
+            # leaves it stale) and phase (0 = decode; mid-prefill slots
+            # never enter the running view — the fused dispatch composes
+            # its chunk rows itself, phase 1 lives only in that window)
+            "starts": np.zeros((Bb,), np.int32),
+            "phase": np.zeros((Bb,), np.int8),
         }
         for i, s in enumerate(running):
             a["tables"][i] = self.cache.seq(s.req.req_id).table(M)
@@ -1921,6 +2242,7 @@ class LLMEngine:
             a["slot_idx"][i] = s.slot
             a["has_image"][i] = self._has_image[s.slot]
             a["cross_len"][i] = self._cross_len[s.slot]
+            a["starts"][i] = s.req.prefix_len + len(s.req.prompt_ids)
         return a
 
     def _spec_step(self) -> bool:
@@ -2079,6 +2401,7 @@ class LLMEngine:
         self._grow_running(lambda s: 1)
         running = self._running_slots()
         if not running:
+            self._flush_chunk()  # chunk-only step: no decode to ride
             return
         n_exec = self.n_executables
         Bb, decode = self._decode_for(self._max_ctx_blocks(running),

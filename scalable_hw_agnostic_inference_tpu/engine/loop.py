@@ -26,7 +26,9 @@ log = logging.getLogger(__name__)
 class EngineLoop:
     def __init__(self, engine: LLMEngine, poll_s: float = 0.005):
         self.engine = engine
-        # items: (prompt_ids, params, prefix [P, dim] or None, future)
+        # items: (prompt_ids, params, extras, future) — or the fan-out
+        # group form (prompt_ids, [params]*K, extras, [future]*K), told
+        # apart by the future slot holding a list
         self._submit_q: "queue.Queue[Tuple[List[int], SamplingParams, Optional[object], Future]]" = (
             queue.Queue()
         )
@@ -129,6 +131,31 @@ class EngineLoop:
             self._fail_all(RuntimeError("engine loop is stopped"))
         return fut
 
+    def submit_group(self, prompt_ids: Sequence[int],
+                     params_list: Sequence[SamplingParams], *,
+                     on_tokens: Optional[Sequence] = None,
+                     deadline_at: float = 0.0, priority: int = 1,
+                     tenant: str = "") -> List[Future]:
+        """n>1 sampling fan-out: ONE tokenized prompt, K sampling-param
+        sets, K futures. The whole group rides one queue item so the loop
+        admits the siblings back-to-back — fully queued together, which
+        is what lets the engine admit them as a single prefill with
+        copy-on-write KV forks (``SHAI_KV_COW``) — and tags them with one
+        parent id so cancel/deadline/migration treat the fan-out as a
+        unit (cancelling any member aborts the whole group)."""
+        if self._stop.is_set():
+            raise RuntimeError("engine loop is stopped")
+        if self._draining.is_set():
+            raise RuntimeError("engine loop is draining")
+        futs: List[Future] = [Future() for _ in params_list]
+        self._submit_q.put(
+            (list(prompt_ids), list(params_list),
+             (list(on_tokens) if on_tokens else [None] * len(futs),
+              deadline_at, priority, tenant), futs))
+        if self._stop.is_set():
+            self._fail_all(RuntimeError("engine loop is stopped"))
+        return futs
+
     def migrate_all(self, timeout: float = 10.0) -> int:
         """Drain-time live migration: refuse new submissions, then have
         the LOOP thread finish every queued + running request with stop
@@ -188,27 +215,49 @@ class EngineLoop:
         except queue.Empty:
             return
         while True:
-            (ids, params,
-             (prefix, cross_states, cross_len, on_token, deadline_at,
-              priority, tenant, already_generated, already_lp,
-              orig_n_prompt),
-             fut) = item
-            try:
-                rid = self.engine.add_request(
-                    ids, params, prefix=prefix,
-                    cross_states=cross_states, cross_len=cross_len,
-                    on_token=on_token, deadline_at=deadline_at,
-                    priority=priority, tenant=tenant,
-                    already_generated=already_generated,
-                    already_lp=already_lp, orig_n_prompt=orig_n_prompt)
-                with self._futures_lock:
-                    self._futures[rid] = fut
-            except Exception as e:  # bad request (e.g. empty prompt)
-                fut.set_exception(e)
+            ids, params, extras, fut = item
+            if isinstance(fut, list):  # submit_group fan-out item
+                self._admit_group(ids, params, extras, fut)
+            else:
+                (prefix, cross_states, cross_len, on_token, deadline_at,
+                 priority, tenant, already_generated, already_lp,
+                 orig_n_prompt) = extras
+                try:
+                    rid = self.engine.add_request(
+                        ids, params, prefix=prefix,
+                        cross_states=cross_states, cross_len=cross_len,
+                        on_token=on_token, deadline_at=deadline_at,
+                        priority=priority, tenant=tenant,
+                        already_generated=already_generated,
+                        already_lp=already_lp, orig_n_prompt=orig_n_prompt)
+                    with self._futures_lock:
+                        self._futures[rid] = fut
+                except Exception as e:  # bad request (e.g. empty prompt)
+                    fut.set_exception(e)
             try:
                 item = self._submit_q.get_nowait()
             except queue.Empty:
                 return
+
+    def _admit_group(self, ids, params_list, extras, futs) -> None:
+        """Admit one fan-out group: K sibling requests sharing a prompt
+        and a parent id (first admitted member leads). A member whose
+        add_request raises fails only its own future — the engine-side
+        group-admission guards simply see a smaller group."""
+        on_tokens, deadline_at, priority, tenant = extras
+        parent = -2  # sentinel: first admitted sibling becomes the parent
+        for on_token, params, fut in zip(on_tokens, params_list, futs):
+            try:
+                rid = self.engine.add_request(
+                    ids, params, on_token=on_token,
+                    deadline_at=deadline_at, priority=priority,
+                    tenant=tenant, parent_rid=parent)
+                if parent == -2:
+                    parent = rid
+                with self._futures_lock:
+                    self._futures[rid] = fut
+            except Exception as e:
+                fut.set_exception(e)
 
     def _fail_all(self, err: Exception) -> None:
         """Fail every queued and in-flight future (loop death / stop).
@@ -241,12 +290,18 @@ class EngineLoop:
                            None)
             if rid is None:
                 continue  # already finished (or never admitted)
-            fin = self.engine.cancel(rid)
-            if fin is not None:
+            # fan-out groups cancel as a UNIT: aborting any sibling aborts
+            # them all (one OpenAI n>1 request is one deliverable — a
+            # partial group decodes for nobody). fanout_siblings returns
+            # [rid] for ordinary requests, so this is the plain path too.
+            for sib in self.engine.fanout_siblings(rid):
+                fin = self.engine.cancel(sib)
+                if fin is None:
+                    continue
                 with self._futures_lock:
-                    self._futures.pop(rid, None)
-                if not fut.done():
-                    fut.set_result(fin)
+                    sfut = self._futures.pop(sib, None)
+                if sfut is not None and not sfut.done():
+                    sfut.set_result(fin)
 
     def _run(self) -> None:
         try:

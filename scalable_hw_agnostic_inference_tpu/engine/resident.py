@@ -15,6 +15,16 @@ removes both halves:
   speculative verify path shares this cache: same composition, same
   device arrays, whichever executable dispatches next.
 
+  The mirror is COLUMN-AGNOSTIC: whatever dict ``engine.
+  _marshal_running`` returns is uploaded wholesale, so per-row metadata
+  columns ride along without touching the refresh mechanics. The fused
+  mixed-phase step (``SHAI_FUSED_STEP``) adds two: ``starts`` (each
+  row's decode start — its prompt boundary in cache tokens, constant
+  per decode segment by CONTRACT, which is what keeps the tables-only
+  refresh path truthful) and ``phase`` (int8, 0 = decode for every
+  resident row; the fused dispatch composes its chunk-window rows
+  itself — a nonzero phase never appears in resident state).
+
 * :class:`InflightStep` records one dispatched-but-not-retired decode
   step: the device-side sampled tokens (which feed straight back as the
   next dispatch's ``tokens`` input — the host never sees them until one

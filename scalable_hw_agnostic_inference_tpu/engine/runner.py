@@ -990,3 +990,210 @@ def make_verify(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
     return jax.jit(verify, donate_argnums=(1,),
                    in_shardings=in_sh,
                    out_shardings=(kvsh,) + (rep,) * 8)
+
+
+def make_fused_step(cfg: LlamaConfig, block_size: int, blocks_per_seq: int,
+                    max_num_seqs: int, bucket: int,
+                    shardings: Optional[EngineShardings] = None,
+                    paged: Optional[bool] = None, feedback: bool = False,
+                    kv_quant: bool = False):
+    """Compile ONE mixed-phase ragged engine step (``SHAI_FUSED_STEP``):
+    the whole decode batch PLUS one chunked-prefill continuation window in
+    a single dispatch.
+
+    ``fused(params, kv, tokens [B], pos [B], tables [B, M], active [B],
+    rng, temperature [B], top_k [B], top_p [B], c_ids [1, C],
+    c_ntext [1], c_table [1, M], c_start [1]) ->
+    (kv, next_tokens [B][, pos + 1], top_ids, top_lp, tok_lp,
+    c_logits [1, V])``.
+
+    Two sections share one layer walk over one donated pool:
+
+    - the DECODE section is ``make_decode``'s math verbatim — the ``T=1``
+      ``_make_token_forward`` body (same write offsets, same int8
+      read-modify-write requantize) with on-device sampling + logprobs —
+      so fused-off/fused-on token-exactness reduces to the section
+      ordering argument below;
+    - the CHUNK section is the ragged continuation's math verbatim
+      (``make_prefill_cont(ragged=True)``): dynamic ``c_start``, chunk
+      scatter first, queries attending their prior context through the
+      pool. Its ``c_logits`` come back RAW — the host samples with the
+      group-specific rng fold, exactly as the laddered path does. A step
+      with no chunk passes null args (zero ids/table, ``c_ntext=1``,
+      ``c_start=0``): the window writes into the reserved null block 0
+      and its logits are dropped, the harmless-garbage padding
+      convention.
+
+    Exactness vs the laddered oracle hangs on per-layer ordering: the
+    chunk scatters BEFORE the decode rows write, matching the oracle's
+    device order (the continuation dispatch completes before the decode
+    dispatch it precedes), so any write collision through a stale table
+    resolves identically. Decode queries then read the chunk's
+    layer-``l`` keys like the oracle's decode step reads the finished
+    continuation's; the chunk's queries never read this step's decode
+    writes (decode rows write past their own prompts into blocks the
+    chunk's ``length``-bounded reads cannot reach — block tables only
+    ever share REGISTERED full prefix blocks, and the null block 0 sits
+    outside every live window).
+
+    On TPU both sections' queries flatten into ONE ragged kernel call
+    (``ops.attention.mixed_phase_ragged_attention`` — ``B + C``
+    single-query rows, the kernel blind to phase). Off-TPU each section
+    keeps its own oracle's attention function (dense gather + mask or the
+    int8 gather reference for decode, ``ragged_gather_attention`` for the
+    chunk) because the two reference softmaxes need not be bitwise
+    interchangeable.
+
+    One executable per BATCH BUCKET replaces the decode context ladder ×
+    batch ladder, the per-bucket ragged continuation ladder, and the
+    cached-admission entries: the chunk window ``C`` is pinned to the
+    largest prefill bucket. Text engines only (the ragged gate excludes
+    cross configs); ragged owns the full ``blocks_per_seq`` window.
+    """
+    assert bucket % block_size == 0
+    assert not cfg.cross_attention_layers, \
+        "fused step serves text engines (the ragged gate)"
+    m_ctx = blocks_per_seq
+    c_blocks = bucket // block_size
+    L = block_size * m_ctx
+    paged = _resolve_paged(paged)
+
+    def _pool_call(qf, kpool, vpool, tf, lf, ks, vs):
+        from ..ops.pallas.ragged_paged_attention import (
+            ragged_paged_attention as kernel,
+        )
+
+        return _pool_kernel_call(kernel, shardings, qf, kpool, vpool, tf,
+                                 lf, ks, vs)
+
+    def _fused_impl(params, kv, tokens, pos, tables, active, rng,
+                    temperature, top_k, top_p, c_ids, c_ntext, c_table,
+                    c_start):
+        from ..ops.attention import mixed_phase_ragged_attention
+
+        p = params["params"]
+        B = max_num_seqs
+        C = bucket
+        Hkv, Dh = cfg.n_kv_heads, cfg.head_dim
+        tables = tables[:, :m_ctx]
+        # -- decode section inputs: make_decode verbatim (T == 1) --------
+        x = p["embed"]["embedding"][tokens[:, None]].astype(jnp.bfloat16)
+        positions = pos[:, None]                                # [B, 1]
+        pblk = positions // block_size
+        blk = jnp.where(
+            pblk < m_ctx,
+            jnp.take_along_axis(tables, jnp.clip(pblk, 0, m_ctx - 1),
+                                axis=1),
+            0)
+        widx = blk * block_size + positions % block_size
+        if not paged and not kv_quant:
+            goff = (tables[:, :, None] * block_size
+                    + jnp.arange(block_size)[None, None, :]).reshape(B, L)
+            mask = (jnp.arange(L)[None, None, :]
+                    <= positions[:, :, None])[:, None]  # [B, 1, 1, L]
+        # -- chunk section inputs: the ragged continuation verbatim ------
+        xc = p["embed"]["embedding"][c_ids].astype(jnp.bfloat16)
+        c_start32 = c_start.astype(jnp.int32)
+        c_positions = c_start32[:, None] + jnp.broadcast_to(
+            jnp.arange(C, dtype=jnp.int32), (1, C))
+        sb = c_start32 // block_size
+        tbl_chunk = jnp.take_along_axis(
+            c_table,
+            sb[:, None] + jnp.arange(c_blocks, dtype=jnp.int32)[None, :],
+            axis=1)                                      # [1, c_blocks]
+        c_tables = c_table[:, :m_ctx]
+        for li in range(cfg.n_layers):
+            lp = p[f"layer_{li}"]
+            # chunk scatter FIRST each layer: the oracle's continuation
+            # dispatch finishes before its decode dispatch, so stale-table
+            # write collisions must resolve in the same order here
+            hc = _rmsnorm(xc, lp["attn_norm"]["scale"], cfg.rms_eps)
+            qc, kc, vc = _qkv(lp, hc, c_positions, cfg)
+            kv[li] = _scatter_blocks(
+                kv[li], tbl_chunk,
+                kc.reshape(1, c_blocks, block_size, Hkv, Dh),
+                vc.reshape(1, c_blocks, block_size, Hkv, Dh), kv_quant)
+            h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
+            q, kk, vv = _qkv(lp, h, positions, cfg)
+            if kv_quant:
+                from ..ops.quant import requantize_block_tokens
+
+                kpool, vpool = kv[li]["k"], kv[li]["v"]
+                ks, vs = kv[li]["ks"], kv[li]["vs"]
+                bt = blk[:, 0]
+                pin = positions[:, 0] % block_size
+                kq, ksn = requantize_block_tokens(
+                    kpool[bt], ks[bt], kk[:, 0], pin)
+                vq, vsn = requantize_block_tokens(
+                    vpool[bt], vs[bt], vv[:, 0], pin)
+                kv[li] = {"k": kpool.at[bt].set(kq),
+                          "v": vpool.at[bt].set(vq),
+                          "ks": ks.at[bt].set(ksn),
+                          "vs": vs.at[bt].set(vsn)}
+            else:
+                pool_shape = kv[li]["k"].shape
+                kflat = kv[li]["k"].reshape(-1, Hkv, Dh)
+                vflat = kv[li]["v"].reshape(-1, Hkv, Dh)
+                kflat = kflat.at[widx].set(kk.astype(kflat.dtype))
+                vflat = vflat.at[widx].set(vv.astype(vflat.dtype))
+                kv[li] = {"k": kflat.reshape(pool_shape),
+                          "v": vflat.reshape(pool_shape)}
+            ksc, vsc = _pool_scales(kv[li])
+            if paged:
+                o_dec, o_chk = mixed_phase_ragged_attention(
+                    q.reshape(B, cfg.n_heads, Dh),
+                    qc.reshape(C, cfg.n_heads, Dh),
+                    kv[li]["k"], kv[li]["v"], tables, c_tables,
+                    pos, c_positions.reshape(C), ksc, vsc,
+                    pool_call=_pool_call)
+                o = o_dec.reshape(B, 1, cfg.n_heads, Dh)
+                oc = o_chk.reshape(1, C, cfg.n_heads, Dh)
+            else:
+                # off-TPU each section keeps ITS OWN oracle's attention
+                # function — the two reference softmaxes need not match
+                # bitwise, and token-exactness is per-section
+                if kv_quant:
+                    from ..ops.attention import ragged_gather_attention
+
+                    o = ragged_gather_attention(
+                        q, kv[li]["k"], kv[li]["v"], tables, positions,
+                        ksc, vsc)
+                else:
+                    kflat = kv[li]["k"].reshape(-1, Hkv, Dh)
+                    vflat = kv[li]["v"].reshape(-1, Hkv, Dh)
+                    o = dot_product_attention(q, kflat[goff], vflat[goff],
+                                              mask=mask)
+                oc = _ragged_pool_attention(qc, kv[li], c_tables,
+                                            c_positions, block_size,
+                                            shardings)
+            x = x + _proj(o.reshape(B, 1, -1), lp["attn"]["o"])
+            x = x + _mlp(lp, _rmsnorm(x, lp["mlp_norm"]["scale"],
+                                      cfg.rms_eps))
+            xc = xc + _proj(oc.reshape(1, C, -1), lp["attn"]["o"])
+            xc = xc + _mlp(lp, _rmsnorm(xc, lp["mlp_norm"]["scale"],
+                                        cfg.rms_eps))
+        logits = _logits(p, x, cfg)[:, 0]                       # [B, V]
+        nxt = sample_logits(logits, rng, temperature, top_k, top_p)
+        top_ids, top_lp, tok_lp = token_logprobs(logits, nxt)
+        lastc = jnp.take_along_axis(xc, (c_ntext - 1).reshape(1, 1, 1),
+                                    axis=1)
+        c_logits = _logits(p, lastc, cfg)[:, 0]                 # [1, V]
+        if feedback:
+            return kv, nxt, pos + 1, top_ids, top_lp, tok_lp, c_logits
+        return kv, nxt, top_ids, top_lp, tok_lp, c_logits
+
+    def fused(params, kv, tokens, pos, tables, active, rng, temperature,
+              top_k, top_p, c_ids, c_ntext, c_table, c_start):
+        return _fused_impl(params, kv, tokens, pos, tables, active, rng,
+                           temperature, top_k, top_p, c_ids, c_ntext,
+                           c_table, c_start)
+
+    donate = (1, 3) if feedback else (1,)
+    if shardings is None:
+        return jax.jit(fused, donate_argnums=donate)
+    sh, rep = shardings, shardings.rep
+    kvsh = sh.kv_pool(cfg.n_layers, quant=kv_quant)
+    in_sh = (sh.params, kvsh) + (rep,) * 12
+    out_sh = (kvsh,) + (rep,) * (6 if feedback else 5)
+    return jax.jit(fused, donate_argnums=donate,
+                   in_shardings=in_sh, out_shardings=out_sh)

@@ -84,6 +84,13 @@ class Request:
     # re-queued remainder is the same tenant's same-priority work.
     priority: int = 1
     tenant: str = ""
+    # n>1 sampling fan-out (SHAI_KV_COW): siblings of one OpenAI request
+    # share a parent id (-1 = not a fan-out member). The engine admits a
+    # fully-queued group as ONE prefill with copy-on-write KV forks, and
+    # the loop cancels/expires the group as a unit. Deliberately NOT
+    # carried across preemption re-queues — a resumed sibling has its own
+    # generated suffix and must re-admit independently.
+    parent_rid: int = -1
 
     def __post_init__(self):
         if self.orig_n_prompt < 0:

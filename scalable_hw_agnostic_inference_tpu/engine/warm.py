@@ -39,7 +39,14 @@ def warm_executables(eng, prefix_lens: Sequence[int] = (0,)) -> int:
             elif 0 < p < b and eng._cross_kv is None:
                 eng._prefill_for(b, p)  # prefix path stays single-seq
                 n += 1
-    if eng._ragged:
+    if eng._fused:
+        # fused mixed-phase step (SHAI_FUSED_STEP): the decode grid below
+        # builds the fused executables, and chunked-prefill continuation
+        # and cached admission ride the SAME executables — the rcont
+        # ladder has no fused-mode callers, so warming it would compile
+        # dead code
+        pass
+    elif eng._ragged:
         # ragged continuation ladder (SHAI_RAGGED_ATTENTION): the chunk
         # start is DATA, so ONE executable per chunk bucket covers every
         # start offset the bucketed ladder compiled one-by-one — the
@@ -157,6 +164,23 @@ def _run_warm_calls(eng) -> None:
             args += [eng._cross_kv, jnp.zeros((bb,), jnp.float32),
                      jnp.zeros((bb,), jnp.int32),
                      jnp.full((bb,), max(eng.cross_seq_len, 1), jnp.int32)]
+        eng.cache.kv, nxt, *_rest = fn(*args)
+        nxt.block_until_ready()
+    for bb, fn in list(eng._fused_fns.items()):
+        # fused mixed-phase executables: decode-style null rows plus the
+        # 4-arg null chunk window (ntext=1 against the zero table — the
+        # write lands in reserved block 0, allowed by contract). tokens
+        # and pos must be SEPARATE buffers: the feedback variant donates
+        # the position argument.
+        args = [eng.params, eng.cache.kv, jnp.zeros((bb,), jnp.int32),
+                jnp.zeros((bb,), jnp.int32), jnp.zeros((bb, M), jnp.int32),
+                jnp.zeros((bb,), bool), jax.random.PRNGKey(0),
+                jnp.ones((bb,), jnp.float32), jnp.zeros((bb,), jnp.int32),
+                jnp.ones((bb,), jnp.float32),
+                jnp.zeros((1, eng.buckets.max), jnp.int32),
+                jnp.ones((1,), jnp.int32),
+                jnp.zeros((1, M), jnp.int32),
+                jnp.zeros((1,), jnp.int32)]
         eng.cache.kv, nxt, *_rest = fn(*args)
         nxt.block_until_ready()
     K = eng.ecfg.num_speculative_tokens

@@ -156,6 +156,12 @@ class StepTelemetry:
         # regression — shows up as pad_fraction on a live pod.
         self.pad_tokens = 0
         self.real_tokens = 0
+        # per-phase split of the same accounting (prefill admission /
+        # chunk continuation / decode / verify): where the pad waste
+        # lives decides WHICH ladder to collapse — the fused-step A/B
+        # (bench.py fused) reads its win off the decode+chunk rows
+        self.pad_by_phase: Dict[str, int] = {}
+        self.real_by_phase: Dict[str, int] = {}
         self.warmed_executables = 0  # closed-set size at readiness
         # last-step gauges (scraped between steps)
         self._gauges: Dict[str, float] = {}
@@ -250,13 +256,30 @@ class StepTelemetry:
             hists = list(self._tenant_ttft.items())
         return {t: h.snapshot() for t, h in hists}
 
-    def count_pad(self, real: int, padded: int) -> None:
+    def count_pad(self, real: int, padded: int, phase: str = "") -> None:
         """One dispatch's token-slot accounting: ``real`` context/prompt
         tokens the shapes carried vs ``padded`` slots walked only because
-        of bucketing/batch padding."""
+        of bucketing/batch padding. ``phase`` additionally buckets the
+        split per dispatch kind (``prefill``/``chunk``/``decode``/
+        ``verify``) — the totals stay the single source the pad_fraction
+        gauge and the unlabelled counters read."""
         with self._lock:
             self.real_tokens += max(0, real)
             self.pad_tokens += max(0, padded)
+            if phase:
+                self.real_by_phase[phase] = (
+                    self.real_by_phase.get(phase, 0) + max(0, real))
+                self.pad_by_phase[phase] = (
+                    self.pad_by_phase.get(phase, 0) + max(0, padded))
+
+    def pad_phase_snapshot(self) -> Dict[str, Dict[str, int]]:
+        """phase -> {real, pad} cumulative counts (the ``/metrics``
+        label export and the ``/stats`` -> ``pad_by_phase`` payload)."""
+        with self._lock:
+            return {p: {"real": self.real_by_phase.get(p, 0),
+                        "pad": self.pad_by_phase.get(p, 0)}
+                    for p in set(self.real_by_phase)
+                    | set(self.pad_by_phase)}
 
     def record_step(self, *, kind: str, duration_s: float, n_running: int,
                     n_waiting: int, n_chunking: int, blocks_free: int,
@@ -370,6 +393,12 @@ class StepTelemetry:
             walked = self.pad_tokens + self.real_tokens
             out["pad_fraction"] = (round(self.pad_tokens / walked, 4)
                                    if walked else 0.0)
+            # per-phase split (prefill/chunk/decode/verify) — nested, so
+            # flat-numeric consumers (publish_engine) skip it untouched
+            out["pad_by_phase"] = {
+                p: {"real": self.real_by_phase.get(p, 0),
+                    "pad": self.pad_by_phase.get(p, 0)}
+                for p in set(self.real_by_phase) | set(self.pad_by_phase)}
             out.update(self._gauges)
         kvt = self.kvtier
         if kvt is not None:

@@ -22,6 +22,17 @@ The XLA gather-based reference for CPU/tier-1 lives in
 ``ops.attention.ragged_gather_attention``; ``ops.attention.
 ragged_paged_attention`` dispatches between the two so every test runs
 deviceless.
+
+Mixed-phase fused rows (``SHAI_FUSED_STEP``): because the kernel is
+row-oriented — each grid row carries its own ``(table, length)`` and pays
+only its own live blocks — an engine step can fuse decode and chunked
+prefill into ONE dispatch by pure layout, no kernel change: the ``B``
+decode rows come first (length ``pos + 1`` each), then the continuation
+chunk's ``C`` queries flattened one-per-row (all sharing the chunking
+sequence's table, lengths ``start + t + 1``). The kernel never learns
+which phase a row belongs to; ``ops.attention.
+mixed_phase_ragged_attention`` builds this layout and splits the outputs
+back at row ``B``.
 """
 
 from __future__ import annotations

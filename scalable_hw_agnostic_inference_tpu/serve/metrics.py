@@ -87,11 +87,19 @@ _ENGINE_COUNTERS = {
     "pipeline_flushes": ("shai_engine_pipeline_flushes",
                          "Async-decode lookahead steps retired early by a "
                          "composition/control-flow event"),
+}
+#: pad/real token counters export with a ``phase`` label (prefill /
+#: chunk / decode / verify — where in a request's life the pad burned).
+#: Any unphased remainder exports under phase="" so the labelled rows
+#: always sum exactly to the engine's cumulative totals.
+_PAD_PHASE_COUNTERS = {
     "pad_tokens": ("shai_engine_pad_tokens_total",
-                   "Padded (wasted) token slots dispatched, cumulative"),
+                   "Padded (wasted) token slots dispatched, cumulative",
+                   "pad"),
     "real_tokens": ("shai_engine_real_tokens_total",
                     "Real context/prompt token slots dispatched, "
-                    "cumulative"),
+                    "cumulative",
+                    "real"),
 }
 #: conformance-layer gauge families: each instrument riding the engine
 #: telemetry object exports its flat numeric snapshot verbatim under a
@@ -227,6 +235,18 @@ class EngineTelemetryCollector:
         for key, (name, doc) in _ENGINE_COUNTERS.items():
             c = CounterMetricFamily(name, doc, labels=["app"])
             c.add_metric([self.app], float(snap.get(key, 0)))
+            yield c
+        phases = snap.get("pad_by_phase") or {}
+        for key, (name, doc, col) in _PAD_PHASE_COUNTERS.items():
+            c = CounterMetricFamily(name, doc, labels=["app", "phase"])
+            total = float(snap.get(key, 0))
+            phased = 0.0
+            for phase in sorted(phases):
+                v = float(phases[phase].get(col, 0))
+                phased += v
+                c.add_metric([self.app, phase], v)
+            if total - phased or not phases:
+                c.add_metric([self.app, ""], total - phased)
             yield c
         hists = tele.histograms()
         for key, (name, doc) in ENGINE_HISTOGRAMS.items():

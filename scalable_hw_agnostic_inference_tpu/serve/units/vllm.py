@@ -897,23 +897,28 @@ class VllmService(ModelService):
         if n == 1:
             outs = [self.infer(payload)]
         else:
-            # n parallel samples: submit together so they join ONE running
-            # batch (and, with prefix caching on, share the prompt's KV)
+            # n parallel samples: ONE tokenization, one fan-out group —
+            # the siblings ride a single queue item so the engine can
+            # admit them as one prefill with copy-on-write KV forks
+            # (SHAI_KV_COW; without it they still join one running batch,
+            # and with prefix caching on they share the prompt's KV), and
+            # one parent request id makes cancel/deadline/migration treat
+            # the group as a unit
             params = self._sampling_from(payload)
             ids = self._encode(prompt, add_special=add_special)
             if not ids:
                 raise HTTPError(400, "empty prompt")
-            futs = [self.loop.submit(list(ids), params,
-                                     deadline_at=self._deadline_at(),
-                                     **self._qos_kw())
-                    for _ in range(n)]
+            futs = self.loop.submit_group(
+                list(ids), [params] * n,
+                deadline_at=self._deadline_at(), **self._qos_kw())
             outs = []
             try:
                 for fut in futs:
                     outs.append(self._collect(fut))
             except BaseException:
                 # one sample failed (rejected/timeout) — the siblings must
-                # not keep decoding for nobody
+                # not keep decoding for nobody (the loop's cancel cascade
+                # aborts the whole group off any one member)
                 for fut in futs:
                     if not fut.done():
                         self.loop.cancel(fut)

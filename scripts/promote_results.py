@@ -44,7 +44,11 @@ KEYS = {"sd": "sd21_img_s",
         # live migration (PR 15): resumed-request added latency p50 after
         # a mid-decode drain cut, KV shipped through the MIGRATE envelope
         # vs manifest-only recompute; errors REQUIRED 0 (bench.py migrate)
-        "migrate": "migrate_resume_p50_ms"}
+        "migrate": "migrate_resume_p50_ms",
+        # fused mixed-phase step (PR 16): laddered/fused TPOT ratio under
+        # a two-wave mixed prefill/decode load — chunk windows ride the
+        # decode dispatch; errors REQUIRED 0 (bench.py fused)
+        "fused": "fused_step_tpot_ratio"}
 
 
 def _load_results() -> dict:

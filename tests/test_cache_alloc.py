@@ -138,3 +138,117 @@ def test_shrink_never_touches_shared_prefix_blocks():
     cache.release(1)
     cache.release(0)
     assert all(cache.allocator.refcount(b) == 1 for b in shared)
+
+
+# -- copy-on-write fan-out (fork_sequence, SHAI_KV_COW) -----------------------
+
+def test_fork_at_every_refcount():
+    """Each fork stacks one reference per shared block — parent, children,
+    and a fork-of-a-fork all count; release unwinds exactly."""
+    cache = make_cache()
+    cache.admit(0, 6)  # 1 full + 1 partial block
+    blocks = list(cache.seq(0).blocks)
+    for k, child in enumerate((1, 2, 3), start=2):
+        cache.fork_sequence(0, child)
+        assert all(cache.allocator.refcount(b) == k for b in blocks)
+    cache.fork_sequence(3, 4)  # grandchild: forks stack from any holder
+    assert all(cache.allocator.refcount(b) == 5 for b in blocks)
+    assert cache.cow_forks == 4
+    for sid in (4, 3, 2, 1, 0):
+        cache.release(sid)
+    assert cache.allocator.n_free == 15
+    assert cache.leaked_blocks == 0
+
+
+def test_write_to_shared_tail_triggers_exactly_one_copy():
+    """Two writers over one shared partial tail block: the first divergent
+    write pays ONE block copy (priced by blocks_to_extend first); the last
+    holder then owns the original at refcount 1 and never copies."""
+    cache = make_cache()
+    cache.admit(0, 6)
+    cache.fork_sequence(0, 1)
+    tail = cache.seq(0).blocks[1]
+    # pricing: position 6 fits the tail block, but the pending CoW fork
+    # adds its +1 so the async pipeline's need-check stays truthful
+    assert cache.blocks_to_extend(1, 1) == 1
+    free_before = cache.allocator.n_free
+    cache.extend(1, 1)
+    assert cache.cow_copies == 1
+    assert cache.allocator.n_free == free_before - 1
+    assert cache.seq(1).blocks[1] != tail
+    assert cache.seq(0).blocks[1] == tail
+    assert cache.allocator.refcount(tail) == 1
+    # full leading block stays shared — only the written tail diverged
+    assert cache.seq(1).blocks[0] == cache.seq(0).blocks[0]
+    # the surviving holder writes in place: no second copy
+    assert cache.blocks_to_extend(0, 1) == 0
+    cache.extend(0, 1)
+    assert cache.cow_copies == 1
+    cache.release(0)
+    cache.release(1)
+    assert cache.allocator.n_free == 15
+    assert cache.leaked_blocks == 0
+
+
+def test_fork_of_prefix_cached_block():
+    """Fork over a registered prompt: cache ref + parent + child stack;
+    block-aligned growth diverges into FRESH blocks (no copy), and release
+    leaves the cache's own reference intact and lookup-able."""
+    cache = make_cache()
+    tokens = list(range(500, 508))  # 2 full blocks, registered
+    alloc = _admit_and_register(cache, 0, tokens)
+    shared = list(alloc.blocks)
+    cache.fork_sequence(0, 1)
+    assert all(cache.allocator.refcount(b) == 3 for b in shared)
+    cache.extend(1, 1)  # position 8 opens a new block: no CoW needed
+    assert cache.cow_copies == 0
+    assert cache.seq(1).blocks[:2] == shared
+    cache.release(1)
+    cache.release(0)
+    assert all(cache.allocator.refcount(b) == 1 for b in shared)
+    assert cache.cached_prefix(tokens) == shared
+    assert cache.leaked_blocks == 0
+
+
+def test_fork_release_order_independence():
+    """Any release order over a diverged fan-out lands on the same exact
+    block accounting — no order leaks or double-frees."""
+    for order in ([0, 1, 2], [2, 1, 0], [1, 0, 2]):
+        cache = make_cache()
+        cache.admit(0, 6)
+        cache.fork_sequence(0, 1)
+        cache.fork_sequence(0, 2)
+        cache.extend(1, 1)  # copy 1 (ref 3 -> writer forks)
+        cache.extend(2, 1)  # copy 2 (ref 2 -> writer forks)
+        cache.extend(0, 1)  # last holder: writes the original in place
+        assert cache.cow_copies == 2
+        for sid in order:
+            cache.release(sid)
+        assert cache.allocator.n_free == 15
+        assert cache.leaked_blocks == 0
+
+
+def test_fork_under_eviction_pressure():
+    """A CoW copy allocated from a dry free list must evict cache-only
+    blocks — never the shared source it is copying (refcount >= 2 is not
+    evictable), and the accounting stays exact."""
+    cache = make_cache()
+    cached_tokens = list(range(600, 608))
+    _admit_and_register(cache, 0, cached_tokens)
+    cache.release(0)  # 2 evictable cache-only blocks
+    cache.admit(1, 6)
+    cache.fork_sequence(1, 2)
+    shared = list(cache.seq(1).blocks)
+    n_fill = cache.allocator.n_free
+    for i in range(n_fill):  # drain the free list completely
+        cache.admit(10 + i, cache.block_size)
+    assert cache.allocator.n_free == 0
+    assert cache.n_evictable == 2
+    cache.extend(2, 1)  # CoW copy evicts exactly one cached block
+    assert cache.cow_copies == 1
+    assert cache.n_evictable == 1
+    assert all(cache.allocator.refcount(b) >= 1 for b in shared)
+    assert cache.seq(1).blocks == shared  # source survived the eviction
+    for sid in [1, 2] + [10 + i for i in range(n_fill)]:
+        cache.release(sid)
+    assert cache.leaked_blocks == 0

@@ -603,6 +603,11 @@ class StubEngine:
         self.waiting.append(rid)
         return rid
 
+    def fanout_siblings(self, rid):
+        # engine protocol: a non-fanout request's group is itself (the
+        # loop cancels fan-out groups as a unit through this call)
+        return [rid]
+
     @property
     def has_work(self):
         return bool(self.waiting or self.running)
