@@ -45,7 +45,7 @@ UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
                   "mllama": "tokens/sec", "llama_spec": "tokens/sec",
                   "vllm": "tokens/sec", "kvtier": "x", "qos": "x",
                   "disagg": "x", "ragged": "tokens/sec",
-                  "fused": "x", "migrate": "ms",
+                  "fused": "x", "migrate": "ms", "kvfabric": "x",
                   "sd": "images/sec", "sd8": "images/sec",
                   "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
@@ -76,7 +76,7 @@ def _which_from_argv(argv) -> str:
     if any(a.startswith("llama") for a in argv):
         return "llama"
     for k in ("vllm", "kvtier", "qos", "disagg", "ragged", "fused",
-              "migrate", "flux", "t5", "mllama", "sd8"):
+              "migrate", "kvfabric", "flux", "t5", "mllama", "sd8"):
         if k in argv:
             return k
     return "sd"
@@ -1122,6 +1122,169 @@ def bench_disagg(tiny: bool) -> dict:
     }
 
 
+def bench_kvfabric(tiny: bool) -> dict:
+    """KV fabric A/B: peer-probe admission vs cold recompute under a
+    shared-system-prompt workload.
+
+    Pod A (role=prefill, host tier on) prefills each round's prompts and
+    banks their KV runs; pod B runs the same round twice as two fresh
+    engines — fabric OFF (every round's new system prefix is a full
+    prefill) and fabric ON with a pushed-down holder slice naming pod A
+    (the probe rung pulls the run over the kvnet wire — an
+    ``httpx.MockTransport`` wired to pod A's tier through the REAL
+    ``KvNetClient`` fetch/validate/publish path — and ordinary warm
+    admission restores it). ``value`` is ``kvfabric_warm_ttft_ratio`` =
+    fabric-off TTFT p50 / fabric-on TTFT p50 (>1 = the fabric is buying
+    TTFT). Greedy decode on both sides; the line asserts token-exactness
+    in-line and REQUIRES zero transport errors — a ratio produced by a
+    degraded run is a lie, not a measurement. Network latency is NOT
+    modeled (same caveat as bench_disagg): this is the compute-side win
+    of restoring vs re-prefilling; the live two-pod socket test covers
+    the wire end-to-end."""
+    import os
+    import statistics
+
+    import httpx
+    import numpy as np
+
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+    from scalable_hw_agnostic_inference_tpu.kvnet import frames
+    from scalable_hw_agnostic_inference_tpu.kvnet.client import KvNetClient
+    from scalable_hw_agnostic_inference_tpu.kvnet.directory import (
+        FabricProbe,
+    )
+    from scalable_hw_agnostic_inference_tpu.models import llama as llama_mod
+
+    if tiny:
+        cfg = llama_mod.LlamaConfig.tiny()
+        kw = dict(max_model_len=768, max_num_seqs=4, block_size=8,
+                  context_encoding_buckets=(32, 64, 128, 256),
+                  max_new_tokens=16, enable_prefix_caching=True)
+        n_prefix, n_tail, batch, new, rounds = 576, 24, 4, 8, 3
+        name = "kvfabric-tiny"
+    else:
+        cfg = llama_mod.LlamaConfig.llama32_1b()
+        kw = dict(max_model_len=1024, max_num_seqs=4, block_size=16,
+                  context_encoding_buckets=(128, 256, 512),
+                  max_new_tokens=32, enable_prefix_caching=True)
+        n_prefix, n_tail, batch, new, rounds = 768, 64, 4, 16, 3
+        name = "kvfabric-1b-geometry"
+
+    params = llama_mod.geometry_params(cfg, quant=False)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=new)
+    sp1 = SamplingParams(temperature=0.0, max_new_tokens=1)
+    peer = "http://pod-a"
+
+    def build(role: str = "both") -> LLMEngine:
+        os.environ["SHAI_KVTIER"] = "1"
+        os.environ["SHAI_KVTIER_ASYNC"] = "0"  # deterministic copies
+        try:
+            return LLMEngine(cfg, params, EngineConfig(role=role, **kw))
+        finally:
+            os.environ.pop("SHAI_KVTIER", None)
+            os.environ.pop("SHAI_KVTIER_ASYNC", None)
+
+    def prompts_for(round_i: int):
+        # ONE shared system prefix per round (fresh each round: no
+        # device-cache reuse across rounds), distinct per-request tails
+        rng = np.random.default_rng(47 + round_i)
+        prefix = rng.integers(3, cfg.vocab_size, n_prefix).tolist()
+        return [prefix + rng.integers(3, cfg.vocab_size, n_tail).tolist()
+                for _ in range(batch)]
+
+    def run_batch(eng, prompts, params_, holders=None):
+        ids = [eng.add_request(list(p), params_, kv_holders=holders)
+               for p in prompts]
+        done = {}
+        while set(ids) - set(done):
+            for f in eng.step():
+                done[f.req_id] = f
+        eng.finish_pending()
+        return [done[i] for i in ids]
+
+    def ttfts(fins):
+        return [f.timing["t_first"] - f.timing["t_submit"] for f in fins]
+
+    # pod A: banks every round's runs in its host tier (the holder)
+    pod_a = build("prefill")
+    run_batch(pod_a, prompts_for(99), sp1)          # warm executables
+    tier_a = pod_a.cache.tier
+
+    def handler(request: "httpx.Request") -> "httpx.Response":
+        # pod A's /kv/blocks, served in-process: same frames, same
+        # leading-run contract the socket endpoint implements
+        if request.url.path == "/kv/blocks":
+            hs = [int(h) for h in
+                  (request.url.params.get("hashes") or "").split(",") if h]
+            run = tier_a.get_run(hs)
+            return httpx.Response(200, content=frames.encode_frames(run))
+        return httpx.Response(404)
+
+    def arm(eng: LLMEngine) -> FabricProbe:
+        fab = FabricProbe(
+            eng.cache.tier, kvnet_stats=eng.obs.kvnet, peers=[],
+            client=KvNetClient(eng.cache.tier, eng.obs.kvnet,
+                               transport=httpx.MockTransport(handler)))
+        eng._kvfabric = fab
+        eng.obs.kvfabric = fab.stats
+        return fab
+
+    b_off = build()
+    b_on = build()
+    fab = arm(b_on)
+    # warm both B engines' executables on an unrelated round (and pod A
+    # banks it so the fabric-on warm-up walks the full probe+restore
+    # path — the restore movers compile OUTSIDE the measured rounds)
+    warm = prompts_for(98)
+    run_batch(pod_a, warm, sp1)
+    run_batch(b_off, warm, sp)
+    run_batch(b_on, warm, sp, holders=[peer])
+
+    off_fins, on_fins = [], []
+    for r in range(rounds):
+        prompts = prompts_for(r)
+        run_batch(pod_a, prompts, sp1)              # the holder's banking
+        off_fins += run_batch(b_off, prompts, sp)   # cold: full prefill
+        on_fins += run_batch(b_on, prompts, sp,     # warm: probe+restore
+                             holders=[peer])
+
+    # token-exactness is part of the measurement's validity, not a
+    # separate test: greedy fabric-on output must equal fabric-off
+    for fo, fn in zip(off_fins, on_fins):
+        assert list(fo.token_ids) == list(fn.token_ids), \
+            "kvfabric changed greedy tokens — the ratio is invalid"
+    kv_errors = int(b_on.obs.kvnet.snapshot()["errors"])
+    assert kv_errors == 0, f"kvfabric bench saw {kv_errors} kvnet errors"
+    fsnap = fab.stats.snapshot()
+    assert fsnap["remote_hits"] > 0, "fabric probe never landed a run"
+
+    off_ttft, on_ttft = ttfts(off_fins), ttfts(on_fins)
+    val = (round(statistics.median(off_ttft)
+                 / statistics.median(on_ttft), 3)
+           if statistics.median(on_ttft) else 0.0)
+    base = _published("kvfabric_warm_ttft_ratio")
+    return {
+        "metric": f"{name} shared-system-prompt TTFT, fabric-off vs "
+                  f"fabric-on p50 ratio (batch {batch}, "
+                  f"{jax.devices()[0].platform})",
+        "value": val,
+        "unit": "x",
+        "vs_baseline": round(val / base, 3) if base else 1.0,
+        "off_ttft_p50_ms": round(statistics.median(off_ttft) * 1e3, 3),
+        "off_ttft_p99_ms": round(_pctl(off_ttft, 0.99) * 1e3, 3),
+        "on_ttft_p50_ms": round(statistics.median(on_ttft) * 1e3, 3),
+        "on_ttft_p99_ms": round(_pctl(on_ttft, 0.99) * 1e3, 3),
+        "errors": kv_errors,
+        "kvfabric": {k: fsnap[k] for k in ("probes", "remote_hits",
+                                           "remote_misses",
+                                           "stale_holders")},
+    }
+
+
 def bench_migrate(tiny: bool) -> dict:
     """Live migration A/B: drain-with-migration vs drain-with-recompute
     under a mid-decode drain cut (the in-process stand-in for a
@@ -1577,7 +1740,7 @@ def inner_main() -> None:
            "vllm": bench_vllm, "kvtier": bench_kvtier,
            "qos": bench_qos, "disagg": bench_disagg,
            "ragged": bench_ragged, "fused": bench_fused,
-           "migrate": bench_migrate,
+           "migrate": bench_migrate, "kvfabric": bench_kvfabric,
            "flux": bench_flux, "t5": bench_t5,
            "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)

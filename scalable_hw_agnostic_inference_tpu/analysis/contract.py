@@ -380,6 +380,30 @@ DEFAULT_CONTRACT = Contract(
             lock_guarded={"_entries": "_lock"},
             owning_modules=("kvnet/migrate.py",),
         ),
+        # KV fabric (kvnet/directory.py): counters take writes from the
+        # engine loop (probe outcomes) and lane threads (replication
+        # pulls); the directory takes updates from whoever polls peers
+        # and reads from the probe path — every map under _lock, every
+        # HTTP fetch outside it (the hot_locks entries enforce that).
+        "KvFabricStats": ClassPolicy(
+            immutable_after_init=("_lock",),
+            lock_guarded={"_counts": "_lock"},
+            owning_modules=("kvnet/directory.py",),
+        ),
+        "KvDirectory": ClassPolicy(
+            immutable_after_init=("ttl_s", "_lock"),
+            lock_guarded={"_holders": "_lock", "_by_holder": "_lock",
+                          "_hits": "_lock", "_aff2head": "_lock"},
+            owning_modules=("kvnet/directory.py",),
+        ),
+        # The probe's own lock guards ONLY the refresh deadline — the
+        # digest fetches and the run pull run outside it by contract.
+        "FabricProbe": ClassPolicy(
+            immutable_after_init=("tier", "stats", "client", "peers",
+                                  "ttl_s", "directory", "_lock"),
+            lock_guarded={"_refresh_at": "_lock"},
+            owning_modules=("kvnet/directory.py",),
+        ),
         # The tenant ledger takes writes from every serving thread
         # (admission checks, completion charges) and reads from scrape
         # threads: bucket state and per-tenant counters move under _lock
@@ -422,7 +446,7 @@ DEFAULT_CONTRACT = Contract(
     ),
     trace_files=("serve/app.py", "serve/asgi.py"),
     poll_routes=("/profile", "/health", "/readiness", "/health/ready",
-                 "/metrics", "/stats", "/kv/blocks"),
+                 "/metrics", "/stats", "/kv/blocks", "/kv/digests"),
     race=RaceSpec(
         # serve.app's closure lock guarding the in-flight counters (the
         # dict_guards entry above names the same lock for the write rule)
@@ -455,6 +479,13 @@ DEFAULT_CONTRACT = Contract(
             # would serialize the whole drain behind one slow peer
             "MigrateStats._lock",
             "MigrationInbox._lock",
+            # KV fabric: the probe rung runs ON the engine loop thread
+            # and the directory serves every routing decision — an HTTP
+            # probe or digest refresh under any of these would stall
+            # admission fleet-wide behind one slow holder
+            "KvFabricStats._lock",
+            "KvDirectory._lock",
+            "FabricProbe._lock",
         ),
         # The declared partial order is EMPTY on purpose: the control
         # plane's design rule is "no lock nesting at all" — every

@@ -329,6 +329,19 @@ class LLMEngine:
             from ..kvnet.client import KvNetStats
 
             self.obs.kvnet = KvNetStats()
+        # fleet KV fabric (kvnet.directory): the peer-probe third rung of
+        # the admission ladder. Constructed HERE, env-gated, so a two-pod
+        # fabric arms with nothing but SHAI_KVFABRIC[_PEERS]; fabric-off
+        # leaves _kvfabric None and the ladder byte-identical to the
+        # pre-fabric engine (the strict-no-op differential contract)
+        self._kvfabric = None
+        if self.cache.tier is not None:
+            from ..kvnet import directory as _kvdir
+
+            if _kvdir.fabric_enabled():
+                self._kvfabric = _kvdir.FabricProbe(
+                    self.cache.tier, kvnet_stats=self.obs.kvnet)
+                self.obs.kvfabric = self._kvfabric.stats
         # live-migration counters (kvnet.migrate): built unconditionally —
         # even a tier-less pod participates in the ladder's cold rung
         # (manifest-only migration), and the shai_migrate_* families must
@@ -380,7 +393,8 @@ class LLMEngine:
                     already_generated: Optional[Sequence[int]] = None,
                     already_lp: Optional[list] = None,
                     orig_n_prompt: int = -1,
-                    parent_rid: int = -1) -> int:
+                    parent_rid: int = -1,
+                    kv_holders: Optional[Sequence[str]] = None) -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -450,7 +464,9 @@ class LLMEngine:
                                         already_generated or []),
                                     already_lp=list(already_lp or []),
                                     orig_n_prompt=orig_n_prompt,
-                                    parent_rid=parent_rid))
+                                    parent_rid=parent_rid,
+                                    kv_holders=[str(u) for u in
+                                                (kv_holders or [])]))
         return rid
 
     def fanout_siblings(self, rid: int) -> List[int]:
@@ -1466,6 +1482,36 @@ class LLMEngine:
             self._record_admission_lps(logits, [int(t) for t in toks],
                                        lp_rows)
 
+    def _fabric_probe(self, req, hashes: List[int],
+                      from_block: int) -> int:
+        """The admission ladder's peer-probe rung (kvnet.directory):
+        pull the prompt's leading KV run from a fleet holder into the
+        host tier so ordinary warm admission takes it from there. Priced
+        BEFORE any network work: no holders (the cold fleet) costs
+        nothing, the probe budget is capped at the recompute time it
+        could save (PERF_MODEL via the sentinel), and a deadline with
+        less headroom than those savings skips the rung outright.
+        Returns blocks fetched (0 = recompute); never raises."""
+        fab = self._kvfabric
+        if fab is None or from_block >= len(hashes):
+            return 0
+        want = hashes[from_block:]
+        holders = list(req.kv_holders) or fab.holders_for(want[0])
+        if not holders:
+            return 0
+        budget = fab.client.timeout_s
+        rate = float(getattr(self.obs.sentinel, "projected_per_s", 0.0)
+                     or 0.0)
+        if rate > 0.0:
+            savings = len(want) * self.ecfg.block_size / rate
+            budget = min(budget, savings)
+            if req.deadline_at and req.deadline_at - time.monotonic() \
+                    < savings:
+                return 0  # priced out: the headroom belongs to recompute
+        elif req.deadline_at:
+            budget = min(budget, req.deadline_at - time.monotonic())
+        return fab.probe(want, holders, budget)
+
     def _admit_cached(self) -> bool:
         """Admit the head request reusing its cached prefix blocks: incref
         the shared blocks, run ONE continuation chunk over just the
@@ -1500,6 +1546,15 @@ class LLMEngine:
         n_tier = self.cache.tier_prefix_len(hashes, len(cached))
         start = self._cached_start_for(
             n_total, (len(cached) + n_tier) * self.ecfg.block_size)
+        if start == 0 and self._kvfabric is not None:
+            # third rung (KV fabric): device AND host tier came up cold —
+            # a fleet holder may still have the run. The probe publishes
+            # into the host tier, so on success the ordinary tier-restore
+            # path below admits against it unchanged.
+            if self._fabric_probe(req, hashes, len(cached)) > 0:
+                n_tier = self.cache.tier_prefix_len(hashes, len(cached))
+                start = self._cached_start_for(
+                    n_total, (len(cached) + n_tier) * self.ecfg.block_size)
         if start == 0:
             return False
         chunk_bucket = self._cached_chunk_bucket(n_total - start)

@@ -100,7 +100,8 @@ class EngineLoop:
                priority: int = 1, tenant: str = "",
                already_generated: Optional[Sequence[int]] = None,
                already_lp: Optional[list] = None,
-               orig_n_prompt: int = -1) -> Future:
+               orig_n_prompt: int = -1,
+               kv_holders: Optional[Sequence[str]] = None) -> Future:
         """Enqueue a request; the future resolves to a :class:`Finished`.
 
         ``prefix``: optional soft-prefix embeddings [P, dim] (vision tokens,
@@ -124,7 +125,7 @@ class EngineLoop:
             (list(prompt_ids), params or SamplingParams(),
              (prefix, cross_states, cross_len, on_token, deadline_at,
               priority, tenant, already_generated, already_lp,
-              orig_n_prompt), fut))
+              orig_n_prompt, kv_holders), fut))
         # close the put-after-drain window: if the loop died between our
         # _stop check and the put, nobody will ever drain this item
         if self._stop.is_set():
@@ -221,7 +222,7 @@ class EngineLoop:
             else:
                 (prefix, cross_states, cross_len, on_token, deadline_at,
                  priority, tenant, already_generated, already_lp,
-                 orig_n_prompt) = extras
+                 orig_n_prompt, kv_holders) = extras
                 try:
                     rid = self.engine.add_request(
                         ids, params, prefix=prefix,
@@ -229,7 +230,8 @@ class EngineLoop:
                         on_token=on_token, deadline_at=deadline_at,
                         priority=priority, tenant=tenant,
                         already_generated=already_generated,
-                        already_lp=already_lp, orig_n_prompt=orig_n_prompt)
+                        already_lp=already_lp, orig_n_prompt=orig_n_prompt,
+                        kv_holders=kv_holders)
                     with self._futures_lock:
                         self._futures[rid] = fut
                 except Exception as e:  # bad request (e.g. empty prompt)

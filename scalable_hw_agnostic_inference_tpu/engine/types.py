@@ -84,6 +84,12 @@ class Request:
     # re-queued remainder is the same tenant's same-priority work.
     priority: int = 1
     tenant: str = ""
+    # KV fabric (kvnet.directory): holder URLs the router believes hold
+    # this prompt's leading KV run — a pushed-down directory slice. A
+    # HINT only: the peer-probe rung tries them under its wall budget
+    # and recomputes on any miss; empty = resolve via the pod-local
+    # directory (or skip the probe entirely — the cold-fleet fast path)
+    kv_holders: List[str] = dataclasses.field(default_factory=list)
     # n>1 sampling fan-out (SHAI_KV_COW): siblings of one OpenAI request
     # share a parent id (-1 = not a fan-out member). The engine admits a
     # fully-queued group as ONE prefill with copy-on-write KV forks, and

@@ -54,6 +54,12 @@ log = logging.getLogger(__name__)
 FETCH_CHUNK_BLOCKS = 32
 #: the pod-side endpoint the client pulls from (serve/app.py registers it)
 BLOCKS_ROUTE = "/kv/blocks"
+#: the advertisement endpoint of the KV fabric (kvnet.directory): a
+#: peer's bounded chain-head set, or one head's full hash run
+DIGESTS_ROUTE = "/kv/digests"
+#: JSON byte cap on a digest response: an advertisement is a bounded
+#: list of small ints — anything bigger is not a digest answer
+MAX_DIGESTS_BYTES = 1 << 20
 #: request cap the serving side enforces (a probe-class route must answer
 #: in bounded time whatever the client asks)
 MAX_BLOCKS_PER_REQUEST = 256
@@ -260,6 +266,52 @@ class KvNetClient:
                     p.endswith("/") or peer_url[len(p)] in "/:?"):
                 return True
         return False
+
+    # -- fabric directory refresh (kvnet.directory) ------------------------
+
+    def fetch_digests(self, peer_url: str,
+                      head: Optional[int] = None) -> Optional[Dict]:
+        """GET a peer's ``/kv/digests`` advertisement (or, with ``head``,
+        that run's full hash list for a replication pull). Returns the
+        parsed JSON dict or None — never raises, same degrade-to-nothing
+        contract as :meth:`fetch_run`, sharing its breaker (a peer whose
+        fetches opened the circuit is not re-probed for digests) and
+        SSRF allowlist. Probe-class: one bounded GET, no retries."""
+        if not peer_url:
+            return None
+        peer = peer_url.rstrip("/")
+        if not self.peer_allowed(peer):
+            log.warning("kvnet: refusing digests from disallowed peer %r",
+                        peer[:120])
+            return None
+        br = self.breaker_of(peer)
+        if not br.allow():
+            return None
+        url = f"{peer}{DIGESTS_ROUTE}"
+        if head is not None:
+            url += f"?head={int(head)}"
+        import httpx
+
+        try:
+            r = self._http().get(url)
+        except (httpx.ConnectError, httpx.ConnectTimeout):
+            br.record_failure()
+            self.stats.count_error()
+            return None
+        except Exception:
+            br.release_probe()
+            self.stats.count_error()
+            log.warning("kvnet: digests from %s failed mid-read", peer,
+                        exc_info=True)
+            return None
+        br.record_success()
+        if r.status_code != 200 or len(r.content) > MAX_DIGESTS_BYTES:
+            return None
+        try:
+            got = r.json()
+        except ValueError:
+            return None
+        return got if isinstance(got, dict) else None
 
     # -- the one public operation ------------------------------------------
 

@@ -50,6 +50,15 @@ _STOP = object()
 DEFAULT_CAPACITY_BYTES = 256 << 20
 #: bounded copy-out queue: past this, demotions drop (never block)
 COPYOUT_QUEUE_DEPTH = 64
+#: chain-head runs one advertisement exports (kvnet.directory): bounds
+#: the /kv/digests + /stats payload whatever the pool holds
+ADVERT_MAX_RUNS = 64
+#: hash-list cap on one run's /kv/digests?head= answer (a replication
+#: pull re-chunks through fetch_run anyway)
+ADVERT_MAX_RUN_HASHES = 1024
+#: LRU entries scanned past protected runs before capacity wins and the
+#: oldest is evicted anyway — protection defers, it never deadlocks
+PROTECT_SCAN_LIMIT = 128
 
 
 def maybe_host_tier(*, n_layers: int, block_size: int, n_kv_heads: int,
@@ -201,6 +210,21 @@ class HostKVTier:
             "hits": 0, "misses": 0, "stores": 0, "evictions": 0,
             "restored": 0, "errors": 0, "dropped": 0, "bytes": 0,
         }
+        # incremental advertisement cache (kvnet.directory): the fleet
+        # polls the chain-head set on EVERY /stats scrape, so it must be
+        # maintained on store/evict instead of recomputed by an
+        # O(entries) walk per poll. Runs are store-adjacency chains —
+        # consecutive hashes of one demotion batch, extended across
+        # batches when a batch continues a tracked run's tail.
+        #: head -> {"hashes": [h, ...], "seq": recency counter}
+        self._adv_runs: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        #: hash -> (head, index inside its run) — indices never shift:
+        #: runs only append at the tail and truncate from a suffix
+        self._adv_of: Dict[int, Tuple[int, int]] = {}
+        self._adv_seq = 0
+        #: head -> protection deadline (monotonic): cova defers eviction
+        #: on a run's LAST advertised holder one directory cycle
+        self._protected: Dict[int, float] = {}
         self._worker: Optional[CopyOutWorker] = None
         #: latched by close(): a post-close demotion must count a drop,
         #: never lazily spawn a fresh worker past the drain
@@ -283,9 +307,11 @@ class HostKVTier:
                 arrays: Tuple[np.ndarray, ...], n: int) -> None:
         """Publish ``n`` materialized blocks, LRU-evicting to capacity."""
         for j, h in enumerate(hashes[:n]):
+            prev = hashes[j - 1] if j > 0 else None
             with self._lock:
                 if h in self._entries:
                     self._entries.move_to_end(h)
+                    self._adv_touch_locked(h)
                     continue
                 if self.block_nbytes > self.capacity_bytes:
                     self._stats["dropped"] += 1
@@ -297,14 +323,135 @@ class HostKVTier:
             with self._lock:
                 if h in self._entries:  # raced publish: keep the LRU touch
                     self._entries.move_to_end(h)
+                    self._adv_touch_locked(h)
                     continue
                 while ((len(self._entries) + 1) * self.block_nbytes
                        > self.capacity_bytes):
-                    self._entries.popitem(last=False)
-                    self._stats["evictions"] += 1
+                    self._evict_one_locked()
                 self._entries[h] = blk
+                self._adv_store_locked(h, prev)
                 self._stats["stores"] += 1
                 self._stats["bytes"] += self.block_nbytes
+
+    # -- advertisement bookkeeping (kvnet.directory) -----------------------
+
+    def _adv_store_locked(self, h: int, prev: Optional[int]) -> None:
+        """Track a freshly stored hash: extend the run whose TAIL is its
+        in-batch predecessor (chain hashes make the successor unique, so
+        store-adjacency IS chain adjacency within a batch), else open a
+        new run headed by ``h``. O(1) — the whole point of the cache."""
+        self._adv_seq += 1
+        if prev is not None:
+            rec = self._adv_of.get(prev)
+            if rec is not None:
+                head, idx = rec
+                run = self._adv_runs.get(head)
+                if run is not None and idx == len(run["hashes"]) - 1:
+                    self._adv_of[h] = (head, len(run["hashes"]))
+                    run["hashes"].append(h)
+                    run["seq"] = self._adv_seq
+                    self._adv_runs.move_to_end(head)
+                    return
+        self._adv_of[h] = (h, 0)
+        self._adv_runs[h] = {"hashes": [h], "seq": self._adv_seq}
+
+    def _adv_touch_locked(self, h: int) -> None:
+        """A re-published resident hash refreshes its run's recency (the
+        advertisement must surface what the pool would keep longest)."""
+        rec = self._adv_of.get(h)
+        if rec is None:
+            return
+        run = self._adv_runs.get(rec[0])
+        if run is not None:
+            self._adv_seq += 1
+            run["seq"] = self._adv_seq
+            self._adv_runs.move_to_end(rec[0])
+
+    def _adv_evict_locked(self, h: int) -> None:
+        """Untrack an evicted hash: its run truncates AT it — everything
+        chained past an evicted block is unreachable by a leading-run
+        walk, so advertising it would only manufacture stale probes.
+        Amortized O(1): each hash leaves the advertisement at most once
+        per store."""
+        rec = self._adv_of.pop(h, None)
+        if rec is None:
+            return
+        head, idx = rec
+        run = self._adv_runs.get(head)
+        if run is None:
+            return
+        for x in run["hashes"][idx + 1:]:
+            self._adv_of.pop(x, None)
+        del run["hashes"][idx:]
+        if not run["hashes"]:
+            del self._adv_runs[head]
+
+    def _evict_one_locked(self) -> None:
+        """Evict one entry LRU-first, skipping (a bounded scan of)
+        entries whose run head is protected — the last-advertised-holder
+        deferral. When every scanned entry is protected, capacity wins
+        and the oldest goes anyway: protection defers an eviction one
+        directory cycle, it never wedges the pool."""
+        victim = None
+        if self._protected:
+            now = time.monotonic()
+            # shai-lint: allow(guarded-read) caller-holds-lock helper
+            for i, h in enumerate(self._entries):
+                if i >= PROTECT_SCAN_LIMIT:
+                    break
+                rec = self._adv_of.get(h)
+                dl = (self._protected.get(rec[0])
+                      if rec is not None else None)
+                if dl is not None and dl > now:
+                    continue
+                victim = h
+                break
+        if victim is None:
+            # shai-lint: allow(guarded-read) caller-holds-lock helper
+            victim = next(iter(self._entries))
+        del self._entries[victim]
+        # shai-lint: allow(thread) caller-holds-lock helper
+        self._stats["evictions"] += 1
+        self._adv_evict_locked(victim)
+
+    def advertisement(self, limit: int = ADVERT_MAX_RUNS) -> List[Dict]:
+        """The pod's bounded chain-head advertisement, most recent run
+        first: ``[{"head", "n", "seq"}, ...]`` — the ``/kv/digests`` and
+        ``/stats`` payload the fleet directory is built from. O(limit)
+        under the lock, never O(entries)."""
+        out: List[Dict] = []
+        with self._lock:
+            for head in reversed(self._adv_runs):
+                if len(out) >= max(0, limit):
+                    break
+                run = self._adv_runs[head]
+                out.append({"head": head, "n": len(run["hashes"]),
+                            "seq": run["seq"]})
+        return out
+
+    def run_hashes(self, head: int,
+                   limit: int = ADVERT_MAX_RUN_HASHES) -> List[int]:
+        """One advertised run's hash chain (``/kv/digests?head=`` — the
+        replication pull resolves what to fetch through this)."""
+        with self._lock:
+            run = self._adv_runs.get(int(head))
+            if run is None:
+                return []
+            return list(run["hashes"][:max(0, limit)])
+
+    def protect(self, heads: Sequence[int], ttl_s: float) -> int:
+        """Defer eviction of the given runs' blocks for ``ttl_s`` (cova
+        marks sole-holder runs each directory cycle so the fleet never
+        drops its only copy while a probe is in flight). Expired marks
+        are swept here — the eviction scan only ever sees live ones.
+        Returns the live protected-head count."""
+        now = time.monotonic()
+        with self._lock:
+            for h in [h for h, dl in self._protected.items() if dl <= now]:
+                del self._protected[h]
+            for h in list(heads)[:ADVERT_MAX_RUNS]:
+                self._protected[int(h)] = now + max(0.0, ttl_s)
+            return len(self._protected)
 
     def drain(self) -> None:
         """Wait for pending async copy-outs to publish (tests/bench)."""

@@ -123,6 +123,11 @@ class StepTelemetry:
         # export through the same collector seam (ship/accept/resume all
         # count onto the one object)
         self.migrate = None
+        # KV-fabric probe counters (kvnet.directory.KvFabricStats):
+        # attached by the engine only when the fabric is armed — the
+        # shai_kvfabric_* families export through the same collector
+        # seam, and fabric-off pods show no kvfabric section at all
+        self.kvfabric = None
         # QoS weighted-fair scheduler (resilience.qos), attached by the
         # engine when SHAI_QOS is on: its pick/aging counters ride the
         # same provider seam into /stats -> "qos"

@@ -24,6 +24,7 @@ import random
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..kvnet.directory import REPLICA_TARGET, KvDirectory
 from ..kvtier.affinity import prompt_affinity
 from ..resilience import faults as rz_faults
 from ..resilience.breaker import CircuitBreaker
@@ -177,12 +178,18 @@ class CovaClient:
         # steers many requests; a poll failure degrades to weighted
         # order). TTL is operator-tunable: a big fleet whose /stats fan-out
         # is expensive widens it, a routing test shrinks it
-        from ..obs.util import env_float
+        from ..obs.util import env_float, env_int
 
         self._fleet_cache: Optional[Dict[str, Any]] = None
         self._fleet_cache_at = 0.0
         self.fleet_cache_ttl_s = env_float("SHAI_FLEET_CACHE_TTL_S",
                                            FLEET_CACHE_TTL_S)
+        # KV fabric directory: chain-head -> holder URLs, rebuilt from
+        # each /fleet poll's kvtier advertisements. Routing hits above
+        # SHAI_KVFABRIC_HOT_N trigger background replication pushes
+        self._kv_dir = KvDirectory()
+        self._fab_hot_n = env_int("SHAI_KVFABRIC_HOT_N", 3)
+        self._fab_busy = False          # ONE maintenance pass in flight
 
     def url_of(self, name: str) -> str:
         if name not in self.models:
@@ -358,7 +365,98 @@ class CovaClient:
         qos_tenants = aggregate_tenant_usage(results)
         if qos_tenants:
             out["qos"] = {"tenants": qos_tenants}
+        # KV fabric: fold each pod's host-tier advertisement into the
+        # directory, age out silent holders, and kick ONE background
+        # maintenance pass (replication + sole-holder protection). The
+        # directory is a routing hint — every ingest error is skipped
+        self._ingest_fabric(results)
+        out["kvfabric"] = self._kv_dir.snapshot()
+        if self._kv_dir.size():
+            self._kick_fabric_maintenance()
         return out
+
+    # -- KV fabric (kvnet.directory) -----------------------------------------
+
+    def _ingest_fabric(self, results: Dict[str, Any]) -> None:
+        """Fold ``/stats`` ``kvtier.adverts`` + ``kvtier.aff_heads`` per
+        backend into the directory. Pods without the fields (older
+        images, fabric off) simply don't advertise — never an error."""
+        for name, st in results.items():
+            if not isinstance(st, dict) or name not in self.models:
+                continue
+            kvt = st.get("kvtier")
+            if not isinstance(kvt, dict):
+                continue
+            url = resolve_service_url(name, self.models[name])
+            adverts = kvt.get("adverts")
+            if isinstance(adverts, list):
+                # an EMPTY list is a real statement (the pod's tier is
+                # cold) and retires its stale directory entries
+                self._kv_dir.update_holder(url, adverts)
+            heads = kvt.get("aff_heads")
+            if isinstance(heads, dict):
+                for aff, head in heads.items():
+                    try:
+                        self._kv_dir.note_affinity(str(aff), int(head))
+                    except (TypeError, ValueError):
+                        continue
+        self._kv_dir.prune()
+
+    def _kick_fabric_maintenance(self) -> None:
+        if self._fab_busy:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return      # sync caller (unit test poking fleet state)
+        self._fab_busy = True
+        loop.create_task(self._fabric_maintain())
+
+    async def _fabric_maintain(self) -> None:
+        """One fire-and-forget pass of the two fleet-LRU policies:
+
+        - sole-holder protection: a head with ONE advertised holder gets
+          ``POST /kv/protect`` there — eviction of the fleet's only copy
+          is deferred one directory cycle, so a just-routed request's
+          probe doesn't chase a run evicted microseconds earlier;
+        - hot-prefix replication: heads above the routing-hit threshold
+          with fewer than REPLICA_TARGET holders get ``POST /kv/pull``
+          on an under-warmed pod (background pull via the migrate/warm
+          path — the puller counts ``replications``).
+
+        Every push is best-effort: an unreachable pod is skipped and the
+        next /fleet cycle retries. Never raises (the task is orphaned)."""
+        try:
+            sole = self._kv_dir.sole_holders()
+            by_url: Dict[str, List[int]] = {}
+            for head, url in sole.items():
+                by_url.setdefault(url, []).append(head)
+            ttl = max(2.0 * self.fleet_cache_ttl_s, 5.0)
+            for url, heads in by_url.items():
+                try:
+                    await self._post_url(url, "/kv/protect",
+                                         {"heads": heads[:64], "ttl_s": ttl})
+                except Exception:
+                    continue
+            urls = [resolve_service_url(n, self.models[n])
+                    for n in self.weighted_order()]
+            for head, _hits in self._kv_dir.hot_heads(self._fab_hot_n)[:8]:
+                holders = self._kv_dir.holders_of(head)
+                if not holders or len(holders) >= REPLICA_TARGET:
+                    continue
+                targets = [u for u in urls if u not in holders]
+                if not targets:
+                    continue
+                try:
+                    await self._post_url(targets[0], "/kv/pull",
+                                         {"source": holders[0],
+                                          "head": head})
+                except Exception:
+                    continue
+        except Exception:
+            log.debug("kvfabric maintenance pass failed", exc_info=True)
+        finally:
+            self._fab_busy = False
 
     # -- prefix-affinity routing (kvtier) -----------------------------------
 
@@ -397,29 +495,36 @@ class CovaClient:
 
     @staticmethod
     def rank_backends(prompt: str, order: List[str],
-                      fleet: Dict[str, Any]) -> Tuple[List[str], List[str]]:
-        """Prefix-affinity ranking: backends advertising the prompt's
-        leading-block digest (``/stats`` → ``kvtier.affinity``) move to
-        the front — their prefix cache / host tier serves the prefill
-        warm — unless they are overloaded; everything else keeps the
-        weighted order. Returns ``(ranked, warm)``; pure and deterministic
-        (unit-tested directly)."""
+                      fleet: Dict[str, Any],
+                      holders: Optional[List[str]] = None
+                      ) -> Tuple[List[str], List[str]]:
+        """Prefix-affinity ranking: backends the KV-fabric directory
+        names as ACTUAL holders of the prompt's chain head come first
+        (an advertisement beats a guess), then backends advertising the
+        prompt's leading-block digest (``/stats`` → ``kvtier.affinity``)
+        — their prefix cache / host tier serves the prefill warm —
+        unless they are overloaded; everything else keeps the weighted
+        order. Returns ``(ranked, warm)`` with holders counted warm;
+        pure and deterministic (unit-tested directly)."""
         if len(order) <= 1:
             return list(order), []
         digest = prompt_affinity(prompt)
         overloaded = set(fleet.get("overloaded") or ())
         models = fleet.get("models") or {}
-        warm, cold = [], []
+        hold = set(holders or ())
+        held, warm, cold = [], [], []
         for n in order:
             st = models.get(n)
             aff = (st.get("kvtier") or {}).get("affinity") \
                 if isinstance(st, dict) else None
-            if (isinstance(aff, list) and digest in aff
+            if n in hold and n not in overloaded:
+                held.append(n)
+            elif (isinstance(aff, list) and digest in aff
                     and n not in overloaded):
                 warm.append(n)
             else:
                 cold.append(n)
-        return warm + cold, warm
+        return held + warm + cold, held + warm
 
     def _role_of(self, name: str, fleet: Dict[str, Any]) -> str:
         """A backend's serving role — :func:`backend_role` over this
@@ -430,7 +535,8 @@ class CovaClient:
     async def _generate_disagg(self, prompt: str, params: Dict[str, Any],
                                prefill_pods: List[str],
                                decode_pods: List[str],
-                               fleet: Dict[str, Any]
+                               fleet: Dict[str, Any],
+                               holders: Optional[List[str]] = None
                                ) -> Optional[Dict[str, Any]]:
         """The disaggregated path: prefill on a prefill-role pod (affinity
         first — a repeat prompt's KV is already banked there), then hand
@@ -438,7 +544,8 @@ class CovaClient:
         declines (unreachable prefill tier, ``kv_ready: false``, every
         decode pod failing) — the caller degrades to monolithic routing,
         never fails the request here."""
-        ranked_p, _warm = self.rank_backends(prompt, prefill_pods, fleet)
+        ranked_p, _warm = self.rank_backends(prompt, prefill_pods, fleet,
+                                             holders=holders)
         handoff = None
         pf_name = None
         for name in ranked_p:
@@ -483,10 +590,16 @@ class CovaClient:
         # (explicit decode pods first) with overloaded pods demoted to
         # the back — affinity ranking would move a warm BOTH-pod ahead of
         # the decode tier, re-mixing decode with that pod's chunked
-        # prefill (the interference the split removes), and warmth is
-        # moot here anyway: the handoff pull warms whichever pod we pick
+        # prefill (the interference the split removes). DIGEST warmth is
+        # moot (the handoff pull warms whichever pod we pick), but a
+        # directory-confirmed HOLDER already banks the run — picking it
+        # turns the handoff pull into a no-op, so holders sort ahead of
+        # the non-overloaded rest (stable sort: role order holds within
+        # each key class)
         ov = set(fleet.get("overloaded") or ())
-        ranked_d = ([n for n in decode_pods if n not in ov]
+        hold = set(holders or ())
+        ranked_d = (sorted([n for n in decode_pods if n not in ov],
+                           key=lambda n: n not in hold)
                     + [n for n in decode_pods if n in ov])
         for name in ranked_d:
             try:
@@ -603,6 +716,17 @@ class CovaClient:
         if not order:
             raise HTTPError(400, "no text-generation models configured")
         fleet = await self._fleet_for_routing()
+        # KV fabric: resolve the prompt's chain head via the affinity
+        # digest, then its directory-confirmed holders. Holder URLs ride
+        # the request as ``kv_holders`` so even a NON-holder target can
+        # probe-pull the prefix instead of recomputing it; the routing
+        # hit feeds the hot-prefix replication trigger
+        head = self._kv_dir.head_of(prompt_affinity(prompt))
+        holder_urls = self._kv_dir.holders_of(head)
+        if holder_urls:
+            self._kv_dir.note_hit(head)
+        holder_names = [n for n in (self._name_of_url(u)
+                                    for u in holder_urls) if n is not None]
         prefill_pods = [n for n in order
                         if self._role_of(n, fleet) == "prefill"]
         decodable = [n for n in order
@@ -612,18 +736,28 @@ class CovaClient:
         decodable.sort(key=lambda n: self._role_of(n, fleet) != "decode")
         if prefill_pods and decodable:
             out = await self._generate_disagg(prompt, params, prefill_pods,
-                                              decodable, fleet)
+                                              decodable, fleet,
+                                              holders=holder_names)
             if out is not None:
                 return out
         if not decodable:
             raise HTTPError(502, "no decode-capable backend (every "
                                  "configured backend is prefill-role)")
-        ranked, warm = self.rank_backends(prompt, decodable, fleet)
+        ranked, warm = self.rank_backends(prompt, decodable, fleet,
+                                          holders=holder_names)
         last: Optional[HTTPError] = None
         for name in ranked:
+            body = {"prompt": prompt, **params}
+            if holder_urls:
+                # push the directory slice down, the target itself
+                # excluded (it needs PEERS to pull from, not its own
+                # address back)
+                push = [u for u in holder_urls
+                        if u != self.url_of(name)][:3]
+                if push:
+                    body["kv_holders"] = push
             try:
-                out = await self.post(name, "/generate",
-                                      {"prompt": prompt, **params})
+                out = await self.post(name, "/generate", body)
             except HTTPError as e:
                 last = e
                 continue

@@ -77,6 +77,10 @@ MIRROR = "multihost.mirror"
 KVNET_FETCH = "kvnet.fetch"
 MIGRATE_SHIP = "migrate.ship"
 MIGRATE_RESTORE = "migrate.restore"
+# the KV-fabric peer-probe rung (kvnet.directory.FabricProbe): error ->
+# the probed holder looks dead (breaker-counted), the admission ladder
+# degrades to recompute — never a request failure
+KVFABRIC_PROBE = "kvfabric.probe"
 
 KINDS = ("delay", "stall", "error", "drop")
 

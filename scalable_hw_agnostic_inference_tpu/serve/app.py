@@ -152,6 +152,24 @@ class ModelService:
         without a tier — the families then never export."""
         return None
 
+    # -- KV fabric (kvnet.directory) ---------------------------------------
+
+    def affinity_heads(self) -> Optional[Dict[str, int]]:
+        """Bounded affinity-digest -> chain-head map (``/stats`` →
+        ``kvtier.aff_heads``): lets the text-only cova router resolve a
+        prompt to the content-addressed head its fleet directory is
+        keyed by. None = no fabric participation."""
+        return None
+
+    def fabric_pull(self, source: str, head: int) -> Optional[int]:
+        """Background replication pull (``POST /kv/pull``): resolve the
+        run's hashes via ``source``'s ``/kv/digests?head=`` and fetch it
+        into the local tier — the hot-prefix replication path, reusing
+        the migrate/warm-pull transport. Returns blocks landed, or None
+        when this pod has no fabric (the route 404s and cova tries
+        another under-warmed pod next cycle)."""
+        return None
+
     # -- live migration (kvnet.migrate) ------------------------------------
 
     def wants_migration(self) -> bool:
@@ -298,7 +316,7 @@ def create_app(
     # runs would otherwise evict real request timelines from the ring
     app.trace_exclude |= {"/health/ready", "/debug/faults",
                           "/debug/conformance", "/profile", "/kv/blocks",
-                          "/kv/migrate"}
+                          "/kv/migrate", "/kv/digests"}
 
     def _do_load_and_warm():
         t0 = time.perf_counter()
@@ -674,7 +692,9 @@ def create_app(
                              ("hbm", getattr(tele, "hbm", None)),
                              ("perf", getattr(tele, "sentinel", None)),
                              ("kvtier", getattr(tele, "kvtier", None)),
-                             ("migrate", getattr(tele, "migrate", None))):
+                             ("migrate", getattr(tele, "migrate", None)),
+                             ("kvfabric", getattr(tele, "kvfabric",
+                                                  None))):
                 if obj is not None:
                     try:
                         out[sec] = obj.snapshot()
@@ -686,6 +706,16 @@ def create_app(
         aff = service.affinity_digests()
         if aff is not None:
             out.setdefault("kvtier", {})["affinity"] = aff
+        # KV fabric (kvnet.directory): the host tier's bounded chain-head
+        # advertisement plus the affinity-digest -> chain-head map — what
+        # cova's fleet directory is built from. Both are O(bounded) reads
+        # off incrementally maintained caches, never an entries walk.
+        tier = service.kv_tier()
+        if tier is not None and hasattr(tier, "advertisement"):
+            out.setdefault("kvtier", {})["adverts"] = tier.advertisement()
+        heads = service.affinity_heads()
+        if heads:
+            out.setdefault("kvtier", {})["aff_heads"] = heads
         # disaggregated serving (kvnet): the pod's role — what cova's
         # disagg router partitions the fleet by — plus the transport
         # counters when the pod participates in the network KV plane
@@ -767,6 +797,71 @@ def create_app(
             stats.count_served(n_run, len(body))
         return Response(body, media_type="application/octet-stream",
                         headers={"x-shai-kv-blocks": str(n_run)})
+
+    @app.get("/kv/digests")
+    def kv_digests(request: Request):
+        """KV fabric advertisement (kvnet.directory): this pod's bounded
+        chain-head set — ``{"adverts": [{"head", "n", "seq"}, ...]}`` —
+        or, with ``?head=``, one advertised run's full hash chain for a
+        replication pull. Probe-class: O(bounded) reads off the tier's
+        incrementally maintained caches (never an entries walk), served
+        inline on the event loop, trace-excluded. A pod without a tier
+        404s — a directory poller treats it as advertising nothing."""
+        tier = service.kv_tier()
+        if tier is None or not hasattr(tier, "advertisement"):
+            raise HTTPError(404, "no host KV tier on this pod")
+        raw = request.query.get("head", "")
+        if raw:
+            try:
+                head = int(raw)
+            except ValueError:
+                raise HTTPError(400, "head must be an integer chain hash")
+            return {"head": head, "hashes": tier.run_hashes(head)}
+        return {"adverts": tier.advertisement()}
+
+    @app.post("/kv/pull")
+    async def kv_pull(request: Request):
+        """Hot-prefix replication (kvnet.directory): cova asks this pod
+        to pull one advertised run from ``source`` into its own tier —
+        ``{"source": url, "head": chain_hash}``. Infrastructure route
+        (no admission gate; the pull is background warmth, not a
+        request), refused while draining, 404 on fabric-off pods so a
+        misconfigured cova can never turn a cold pod into a puller. The
+        blocking fetch runs on the default executor."""
+        _require_ready()
+        if drainer.draining:
+            raise HTTPError(503, "pod is draining; pick another peer",
+                            headers={"retry-after": "1"})
+        body = request.json()
+        try:
+            source = str(body["source"])
+            head = int(body["head"])
+        except (ValueError, TypeError, KeyError):
+            raise HTTPError(400, "need {source: url, head: chain_hash}")
+        n = await asyncio.get_running_loop().run_in_executor(
+            None, service.fabric_pull, source, head)
+        if n is None:
+            raise HTTPError(404, "no KV fabric on this pod")
+        return {"fetched": int(n)}
+
+    @app.post("/kv/protect")
+    async def kv_protect(request: Request):
+        """Last-holder eviction deferral (kvnet.directory): cova marks
+        the runs this pod is the fleet's ONLY advertised holder of —
+        ``{"heads": [chain_hash, ...], "ttl_s": s}`` — so LRU eviction
+        skips them for one directory cycle and a probe in flight never
+        races the fleet's last copy out of existence. Bounded, advisory
+        (capacity still wins), 404 without a tier."""
+        tier = service.kv_tier()
+        if tier is None or not hasattr(tier, "protect"):
+            raise HTTPError(404, "no host KV tier on this pod")
+        body = request.json()
+        try:
+            heads = [int(h) for h in body.get("heads", [])]
+            ttl_s = float(body.get("ttl_s", 5.0))
+        except (ValueError, TypeError, AttributeError):
+            raise HTTPError(400, "need {heads: [chain_hash], ttl_s: s}")
+        return {"protected": tier.protect(heads, min(ttl_s, 60.0))}
 
     @app.post("/kv/migrate")
     async def kv_migrate(request: Request):

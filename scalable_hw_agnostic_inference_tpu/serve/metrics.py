@@ -170,6 +170,30 @@ _MIGRATE_COUNTERS = {
                   "restore, unencodable blocks) — each one recomputed "
                   "instead of failing"),
 }
+#: KV fabric (kvnet.directory.KvFabricStats snapshot keys): the fleet-
+#: wide prefix-pool counters. Runbook: rising stale_holders = the
+#: directory TTL outlives the pools (shorten SHAI_KVFABRIC_TTL_S);
+#: rising remote_misses with flat stale_holders = holders unreachable —
+#: under-replication (lower SHAI_KVFABRIC_HOT_N / add capacity)
+_KVFABRIC_COUNTERS = {
+    "probes": ("shai_kvfabric_probes_total",
+               "KV fabric: peer-probe admissions attempted (the ladder's "
+               "third rung)"),
+    "remote_hits": ("shai_kvfabric_remote_hits_total",
+                    "KV fabric: probes that landed a remote KV run"),
+    "remote_misses": ("shai_kvfabric_remote_misses_total",
+                      "KV fabric: probes that came up empty and "
+                      "recomputed"),
+    "replications": ("shai_kvfabric_replications_total",
+                     "KV fabric: hot-prefix runs pulled by background "
+                     "replication (/kv/pull)"),
+    "directory_size": ("shai_kvfabric_directory_size_total",
+                       "KV fabric: chain heads in this pod's local "
+                       "directory"),
+    "stale_holders": ("shai_kvfabric_stale_holders_total",
+                      "KV fabric: holders that answered but no longer "
+                      "held the advertised run"),
+}
 _KVTIER_GAUGES = {
     "used_bytes": ("shai_kvtier_used_bytes",
                    "Host KV tier: bytes resident in the host pool"),
@@ -326,6 +350,20 @@ class EngineTelemetryCollector:
                 snap = None
             if snap is not None:
                 for key, (name, doc) in _MIGRATE_COUNTERS.items():
+                    c = CounterMetricFamily(name, doc, labels=["app"])
+                    c.add_metric([self.app], float(snap.get(key, 0)))
+                    yield c
+        # KV fabric (kvnet.directory): the fleet prefix-pool counters —
+        # attached by the engine only when the fabric is armed, so a
+        # fabric-off pod exports no shai_kvfabric_* family at all
+        fab = getattr(tele, "kvfabric", None)
+        if fab is not None:
+            try:
+                snap = fab.snapshot()
+            except Exception:
+                snap = None
+            if snap is not None:
+                for key, (name, doc) in _KVFABRIC_COUNTERS.items():
                     c = CounterMetricFamily(name, doc, labels=["app"])
                     c.add_metric([self.app], float(snap.get(key, 0)))
                     yield c

@@ -8,7 +8,8 @@ compatibility, and registration happens on import (models.registry).
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, Optional
+import threading
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -79,6 +80,14 @@ class VllmService(ModelService):
         self.role = resolve_role(self.ecfg.role if self.ecfg else "both")
         self._kvnet = None
         self._kvnet_stats = None
+        # KV fabric (kvnet.directory): bounded affinity-digest -> chain-
+        # head map, exported on /stats so the text-only cova router can
+        # key its fleet directory by the same content-addressed heads the
+        # engines probe with. Written by lane threads, read by scrapes.
+        from collections import OrderedDict
+
+        self._aff_lock = threading.Lock()
+        self._aff_heads: "OrderedDict[str, int]" = OrderedDict()
 
     @staticmethod
     def _resolve_ecfg(cfg: ServeConfig):
@@ -338,6 +347,9 @@ class VllmService(ModelService):
         kn = getattr(self, "_kvnet", None)
         if kn is not None:
             kn.close()  # the shared transport client's sockets
+        fab = getattr(eng, "_kvfabric", None)
+        if fab is not None:
+            fab.close()  # fabric probe's own transport client
 
     def engine_telemetry(self):
         eng = getattr(self, "_engine", None)
@@ -350,6 +362,57 @@ class VllmService(ModelService):
 
     def kvnet_stats(self):
         return getattr(self, "_kvnet_stats", None)
+
+    # ---- KV fabric hooks (served on /stats and /kv/pull) -------------
+
+    def affinity_heads(self) -> Optional[Dict[str, int]]:
+        # affinity digest -> chain head: lets the text-only control plane
+        # (cova sees prompts, never token ids) resolve its routing digest
+        # to the content hash the directory is keyed by
+        eng = getattr(self, "_engine", None)
+        if eng is None or getattr(eng, "_kvfabric", None) is None:
+            return None
+        with self._aff_lock:
+            return dict(self._aff_heads)
+
+    def fabric_pull(self, source: str, head: int) -> Optional[int]:
+        """Background replication pull: ask `source` for the run headed by
+        `head` and warm it into the local host tier. Returns blocks
+        fetched, or None when this pod has no fabric/transport armed."""
+        eng = getattr(self, "_engine", None)
+        fab = None if eng is None else getattr(eng, "_kvfabric", None)
+        kn = getattr(self, "_kvnet", None)
+        if fab is None or kn is None:
+            return None
+        listing = kn.fetch_digests(str(source), head=int(head))
+        if not isinstance(listing, dict):
+            return 0
+        try:
+            hashes = [int(h) for h in listing.get("hashes") or []]
+        except (TypeError, ValueError):
+            return 0
+        if not hashes:
+            return 0
+        n = kn.fetch_run(str(source), hashes)
+        if n > 0:
+            fab.stats.count("replications")
+        return n
+
+    def _note_aff_head(self, aff: str, ids) -> None:
+        eng = getattr(self, "_engine", None)
+        if eng is None or getattr(eng, "_kvfabric", None) is None:
+            return
+        bs = eng.ecfg.block_size
+        if len(ids) < bs:
+            return  # no full block, nothing advertisable under this digest
+        from ...engine.cache import PagedKVCache
+
+        head = PagedKVCache._chain_hashes(list(ids)[:bs], bs)[0]
+        with self._aff_lock:
+            self._aff_heads[aff] = int(head)
+            self._aff_heads.move_to_end(aff)
+            while len(self._aff_heads) > 256:
+                self._aff_heads.popitem(last=False)
 
     def _encode(self, text: str, add_special: bool = True):
         # the engine's true capacity, not the largest bucket — prompts past
@@ -478,10 +541,19 @@ class VllmService(ModelService):
             if max_text < 1:
                 raise HTTPError(400, "image prefix leaves no prompt room")
             ids = ids[:max_text]
+        # KV fabric (kvnet.directory): a pushed-down holder slice rides
+        # the payload — a HINT the engine's peer-probe rung tries under
+        # its wall budget. Bounded and stringified here; the transport's
+        # SSRF allowlist validates each URL before any fetch.
+        kv_holders = payload.get("kv_holders")
+        if isinstance(kv_holders, (list, tuple)):
+            kv_holders = [str(u) for u in kv_holders[:4]]
+        else:
+            kv_holders = None
         out = self._collect(self.loop.submit(
             ids, params, prefix=prefix, cross_states=cross_states,
             cross_len=cross_len, deadline_at=self._deadline_at(),
-            **self._qos_kw()))
+            kv_holders=kv_holders, **self._qos_kw()))
         if self._engine.cache.prefix_caching:
             # advertise warmth ONLY for the /generate path cova routes,
             # and only after the request actually served: chat-templated
@@ -490,7 +562,9 @@ class VllmService(ModelService):
             # rejected request left no KV to be warm about
             from ...kvtier.affinity import prompt_affinity
 
-            self._affinity.note(prompt_affinity(prompt))
+            aff = prompt_affinity(prompt)
+            self._affinity.note(aff)
+            self._note_aff_head(aff, ids)
         return out
 
     def _prefill_handoff(self, prompt: str, ids) -> Dict[str, Any]:
@@ -523,7 +597,9 @@ class VllmService(ModelService):
                 log.warning("kvnet: tier drain after prefill failed",
                             exc_info=True)
         if eng.cache.prefix_caching:
-            self._affinity.note(prompt_affinity(prompt))
+            aff = prompt_affinity(prompt)
+            self._affinity.note(aff)
+            self._note_aff_head(aff, ids)
         return {
             "kv_ready": bool(kv_ready),
             "digest": prompt_affinity(prompt),

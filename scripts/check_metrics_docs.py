@@ -52,6 +52,8 @@ SCAN_FILES = (
     # live migration's shai_migrate_* family (METRIC_FAMILIES literals —
     # a counter added to the ladder must reach the README runbook)
     os.path.join(PKG, "kvnet", "migrate.py"),
+    # the KV fabric's shai_kvfabric_* family (directory + probe rung)
+    os.path.join(PKG, "kvnet", "directory.py"),
 )
 README = os.path.join(ROOT, "README.md")
 

@@ -48,7 +48,12 @@ KEYS = {"sd": "sd21_img_s",
         # fused mixed-phase step (PR 16): laddered/fused TPOT ratio under
         # a two-wave mixed prefill/decode load — chunk windows ride the
         # decode dispatch; errors REQUIRED 0 (bench.py fused)
-        "fused": "fused_step_tpot_ratio"}
+        "fused": "fused_step_tpot_ratio",
+        # KV fabric (PR 17): fabric-off/fabric-on TTFT p50 ratio under a
+        # shared-system-prompt load — the peer-probe rung pulls the run
+        # from the holder pod instead of re-prefilling; token-exactness
+        # asserted in-line, errors REQUIRED 0 (bench.py kvfabric)
+        "kvfabric": "kvfabric_warm_ttft_ratio"}
 
 
 def _load_results() -> dict:

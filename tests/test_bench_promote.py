@@ -333,6 +333,44 @@ def test_fused_bench_acceptance_on_cpu_tiny():
     assert on["ttft_s_p50"] > 0 and on["tpot_s_p50"] > 0
 
 
+def test_kvfabric_key_promotes_warm_ttft_ratio():
+    # PR-17 tentpole: the KV fabric bench publishes under its own key
+    # and dispatches as its own variant (never banking as another bench)
+    assert promote.KEYS["kvfabric"] == "kvfabric_warm_ttft_ratio"
+    bspec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(ROOT, "bench.py"))
+    bench = importlib.util.module_from_spec(bspec)
+    bspec.loader.exec_module(bench)
+    assert bench._which_from_argv(["bench.py", "kvfabric"]) == "kvfabric"
+    assert bench._which_from_argv(["bench.py", "--inner", "kvfabric",
+                                   "--cpu"]) == "kvfabric"
+    assert bench.UNITS_BY_BENCH["kvfabric"] == "x"
+    assert promote.is_real(_entry(metric="kvfabric warm ttft ratio (tpu)",
+                                  unit="x"))
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_kvfabric_bench_acceptance_on_cpu_tiny():
+    """The PR-17 acceptance numbers, measured: under the shared-system-
+    prompt load the fabric-on engine probe-pulls every round's run from
+    the holder pod (remote_hits > 0 through the REAL KvNetClient path),
+    no transport error occurred (errors REQUIRED 0), and greedy output
+    is token-exact vs fabric-off (asserted inside the bench — a ratio
+    from a degraded run never prints). The >1 TTFT win claim belongs to
+    real-geometry runs; cpu-tiny asserts sanity."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--inner",
+         "kvfabric", "--cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu" and out["unit"] == "x"
+    assert out["errors"] == 0, out
+    assert out["kvfabric"]["remote_hits"] > 0, out
+    assert out["value"] > 0
+    assert out["off_ttft_p50_ms"] > 0 and out["on_ttft_p50_ms"] > 0
+
+
 @pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_disagg_bench_acceptance_on_cpu_tiny():
     """The PR-14 acceptance number, measured: under the long mixed-prompt

@@ -1,0 +1,881 @@
+"""Fleet-wide KV fabric (kvnet/directory.py): content-addressed prefix
+pool with directory routing, peer-probe admission, and hot-prefix
+replication.
+
+THE invariant, one layer up from kvnet's: the DIRECTORY changes where KV
+bytes are looked for — never what gets generated. Fabric-off is a strict
+no-op (the admission ladder is byte-identical to the pre-fabric engine);
+fabric-on is greedy token-exact vs fabric-off across both async
+disciplines and both KV dtypes; a stale directory entry (holder evicted
+between advertise and probe) degrades to recompute and counts
+``stale_holders``; injected ``kvfabric.probe`` faults degrade token-exact
+with pool-exact accounting and open the holder's breaker; the host tier's
+incremental advertisement equals a walk-based oracle; and the live
+two-pod suite proves a prompt prefilled on pod A admits warm on pod B
+over real sockets with ``shai_kvfabric_*`` live on /metrics.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+from scalable_hw_agnostic_inference_tpu.engine.engine import (
+    LLMEngine,
+    SamplingParams,
+)
+from scalable_hw_agnostic_inference_tpu.kvnet import frames
+from scalable_hw_agnostic_inference_tpu.kvnet.client import (
+    KvNetClient,
+    KvNetStats,
+)
+from scalable_hw_agnostic_inference_tpu.kvnet.directory import (
+    FabricProbe,
+    KvDirectory,
+    KvFabricStats,
+    fabric_enabled,
+    resolve_fabric_peers,
+)
+from scalable_hw_agnostic_inference_tpu.kvtier.pool import HostKVTier
+from scalable_hw_agnostic_inference_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+from scalable_hw_agnostic_inference_tpu.resilience import faults as rz_faults
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, params
+
+
+def make_engine(tiny_model, monkeypatch, role="both", tier=True, quant=False,
+                async_decode=None, fabric=False, **over):
+    cfg, _, params = tiny_model
+    monkeypatch.setenv("SHAI_KVTIER", "1" if tier else "0")
+    monkeypatch.setenv("SHAI_KVTIER_ASYNC", "0")
+    monkeypatch.setenv("SHAI_KV_QUANT", "int8" if quant else "")
+    monkeypatch.setenv("SHAI_KVFABRIC", "1" if fabric else "0")
+    if async_decode is not None:
+        monkeypatch.setenv("SHAI_ASYNC_DECODE", "1" if async_decode else "0")
+    kw = dict(max_model_len=128, max_num_seqs=3, block_size=8,
+              context_encoding_buckets=(16, 32), max_new_tokens=16,
+              enable_prefix_caching=True, role=role)
+    kw.update(over)
+    return LLMEngine(cfg, params, EngineConfig(**kw))
+
+
+def _prompt(seed, length=40):
+    rng = np.random.default_rng(seed)
+    return [int(x) for x in rng.integers(2, 500, length)]
+
+
+def _run_all(eng, prompts, sp, kv_holders=None):
+    ids = [eng.add_request(list(p), sp, kv_holders=kv_holders)
+           for p in prompts]
+    done = {}
+    while eng.has_work:
+        for f in eng.step():
+            done[f.req_id] = f
+    eng.finish_pending()
+    return [done[i] for i in ids]
+
+
+def _assert_pool_exact(eng):
+    cache = eng.cache
+    assert cache.active == []
+    used = (cache.total_blocks - 1) - cache.allocator.n_free
+    assert used == len(cache._block2hash)
+    assert cache.leaked_blocks == 0
+    tier = cache.tier
+    if tier is not None:
+        tier.drain()
+        snap = tier.snapshot()
+        assert snap["used_bytes"] == snap["entries"] * snap["block_nbytes"]
+        assert snap["used_bytes"] <= snap["capacity_bytes"]
+
+
+def _tier(capacity_blocks=8, quant=False):
+    t = HostKVTier(n_layers=2, block_size=4, n_kv_heads=2, head_dim=4,
+                   dtype=np.int8 if quant else np.float32,
+                   capacity_bytes=0, async_copy=False, quant=quant)
+    t.capacity_bytes = capacity_blocks * t.block_nbytes
+    return t
+
+
+def _blockdata(tier, n, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (tier.n_layers, n, tier.block_size, tier.n_kv_heads,
+             tier.head_dim)
+    if tier.quant:
+        sc = (tier.n_layers, n, tier.n_kv_heads)
+        return ((rng.standard_normal(shape) * 20).astype(np.int8),
+                (rng.standard_normal(shape) * 20).astype(np.int8),
+                rng.standard_normal(sc).astype(np.float32),
+                rng.standard_normal(sc).astype(np.float32))
+    return (rng.standard_normal(shape).astype(tier.dtype),
+            rng.standard_normal(shape).astype(tier.dtype))
+
+
+def _fabric_handler(src_tier):
+    """Pod-A-in-process: /kv/blocks + /kv/digests served from a tier
+    through httpx.MockTransport — the REAL client path minus the socket."""
+    httpx = pytest.importorskip("httpx")
+
+    def handler(request):
+        if request.url.path == "/kv/blocks":
+            hashes = [int(h) for h in
+                      request.url.params["hashes"].split(",")]
+            return httpx.Response(
+                200, content=frames.encode_frames(src_tier.get_run(hashes)))
+        if request.url.path == "/kv/digests":
+            head = request.url.params.get("head")
+            if head is not None:
+                return httpx.Response(200, json={
+                    "head": int(head),
+                    "hashes": src_tier.run_hashes(int(head))})
+            return httpx.Response(200,
+                                  json={"adverts": src_tier.advertisement()})
+        return httpx.Response(404)
+
+    return handler
+
+
+def _arm(eng, handler, peers=()):
+    """Attach a FabricProbe whose transport is the mock handler — the
+    bench and the engine tests share this seam."""
+    httpx = pytest.importorskip("httpx")
+    client = KvNetClient(eng.cache.tier,
+                         getattr(eng.obs, "kvnet", None) or KvNetStats(),
+                         transport=httpx.MockTransport(handler),
+                         connect_retries=0)
+    fab = FabricProbe(eng.cache.tier, peers=list(peers), client=client)
+    eng._kvfabric = fab
+    eng.obs.kvfabric = fab.stats
+    return fab
+
+
+# -- env gate -----------------------------------------------------------------
+
+def test_fabric_enabled_gate_and_peers(monkeypatch):
+    monkeypatch.delenv("SHAI_KVFABRIC", raising=False)
+    monkeypatch.delenv("SHAI_KVFABRIC_PEERS", raising=False)
+    assert not fabric_enabled()
+    monkeypatch.setenv("SHAI_KVFABRIC", "1")
+    assert fabric_enabled()
+    monkeypatch.setenv("SHAI_KVFABRIC", "0")
+    assert not fabric_enabled()
+    # a static peer list arms the fabric implicitly (migration's pattern)
+    monkeypatch.setenv("SHAI_KVFABRIC_PEERS",
+                       "http://a:8000, http://b:8000/")
+    assert fabric_enabled()
+    assert resolve_fabric_peers() == ["http://a:8000", "http://b:8000"]
+
+
+# -- KvDirectory units --------------------------------------------------------
+
+def test_directory_update_holders_and_ranking():
+    d = KvDirectory(ttl_s=60)
+    d.update_holder("http://a", [{"head": 1, "n": 4, "seq": 9}])
+    d.update_holder("http://b/", [{"head": 1, "n": 6, "seq": 2},
+                                  {"head": 2, "n": 1, "seq": 3}])
+    # longest advertised run first; trailing slash normalized away
+    assert d.holders_of(1) == ["http://b", "http://a"]
+    assert d.holders_of(2) == ["http://b"]
+    assert d.holders_of(None) == [] and d.holders_of(999) == []
+    assert d.size() == 2
+    # a fresh advertisement RETIRES the holder's dropped heads
+    d.update_holder("http://b", [{"head": 2, "n": 1, "seq": 4}])
+    assert d.holders_of(1) == ["http://a"]
+    # an empty advertisement retires the holder entirely
+    d.update_holder("http://a", [])
+    assert d.holders_of(1) == []
+    assert d.size() == 1
+    # malformed entries are skipped, never raised (network input)
+    d.update_holder("http://c", [{"n": 3}, "bogus", {"head": "x"},
+                                 {"head": 7, "n": 2, "seq": 1}])
+    assert d.holders_of(7) == ["http://c"]
+
+
+def test_directory_affinity_hits_and_sole_holders():
+    d = KvDirectory(ttl_s=60)
+    d.note_affinity("aff1", 11)
+    assert d.head_of("aff1") == 11 and d.head_of("nope") is None
+    d.update_holder("http://a", [{"head": 11, "n": 4, "seq": 1}])
+    d.update_holder("http://b", [{"head": 11, "n": 4, "seq": 1},
+                                 {"head": 12, "n": 2, "seq": 2}])
+    assert d.sole_holders() == {12: "http://b"}
+    assert d.note_hit(11) == 1
+    assert d.note_hit(11) == 2
+    assert d.note_hit(12) == 1
+    assert d.hot_heads(2) == [(11, 2)]
+    assert d.hot_heads(1) == [(11, 2), (12, 1)]
+
+
+def test_directory_prune_ages_out_silent_holders():
+    d = KvDirectory(ttl_s=10.0)
+    d.update_holder("http://a", [{"head": 1, "n": 2, "seq": 1}], now=100.0)
+    d.update_holder("http://b", [{"head": 1, "n": 2, "seq": 1}], now=105.0)
+    assert d.prune(now=112.0) == 1          # a unseen for 12s > ttl
+    assert d.holders_of(1) == ["http://b"]
+    assert d.prune(now=130.0) == 1
+    assert d.size() == 0
+    snap = d.snapshot()
+    assert snap["directory_size"] == 0 and snap["holders"] == 0
+
+
+# -- host tier advertisement: incremental == walk-based oracle ----------------
+
+def _adv_oracle(t, chains):
+    """Walk-based oracle: per stored chain, the leading resident length
+    via t.has() — what a peer's probe could actually pull."""
+    out = {}
+    for hashes in chains:
+        n = 0
+        for h in hashes:
+            if not t.has(h):
+                break
+            n += 1
+        if n:
+            out[hashes[0]] = n
+    return out
+
+
+def _adv_map(t):
+    return {a["head"]: a["n"] for a in t.advertisement()}
+
+
+def test_advertisement_matches_walk_oracle_through_lifecycle():
+    """The bugfix satellite, pinned: the advertisement is maintained
+    incrementally on store/touch/evict (O(1) amortized — /stats polls
+    previously walked every entry), and at every lifecycle step it equals
+    the walk-based oracle."""
+    t = _tier(capacity_blocks=8)
+    a = [1, 2, 3, 4, 5]
+    b = [10, 11, 12]
+    t.store_batch(a, *_blockdata(t, 5), 5)
+    t.store_batch(b, *_blockdata(t, 3, seed=1), 3)
+    assert _adv_map(t) == _adv_oracle(t, [a, b]) == {1: 5, 10: 3}
+    # most-recent run first in the bounded export
+    assert [x["head"] for x in t.advertisement()] == [10, 1]
+    assert t.run_hashes(1) == a and t.run_hashes(10) == b
+    assert t.run_hashes(999) == []
+    # a re-demotion extending the tail grows the SAME run (store-
+    # adjacency: the batch overlaps the tail, chain order preserved)
+    t2 = _tier(capacity_blocks=16)
+    t2.store_batch(a, *_blockdata(t2, 5), 5)
+    # blocks 4,5 are already resident (touch); 6,7 chain off tail 5
+    t2.store_batch([4, 5, 6, 7], *_blockdata(t2, 4, seed=2), 4)
+    assert _adv_map(t2) == {1: 7}
+    assert t2.run_hashes(1) == [1, 2, 3, 4, 5, 6, 7]
+    # mid-run eviction truncates the run AT the victim: blocks chained
+    # past it are unreachable by a leading-run walk and stop advertising
+    t.get_run([1, 2])                        # 1,2 most recent; 3 is LRU
+    t.get_run(b)
+    t.store_batch([20], *_blockdata(t, 1, seed=3), 1)   # evicts 3
+    assert not t.has(3) and t.has(4) and t.has(5)
+    assert _adv_map(t) == _adv_oracle(t, [a, b, [20]]) == \
+        {1: 2, 10: 3, 20: 1}
+    # head eviction drops the whole run from the advertisement
+    t3 = _tier(capacity_blocks=4)
+    t3.store_batch([1, 2], *_blockdata(t3, 2), 2)
+    t3.store_batch([10, 11], *_blockdata(t3, 2, seed=1), 2)
+    t3.store_batch([20], *_blockdata(t3, 1, seed=2), 1)  # evicts head 1
+    assert not t3.has(1)
+    assert _adv_map(t3) == _adv_oracle(t3, [[1, 2], [10, 11], [20]])
+    assert 1 not in _adv_map(t3)
+
+
+def test_advertisement_is_bounded():
+    t = _tier(capacity_blocks=80)
+    for i in range(70):                      # 70 single-block runs
+        t.store_batch([1000 + i], *_blockdata(t, 1, seed=i), 1)
+    assert len(t.advertisement()) == 64      # ADVERT_MAX_RUNS
+    assert len(t.advertisement(limit=5)) == 5
+    # most recent first: the newest stores win the bounded export
+    assert t.advertisement()[0]["head"] == 1069
+
+
+def test_protect_defers_eviction_one_cycle_capacity_wins():
+    """Last-holder eviction deferral: a protected run's blocks are
+    skipped by the LRU scan until the mark expires; when EVERYTHING is
+    protected, capacity wins and the oldest goes anyway."""
+    t = _tier(capacity_blocks=4)
+    t.store_batch([1, 2], *_blockdata(t, 2), 2)
+    t.store_batch([10, 11], *_blockdata(t, 2, seed=1), 2)
+    assert t.protect([1], ttl_s=30.0) == 1
+    # pressure: the protected run [1,2] is skipped, [10,11] evicts
+    t.store_batch([20, 21], *_blockdata(t, 2, seed=2), 2)
+    assert t.has(1) and t.has(2)
+    assert not t.has(10) and not t.has(11)
+    # everything protected: capacity still wins (defer, never wedge)
+    assert t.protect([1, 20], ttl_s=30.0) == 2
+    t.store_batch([30], *_blockdata(t, 1, seed=3), 1)
+    assert t.snapshot()["entries"] == 4
+    # expired marks are swept; eviction returns to plain LRU
+    t2 = _tier(capacity_blocks=2)
+    t2.store_batch([1, 2], *_blockdata(t2, 2), 2)
+    t2.protect([1], ttl_s=0.0)
+    time.sleep(0.01)
+    t2.store_batch([3], *_blockdata(t2, 1, seed=1), 1)
+    assert not t2.has(1)                     # protection lapsed
+    assert t2.protect([], ttl_s=1.0) == 0    # the sweep dropped the mark
+
+
+# -- FabricProbe: stale-vs-miss accounting ------------------------------------
+
+def test_probe_pulls_run_and_counts_remote_hit():
+    src, dst = _tier(8), _tier(8)
+    src.store_batch([1, 2, 3], *_blockdata(src, 3), 3)
+    stats = KvFabricStats()
+    httpx = pytest.importorskip("httpx")
+    client = KvNetClient(dst, KvNetStats(),
+                         transport=httpx.MockTransport(_fabric_handler(src)),
+                         connect_retries=0)
+    fab = FabricProbe(dst, stats=stats, peers=[], client=client)
+    assert fab.probe([1, 2, 3], ["http://holder"], budget_s=5.0) == 3
+    assert dst.has(1) and dst.has(2) and dst.has(3)
+    snap = stats.snapshot()
+    assert snap["probes"] == 1 and snap["remote_hits"] == 1
+    assert snap["remote_misses"] == 0 and snap["stale_holders"] == 0
+    # degenerate inputs never count a probe
+    assert fab.probe([], ["http://holder"], 5.0) == 0
+    assert fab.probe([1], [], 5.0) == 0
+    assert fab.probe([1], ["http://holder"], 0.0) == 0
+    assert stats.snapshot()["probes"] == 1
+
+
+def test_probe_stale_holder_vs_transport_miss_are_distinct():
+    """The runbook contrast, pinned: a holder that ANSWERS cleanly but
+    holds nothing (advertisement outlived the blocks — directory TTL too
+    long) counts ``stale_holders``; an unreachable holder (under-
+    replication) counts only ``remote_misses``."""
+    httpx = pytest.importorskip("httpx")
+    src, dst = _tier(4), _tier(8)
+    src.store_batch([1, 2], *_blockdata(src, 2), 2)
+    # evict everything the holder advertised (between advertise & probe)
+    src.store_batch([50, 51, 52, 53], *_blockdata(src, 4, seed=1), 4)
+    assert not src.has(1)
+    stats = KvFabricStats()
+    client = KvNetClient(dst, KvNetStats(),
+                         transport=httpx.MockTransport(_fabric_handler(src)),
+                         connect_retries=0)
+    fab = FabricProbe(dst, stats=stats, peers=[], client=client)
+    assert fab.probe([1, 2], ["http://holder"], budget_s=5.0) == 0
+    snap = stats.snapshot()
+    assert snap["remote_misses"] == 1 and snap["stale_holders"] == 1
+
+    def dead(request):
+        raise httpx.ConnectError("refused")
+
+    client2 = KvNetClient(dst, KvNetStats(),
+                          transport=httpx.MockTransport(dead),
+                          connect_retries=0)
+    fab2 = FabricProbe(dst, stats=stats, peers=[], client=client2)
+    assert fab2.probe([1, 2], ["http://gone"], budget_s=5.0) == 0
+    snap = stats.snapshot()
+    assert snap["remote_misses"] == 2
+    assert snap["stale_holders"] == 1        # unchanged: a REAL fault
+
+
+def test_probe_static_peers_directory_refresh():
+    """SHAI_KVFABRIC_PEERS mode: holders_for refreshes the pod-local
+    directory from each peer's /kv/digests on a TTL, and the probe then
+    pulls from the resolved holder."""
+    src, dst = _tier(8), _tier(8)
+    src.store_batch([1, 2, 3], *_blockdata(src, 3), 3)
+    httpx = pytest.importorskip("httpx")
+    stats = KvFabricStats()
+    client = KvNetClient(dst, KvNetStats(),
+                         transport=httpx.MockTransport(_fabric_handler(src)),
+                         connect_retries=0)
+    fab = FabricProbe(dst, stats=stats, peers=["http://holder"],
+                      client=client, ttl_s=30.0)
+    assert fab.holders_for(1) == ["http://holder"]
+    assert stats.snapshot()["directory_size"] == 1
+    assert fab.probe([1, 2, 3], fab.holders_for(1), budget_s=5.0) == 3
+    # no peers configured -> no directory, no holders (cova pushes down)
+    fab2 = FabricProbe(dst, peers=[], client=client)
+    assert fab2.holders_for(1) == []
+
+
+# -- engine differential: fabric-on == fabric-off, fabric-off is a no-op ------
+
+def _fabric_differential(tiny_model, monkeypatch, quant=False,
+                         async_decode=None):
+    sp1 = SamplingParams(temperature=0.0, max_new_tokens=1)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    prompt = _prompt(5, 40)
+    holder = make_engine(tiny_model, monkeypatch, role="prefill",
+                         quant=quant, async_decode=async_decode)
+    plain = make_engine(tiny_model, monkeypatch, role="both", tier=False,
+                        quant=quant, async_decode=async_decode)
+    fabric = make_engine(tiny_model, monkeypatch, role="both", quant=quant,
+                         async_decode=async_decode)
+    _run_all(holder, [prompt], sp1)          # bank the run on the holder
+    hashes = holder.cache.prefix_hashes(prompt)
+    assert holder.cache.tier.n_entries == len(hashes) > 0
+    fab = _arm(fabric, _fabric_handler(holder.cache.tier))
+    [ff] = _run_all(fabric, [prompt], sp, kv_holders=["http://holder"])
+    [fp] = _run_all(plain, [prompt], sp)
+    assert ff.token_ids == fp.token_ids, \
+        "fabric-restored decode diverged from the fabric-off oracle"
+    snap = fab.stats.snapshot()
+    assert snap["probes"] == 1 and snap["remote_hits"] == 1
+    assert fabric.cache.tier.snapshot()["restored"] > 0, \
+        "admission never used the probed run"
+    assert fabric.obs.kvnet.snapshot()["errors"] == 0
+    _assert_pool_exact(holder)
+    _assert_pool_exact(fabric)
+    return fabric
+
+
+def test_fabric_differential_greedy(tiny_model, monkeypatch):
+    _fabric_differential(tiny_model, monkeypatch)
+
+
+def test_fabric_differential_lockstep_discipline(tiny_model, monkeypatch):
+    _fabric_differential(tiny_model, monkeypatch, async_decode=False)
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_fabric_differential_async_discipline(tiny_model, monkeypatch):
+    _fabric_differential(tiny_model, monkeypatch, async_decode=True)
+
+
+def test_fabric_differential_int8_byte_exact(tiny_model, monkeypatch):
+    eng = _fabric_differential(tiny_model, monkeypatch, quant=True)
+    assert eng.cache.tier.quant
+
+
+def test_fabric_off_is_strict_noop(tiny_model, monkeypatch):
+    """With the fabric off (the default), the engine builds NO probe, a
+    kv_holders hint on the request is inert, and generation matches the
+    tier-less oracle token-exact — the pre-fabric admission ladder
+    verbatim."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    prompt = _prompt(9, 40)
+    eng = make_engine(tiny_model, monkeypatch, role="both")
+    assert eng._kvfabric is None
+    assert getattr(eng.obs, "kvfabric", None) is None
+    plain = make_engine(tiny_model, monkeypatch, role="both", tier=False)
+    [f1] = _run_all(eng, [prompt], sp, kv_holders=["http://nowhere"])
+    [f2] = _run_all(plain, [prompt], sp)
+    assert f1.token_ids == f2.token_ids
+    _assert_pool_exact(eng)
+
+
+def test_fabric_armed_by_env_constructs_probe(tiny_model, monkeypatch):
+    eng = make_engine(tiny_model, monkeypatch, fabric=True)
+    assert eng._kvfabric is not None
+    assert eng.obs.kvfabric is eng._kvfabric.stats
+    # tier off: no fabric even when armed (nothing to publish into)
+    eng2 = make_engine(tiny_model, monkeypatch, tier=False, fabric=True)
+    assert eng2._kvfabric is None
+
+
+def test_fabric_probe_priced_out_by_deadline(tiny_model, monkeypatch):
+    """The priced rung: with a request deadline whose headroom is below
+    the projected recompute savings, the probe is skipped outright (no
+    network work at all) — the remaining budget belongs to recompute."""
+
+    class _Rate:
+        projected_per_s = 0.001          # savings = blocks*bs/rate: huge
+
+        @staticmethod
+        def record_step(**kw):
+            return False                 # never trips the sentinel
+
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    prompt = _prompt(12, 40)
+    holder = make_engine(tiny_model, monkeypatch, role="prefill")
+    _run_all(holder, [prompt],
+             SamplingParams(temperature=0.0, max_new_tokens=1))
+    fabric = make_engine(tiny_model, monkeypatch, role="both")
+    fab = _arm(fabric, _fabric_handler(holder.cache.tier))
+    fabric.obs.sentinel = _Rate()
+    rid = fabric.add_request(list(prompt), sp,
+                             deadline_at=time.monotonic() + 30.0,
+                             kv_holders=["http://holder"])
+    done = {}
+    while fabric.has_work:
+        for f in fabric.step():
+            done[f.req_id] = f
+    fabric.finish_pending()
+    assert done[rid].stop_reason in ("length", "eos")
+    assert fab.stats.snapshot()["probes"] == 0, \
+        "priced-out rung still probed"
+    assert fabric.cache.tier.snapshot()["restored"] == 0
+    _assert_pool_exact(fabric)
+
+
+# -- chaos: kvfabric.probe fault site -----------------------------------------
+
+def test_chaos_probe_fault_degrades_token_exact_and_opens_breaker(
+        tiny_model, monkeypatch):
+    """SHAI_FAULTS site kvfabric.probe: every injected probe failure
+    degrades to recompute (token-exact vs the fabric-off oracle, pool-
+    exact accounting on both pods) and is breaker-counted — repeated
+    failures OPEN the circuit on that holder."""
+    sp1 = SamplingParams(temperature=0.0, max_new_tokens=1)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    prompts = [_prompt(20 + i, 40) for i in range(4)]
+    holder = make_engine(tiny_model, monkeypatch, role="prefill")
+    plain = make_engine(tiny_model, monkeypatch, role="both", tier=False)
+    fabric = make_engine(tiny_model, monkeypatch, role="both")
+    _run_all(holder, prompts, sp1)
+    fab = _arm(fabric, _fabric_handler(holder.cache.tier))
+    rz_faults.configure("kvfabric.probe=error", 0)
+    try:
+        for p in prompts:
+            [ff] = _run_all(fabric, [p], sp,
+                            kv_holders=["http://holder"])
+            [fp] = _run_all(plain, [p], sp)
+            assert ff.token_ids == fp.token_ids
+    finally:
+        rz_faults.reset()
+    snap = fab.stats.snapshot()
+    assert snap["probes"] == 4
+    assert snap["remote_hits"] == 0 and snap["remote_misses"] == 4
+    assert snap["stale_holders"] == 0        # real faults, not staleness
+    assert fab.client.stats.snapshot()["errors"] >= 4
+    assert fab.client.breaker_of("http://holder").state != "closed"
+    assert fabric.cache.tier.snapshot()["restored"] == 0
+    _assert_pool_exact(fabric)
+    _assert_pool_exact(holder)
+    # faults lifted + the open interval elapsed: the half-open probe
+    # succeeds and the rung recovers on its own
+    br = fab.client.breaker_of("http://holder")
+    time.sleep(min(br.retry_after_s + 0.05, 10.0))
+    p = _prompt(99, 40)
+    _run_all(holder, [p], sp1)
+    [ff] = _run_all(fabric, [p], sp, kv_holders=["http://holder"])
+    assert fab.stats.snapshot()["remote_hits"] == 1
+
+
+# -- metrics export -----------------------------------------------------------
+
+def test_metrics_collector_exports_kvfabric_family():
+    prom = pytest.importorskip("prometheus_client")
+    del prom
+    from scalable_hw_agnostic_inference_tpu.obs.steploop import StepTelemetry
+    from scalable_hw_agnostic_inference_tpu.serve.metrics import (
+        EngineTelemetryCollector,
+    )
+
+    tele = StepTelemetry(total_blocks=8)
+    tele.kvfabric = KvFabricStats()
+    tele.kvfabric.count("probes")
+    tele.kvfabric.count("remote_hits")
+    tele.kvfabric.count("stale_holders", 2)
+    tele.kvfabric.set_directory_size(5)
+    fams = {m.name: m for m in
+            EngineTelemetryCollector(lambda: tele, "t").collect()}
+    # prometheus strips _total from counter FAMILY names
+    for fam in ("shai_kvfabric_probes", "shai_kvfabric_remote_hits",
+                "shai_kvfabric_remote_misses",
+                "shai_kvfabric_replications",
+                "shai_kvfabric_directory_size",
+                "shai_kvfabric_stale_holders"):
+        assert fam in fams, fam
+    assert fams["shai_kvfabric_stale_holders"].samples[0].value == 2.0
+    assert fams["shai_kvfabric_directory_size"].samples[0].value == 5.0
+    # fabric-off pods export nothing
+    bare = StepTelemetry(total_blocks=8)
+    assert not any(n.startswith("shai_kvfabric")
+                   for n in {m.name for m in EngineTelemetryCollector(
+                       lambda: bare, "t").collect()})
+
+
+# -- cova: directory ingest, routing, replication -----------------------------
+
+def _dir_client(models=None):
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        CovaClient,
+    )
+
+    return CovaClient(models or {"a": {"url": "http://a"},
+                                 "b": {"url": "http://b"}})
+
+
+def test_cova_ingests_adverts_and_aff_heads():
+    c = _dir_client()
+    c._ingest_fabric({
+        "a": {"kvtier": {"adverts": [{"head": 7, "n": 4, "seq": 1}],
+                         "aff_heads": {"aff7": 7}}},
+        "b": {"kvtier": {"adverts": [{"head": 7, "n": 2, "seq": 1}]}},
+        "down": {"error": "unreachable"},    # not in models: skipped
+    })
+    assert c._kv_dir.head_of("aff7") == 7
+    assert c._kv_dir.holders_of(7) == ["http://a", "http://b"]
+    # malformed aff_heads values are skipped
+    c._ingest_fabric({"a": {"kvtier": {"aff_heads": {"bad": "x"}}}})
+    assert c._kv_dir.head_of("bad") is None
+
+
+def test_cova_rank_backends_prefers_actual_holders():
+    from scalable_hw_agnostic_inference_tpu.kvtier.affinity import (
+        prompt_affinity,
+    )
+    from scalable_hw_agnostic_inference_tpu.orchestrate.cova import (
+        CovaClient,
+    )
+
+    prompt = "the shared system prompt"
+    fleet = {"models": {
+        "warm": {"kvtier": {"affinity": [prompt_affinity(prompt)]}},
+        "hold": {}, "cold": {}}, "overloaded": []}
+    order = ["cold", "warm", "hold"]
+    # an advertised HOLDER beats a digest-affinity guess
+    ranked, warm = CovaClient.rank_backends(prompt, order, fleet,
+                                            holders=["hold"])
+    assert ranked == ["hold", "warm", "cold"]
+    assert warm == ["hold", "warm"]
+    # overloaded holders lose the preference
+    fleet2 = dict(fleet, overloaded=["hold"])
+    ranked2, warm2 = CovaClient.rank_backends(prompt, order, fleet2,
+                                              holders=["hold"])
+    assert ranked2 == ["warm", "cold", "hold"]
+    assert warm2 == ["warm"]
+    # no holders: the pre-fabric contract verbatim
+    ranked3, warm3 = CovaClient.rank_backends(prompt, order, fleet)
+    assert ranked3 == ["warm", "cold", "hold"] and warm3 == ["warm"]
+
+
+def test_cova_generate_pushes_holder_slice_down():
+    from scalable_hw_agnostic_inference_tpu.kvtier.affinity import (
+        prompt_affinity,
+    )
+
+    c = _dir_client()
+    prompt = "a routed prompt"
+    aff = prompt_affinity(prompt)
+    c._kv_dir.note_affinity(aff, 77)
+    c._kv_dir.update_holder("http://a", [{"head": 77, "n": 3, "seq": 1}])
+    calls = []
+
+    async def fake_post(name, route, payload):
+        calls.append((name, dict(payload)))
+        return {"generated_text": "t", "n_tokens": 2, "n_prompt": 4,
+                "stop_reason": "length"}
+
+    async def fake_fleet():
+        return {"models": {"a": {}, "b": {}}, "overloaded": []}
+
+    c.post = fake_post
+    c._fleet_for_routing = fake_fleet
+    out = asyncio.run(c.generate(prompt, {}))
+    # the holder itself is ranked first -> routed to a, and its OWN url
+    # is excluded from the pushed-down slice (nothing left to push)
+    assert out["model"] == "a" and out["routed_by"] == "affinity"
+    assert "kv_holders" not in calls[0][1]
+    # routing recorded a hit (the replication trigger)
+    assert c._kv_dir.hot_heads(1) == [(77, 1)]
+    # force the request onto the non-holder: the slice rides the payload
+    calls.clear()
+    out2 = asyncio.run(c.generate(prompt, {}, names=["b"]))
+    assert out2["model"] == "b"
+    assert calls[0][1]["kv_holders"] == ["http://a"]
+
+
+def test_cova_fabric_maintenance_protects_and_replicates():
+    """ONE maintenance pass: sole-holder heads get /kv/protect on their
+    holder (eviction deferral), hot under-replicated heads get /kv/pull
+    pushed to an under-warmed pod with the holder as source."""
+    c = _dir_client()
+    c._kv_dir.update_holder("http://a", [{"head": 7, "n": 4, "seq": 1}])
+    for _ in range(c._fab_hot_n):
+        c._kv_dir.note_hit(7)
+    posts = []
+
+    async def fake_post_url(url, route, payload):
+        posts.append((url, route, dict(payload)))
+        return {}
+
+    c._post_url = fake_post_url
+    asyncio.run(c._fabric_maintain())
+    routes = {(u, r) for u, r, _ in posts}
+    assert ("http://a", "/kv/protect") in routes
+    assert ("http://b", "/kv/pull") in routes
+    pull = next(p for u, r, p in posts if r == "/kv/pull")
+    assert pull == {"source": "http://a", "head": 7}
+    prot = next(p for u, r, p in posts if r == "/kv/protect")
+    assert prot["heads"] == [7] and prot["ttl_s"] > 0
+    assert c._fab_busy is False
+    # fully replicated: no further pulls
+    posts.clear()
+    c._kv_dir.update_holder("http://b", [{"head": 7, "n": 4, "seq": 1}])
+    asyncio.run(c._fabric_maintain())
+    assert not any(r == "/kv/pull" for _, r, _p in posts)
+
+
+def test_cova_fleet_snapshot_carries_kvfabric_section():
+    c = _dir_client()
+    c._kv_dir.update_holder("http://a", [{"head": 7, "n": 4, "seq": 1}])
+    snap = c._kv_dir.snapshot()
+    assert snap == {"directory_size": 1.0, "holders": 1.0,
+                    "sole_holders": 1.0, "routing_hits": 0.0}
+
+
+# -- live: two pods over real sockets -----------------------------------------
+
+def _write_vllm_yaml(path, role):
+    path.write_text(
+        "model: tiny\nmax_model_len: 256\nblock_size: 16\n"
+        "max_num_seqs: 4\ncontext_encoding_buckets: [32, 64, 128]\n"
+        "enable_prefix_caching: true\nmax_new_tokens: 16\n"
+        f"role: {role}\n")
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def fabric_pods(tmp_path_factory):
+    """A prefill pod (the holder) + a both-role pod with the fabric
+    armed, on loopback sockets."""
+    from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+    from scalable_hw_agnostic_inference_tpu.serve.httpd import Server
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    httpx = pytest.importorskip("httpx")
+    from test_serve_http import wait_ready_sync
+
+    saved = {k: os.environ.get(k)
+             for k in ("SHAI_KVTIER", "SHAI_KVTIER_ASYNC", "SHAI_ROLE",
+                       "SHAI_KVFABRIC", "SHAI_KVNET_PEER_URL")}
+    os.environ["SHAI_KVTIER"] = "1"
+    os.environ["SHAI_KVTIER_ASYNC"] = "0"
+    os.environ["SHAI_KVFABRIC"] = "1"
+    os.environ.pop("SHAI_ROLE", None)
+    os.environ.pop("SHAI_KVNET_PEER_URL", None)
+    tmp = tmp_path_factory.mktemp("kvfabric")
+    servers, services, urls = [], {}, {}
+    try:
+        for name, role in (("hold", "prefill"), ("pod", "both")):
+            cfg = ServeConfig(
+                app=name, model_id="tiny", device="cpu", max_new_tokens=16,
+                vllm_config=_write_vllm_yaml(tmp / f"{name}.yaml", role))
+            svc = get_model("vllm")(cfg)
+            srv = Server(create_app(cfg, svc), port=0)
+            srv.start_background()
+            servers.append(srv)
+            services[name] = svc
+            urls[name] = f"http://127.0.0.1:{srv.port}"
+        for u in urls.values():
+            with httpx.Client(base_url=u) as c:
+                r = wait_ready_sync(c, timeout=300.0)
+                assert r.status_code == 200, r.text
+        yield urls, services
+    finally:
+        for s in servers:
+            s.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+@pytest.mark.asyncio
+async def test_kvfabric_live_over_sockets(fabric_pods):
+    """THE acceptance run: a prompt prefilled on the holder pod admits
+    warm on the other pod via a pushed-down holder slice over real
+    sockets — remote_hits counted, runs restored, every shai_kvfabric_*
+    family live on both /metrics, /kv/digests serving the advertisement,
+    /kv/protect deferring eviction, and /kv/pull replicating a run."""
+    import httpx
+
+    urls, services = fabric_pods
+    prompt = ("the fleet-wide shared system prompt that every request "
+              "carries in front of its own question, long enough to "
+              "span several kv blocks on the tiny byte tokenizer")
+    async with httpx.AsyncClient(base_url=urls["hold"]) as hc:
+        r = await hc.post("/generate", json={"prompt": prompt})
+        assert r.status_code == 200 and r.json()["kv_ready"], r.text
+        # the advertisement is live on /kv/digests and /stats
+        adv = (await hc.get("/kv/digests")).json()["adverts"]
+        assert adv and adv[0]["n"] > 0
+        head = adv[0]["head"]
+        run = (await hc.get(f"/kv/digests?head={head}")).json()
+        assert run["head"] == head and len(run["hashes"]) == adv[0]["n"]
+        st = (await hc.get("/stats")).json()
+        assert st["kvtier"]["adverts"][0]["head"] == head
+        assert st["kvtier"]["aff_heads"]          # text-digest -> head
+
+    async with httpx.AsyncClient(base_url=urls["pod"]) as pc:
+        # the probe rung: holder slice pushed down with the request
+        r = await pc.post("/generate", json={
+            "prompt": prompt, "temperature": 0.0, "logprobs": 1,
+            "max_new_tokens": 8, "kv_holders": [urls["hold"]]})
+        assert r.status_code == 200, r.text
+        out = r.json()
+        assert out["n_tokens"] == 8
+        warm_toks = [e["token"] for e in out["logprobs"]]
+        st = (await pc.get("/stats")).json()
+        assert st["kvfabric"]["probes"] >= 1
+        assert st["kvfabric"]["remote_hits"] >= 1
+        assert st["kvtier"]["restored"] > 0, \
+            "admission never used the probed run"
+        assert st["kvnet"]["errors"] == 0
+        # greedy determinism: the same prompt again (device-warm now)
+        r2 = await pc.post("/generate", json={
+            "prompt": prompt, "temperature": 0.0, "logprobs": 1,
+            "max_new_tokens": 8})
+        assert [e["token"] for e in r2.json()["logprobs"]] == warm_toks
+
+        # every family is live on both pods' /metrics
+        pod_metrics = (await pc.get("/metrics")).text
+    async with httpx.AsyncClient(base_url=urls["hold"]) as hc:
+        hold_metrics = (await hc.get("/metrics")).text
+        hold_stats = (await hc.get("/stats")).json()
+    for fam in ("shai_kvfabric_probes_total",
+                "shai_kvfabric_remote_hits_total",
+                "shai_kvfabric_remote_misses_total",
+                "shai_kvfabric_replications_total",
+                "shai_kvfabric_directory_size_total",
+                "shai_kvfabric_stale_holders_total"):
+        assert fam in pod_metrics, fam
+        assert fam in hold_metrics, fam
+    assert hold_stats["kvnet"]["served"] > 0   # the holder fed the pull
+
+    # /kv/protect: sole-holder eviction deferral over the wire
+    async with httpx.AsyncClient(base_url=urls["hold"]) as hc:
+        r = await hc.post("/kv/protect", json={"heads": [head],
+                                               "ttl_s": 2.0})
+        assert r.status_code == 200 and r.json()["protected"] >= 1
+
+    # /kv/pull: background replication of a run banked ONLY on the holder
+    prompt2 = ("an entirely different conversation whose kv blocks only "
+               "the holder pod has banked so far, also spanning blocks")
+    async with httpx.AsyncClient(base_url=urls["hold"]) as hc:
+        r = await hc.post("/generate", json={"prompt": prompt2})
+        assert r.status_code == 200 and r.json()["kv_ready"]
+    hold_eng = services["hold"]._engine
+    ids2 = services["hold"]._encode(prompt2)
+    head2 = hold_eng.cache.prefix_hashes(ids2)[0]
+    async with httpx.AsyncClient(base_url=urls["pod"]) as pc:
+        r = await pc.post("/kv/pull", json={"source": urls["hold"],
+                                            "head": head2})
+        assert r.status_code == 200, r.text
+        assert r.json()["fetched"] > 0
+        st = (await pc.get("/stats")).json()
+        assert st["kvfabric"]["replications"] >= 1
+
+    # pool-exact on both pods once the dust settles
+    for name in ("hold", "pod"):
+        eng = services[name]._engine
+        assert eng.n_running == 0 and eng.n_waiting == 0
+        assert eng.cache.leaked_blocks == 0
+        snap = eng.cache.tier.snapshot()
+        assert snap["used_bytes"] == snap["entries"] * snap["block_nbytes"]
