@@ -282,7 +282,8 @@ DEFAULT_CONTRACT = Contract(
         # The flight ring takes writes from every request thread.
         "FlightRecorder": ClassPolicy(
             immutable_after_init=("max_requests", "max_steps", "_lock"),
-            lock_guarded={"_requests": "_lock", "_seq": "_lock"},
+            lock_guarded={"_requests": "_lock", "_seq": "_lock",
+                          "_by_trace": "_lock"},
             owning_modules=("obs/flight.py",),
         ),
         # The step telemetry is written by the engine-loop thread and read
@@ -444,9 +445,10 @@ DEFAULT_CONTRACT = Contract(
         "XLA_FLAGS", "JAX_DEFAULT_DEVICE", "JAX_PLATFORMS",
         "ALLOW_MULTIPLE_LIBTPU_LOAD", "SHAI_TEST_DURATIONS",
     ),
-    trace_files=("serve/app.py", "serve/asgi.py"),
+    trace_files=("serve/app.py", "serve/asgi.py", "orchestrate/cova.py"),
     poll_routes=("/profile", "/health", "/readiness", "/health/ready",
-                 "/metrics", "/stats", "/kv/blocks", "/kv/digests"),
+                 "/metrics", "/stats", "/kv/blocks", "/kv/digests",
+                 "/fleet", "/trace/{trace_id}"),
     race=RaceSpec(
         # serve.app's closure lock guarding the in-flight counters (the
         # dict_guards entry above names the same lock for the write rule)

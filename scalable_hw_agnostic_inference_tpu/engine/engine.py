@@ -394,7 +394,8 @@ class LLMEngine:
                     already_lp: Optional[list] = None,
                     orig_n_prompt: int = -1,
                     parent_rid: int = -1,
-                    kv_holders: Optional[Sequence[str]] = None) -> int:
+                    kv_holders: Optional[Sequence[str]] = None,
+                    traceparent: str = "") -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -466,7 +467,8 @@ class LLMEngine:
                                     orig_n_prompt=orig_n_prompt,
                                     parent_rid=parent_rid,
                                     kv_holders=[str(u) for u in
-                                                (kv_holders or [])]))
+                                                (kv_holders or [])],
+                                    traceparent=str(traceparent or "")))
         return rid
 
     def fanout_siblings(self, rid: int) -> List[int]:
@@ -600,23 +602,26 @@ class LLMEngine:
             if r.req_id == req_id:
                 man = self.snapshot_sequence(req_id)
                 del self.waiting[i]
+                r.obs_extra["t_migrate_cut"] = time.monotonic()
                 return Finished(
                     req_id, list(r.already_generated), r.orig_n_prompt,
                     "migrated",
                     logprobs=(list(r.already_lp)
                               if r.params.logprobs else None),
                     timing=self._timing_of(r), migration=man)
-        if not any(s is not None and s.req.req_id == req_id
-                   for s in self.slots):
+        cut_slot = next((s for s in self.slots
+                         if s is not None and s.req.req_id == req_id), None)
+        if cut_slot is None:
             return None
         # the in-flight lookahead may hold an extra sampled token for
         # this slot: retire it first so the snapshot sees current host
         # mirrors (the extra token is the discarded lookahead, exactly
         # the _abort contract)
-        self._flush_pipeline("migrate")
+        self._flush_pipeline("migrate", req=cut_slot.req)
         for s in self.slots:
             if s is None or s.req.req_id != req_id:
                 continue
+            s.req.obs_extra["t_migrate_cut"] = time.monotonic()
             req, p = s.req, s.req.params
             if s.prefill_cursor is None:
                 committed = s.generated + [s.pending_token]
@@ -676,13 +681,16 @@ class LLMEngine:
                                 logprobs=(list(r.already_lp)
                                           if r.params.logprobs else None),
                                 timing=self._timing_of(r))
-        if any(s is not None and s.req.req_id == req_id for s in self.slots):
+        abort_slot = next((s for s in self.slots
+                           if s is not None and s.req.req_id == req_id),
+                          None)
+        if abort_slot is not None:
             # the in-flight lookahead step (async decode) may have computed
             # one extra token for this slot: retire it so the host mirrors
             # are current before teardown — the extra token is discarded
             # (never emitted) and its block reservation frees with the
             # slot's release below, same flush
-            self._flush_pipeline(reason)
+            self._flush_pipeline(reason, req=abort_slot.req)
         for s in self.slots:
             if s is not None and s.req.req_id == req_id:
                 self._record_tpot(s)
@@ -1031,16 +1039,22 @@ class LLMEngine:
         self._apply_sampled(pipe.running, nxt, top_ids, top_lp, tok_lp)
         return t_f
 
-    def _flush_pipeline(self, reason: str) -> None:
+    def _flush_pipeline(self, reason: str,
+                        req: Optional[Request] = None) -> None:
         """Retire the in-flight lookahead (no-op when none): the explicit
         pipeline flush every composition/control-flow event pays. Counted
         per reason — a high flush rate is the 'pipeline never gets to
-        stream' signal on ``/metrics``."""
+        stream' signal on ``/metrics``. ``req``: the request this flush is
+        attributable to (abort/migrate/kv-restore sites know one) — its
+        trace's decode span carries the per-request count."""
         pipe, self._pipe = self._pipe, None
         if pipe is None:
             return
         self._retire_pipe(pipe)
         self.obs.count_flush(reason)
+        if req is not None:
+            req.obs_extra["pipeline_flushes"] = \
+                req.obs_extra.get("pipeline_flushes", 0.0) + 1.0
 
     def finish_pending(self) -> None:
         """Retire any in-flight lookahead step — the engine loop calls this
@@ -1245,7 +1259,7 @@ class LLMEngine:
         t_f = min(req.t_first or t_first or now, now)
         t_adm = max(t_sub, t_adm)
         t_f = max(t_adm, t_f)
-        return {
+        out = {
             "t_submit": t_sub, "t_admit": t_adm, "t_first": t_f,
             "t_done": now,
             "queue_s": round(max(0.0, t_adm - t_sub), 6),
@@ -1253,6 +1267,12 @@ class LLMEngine:
             "decode_s": round(max(0.0, now - t_f), 6),
             "total_s": round(max(0.0, now - t_sub), 6),
         }
+        # sub-phase attribution (fabric probe, kv restore, recompute
+        # fallback, pipeline flushes, migration cut): every Finished exit
+        # path prices through here, so merging once covers them all
+        if req.obs_extra:
+            out.update(req.obs_extra)
+        return out
 
     def _start_slot(self, slot: int, req: Request, tok: int) -> None:
         """Seat a fully-prefilled request with its sampled first token."""
@@ -1510,7 +1530,13 @@ class LLMEngine:
                 return 0  # priced out: the headroom belongs to recompute
         elif req.deadline_at:
             budget = min(budget, req.deadline_at - time.monotonic())
-        return fab.probe(want, holders, budget)
+        t0 = time.monotonic()
+        got = fab.probe(want, holders, budget,
+                        traceparent=req.traceparent or None)
+        req.obs_extra["t_fabric"] = t0
+        req.obs_extra["fabric_probe_s"] = round(time.monotonic() - t0, 6)
+        req.obs_extra["fabric_blocks"] = float(got)
+        return got
 
     def _admit_cached(self) -> bool:
         """Admit the head request reusing its cached prefix blocks: incref
@@ -1574,9 +1600,16 @@ class LLMEngine:
             # the restore scatter donates the device pool buffers: retire
             # any in-flight lookahead FIRST so the async discipline stays
             # token-exact (no-op in lock-step / already-flushed steps)
-            self._flush_pipeline("kvtier")
+            self._flush_pipeline("kvtier", req=req)
+            t0 = time.monotonic()
+            n_before = len(cached)
             cached = cached + self.cache.restore_prefix(
                 hashes, len(cached), take, pin=cached)
+            req.obs_extra["t_kv_restore"] = t0
+            req.obs_extra["kv_restore_s"] = round(
+                time.monotonic() - t0, 6)
+            req.obs_extra["kv_restore_blocks"] = float(
+                len(cached) - n_before)
             if len(cached) < sb:
                 # tier shortfall (raced host eviction, transfer failure):
                 # degrade to the blocks we DID land — they are device-
@@ -1600,6 +1633,9 @@ class LLMEngine:
             self.waiting.appendleft(req)
             return False  # let the normal paths wait-or-reject
         self._note_admitted(req)
+        # recompute fallback: the prompt suffix past the warm start is
+        # re-prefilled, not restored — the trace's prefill span carries it
+        req.obs_extra["recompute_tokens"] = float(n_total - start)
         table = jnp.asarray(alloc.table(self.ecfg.blocks_per_seq))[None]
         n = n_total - start
         ids = np.zeros((1, chunk_bucket), np.int32)

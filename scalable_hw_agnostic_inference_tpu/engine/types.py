@@ -90,6 +90,18 @@ class Request:
     # and recomputes on any miss; empty = resolve via the pod-local
     # directory (or skip the probe entirely — the cold-fleet fast path)
     kv_holders: List[str] = dataclasses.field(default_factory=list)
+    # distributed tracing (obs.trace): the request's W3C traceparent,
+    # captured on the serving lane at submit time. The engine loop thread
+    # has NO request contextvars, so cross-pod work it initiates itself
+    # (the fabric-probe pull rung) forwards THIS header to keep one
+    # request one trace. "" = untraced (SHAI_TRACE=0 or no active trace).
+    traceparent: str = ""
+    # engine-side trace attribution: sub-phase instants/durations the span
+    # tree can't see from outside (fabric probe, kv restore, recompute
+    # fallback, per-request pipeline flushes, migration cut), merged into
+    # Finished.timing by _timing_of and grafted as spans/attrs by the
+    # serving layer (Trace.add_phase_spans). Engine-loop-thread-only.
+    obs_extra: Dict[str, float] = dataclasses.field(default_factory=dict)
     # n>1 sampling fan-out (SHAI_KV_COW): siblings of one OpenAI request
     # share a parent id (-1 = not a fan-out member). The engine admits a
     # fully-queued group as ONE prefill with copy-on-write KV forks, and

@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import trace as obs_trace
 from ..resilience import faults as rz_faults
 from ..resilience.breaker import CircuitBreaker
 from . import frames
@@ -292,8 +293,10 @@ class KvNetClient:
             url += f"?head={int(head)}"
         import httpx
 
+        tp = obs_trace.current_traceparent()
         try:
-            r = self._http().get(url)
+            r = self._http().get(
+                url, headers={"traceparent": tp} if tp else None)
         except (httpx.ConnectError, httpx.ConnectTimeout):
             br.record_failure()
             self.stats.count_error()
@@ -316,7 +319,8 @@ class KvNetClient:
     # -- the one public operation ------------------------------------------
 
     def fetch_run(self, peer_url: str, hashes: Sequence[int],
-                  budget_s: Optional[float] = None) -> int:
+                  budget_s: Optional[float] = None,
+                  traceparent: Optional[str] = None) -> int:
         """Make the local tier hold the longest leading run of ``hashes``
         it can, pulling missing blocks from ``peer_url``. Returns the
         leading-run length now resident locally. Never raises.
@@ -325,7 +329,13 @@ class KvNetClient:
         an aggregate wall budget) — a slow-but-alive peer drip-feeding
         chunks inside the per-request read timeout must not hold the
         serving lane longer than the recompute it is trying to save; the
-        caller derives it from the request deadline where one exists."""
+        caller derives it from the request deadline where one exists.
+
+        ``traceparent`` joins the pull to the request's distributed trace
+        on the serving peer. Lane-thread callers may omit it (the
+        contextvar fills in); the engine-loop thread has no request
+        context, so the fabric-probe path passes the one it carried on
+        the :class:`~..engine.types.Request`."""
         hashes = list(hashes)
         if self.tier is None or not hashes or not peer_url:
             return 0
@@ -348,9 +358,10 @@ class KvNetClient:
             self.stats.count_fallback()
             return resident
         try:
-            fetched = self._fetch_from(peer_url.rstrip("/"), br,
-                                       hashes[resident:],
-                                       time.monotonic() + budget)
+            fetched = self._fetch_from(
+                peer_url.rstrip("/"), br, hashes[resident:],
+                time.monotonic() + budget,
+                traceparent or obs_trace.current_traceparent())
         except BaseException:
             # a probe slot taken by allow() must never wedge half-open on
             # an unexpected escape (idempotent; the normal record_* paths
@@ -360,10 +371,12 @@ class KvNetClient:
         return resident + fetched
 
     def _fetch_from(self, peer: str, br: CircuitBreaker,
-                    want: List[int], deadline: float) -> int:
+                    want: List[int], deadline: float,
+                    traceparent: Optional[str] = None) -> int:
         import httpx
 
         inj = rz_faults.get()
+        headers = {"traceparent": traceparent} if traceparent else None
         landed = 0
         reported = False          # br outcome recorded for this fetch
         while landed < len(want):
@@ -397,7 +410,8 @@ class KvNetClient:
                         if inj.should_fail(rz_faults.KVNET_FETCH):
                             raise httpx.ConnectError(
                                 "injected kvnet.fetch fault")
-                    with self._http().stream("GET", url) as r:
+                    with self._http().stream("GET", url,
+                                             headers=headers) as r:
                         status = r.status_code
                         content = b""
                         if status == 200:

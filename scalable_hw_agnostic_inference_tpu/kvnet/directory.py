@@ -343,11 +343,13 @@ class FabricProbe:
     # -- the probe -----------------------------------------------------------
 
     def probe(self, hashes: Sequence[int], holders: Sequence[str],
-              budget_s: float) -> int:
+              budget_s: float, traceparent: Optional[str] = None) -> int:
         """Try to make the local tier hold the leading run of ``hashes``
         by pulling from ``holders`` in order, all attempts sharing ONE
         aggregate wall budget. Returns blocks now resident (0 = the
-        engine recomputes). Never raises.
+        engine recomputes). Never raises. ``traceparent`` (the probe runs
+        on the engine loop — no contextvar to read) joins each holder
+        pull to the request's distributed trace.
 
         Outcome accounting: one ``probes`` per call; ``remote_hits``
         when any holder lands blocks, else ``remote_misses``. A holder
@@ -380,7 +382,8 @@ class FabricProbe:
                     self.client.stats.count_error()
                     continue
             before = self.client.stats.snapshot()
-            fetched = self.client.fetch_run(url, hashes, budget_s=remaining)
+            fetched = self.client.fetch_run(url, hashes, budget_s=remaining,
+                                            traceparent=traceparent)
             if fetched > 0:
                 break
             after = self.client.stats.snapshot()

@@ -60,6 +60,7 @@ import zlib
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..obs import trace as obs_trace
 from ..resilience import faults as rz_faults
 from . import frames
 from .client import KvNetClient, publish_run
@@ -242,6 +243,13 @@ class MigrateClient(KvNetClient):
         url = f"{peer_url.rstrip('/')}{MIGRATE_ROUTE}"
         inj = rz_faults.get()
         attempt = 0
+        # the ship runs on a serving-lane thread where the request's trace
+        # context is live: the header joins the peer's /kv/migrate restore
+        # spans to the SAME distributed trace as the cut
+        headers = {"content-type": "application/x-shai-migrate"}
+        tp = obs_trace.current_traceparent()
+        if tp:
+            headers["traceparent"] = tp
         try:
             while True:
                 try:
@@ -253,9 +261,7 @@ class MigrateClient(KvNetClient):
                             raise httpx.ConnectError(
                                 "injected migrate.ship fault")
                     r = self._http().post(
-                        url, content=payload,
-                        headers={"content-type":
-                                 "application/x-shai-migrate"})
+                        url, content=payload, headers=headers)
                 except (httpx.ConnectError, httpx.ConnectTimeout):
                     br.record_failure()
                     if attempt < self.connect_retries and br.allow():

@@ -8,6 +8,9 @@ and the flight recorder.
                     serve layer adapts them to Prometheus/JSON lines)
 - ``obs.flight``    bounded ring buffers of recent request timelines and
                     engine-step records, dumped by ``GET /debug/flight``
+                    and served per-trace by ``GET /trace/{trace_id}``
+- ``obs.autopsy``   cross-pod trace assembly + per-category latency
+                    attribution (the ``/trace/{id}`` fleet autopsy)
 - ``obs.hbm``       live HBM ledger: per-pool byte attribution, headroom/
                     fragmentation gauges, steady-state leak drift detector
 - ``obs.slo``       per-model TTFT/TPOT/error objectives as rolling
@@ -18,6 +21,10 @@ Layering: ``obs`` imports nothing from the rest of the package (and no
 third-party deps), so engine AND serve may both depend on it.
 """
 
+# NOTE: the ``autopsy`` FUNCTION is deliberately not re-exported here —
+# it would shadow the ``obs.autopsy`` submodule attribute that cova and
+# the CLI import as a module (``from ..obs import autopsy``)
+from .autopsy import assemble, format_report  # noqa: F401
 from .flight import FlightRecorder  # noqa: F401
 from .hbm import DriftDetector, HbmLedger  # noqa: F401
 from .sentinel import PerfSentinel  # noqa: F401
@@ -34,6 +41,7 @@ from .trace import (  # noqa: F401
     annotate,
     begin_request_trace,
     configure,
+    current_span,
     current_trace,
     current_traceparent,
     enabled,

@@ -1,0 +1,177 @@
+"""Cross-pod trace assembly + per-request latency autopsy.
+
+One request is ONE trace across the fleet (cova hop → pod spans → fabric /
+migration sub-hops), but each pod only holds its own shard of the tree in
+its flight ring. :func:`assemble` merges the per-pod trace dicts served by
+``GET /trace/{trace_id}`` into a single span tree: every pod-local root
+carries the remote span id it continued from (``remote_parent``), so the
+shards rewire into parent/child edges wherever both sides survived.
+Shards whose remote parent died with its pod stay as *orphan roots* —
+reported, never silently dropped, and never double-counted.
+
+:func:`autopsy` answers "where did this request's wall time go": per-span
+SELF time (duration minus the sum of direct children's durations) rolled
+up into named categories — queue / admission / kv-pull / prefill / decode /
+network / migration. Self-time is computed from span-local durations only;
+wall-clock starts are never compared across pods, so the math is immune to
+inter-pod clock skew. Coverage is the categorized fraction of the global
+root's duration — the runbook's ≥ 0.9 bar for a trustworthy autopsy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+#: span name -> autopsy category. Names not listed fall through the
+#: prefix rules below, then to "admission" (serving-layer overhead:
+#: http roots, tokenize/detokenize, model_infer bookkeeping).
+_EXACT = {
+    "queue": "queue",
+    "prefill": "prefill",
+    "decode": "decode",
+    "fabric_probe": "kv-pull",
+    "kv_restore": "kv-pull",
+    "kvnet_fetch": "kv-pull",
+    "migrate_ship": "migration",
+    "migrate_cut": "migration",
+    "migrate_resume": "migration",
+}
+
+CATEGORIES = ("queue", "admission", "kv-pull", "prefill", "decode",
+              "network", "migration")
+
+
+def categorize(name: str) -> str:
+    """Autopsy category for one span name."""
+    cat = _EXACT.get(name)
+    if cat:
+        return cat
+    if name.startswith("hop:"):
+        return "network"
+    # server-side roots of KV fabric / migration hops ("GET /kv/blocks",
+    # "POST /kv/pull", "POST /kv/migrate", ...)
+    route = name.split(" ", 1)[1] if " " in name else name
+    if route.startswith("/kv/migrate"):
+        return "migration"
+    if route.startswith("/kv/"):
+        return "kv-pull"
+    return "admission"
+
+
+def assemble(trace_dicts: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-pod trace dicts (one ``Trace.to_dict()`` each) into one
+    span tree. Pod-local roots are rewired under the remote span that
+    spawned them when that span is present in the merged set; roots whose
+    remote parent is absent (dead pod, evicted ring) keep ``parent_id``
+    None and are listed in ``orphan_root_ids``. The GLOBAL root is the
+    longest-duration parentless span — duration, not wall start, so clock
+    skew cannot elect the wrong root."""
+    by_id: Dict[str, Dict[str, Any]] = {}
+    rewire: List[Dict[str, Any]] = []  # {"root_id", "remote_parent"}
+    trace_id = None
+    for td in trace_dicts or []:
+        if not td:
+            continue
+        trace_id = trace_id or td.get("trace_id")
+        local_roots = []
+        for s in td.get("spans", []):
+            sid = s.get("span_id")
+            if not sid or sid in by_id:
+                continue  # duplicate shard of the same pod record
+            by_id[sid] = dict(s)
+            if s.get("parent_id") is None:
+                local_roots.append(sid)
+        rp = td.get("remote_parent")
+        if rp:
+            for rid in local_roots:
+                rewire.append({"root_id": rid, "remote_parent": rp})
+    for r in rewire:
+        if r["remote_parent"] in by_id:
+            by_id[r["root_id"]]["parent_id"] = r["remote_parent"]
+    roots = [s for s in by_id.values() if s.get("parent_id") is None]
+    roots.sort(key=lambda s: s.get("duration_s") or 0.0, reverse=True)
+    root_id = roots[0]["span_id"] if roots else None
+    return {
+        "trace_id": trace_id,
+        "spans": list(by_id.values()),
+        "root_span_id": root_id,
+        "orphan_root_ids": [s["span_id"] for s in roots[1:]],
+    }
+
+
+def autopsy(assembled: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-category wall-time attribution over an :func:`assemble` result.
+
+    Only spans reachable from the global root count toward the budget —
+    orphan subtrees (shards from dead pods) are tallied separately so a
+    half-assembled trace degrades to lower coverage, not to double
+    counting. Category seconds are Σ self-time of the member spans."""
+    spans = assembled.get("spans", [])
+    root_id = assembled.get("root_span_id")
+    by_id = {s["span_id"]: s for s in spans}
+    children: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    for s in spans:
+        children.setdefault(s.get("parent_id"), []).append(s)
+
+    reachable = set()
+    stack = [root_id] if root_id else []
+    while stack:
+        sid = stack.pop()
+        if sid in reachable:
+            continue
+        reachable.add(sid)
+        stack.extend(c["span_id"] for c in children.get(sid, []))
+
+    cats: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+    orphan_s = 0.0
+    for s in spans:
+        dur = max(0.0, s.get("duration_s") or 0.0)
+        kids = sum(max(0.0, c.get("duration_s") or 0.0)
+                   for c in children.get(s["span_id"], []))
+        self_s = max(0.0, dur - kids)
+        if s["span_id"] in reachable:
+            cats[categorize(s["name"])] += self_s
+        else:
+            orphan_s += self_s
+
+    root = by_id.get(root_id) or {}
+    total = max(0.0, root.get("duration_s") or 0.0)
+    attributed = sum(cats.values())
+    dominant = max(cats, key=cats.get) if attributed > 0 else None
+    return {
+        "trace_id": assembled.get("trace_id"),
+        "root": root.get("name"),
+        "total_s": round(total, 6),
+        "categories": {c: round(v, 6) for c, v in cats.items()},
+        "coverage": round(attributed / total, 4) if total > 0 else 0.0,
+        "dominant": dominant,
+        "n_spans": len(spans),
+        "n_orphan_roots": len(assembled.get("orphan_root_ids", [])),
+        "orphan_self_s": round(orphan_s, 6),
+    }
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Human-readable autopsy (the ``scripts/trace_autopsy.py`` output)."""
+    lines = [
+        f"trace   {report.get('trace_id')}",
+        f"root    {report.get('root')}  ({report.get('total_s', 0.0):.3f}s"
+        f" over {report.get('n_spans', 0)} spans)",
+    ]
+    total = report.get("total_s") or 0.0
+    cats = report.get("categories", {})
+    for cat in CATEGORIES:
+        v = cats.get(cat, 0.0)
+        if v <= 0.0:
+            continue
+        frac = v / total if total > 0 else 0.0
+        flag = "  <-- dominant" if cat == report.get("dominant") else ""
+        lines.append(f"  {cat:<10s} {v * 1e3:9.1f} ms  {frac:6.1%}{flag}")
+    lines.append(f"coverage {report.get('coverage', 0.0):.1%} of root wall"
+                 " time attributed")
+    if report.get("n_orphan_roots"):
+        lines.append(
+            f"orphans  {report['n_orphan_roots']} unrooted subtree(s), "
+            f"{report.get('orphan_self_s', 0.0) * 1e3:.1f} ms uncounted "
+            "(dead pod or evicted ring?)")
+    return "\n".join(lines)

@@ -33,6 +33,10 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._requests: deque = deque(maxlen=max_requests)
         self._seq = 0
+        # trace_id -> records still in the ring (newest last). Maintained
+        # on record/evict so /trace/{trace_id} is a dict hit, not a ring
+        # walk; strictly bounded by the ring itself.
+        self._by_trace: Dict[str, List[Dict[str, Any]]] = {}
 
     def record_request(self, trace_dict: Dict[str, Any]) -> None:
         """Ring-append one completed request's trace (the asgi layer's
@@ -46,7 +50,39 @@ class FlightRecorder:
         with self._lock:
             self._seq += 1
             rec["seq"] = self._seq
-            self._requests.append(rec)
+            if (self._requests.maxlen is not None and self._requests
+                    and len(self._requests) == self._requests.maxlen):
+                self._unindex(self._requests[0])
+            if self._requests.maxlen != 0:
+                self._requests.append(rec)
+                self._index(rec)
+
+    def _index(self, rec: Dict[str, Any]) -> None:
+        tid = rec.get("trace_id")
+        if tid:
+            # shai-lint: allow(thread) caller-holds-lock helper (record)
+            self._by_trace.setdefault(tid, []).append(rec)
+
+    def _unindex(self, rec: Dict[str, Any]) -> None:
+        tid = rec.get("trace_id")
+        if not tid:
+            return
+        # shai-lint: allow(guarded-read) caller-holds-lock helper (record)
+        recs = self._by_trace.get(tid)
+        if recs is not None:
+            try:
+                recs.remove(rec)
+            except ValueError:
+                pass
+            if not recs:
+                del self._by_trace[tid]
+
+    def traces_for(self, trace_id: str) -> List[Dict[str, Any]]:
+        """All still-resident trace dicts recorded under ``trace_id``
+        (oldest first) — the ``GET /trace/{trace_id}`` backing lookup."""
+        with self._lock:
+            recs = self._by_trace.get(trace_id) or []
+            return [r["trace"] for r in recs]
 
     @property
     def n_recorded(self) -> int:

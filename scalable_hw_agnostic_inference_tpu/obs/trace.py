@@ -55,7 +55,7 @@ def enabled() -> bool:
 # -- W3C trace context -------------------------------------------------------
 
 _TRACEPARENT = re.compile(
-    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})(-.*)?$")
 
 
 def new_trace_id() -> str:
@@ -68,13 +68,23 @@ def new_span_id() -> str:
 
 def parse_traceparent(header: Optional[str]) -> Optional[Tuple[str, str]]:
     """``traceparent`` header → ``(trace_id, parent_span_id)``; None when
-    absent/malformed (a bad header starts a fresh trace, never a 4xx)."""
+    absent/malformed (a bad header starts a fresh trace, never a 4xx).
+
+    W3C versioning: version ``ff`` is forbidden; version ``00`` must have
+    exactly the four defined fields; a FUTURE version (``01``..``fe``) may
+    carry extra trailing fields — parse the leading four and continue the
+    trace rather than orphaning it on the first spec bump."""
     if not header:
         return None
     m = _TRACEPARENT.match(header.strip().lower())
     if not m:
         return None
-    trace_id, span_id = m.group(2), m.group(3)
+    version, trace_id, span_id, tail = (
+        m.group(1), m.group(2), m.group(3), m.group(5))
+    if version == "ff":
+        return None  # spec: version 255 is invalid
+    if version == "00" and tail:
+        return None  # spec: version 00 defines exactly four fields
     if trace_id == "0" * 32 or span_id == "0" * 16:
         return None  # spec: all-zero ids are invalid
     return trace_id, span_id
@@ -251,16 +261,50 @@ class Trace:
 
     def add_phase_spans(self, timing: Dict[str, float],
                         parent: Optional[Span] = None) -> None:
-        """Engine ``Finished.timing`` → queue/prefill/decode child spans."""
+        """Engine ``Finished.timing`` → queue/prefill/decode child spans,
+        plus the sub-phase events the span tree cannot see from outside:
+        the fabric-probe rung and KV-tier restore become child spans of
+        whichever phase window contains them (the probe can run before
+        ``t_admit`` is stamped, so containment decides — not assumption),
+        recompute-fallback tokens annotate prefill, request-attributed
+        pipeline flushes annotate decode, and a migration cut leaves a
+        zero-duration marker at its instant."""
         t_sub = timing.get("t_submit") or 0.0
         t_adm = timing.get("t_admit") or t_sub
         t_first = timing.get("t_first") or t_adm
         t_done = timing.get("t_done") or t_first
         if not t_sub:
             return
-        self.add_span("queue", t_sub, t_adm, parent=parent)
-        self.add_span("prefill", t_adm, t_first, parent=parent)
-        self.add_span("decode", t_first, t_done, parent=parent)
+        queue = self.add_span("queue", t_sub, t_adm, parent=parent)
+        prefill = self.add_span("prefill", t_adm, t_first, parent=parent)
+        decode = self.add_span("decode", t_first, t_done, parent=parent)
+        if timing.get("recompute_tokens"):
+            prefill.attrs["recompute_tokens"] = int(
+                timing["recompute_tokens"])
+        if timing.get("pipeline_flushes"):
+            decode.attrs["pipeline_flushes"] = int(
+                timing["pipeline_flushes"])
+
+        def _phase_parent(t: float) -> Span:
+            return queue if t < t_adm else prefill
+
+        t_fab = timing.get("t_fabric") or 0.0
+        if t_fab:
+            self.add_span(
+                "fabric_probe", t_fab,
+                t_fab + max(0.0, timing.get("fabric_probe_s") or 0.0),
+                parent=_phase_parent(t_fab),
+                blocks=int(timing.get("fabric_blocks") or 0))
+        t_res = timing.get("t_kv_restore") or 0.0
+        if t_res:
+            self.add_span(
+                "kv_restore", t_res,
+                t_res + max(0.0, timing.get("kv_restore_s") or 0.0),
+                parent=_phase_parent(t_res),
+                blocks=int(timing.get("kv_restore_blocks") or 0))
+        t_cut = timing.get("t_migrate_cut") or 0.0
+        if t_cut:
+            self.add_span("migrate_cut", t_cut, t_cut, parent=parent)
 
     def close(self) -> None:
         """Close the root (and defensively any span a crashed handler left
@@ -298,6 +342,14 @@ _current_span: contextvars.ContextVar[Optional[Span]] = \
 
 def current_trace() -> Optional[Trace]:
     return _current_trace.get()
+
+
+def current_span() -> Optional[Span]:
+    """The context-current live span (None outside any ``span()`` body).
+    The serving lane passes this as the graft parent for engine phase
+    spans so queue/prefill/decode land UNDER ``model_infer`` instead of
+    overlapping it as root siblings — self-time autopsies depend on it."""
+    return _current_span.get()
 
 
 def current_traceparent() -> Optional[str]:

@@ -21,11 +21,15 @@ import json
 import logging
 import os
 import random
+import re
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..kvnet.directory import REPLICA_TARGET, KvDirectory
 from ..kvtier.affinity import prompt_affinity
+from ..obs import autopsy as obs_autopsy
+from ..obs import trace as obs_trace
+from ..obs.flight import FlightRecorder
 from ..resilience import faults as rz_faults
 from ..resilience.breaker import CircuitBreaker
 from ..serve.asgi import App, HTTPError, Request, Response
@@ -184,6 +188,10 @@ class CovaClient:
         self._fleet_cache_at = 0.0
         self.fleet_cache_ttl_s = env_float("SHAI_FLEET_CACHE_TTL_S",
                                            FLEET_CACHE_TTL_S)
+        # per-pod read budget for the /trace/{id} fleet fan-out: trace
+        # assembly is a debugging surface — a dead pod costs one timeout,
+        # never the whole autopsy
+        self.trace_fanout_s = env_float("SHAI_TRACE_FANOUT_S", 5.0)
         # KV fabric directory: chain-head -> holder URLs, rebuilt from
         # each /fleet poll's kvtier advertisements. Routing hits above
         # SHAI_KVFABRIC_HOT_N trigger background replication pushes
@@ -237,51 +245,64 @@ class CovaClient:
         url = f"{self.url_of(name)}{route}"
         inj = rz_faults.get()
         attempt = 0
-        try:
-            while True:
-                try:
-                    if inj.active:
-                        # chaos site: injected RPC latency / connect error
-                        await inj.asleep_at(rz_faults.COVA_RPC)
-                        if inj.should_fail(rz_faults.COVA_RPC):
-                            raise httpx.ConnectError("injected cova.rpc fault")
-                    r = await self._http().post(url, json=payload)
-                except (httpx.ConnectError, httpx.ConnectTimeout) as e:
-                    # connect phase: the backend never saw the request, so a
-                    # bounded retry is always safe
-                    br.record_failure()
-                    if attempt < self.connect_retries and br.allow():
-                        await asyncio.sleep(self._retry_backoff_s(attempt))
-                        attempt += 1
-                        continue
-                    raise HTTPError(502, f"{name}{route} unreachable: "
-                                         f"{type(e).__name__}: {e}")
-                except httpx.TimeoutException as e:
-                    # read phase: the request may be EXECUTING — never
-                    # retried, and NOT fed to the breaker: the backend is
-                    # reachable (it accepted the connect), just slow; a few
-                    # long generations must not open the circuit and
-                    # fail-fast a healthy backend. The breaker's contract
-                    # is connect-phase failures only.
-                    raise HTTPError(504, f"{name}{route} timed out: {e}")
-                except httpx.HTTPError as e:
-                    # reached the backend (protocol/read error mid-exchange):
-                    # surfaced, not breaker-counted, same as the read timeout
-                    raise HTTPError(502, f"{name}{route} failed: "
-                                         f"{type(e).__name__}: {e}")
-                br.record_success()
-                if r.status_code != 200:
-                    raise HTTPError(502, f"{name}{route} -> {r.status_code}: "
-                                         f"{r.text[:200]}")
-                return r.json()
-        except BaseException:
-            # A CancelledError (or anything the httpx clauses above don't
-            # catch) escaping while this call holds the half-open probe slot
-            # would wedge the breaker half-open forever. release_probe() is
-            # idempotent, so the record_success/record_failure paths that
-            # already cleared it are unaffected.
-            br.release_probe()
-            raise
+        # hop span: one request stays ONE trace across the fan-out — the
+        # span covers the whole RPC (retries included) and its id becomes
+        # the remote parent of the backend's server-side root. No trace
+        # active (or tracing off) → NOOP span, no header, zero overhead.
+        with obs_trace.span(f"hop:{route}", annotation=False, peer=name):
+            tp = obs_trace.current_traceparent()
+            headers = {"traceparent": tp} if tp else None
+            try:
+                while True:
+                    try:
+                        if inj.active:
+                            # chaos site: injected RPC latency / connect error
+                            await inj.asleep_at(rz_faults.COVA_RPC)
+                            if inj.should_fail(rz_faults.COVA_RPC):
+                                raise httpx.ConnectError(
+                                    "injected cova.rpc fault")
+                        r = await self._http().post(url, json=payload,
+                                                    headers=headers)
+                    except (httpx.ConnectError, httpx.ConnectTimeout) as e:
+                        # connect phase: the backend never saw the request,
+                        # so a bounded retry is always safe
+                        br.record_failure()
+                        if attempt < self.connect_retries and br.allow():
+                            await asyncio.sleep(
+                                self._retry_backoff_s(attempt))
+                            attempt += 1
+                            continue
+                        raise HTTPError(502, f"{name}{route} unreachable: "
+                                             f"{type(e).__name__}: {e}")
+                    except httpx.TimeoutException as e:
+                        # read phase: the request may be EXECUTING — never
+                        # retried, and NOT fed to the breaker: the backend is
+                        # reachable (it accepted the connect), just slow; a
+                        # few long generations must not open the circuit and
+                        # fail-fast a healthy backend. The breaker's contract
+                        # is connect-phase failures only.
+                        raise HTTPError(504, f"{name}{route} timed out: {e}")
+                    except httpx.HTTPError as e:
+                        # reached the backend (protocol/read error
+                        # mid-exchange): surfaced, not breaker-counted, same
+                        # as the read timeout
+                        raise HTTPError(502, f"{name}{route} failed: "
+                                             f"{type(e).__name__}: {e}")
+                    br.record_success()
+                    if r.status_code != 200:
+                        raise HTTPError(
+                            502, f"{name}{route} -> {r.status_code}: "
+                                 f"{r.text[:200]}")
+                    return r.json()
+            except BaseException:
+                # A CancelledError (or anything the httpx clauses above
+                # don't catch) escaping while this call holds the half-open
+                # probe slot would wedge the breaker half-open forever.
+                # release_probe() is idempotent, so the record_success/
+                # record_failure paths that already cleared it are
+                # unaffected.
+                br.release_probe()
+                raise
 
     async def fleet(self) -> Dict[str, Any]:
         """Every configured model's ``/stats`` in one fan-out: served
@@ -374,6 +395,33 @@ class CovaClient:
         if self._kv_dir.size():
             self._kick_fabric_maintenance()
         return out
+
+    async def trace_shards(self, trace_id: str) -> Dict[str, Any]:
+        """Fan ``GET /trace/{trace_id}`` across the fleet: per backend,
+        either the list of that pod's trace-dict shards (``[]`` when the
+        pod never saw the trace — a 404 there is normal, not an error) or
+        ``{"error": ...}`` for a dead/timing-out pod. The caller assembles
+        whatever survived — a half-answered fan-out degrades the autopsy's
+        coverage number, never the endpoint."""
+
+        async def one(c, name):
+            try:
+                r = await c.get(f"{self.url_of(name)}/trace/{trace_id}",
+                                timeout=self.trace_fanout_s)
+                if r.status_code == 404:
+                    return name, []
+                if r.status_code != 200:
+                    return name, {"error": f"/trace -> {r.status_code}"}
+                body = r.json()
+                traces = body.get("traces") if isinstance(body, dict) \
+                    else None
+                return name, traces if isinstance(traces, list) else []
+            except Exception as e:
+                return name, {"error": str(e)[:200]}
+
+        c = self._http()
+        return dict(await asyncio.gather(
+            *[one(c, n) for n in self.models]))
 
     # -- KV fabric (kvnet.directory) -----------------------------------------
 
@@ -641,16 +689,21 @@ class CovaClient:
         if not url.startswith(("http://", "https://")):
             raise HTTPError(502, f"refusing non-http migration peer "
                                  f"{url[:80]!r}")
-        try:
-            r = await self._http().post(f"{url.rstrip('/')}{route}",
-                                        json=payload)
-        except httpx.HTTPError as e:
-            raise HTTPError(502, f"{url}{route} failed: "
-                                 f"{type(e).__name__}: {e}")
-        if r.status_code != 200:
-            raise HTTPError(502, f"{url}{route} -> {r.status_code}: "
-                                 f"{r.text[:200]}")
-        return r.json()
+        # same hop-span contract as :meth:`post` — a migration follow is a
+        # leg of the SAME request, so its server-side spans join the trace
+        with obs_trace.span(f"hop:{route}", annotation=False):
+            tp = obs_trace.current_traceparent()
+            headers = {"traceparent": tp} if tp else None
+            try:
+                r = await self._http().post(f"{url.rstrip('/')}{route}",
+                                            json=payload, headers=headers)
+            except httpx.HTTPError as e:
+                raise HTTPError(502, f"{url}{route} failed: "
+                                     f"{type(e).__name__}: {e}")
+            if r.status_code != 200:
+                raise HTTPError(502, f"{url}{route} -> {r.status_code}: "
+                                     f"{r.text[:200]}")
+            return r.json()
 
     async def _follow_migration(self, prompt: str, params: Dict[str, Any],
                                 handoff: Dict[str, Any], exclude,
@@ -861,6 +914,15 @@ def create_cova_app(models_path: str) -> App:
     models = load_models_config(models_path)
     client = CovaClient(models)
     app = App(title="cova")
+    # the orchestrator records its OWN shard of each distributed trace
+    # (root + hop spans); /trace/{id} assembles it with the pods' shards.
+    # /fleet is poll traffic (the capacity checker and routing cache hit
+    # it on a timer) and /trace/{id} is the debugging surface itself —
+    # neither may turn over the flight ring
+    flight = FlightRecorder()
+    app.trace_sink = flight.record_request
+    app.trace_exclude |= {"/fleet", "/trace/{trace_id}"}
+    app.state.update(flight=flight, client=client)
 
     @app.shutdown
     async def _close_client():
@@ -884,6 +946,29 @@ def create_cova_app(models_path: str) -> App:
     @app.get("/fleet")
     async def fleet(request: Request):
         return await client.fleet()
+
+    @app.get("/trace/{trace_id}")
+    async def trace_fleet(request: Request, trace_id: str):
+        """ONE request's whole fleet story: this orchestrator's shard
+        (root + hop spans) merged with every pod's ``/trace/{id}`` shard
+        into a single span tree, plus the per-category latency autopsy.
+        Dead pods degrade coverage (reported per pod), never the dump."""
+        tid = trace_id.strip().lower()
+        if not re.fullmatch(r"[0-9a-f]{32}", tid):
+            raise HTTPError(400, "trace_id must be 32 lowercase hex chars")
+        shards = list(flight.traces_for(tid))
+        pods: Dict[str, Any] = {}
+        for name, res in (await client.trace_shards(tid)).items():
+            if isinstance(res, dict):
+                pods[name] = res            # {"error": ...}
+            else:
+                pods[name] = {"traces": len(res)}
+                shards.extend(res)
+        if not shards:
+            raise HTTPError(404, f"trace {tid} not found in the fleet")
+        assembled = obs_autopsy.assemble(shards)
+        return {"trace_id": tid, "pods": pods, "assembled": assembled,
+                "autopsy": obs_autopsy.autopsy(assembled)}
 
     @app.post("/generate")
     async def generate(request: Request):

@@ -316,7 +316,8 @@ def create_app(
     # runs would otherwise evict real request timelines from the ring
     app.trace_exclude |= {"/health/ready", "/debug/faults",
                           "/debug/conformance", "/profile", "/kv/blocks",
-                          "/kv/migrate", "/kv/digests"}
+                          "/kv/migrate", "/kv/digests", "/kv/pull",
+                          "/kv/protect", "/trace/{trace_id}"}
 
     def _do_load_and_warm():
         t0 = time.perf_counter()
@@ -977,6 +978,17 @@ def create_app(
                 raise HTTPError(400, "requests must be an integer")
         return flight.dump(step_source=service.step_records,
                            n_requests=n_req)
+
+    @app.get("/trace/{trace_id}")
+    def trace_by_id(request: Request, trace_id: str):
+        """This pod's shard of one distributed trace: every flight-ring
+        record under ``trace_id`` (dict-indexed — no ring walk). 404 when
+        the id never recorded here or has been evicted; cova's fleet
+        ``/trace/{id}`` treats that as "no spans from this pod"."""
+        traces = flight.traces_for(trace_id)
+        if not traces:
+            raise HTTPError(404, f"trace {trace_id} not in flight ring")
+        return {"trace_id": trace_id, "traces": traces}
 
     if pub.registry is not None:
         # service gauges read at scrape time — queue depth / pool occupancy

@@ -185,6 +185,9 @@ class App:
         # would evict every real request from the flight ring
         self.trace_exclude = {"/health", "/readiness", "/metrics", "/stats",
                               "/debug/flight"}
+        # compiled patterns for parameterized trace_exclude entries
+        # ("/trace/{trace_id}"): lazily built, cached per literal
+        self._exclude_patterns: Dict[str, re.Pattern] = {}
 
     # -- registration ------------------------------------------------------
     def route(self, pattern: str, methods: Tuple[str, ...] = ("GET",)):
@@ -224,6 +227,23 @@ class App:
             r = fn()
             if inspect.isawaitable(r):
                 await r
+
+    def _trace_excluded(self, path: str) -> bool:
+        """Whether ``path`` sits on the untraced poll/bulk surface.
+        ``trace_exclude`` entries are literals; entries containing ``{``
+        are route patterns (``/trace/{trace_id}``) compiled on first use."""
+        if path in self.trace_exclude:
+            return True
+        for entry in self.trace_exclude:
+            if "{" not in entry:
+                continue
+            rx = self._exclude_patterns.get(entry)
+            if rx is None:
+                rx = _compile_pattern(entry)[0]
+                self._exclude_patterns[entry] = rx
+            if rx.match(path):
+                return True
+        return False
 
     # -- dispatch ----------------------------------------------------------
     async def _dispatch(self, request: Request) -> Response:
@@ -285,11 +305,20 @@ class App:
         # fresh trace roots here. The whole request — dispatch, model call,
         # stream drain — lives under ONE root span.
         tr = None
-        if request.path not in self.trace_exclude:
+        tp_header = request.headers.get("traceparent")
+        if not self._trace_excluded(request.path):
             tr = obs_trace.begin_request_trace(
                 f"{request.method} {request.path}",
-                request.headers.get("traceparent"),
-                method=request.method, path=request.path)
+                tp_header, method=request.method, path=request.path)
+        elif obs_trace.parse_traceparent(tp_header) is not None:
+            # excluded surfaces begin a trace ONLY when the caller sent a
+            # valid traceparent: bare poll traffic (kubelet, /stats scrape)
+            # stays off the flight ring, while correlated fleet hops
+            # (/kv/blocks, /kv/pull, /kv/migrate from a traced request)
+            # join the caller's trace as server-side child spans
+            tr = obs_trace.begin_request_trace(
+                f"{request.method} {request.path}",
+                tp_header, method=request.method, path=request.path)
         request.trace = tr
 
         def _finish_trace(status: int) -> None:

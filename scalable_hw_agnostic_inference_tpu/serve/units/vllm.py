@@ -553,7 +553,9 @@ class VllmService(ModelService):
         out = self._collect(self.loop.submit(
             ids, params, prefix=prefix, cross_states=cross_states,
             cross_len=cross_len, deadline_at=self._deadline_at(),
-            kv_holders=kv_holders, **self._qos_kw()))
+            kv_holders=kv_holders,
+            traceparent=obs_trace.current_traceparent() or "",
+            **self._qos_kw()))
         if self._engine.cache.prefix_caching:
             # advertise warmth ONLY for the /generate path cova routes,
             # and only after the request actually served: chat-templated
@@ -586,6 +588,7 @@ class VllmService(ModelService):
                                   eos_id=self.eos_id)
         out = self._collect(self.loop.submit(
             list(ids), sp, deadline_at=self._deadline_at(),
+            traceparent=obs_trace.current_traceparent() or "",
             **self._qos_kw()))
         if kv_ready:
             try:
@@ -644,8 +647,13 @@ class VllmService(ModelService):
         # deadline the generation still has to fit inside
         dl = rz_deadline.current_deadline()
         budget = None if dl is None else max(0.0, dl.remaining_s)
-        with obs_trace.span("kvnet_fetch", annotation=False):
-            return self._kvnet.fetch_run(peer, hashes, budget_s=budget)
+        with obs_trace.span("kvnet_fetch", annotation=False) as sp:
+            n = self._kvnet.fetch_run(peer, hashes, budget_s=budget)
+            # kv-pull attribution: blocks landed vs asked — the span's own
+            # duration is the pull's wall time, so the autopsy needs no
+            # separate stamp
+            sp.set(blocks=int(n), blocks_wanted=len(hashes))
+            return n
 
     # -- live migration (kvnet.migrate) ------------------------------------
 
@@ -762,11 +770,13 @@ class VllmService(ModelService):
             raise HTTPError(400, "migration manifest has no prompt")
         deadline_at = (_time.monotonic() + dl_ms / 1000.0
                        if dl_ms > 0 else self._deadline_at())
-        out = self._collect(self.loop.submit(
-            ids, params, deadline_at=deadline_at, priority=priority,
-            tenant=str(man.get("tenant") or ""),
-            already_generated=already,
-            already_lp=man.get("lps"), orig_n_prompt=n_prompt))
+        with obs_trace.span("migrate_resume", annotation=False):
+            out = self._collect(self.loop.submit(
+                ids, params, deadline_at=deadline_at, priority=priority,
+                tenant=str(man.get("tenant") or ""),
+                already_generated=already,
+                already_lp=man.get("lps"), orig_n_prompt=n_prompt,
+                traceparent=obs_trace.current_traceparent() or ""))
         if isinstance(out, dict) and out.get("migrated"):
             # this pod's OWN drain re-migrated the replay: it did not
             # complete here — the handoff must not read as a resume
@@ -849,21 +859,27 @@ class VllmService(ModelService):
         from Finished to the serving dict (rejected → 503, deadline →
         504), shared by infer and the OpenAI n>1 fan-out."""
         fin = fut.result(timeout=self._result_timeout())
+        # graft the engine's per-phase timeline onto the request trace:
+        # queue/prefill/decode become spans of THIS request even though the
+        # engine loop ran them on its own thread. BEFORE the migrated
+        # branch — the pre-migration segment's phases (and its
+        # migrate_cut instant) belong to this pod's shard of the trace,
+        # or the autopsy books the whole segment as serving overhead
+        tr = obs_trace.current_trace()
+        if tr is not None and fin.timing:
+            # parent under the live span (model_infer, or migrate_resume on
+            # a replay) so the phase wall time is the parent's CHILD time,
+            # not double-counted self time in the autopsy
+            tr.add_phase_spans(fin.timing, parent=obs_trace.current_span())
+            # flight-recorder join key: step records carry finished_ids,
+            # the trace root carries the engine request id (first id wins
+            # for the OpenAI n>1 fan-out — one trace, n engine requests)
+            tr.root.attrs.setdefault("engine_req_id", fin.req_id)
         if fin.stop_reason == "migrated":
             # drain migrate phase: ship the snapshot and hand the caller
             # the handoff record — cova (or the client) replays it
             # against the peer; this is a continuation, not a failure
             return self._migrated_handoff(fin)
-        # graft the engine's per-phase timeline onto the request trace:
-        # queue/prefill/decode become spans of THIS request even though the
-        # engine loop ran them on its own thread
-        tr = obs_trace.current_trace()
-        if tr is not None and fin.timing:
-            tr.add_phase_spans(fin.timing)
-            # flight-recorder join key: step records carry finished_ids,
-            # the trace root carries the engine request id (first id wins
-            # for the OpenAI n>1 fan-out — one trace, n engine requests)
-            tr.root.attrs.setdefault("engine_req_id", fin.req_id)
         if fin.stop_reason == "rejected":
             raise HTTPError(503, "request rejected: prompt cannot fit the KV pool")
         if fin.stop_reason == "timeout":
@@ -1116,13 +1132,16 @@ class VllmService(ModelService):
         stop = body.get("stop") or []
         stops = [stop] if isinstance(stop, str) else list(stop)
         tokq: "_q.Queue[int]" = _q.Queue()
-        fut = self.loop.submit(ids, params, on_token=tokq.put,
-                               deadline_at=self._deadline_at(),
-                               **self._qos_kw())
+        fut = self.loop.submit(
+            ids, params, on_token=tokq.put,
+            deadline_at=self._deadline_at(),
+            traceparent=obs_trace.current_traceparent() or "",
+            **self._qos_kw())
         # captured HERE (handler context): the chunk generator drains on a
         # stream-pool thread where the request contextvar is absent
         result_timeout = self._result_timeout()
         req_trace = obs_trace.current_trace()
+        req_span = obs_trace.current_span()
         rid = f"shai-{self._next_openai_id()}"
         created = int(_time.time())
         model = self.cfg.model_id or "tiny"
@@ -1171,7 +1190,7 @@ class VllmService(ModelService):
                         break
                 fin = fut.result(timeout=result_timeout)
                 if req_trace is not None and fin.timing:
-                    req_trace.add_phase_spans(fin.timing)
+                    req_trace.add_phase_spans(fin.timing, parent=req_span)
                     req_trace.root.attrs.setdefault("engine_req_id",
                                                     fin.req_id)
                 if fin.stop_reason == "migrated":

@@ -54,6 +54,11 @@ SCAN_FILES = (
     os.path.join(PKG, "kvnet", "migrate.py"),
     # the KV fabric's shai_kvfabric_* family (directory + probe rung)
     os.path.join(PKG, "kvnet", "directory.py"),
+    # fleet tracing: the flight ring's trace index + the autopsy module
+    # (obs/trace.py is deliberately NOT scanned — its ContextVar names
+    # "shai_trace"/"shai_span" are not metric names)
+    os.path.join(PKG, "obs", "flight.py"),
+    os.path.join(PKG, "obs", "autopsy.py"),
 )
 README = os.path.join(ROOT, "README.md")
 

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Per-request latency autopsy CLI: fetch an assembled fleet trace and
+print where the wall time went.
+
+One request is ONE trace across the fleet (obs.trace propagation); cova's
+``GET /trace/{trace_id}`` fans out to every pod, merges the per-pod span
+shards from their flight rings, and returns the assembled cross-pod tree
+plus the critical-path report (``obs.autopsy``). This script is the
+operator's front door to that endpoint: point it at cova (or any single
+pod) with a trace id, or at a JSON file saved earlier, and it prints the
+per-category attribution — queue / admission / kv-pull / prefill /
+decode / network / migration — with the dominant contributor flagged.
+
+Usage::
+
+    python scripts/trace_autopsy.py --url http://cova:9100 TRACE_ID
+    python scripts/trace_autopsy.py --file trace.json
+    python scripts/trace_autopsy.py --url ... TRACE_ID --json   # raw dump
+
+Exit codes: 0 printed a report, 1 trace not found / bad input, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalable_hw_agnostic_inference_tpu.obs import autopsy as obs_autopsy  # noqa: E402
+
+
+def _fetch(url: str, trace_id: str, timeout_s: float) -> dict:
+    full = url.rstrip("/") + "/trace/" + trace_id
+    req = urllib.request.Request(full, headers={"accept": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:  # nosec B310
+        return json.loads(resp.read().decode("utf-8", "replace"))
+
+
+def _report_of(doc: dict) -> dict:
+    """Accept either cova's assembled answer (``assembled``/``autopsy``
+    keys), a single pod's shard answer (``traces``: list of trace dicts),
+    or a bare list of trace dicts — assemble/autopsy locally whenever the
+    server didn't."""
+    if isinstance(doc, dict) and isinstance(doc.get("autopsy"), dict):
+        return doc["autopsy"]
+    if isinstance(doc, dict) and isinstance(doc.get("assembled"), dict):
+        return obs_autopsy.autopsy(doc["assembled"])
+    traces = doc.get("traces") if isinstance(doc, dict) else doc
+    if not isinstance(traces, list) or not traces:
+        raise ValueError("no trace spans in the response")
+    return obs_autopsy.autopsy(obs_autopsy.assemble(traces))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_id", nargs="?", default="",
+                    help="32-hex trace id (required with --url)")
+    ap.add_argument("--url", default="",
+                    help="cova (or pod) base URL serving /trace/{id}")
+    ap.add_argument("--file", default="",
+                    help="read a saved /trace/{id} JSON answer instead")
+    ap.add_argument("--timeout", type=float, default=10.0,
+                    help="HTTP timeout in seconds (default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw report dict instead of the table")
+    args = ap.parse_args(argv)
+
+    if bool(args.url) == bool(args.file):
+        ap.error("exactly one of --url or --file is required")
+    try:
+        if args.file:
+            with open(args.file, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        else:
+            if not re.fullmatch(r"[0-9a-f]{32}", args.trace_id or ""):
+                ap.error("trace_id must be 32 lowercase hex chars")
+            doc = _fetch(args.url, args.trace_id, args.timeout)
+        report = _report_of(doc)
+    except Exception as e:
+        print(f"trace_autopsy: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        print(obs_autopsy.format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

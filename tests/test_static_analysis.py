@@ -684,6 +684,38 @@ class TestTraceExclude:
             """)
         assert live(routes.check([asgi, app], TRC)) == []
 
+    def test_parameterized_poll_route_covered_by_literal_exclude(self):
+        """PR 18: ``/trace/{trace_id}`` is poll-class but parameterized —
+        the rule must accept the LITERAL pattern string in trace_exclude
+        (the asgi layer compiles it at match time) and flag its absence."""
+        trc = dataclasses.replace(
+            Contract(),
+            trace_files=("serve/app.py", "serve/asgi.py"),
+            poll_routes=("/stats", "/trace/{trace_id}"),
+        )
+        asgi = mod("serve/asgi.py", """\
+            class App:
+                def __init__(self):
+                    self.trace_exclude = {"/stats"}
+            """)
+        covered = mod("serve/app.py", """\
+            def create_app(app):
+                app.trace_exclude |= {"/trace/{trace_id}"}
+
+                @app.get("/trace/{trace_id}")
+                def trace_by_id(request, trace_id):
+                    return {}
+            """)
+        assert live(routes.check([asgi, covered], trc)) == []
+        missing = mod("serve/app.py", """\
+            def create_app(app):
+                @app.get("/trace/{trace_id}")
+                def trace_by_id(request, trace_id):
+                    return {}
+            """)
+        found = live(routes.check([asgi, missing], trc))
+        assert {f.context for f in found} == {"/trace/{trace_id}"}
+
 
 # -- the live tree -----------------------------------------------------------
 
