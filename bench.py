@@ -46,6 +46,7 @@ UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
                   "vllm": "tokens/sec", "kvtier": "x", "qos": "x",
                   "disagg": "x", "ragged": "tokens/sec",
                   "fused": "x", "migrate": "ms", "kvfabric": "x",
+                  "scaler": "s",
                   "sd": "images/sec", "sd8": "images/sec",
                   "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
@@ -76,7 +77,8 @@ def _which_from_argv(argv) -> str:
     if any(a.startswith("llama") for a in argv):
         return "llama"
     for k in ("vllm", "kvtier", "qos", "disagg", "ragged", "fused",
-              "migrate", "kvfabric", "flux", "t5", "mllama", "sd8"):
+              "migrate", "kvfabric", "scaler", "flux", "t5", "mllama",
+              "sd8"):
         if k in argv:
             return k
     return "sd"
@@ -1488,6 +1490,90 @@ def bench_migrate(tiny: bool) -> dict:
     }
 
 
+def bench_scaler(tiny: bool) -> dict:
+    """Autoscaler control-quality line: deviceless, trace-driven.
+
+    Two questions, two traces, one simulator
+    (``orchestrate/load_sim.py``):
+
+    * **recovery** (the promoted value): replay the flash-crowd trace
+      and measure SLO-recovery time — seconds from spike onset to the
+      first sustained run of SLO-compliant ticks. Smaller is better, so
+      ``vs_baseline`` inverts like the migrate line.
+    * **economics**: replay the diurnal trace twice — scaled fleet vs a
+      static fleet sized for PEAK need — and report
+      ``pod_hours_ratio`` (scaled/static, < 1 = the controller pays for
+      fewer pod-hours). The comparison only counts at equal SLO
+      compliance, so both runs' compliance rides the line and the
+      scaled run must stay inside the trace's error budget.
+
+    ``errors`` is REQUIRED 0 (every simulated request reaches exactly
+    one terminal state), and the control invariants (herd cap, anti-flap
+    spacing, migrate-storm cap, recovery window) must hold on both
+    traces — a violation fails the bench, not just dents the number.
+    The pod capacity/warm-up prices come from PERF_MODEL.json via
+    PerfPricer, so the sim's economics share the capacity checker's
+    math. ``tiny`` shortens the traces; the control law is identical.
+    """
+    from scalable_hw_agnostic_inference_tpu.orchestrate import load_sim
+
+    if tiny:
+        flash = load_sim.flash_crowd_trace(duration_s=2700.0)
+        day = load_sim.diurnal_trace(duration_s=3600.0)
+        name = "scaler-tiny"
+    else:
+        flash = load_sim.flash_crowd_trace()
+        day = load_sim.diurnal_trace()
+        name = "scaler"
+
+    crowd = load_sim.run_fleet_sim(flash)
+    viol = crowd.violations()
+    assert not viol, f"flash-crowd invariants violated: {viol}"
+    rec = crowd.recovery_s()
+    assert rec is not None, "fleet never recovered SLO after the spike"
+
+    dyn = load_sim.run_fleet_sim(day)
+    dviol = dyn.violations()
+    assert not dviol, f"diurnal invariants violated: {dviol}"
+    # the static strawman: a fleet sized for the trace's PEAK need,
+    # priced with the SAME capacity math the scaler uses
+    sim0 = load_sim.FleetSim(day)
+    peak_rps = max(day.rps_fn(i * day.tick_s)
+                   for i in range(int(day.duration_s / day.tick_s)))
+    peak_need = sim0.scaler.pricer.replicas_for(
+        peak_rps, util=sim0.cfg.target_util) or 8
+    static = load_sim.run_fleet_sim(day, static_replicas=peak_need)
+    ratio = (round(dyn.pod_hours / static.pod_hours, 3)
+             if static.pod_hours else 0.0)
+    # equal-compliance guard: the cheaper fleet must still hold the SLO
+    budget = sim0.budget_frac
+    assert dyn.slo_compliance() >= 1.0 - budget, \
+        f"scaled diurnal compliance {dyn.slo_compliance():.3f} blew " \
+        f"the {budget:.0%} budget — the ratio would be bought with " \
+        f"SLO debt"
+
+    errors = crowd.errors + dyn.errors + static.errors
+    assert errors == 0, f"{errors} simulated requests failed"
+    base = _published("scaler_recovery_s")
+    return {
+        "metric": f"{name} flash-crowd SLO recovery time "
+                  f"(spike {flash.rps_fn(flash.event_at_s):.0f} rps, "
+                  f"deviceless sim)",
+        "value": round(rec, 1),
+        "unit": "s",
+        # latency-like metric: smaller is better, vs_baseline inverts
+        "vs_baseline": round(base / rec, 3) if base and rec else 1.0,
+        "scaler_pod_hours_ratio": ratio,
+        "static_peak_replicas": peak_need,
+        "scaled_pod_hours": round(dyn.pod_hours, 2),
+        "static_pod_hours": round(static.pod_hours, 2),
+        "scaled_slo_compliance": round(dyn.slo_compliance(), 4),
+        "static_slo_compliance": round(static.slo_compliance(), 4),
+        "flips_per_hour": round(crowd.flips_per_hour(), 2),
+        "errors": errors,  # MUST be 0: exactly-once terminal contract
+    }
+
+
 def bench_flux(tiny: bool) -> dict:
     """Flux (rectified-flow DiT) txt2img on ONE chip.
 
@@ -1752,6 +1838,7 @@ def inner_main() -> None:
            "qos": bench_qos, "disagg": bench_disagg,
            "ragged": bench_ragged, "fused": bench_fused,
            "migrate": bench_migrate, "kvfabric": bench_kvfabric,
+           "scaler": bench_scaler,
            "flux": bench_flux, "t5": bench_t5,
            "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)

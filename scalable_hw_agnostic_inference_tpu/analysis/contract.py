@@ -212,6 +212,11 @@ DEFAULT_CONTRACT = Contract(
         # demotion gather or restore scatter would serialize every
         # eviction/warm-hit on the host (same discipline as runner.py)
         "kvtier/restore.py": ("make_tier_gather", "make_tier_restore"),
+        # the autoscaler's decision kernel and tick: pure host arithmetic
+        # by contract — the control loop must never block on a device (a
+        # sync here would couple scaling cadence to decode dispatch)
+        "orchestrate/scaler.py": (
+            "Scaler._decide_pool", "Scaler.tick", "Scaler.run_tick"),
     },
     donation_factory_files=("engine/runner.py", "core/aot.py",
                             "kvtier/restore.py"),
@@ -378,7 +383,7 @@ DEFAULT_CONTRACT = Contract(
         ),
         "MigrationInbox": ClassPolicy(
             immutable_after_init=("capacity", "_lock"),
-            lock_guarded={"_entries": "_lock"},
+            lock_guarded={"_entries": "_lock", "_accepting": "_lock"},
             owning_modules=("kvnet/migrate.py",),
         ),
         # KV fabric (kvnet/directory.py): counters take writes from the
@@ -423,6 +428,22 @@ DEFAULT_CONTRACT = Contract(
             immutable_after_init=("weights", "aging_rounds"),
             owning_modules=("resilience/qos.py", "engine/engine.py"),
             instance_markers=("sched.",),
+        ),
+        # The autoscaler: decision counters take writes from the control
+        # tick and reads from scrape threads; pool state moves only under
+        # the scaler's own lock. The apply callback (drain/spawn, which
+        # may block on HTTP) runs OUTSIDE both by contract — the
+        # hot_locks entries enforce that mechanically.
+        "ScalerStats": ClassPolicy(
+            immutable_after_init=("_lock",),
+            lock_guarded={"_counts": "_lock"},
+            owning_modules=("orchestrate/scaler.py",),
+        ),
+        "Scaler": ClassPolicy(
+            immutable_after_init=("cfg", "pricer", "stats", "clock",
+                                  "_lock"),
+            lock_guarded={"_pools": "_lock"},
+            owning_modules=("orchestrate/scaler.py",),
         ),
     },
     dict_guards={
@@ -488,6 +509,11 @@ DEFAULT_CONTRACT = Contract(
             "KvFabricStats._lock",
             "KvDirectory._lock",
             "FabricProbe._lock",
+            # the autoscaler: stats count on every tick and pool state
+            # fronts every decision — a drain HTTP call under either
+            # would freeze the control loop behind one slow pod
+            "ScalerStats._lock",
+            "Scaler._lock",
         ),
         # The declared partial order is EMPTY on purpose: the control
         # plane's design rule is "no lock nesting at all" — every

@@ -40,7 +40,19 @@ and ``migrate.restore`` (the peer refuses the blocks → rung 2), both in
 Counters (``shai_migrate_*``, exported via the engine-telemetry seam):
 ``shipped``/``received``/``resumed`` move on the happy path;
 ``failed`` counts ship attempts that never landed; ``fallbacks`` counts
-ladder degradations (no peer, refused restore, budget exhausted).
+ladder degradations (no peer, refused restore, budget exhausted);
+``busy`` counts 429 answers from saturated peers — back-pressure the
+shipper routes around (try the next peer), never a failure.
+
+Migrate-storm guard (the scale-down discipline, usable outside the
+scaler too): a pod whose :class:`MigrationInbox` is saturated — banked
+manifests at capacity, or concurrent accepts at the
+``SHAI_MIGRATE_MAX_INBOUND`` cap — answers ``POST /kv/migrate`` with
+**429 + Retry-After** instead of absorbing the ship. The shipping side
+(:meth:`MigrateClient.ship_any`) walks its candidate peers, skipping
+busy ones, and only after every candidate refused does it wait out the
+smallest advertised Retry-After within its budget. A bin-packing drain
+sweep therefore spreads across survivors instead of storming one.
 
 Thread contract (``analysis/contract.py``): :class:`MigrateStats` counters
 and the :class:`MigrationInbox` entry map are lock-guarded (lane threads
@@ -55,6 +67,7 @@ import json
 import logging
 import struct
 import threading
+import time
 import uuid
 import zlib
 from collections import OrderedDict
@@ -84,12 +97,32 @@ _HEAD = struct.Struct("<4sBQI")  # magic, version, manifest_len, crc32
 METRIC_FAMILIES = (
     "shai_migrate_shipped_total", "shai_migrate_received_total",
     "shai_migrate_resumed_total", "shai_migrate_failed_total",
-    "shai_migrate_fallbacks_total",
+    "shai_migrate_fallbacks_total", "shai_migrate_peer_busy_total",
 )
 
 
 class MigrateError(ValueError):
     """Malformed / truncated / corrupt migration envelope."""
+
+
+class MigrateBusy(RuntimeError):
+    """The accept side is saturated (inbox full or at the concurrent-
+    inbound cap): the route answers 429 + Retry-After and the shipper
+    tries another peer. Carries the seconds the peer asked it to wait."""
+
+    def __init__(self, retry_after_s: float = 1.0):
+        super().__init__("migration inbox saturated; try another peer")
+        self.retry_after_s = max(0.1, float(retry_after_s))
+
+
+def migrate_max_inbound() -> int:
+    """Per-pod cap on CONCURRENT inbound migration accepts
+    (``SHAI_MIGRATE_MAX_INBOUND``, default 4, lenient): above it the pod
+    answers 429 so a simultaneous multi-pod drain cannot storm one
+    survivor. The fleet simulator enforces the same bound per tick."""
+    from ..obs.util import env_int
+
+    return max(1, env_int("SHAI_MIGRATE_MAX_INBOUND", 4))
 
 
 class MigrateStats:
@@ -102,7 +135,7 @@ class MigrateStats:
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {
             "shipped": 0, "received": 0, "resumed": 0, "failed": 0,
-            "fallbacks": 0,
+            "fallbacks": 0, "busy": 0,
         }
 
     def count(self, key: str, n: int = 1) -> None:
@@ -176,6 +209,33 @@ class MigrationInbox:
         self.capacity = max(1, int(capacity))
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._accepting = 0   # concurrent in-flight accepts (the 429 gate)
+
+    def begin_accept(self, cap: int) -> bool:
+        """Reserve one concurrent-accept slot; False when the pod should
+        answer 429 instead (at the ``cap`` of in-flight accepts, or the
+        banked-entry map would evict on the next put — a saturated inbox
+        taking more ships just silently drops someone's resume). Pair
+        every True with :meth:`end_accept` in a finally."""
+        with self._lock:
+            if self._accepting >= max(1, int(cap)) \
+                    or len(self._entries) + self._accepting >= self.capacity:
+                return False
+            self._accepting += 1
+            return True
+
+    def end_accept(self) -> None:
+        with self._lock:
+            self._accepting = max(0, self._accepting - 1)
+
+    def saturated(self, cap: int) -> bool:
+        """The cheap pre-body probe the route runs BEFORE reading a
+        potentially tens-of-MB envelope; check-then-accept races are
+        closed by :meth:`begin_accept` at the real accept."""
+        with self._lock:
+            return (self._accepting >= max(1, int(cap))
+                    or len(self._entries) + self._accepting
+                    >= self.capacity)
 
     def put(self, manifest: Dict[str, Any]) -> str:
         rid = uuid.uuid4().hex[:16]
@@ -207,24 +267,10 @@ class MigrateClient(KvNetClient):
         super().__init__(tier, stats, **kw)
         self.mstats = mstats or MigrateStats()
 
-    def ship(self, peer_url: str, manifest: Dict[str, Any],
-             entries: Sequence[Tuple] = ()) -> Optional[Dict[str, Any]]:
-        """POST one MIGRATE envelope to ``peer_url``. Returns the peer's
-        ack (``{"accepted": true, "resume": ..., "restored": n}``) or
-        None — NEVER raises; every failure counts ``failed`` and the
-        caller degrades down the ladder (the client/cova replays cold).
-        Runs on a serving thread, outside every declared lock (the
-        snapshot already happened on the engine loop thread)."""
-        import httpx
-
-        if not peer_url or not self.peer_allowed(peer_url):
-            if peer_url:
-                log.warning("migrate: refusing ship to disallowed peer %r",
-                            peer_url[:120])
-            self.mstats.count_fallback()
-            return None
+    def _encode_payload(self, manifest: Dict[str, Any],
+                        entries: Sequence[Tuple]) -> Optional[bytes]:
         try:
-            payload = encode_migration(manifest, entries)
+            return encode_migration(manifest, entries)
         except Exception:
             # unencodable blocks: retry manifest-only — the peer pulls or
             # recomputes (rung 2), the manifest itself must still land
@@ -232,14 +278,29 @@ class MigrateClient(KvNetClient):
                         "manifest-only", exc_info=True)
             self.mstats.count_fallback()
             try:
-                payload = encode_migration(manifest, ())
+                return encode_migration(manifest, ())
             except Exception:
                 self.mstats.count("failed")
                 return None
+
+    def _post_envelope(self, peer_url: str, payload: bytes
+                       ) -> Tuple[str, Any]:
+        """One POST to one peer. Returns ``("ok", ack)``,
+        ``("busy", retry_after_s)`` — the peer is alive but saturated
+        (429), the caller tries the NEXT peer — or ``("fail", None)``.
+        Counts ``shipped``/``busy``/``failed`` respectively."""
+        import httpx
+
+        if not peer_url or not self.peer_allowed(peer_url):
+            if peer_url:
+                log.warning("migrate: refusing ship to disallowed peer %r",
+                            peer_url[:120])
+            self.mstats.count_fallback()
+            return "fail", None
         br = self.breaker_of(peer_url)
         if not br.allow():
             self.mstats.count("failed")
-            return None
+            return "fail", None
         url = f"{peer_url.rstrip('/')}{MIGRATE_ROUTE}"
         inj = rz_faults.get()
         attempt = 0
@@ -270,34 +331,98 @@ class MigrateClient(KvNetClient):
                     self.mstats.count("failed")
                     log.warning("migrate: peer %s unreachable — falling "
                                 "back to client replay", peer_url)
-                    return None
+                    return "fail", None
                 except Exception:
                     # read phase: reachable but failed — never retried
                     br.release_probe()
                     self.mstats.count("failed")
                     log.warning("migrate: ship to %s failed mid-exchange",
                                 peer_url, exc_info=True)
-                    return None
+                    return "fail", None
                 break
             br.record_success()
+            if r.status_code == 429:
+                # migrate-storm guard: the peer is healthy, its inbox is
+                # full — back-pressure, not failure; honor Retry-After
+                self.mstats.count("busy")
+                try:
+                    ra = float(r.headers.get("retry-after") or 1.0)
+                except (TypeError, ValueError):
+                    ra = 1.0
+                log.info("migrate: peer %s busy (retry-after %.1fs) — "
+                         "trying the next peer", peer_url, ra)
+                return "busy", max(0.1, min(ra, 30.0))
             if r.status_code != 200:
                 self.mstats.count("failed")
                 log.warning("migrate: %s%s -> %d", peer_url, MIGRATE_ROUTE,
                             r.status_code)
-                return None
+                return "fail", None
             try:
                 ack = r.json()
             except Exception:
                 self.mstats.count("failed")
-                return None
+                return "fail", None
             if not isinstance(ack, dict) or not ack.get("accepted"):
                 self.mstats.count("failed")
-                return None
+                return "fail", None
             self.mstats.count("shipped")
-            return ack
+            return "ok", ack
         except BaseException:
             br.release_probe()
             raise
+
+    def ship(self, peer_url: str, manifest: Dict[str, Any],
+             entries: Sequence[Tuple] = ()) -> Optional[Dict[str, Any]]:
+        """POST one MIGRATE envelope to ``peer_url``. Returns the peer's
+        ack (``{"accepted": true, "resume": ..., "restored": n}``) or
+        None — NEVER raises; every failure counts ``failed`` and the
+        caller degrades down the ladder (the client/cova replays cold).
+        A 429 busy answer counts ``busy``, not ``failed`` — callers with
+        alternatives use :meth:`ship_any`. Runs on a serving thread,
+        outside every declared lock (the snapshot already happened on
+        the engine loop thread)."""
+        payload = self._encode_payload(manifest, entries)
+        if payload is None:
+            return None
+        state, ack = self._post_envelope(peer_url, payload)
+        return ack if state == "ok" else None
+
+    def ship_any(self, peers: Sequence[str], manifest: Dict[str, Any],
+                 entries: Sequence[Tuple] = (), budget_s: float = 3.0
+                 ) -> Optional[Tuple[str, Dict[str, Any]]]:
+        """Walk candidate peers until one accepts the envelope. A busy
+        (429) peer means try the NEXT one; only when EVERY candidate is
+        busy does the shipper wait out the smallest advertised
+        Retry-After (within ``budget_s``) and sweep again — so a
+        simultaneous multi-pod drain converges by spreading over
+        survivors instead of failing or storming one. Returns
+        ``(peer_url, ack)`` or None (every peer failed / budget
+        exhausted)."""
+        peers = [p for p in peers if p]
+        if not peers:
+            return None
+        payload = self._encode_payload(manifest, entries)
+        if payload is None:
+            return None
+        deadline = time.monotonic() + max(0.0, budget_s)
+        while True:
+            wait: Optional[float] = None
+            for peer in peers:
+                state, out = self._post_envelope(peer, payload)
+                if state == "ok":
+                    return peer, out
+                if state == "busy":
+                    wait = out if wait is None else min(wait, out)
+                # "fail": next peer — the breaker remembers
+            if wait is None:
+                return None            # no peer is even busy: all failed
+            remaining = deadline - time.monotonic()
+            if remaining <= 0.05:
+                # budget exhausted with every candidate still busy: the
+                # caller degrades to the cold-replay rung (fallbacks
+                # counted there) — still never a request error
+                return None
+            time.sleep(min(wait, remaining))
 
 
 # -- restore (receiving pod) --------------------------------------------------
@@ -355,25 +480,29 @@ def migration_enabled() -> bool:
                 or env_str("SHAI_MIGRATE_FLEET_URL", "").strip())
 
 
-def resolve_migrate_peer(own_url: str = "") -> str:
-    """The ship target: ``SHAI_MIGRATE_PEER_URL`` wins (operator-pinned);
-    otherwise ask the cova ``/fleet`` named by ``SHAI_MIGRATE_FLEET_URL``
-    for a serving, non-overloaded, decode-capable backend that is not
-    this pod. Empty string = no peer (the ladder's cold rung)."""
+def resolve_migrate_peers(own_url: str = "", limit: int = 3) -> List[str]:
+    """Candidate ship targets, best first: ``SHAI_MIGRATE_PEER_URL`` wins
+    (operator-pinned, sole candidate); otherwise ask the cova ``/fleet``
+    named by ``SHAI_MIGRATE_FLEET_URL`` for up to ``limit`` serving,
+    non-overloaded, decode-capable backends that are not this pod. More
+    than one candidate is what lets :meth:`MigrateClient.ship_any` route
+    AROUND a 429-busy survivor during a simultaneous drain. Empty list =
+    no peer (the ladder's cold rung)."""
     from ..obs.util import env_str
 
     peer = env_str("SHAI_MIGRATE_PEER_URL", "").strip()
     if peer:
-        return peer
+        return [peer]
     fleet_url = env_str("SHAI_MIGRATE_FLEET_URL", "").strip()
     if not fleet_url:
-        return ""
+        return []
+    out: List[str] = []
     try:
         import httpx
 
         r = httpx.get(f"{fleet_url.rstrip('/')}/fleet", timeout=5.0)
         if r.status_code != 200:
-            return ""
+            return []
         snap = r.json()
         urls = snap.get("urls") or {}
         overloaded = set(snap.get("overloaded") or ())
@@ -382,11 +511,21 @@ def resolve_migrate_peer(own_url: str = "") -> str:
         for role in ("decode", "both"):
             for name in (roles.get(role) or {}).get("serving") or []:
                 u = str(urls.get(name) or "")
-                if u and name not in overloaded and u.rstrip("/") != own:
-                    return u
+                if u and name not in overloaded and u.rstrip("/") != own \
+                        and u not in out:
+                    out.append(u)
+                    if len(out) >= max(1, limit):
+                        return out
     except Exception:
         log.warning("migrate: fleet peer discovery failed", exc_info=True)
-    return ""
+    return out
+
+
+def resolve_migrate_peer(own_url: str = "") -> str:
+    """The single best ship target (first of
+    :func:`resolve_migrate_peers`); empty string = no peer."""
+    peers = resolve_migrate_peers(own_url, limit=1)
+    return peers[0] if peers else ""
 
 
 def migrate_reserve_s(budget_s: float) -> float:

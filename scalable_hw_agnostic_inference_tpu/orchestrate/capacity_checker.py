@@ -25,7 +25,7 @@ import json
 import logging
 import subprocess
 import time
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 log = logging.getLogger(__name__)
 
@@ -253,6 +253,69 @@ def fetch_load_ready(deployment: str, namespace: str = "load") -> Optional[int]:
         return None
 
 
+def _merge_slo(eng: dict, slo) -> dict:
+    """Fold a pod's ``"slo"`` section into its engine entry — the shape
+    :func:`slo_breached` and the scaler's :func:`~.scaler.role_burn`
+    read, shared by the per-pod poll and the fleet-snapshot path."""
+    if isinstance(slo, dict):
+        eng["slo_breach"] = slo.get("breach", 0.0)
+        for k, v in slo.items():
+            if k.endswith("_burn"):
+                eng[f"slo_{k}"] = v
+    return eng
+
+
+def fetch_fleet_stats(fleet_url: str, urls: Sequence[str],
+                      timeout: float = 10.0
+                      ) -> Optional[List[Optional[dict]]]:
+    """ONE ``GET /fleet`` against cova instead of N per-pod polls: the
+    fleet dump already carries every backend's full ``/stats`` body
+    (``models``) plus the aggregated ``conformance`` verdicts — failover
+    and scaling then decide from the SAME view of the fleet, instead of
+    two pollers racing each other's snapshots.
+
+    Returns entries in ``urls`` order (same contract as
+    :func:`fetch_engine_stats`: one entry per url, None for backends the
+    dump does not cover). Returns **None** — not a list — when the fleet
+    endpoint itself is unreachable, so the caller can fall back to the
+    legacy per-pod poll rung."""
+    import httpx
+
+    try:
+        r = httpx.get(f"{fleet_url.rstrip('/')}/fleet", timeout=timeout)
+        if r.status_code != 200:
+            return None
+        snap = r.json()
+        models = snap.get("models") or {}
+        by_url: Dict[str, dict] = {}
+        for name, u in (snap.get("urls") or {}).items():
+            body = models.get(name)
+            if not isinstance(body, dict) or "error" in body:
+                continue
+            eng = body.get("engine")
+            if isinstance(eng, dict):
+                by_url[str(u).rstrip("/")] = _merge_slo(
+                    dict(eng), body.get("slo"))
+        return [by_url.get(u.rstrip("/")) for u in urls]
+    except Exception:
+        log.warning("fleet snapshot poll failed — falling back to "
+                    "per-pod stats", exc_info=True)
+        return None
+
+
+def fetch_stats(urls: Sequence[str], fleet_url: str = "",
+                timeout: float = 5.0) -> List[Optional[dict]]:
+    """The deduped stats path: prefer the cova ``/fleet`` snapshot when a
+    fleet URL is configured, degrade to the legacy per-pod poll when the
+    snapshot is unavailable — one fleet view, with the old rung kept as
+    the fallback."""
+    if fleet_url:
+        got = fetch_fleet_stats(fleet_url, urls)
+        if got is not None:
+            return got
+    return fetch_engine_stats(urls, timeout=timeout)
+
+
 def fetch_engine_stats(urls: Sequence[str],
                        timeout: float = 5.0) -> List[Optional[dict]]:
     """Poll each serving pod's ``/stats`` for its engine telemetry snapshot
@@ -277,13 +340,7 @@ def fetch_engine_stats(urls: Sequence[str],
             body = r.json()
             got = body.get("engine")
             if isinstance(got, dict):
-                eng = dict(got)
-                slo = body.get("slo")
-                if isinstance(slo, dict):
-                    eng["slo_breach"] = slo.get("breach", 0.0)
-                    for k, v in slo.items():
-                        if k.endswith("_burn"):
-                            eng[f"slo_{k}"] = v
+                eng = _merge_slo(dict(got), body.get("slo"))
         except Exception:
             log.debug("stats poll failed for %s", u, exc_info=True)
         out.append(eng)
@@ -301,7 +358,8 @@ def apply_mode(mode: str, manifest_dir: str, app: str) -> None:
 def main_loop(app: str = "sd21", manifest_dir: str = "/deploy",
               nodepools: Sequence[str] = ("tpu", "v5e"),
               load_deploy: str = "load", interval_s: int = 300,
-              stats_urls: Sequence[str] = ()) -> None:
+              stats_urls: Sequence[str] = (),
+              fleet_url: str = "") -> None:
     state = ControllerState()
     consecutive_failures = 0
     start_metrics_exporter()
@@ -309,7 +367,8 @@ def main_loop(app: str = "sd21", manifest_dir: str = "/deploy",
         try:
             action = decide(state, fetch_events(), fetch_load_ready(load_deploy),
                             nodepool_substrings=nodepools,
-                            engine_stats=(fetch_engine_stats(stats_urls)
+                            engine_stats=(fetch_stats(stats_urls,
+                                                      fleet_url=fleet_url)
                                           if stats_urls else None))
             if action in ("failover", "fallback"):
                 mode = "equal" if action == "failover" else "weighted"
@@ -351,4 +410,8 @@ if __name__ == "__main__":
         # failover trigger (queue depth / KV pressure from obs telemetry)
         stats_urls=tuple(u for u in
                          env_str("STATS_URLS").split(",") if u),
+        # cova base URL: ONE /fleet snapshot replaces the per-pod polls
+        # (failover and scaling decide from the same fleet view); the
+        # per-pod rung stays as the fallback when cova is down
+        fleet_url=env_str("FLEET_URL", ""),
     )

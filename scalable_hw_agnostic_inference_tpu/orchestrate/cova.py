@@ -510,20 +510,31 @@ class CovaClient:
 
     def weighted_order(self, names: Optional[List[str]] = None) -> List[str]:
         """The cost-optimized base order: text-generation backends by
-        descending ``weight`` from models.json (default 1.0), name-stable
-        on ties — the same weighted-vs-equal discipline the ingress runs
-        (``capacity_checker``), applied to cova's own fan-out."""
+        descending ``weight`` from models.json (default 1.0) divided by
+        the tier's ``chip_cost_per_hr`` (default 1.0) — the $/token
+        extension (PR 19): at equal operator weight, a cheaper tier
+        serves first, the same preference the fleet autoscaler applies
+        when growing capacity (``orchestrate.scaler.cheapest_first``).
+        Name-stable on ties — the same weighted-vs-equal discipline the
+        ingress runs (``capacity_checker``), applied to cova's own
+        fan-out."""
         gen = [n for n in (names or self.models)
                if self.models.get(n, {}).get("task", "text-generation")
                == "text-generation"]
 
-        def weight_of(n: str) -> float:
+        def value_of(n: str) -> float:
+            cfg = self.models.get(n, {})
             try:
-                return float(self.models.get(n, {}).get("weight", 1.0))
+                w = float(cfg.get("weight", 1.0))
             except (TypeError, ValueError):
-                return 1.0
+                w = 1.0
+            try:
+                cost = float(cfg.get("chip_cost_per_hr", 1.0))
+            except (TypeError, ValueError):
+                cost = 1.0
+            return w / cost if cost > 0 else w
 
-        return sorted(gen, key=lambda n: (-weight_of(n), n))
+        return sorted(gen, key=lambda n: (-value_of(n), n))
 
     async def _fleet_for_routing(self) -> Dict[str, Any]:
         """Short-TTL cached /fleet snapshot; a poll failure returns the

@@ -1,20 +1,51 @@
-"""Synthetic-demand simulator: scale a client Deployment along a wave.
+"""Synthetic-demand simulation: wave-driven load AND the deviceless
+trace-driven fleet simulator the autoscaler is proven against.
 
-Parity targets: ``load-cosine-simu.yaml:26-69`` (cosine wave, 20-min steps)
-and ``app/appsimulator.sh`` (sine wave; persists phase to SQS so a restarted
-simulator resumes mid-cycle ``:2-20``; deletes Evicted/CrashLoop pods each
-tick ``:56``). Here the wave math is pure and tested; phase persists to a
-state file (PV) instead of SQS; kubectl does the scaling.
+Part 1 (the reference's load generator): scale a client Deployment along
+a wave. Parity targets: ``load-cosine-simu.yaml:26-69`` (cosine wave,
+20-min steps) and ``app/appsimulator.sh`` (sine wave; persists phase to
+SQS so a restarted simulator resumes mid-cycle ``:2-20``; deletes
+Evicted/CrashLoop pods each tick ``:56``). Here the wave math is pure
+and tested; phase persists to a state file (PV) instead of SQS; kubectl
+does the scaling.
+
+Part 2 (PR 19, the reference's cosine-load/breaking-point harness grown
+into CI): :class:`FleetSim` replays a demand trace against simulated pod
+actors — no devices, no kubectl, no sockets; virtual time only. Pod
+capacity is priced by PERF_MODEL.json (``orchestrate.scaler.PerfPricer``
+— the same math as ``scripts/project_breakpoints.py``), warm-up lead
+times by the AOT-bank pricing, and scale-down drains through a simulated
+migration ladder with the per-peer concurrent-inbound cap
+(``SHAI_MIGRATE_MAX_INBOUND``). The simulator runs the REAL
+``orchestrate.scaler.Scaler`` tick (including its ``scale.decide`` /
+``scale.apply`` chaos sites and the ``migrate.ship`` site at the sim's
+ship step) and records everything the policy invariants need:
+
+- executed step sizes (herd cap) and direction-change spacing (anti-flap);
+- inbound migrations per pod per tick (no migrate storm);
+- per-request terminal accounting (exactly once, across scale-down AND
+  pod kill);
+- per-tick SLO compliance, for the declared-transient-window recovery
+  check and the pod-hours/compliance ledger ``bench.py scaler`` prices.
+
+:meth:`SimReport.violations` turns those records into a list of human-
+readable policy violations — empty on a healthy control, and PROVABLY
+non-empty for the de-tuned (no-hysteresis) control, so CI can catch the
+bug class, not just the bug.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import math
 import os
 import time
-from typing import Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..resilience import faults as rz_faults
+from . import scaler as scaler_mod
 
 log = logging.getLogger(__name__)
 
@@ -101,6 +132,473 @@ def main_loop(deployment: str = "load", namespace: str = "load",
         step += 1
         store.save(step)
         time.sleep(step_s)
+
+
+# -- PR 19: the deviceless trace-driven fleet simulator -----------------------
+
+@dataclasses.dataclass(frozen=True)
+class SimTrace:
+    """A demand trace: offered requests/s over virtual time, plus pod-kill
+    events ``(t_s, n_pods)``. ``rps_fn`` is pure — replaying the same
+    trace with the same seed reproduces every tick exactly."""
+
+    name: str
+    duration_s: float
+    rps_fn: Callable[[float], float]
+    tick_s: float = 15.0
+    kills: Tuple[Tuple[float, int], ...] = ()
+    #: the moment the declared-transient-window recovery clock starts
+    #: (spike onset / first kill); None = no recovery check
+    event_at_s: Optional[float] = None
+
+
+def diurnal_trace(base_rps: float = 20.0, peak_rps: float = 140.0,
+                  period_s: float = 3600.0, duration_s: float = 7200.0,
+                  tick_s: float = 15.0) -> SimTrace:
+    """The reference's cosine day: trough ``base_rps``, crest
+    ``peak_rps`` — the trace the pod-hours-vs-static-peak economics are
+    judged on."""
+
+    def rps(t: float) -> float:
+        phase = 2.0 * math.pi * (t % period_s) / period_s
+        return base_rps + (peak_rps - base_rps) * (1 - math.cos(phase)) / 2
+
+    return SimTrace("diurnal", duration_s, rps, tick_s=tick_s)
+
+
+def flash_crowd_trace(base_rps: float = 25.0, spike_rps: float = 180.0,
+                      at_s: float = 900.0, spike_dur_s: float = 1200.0,
+                      duration_s: float = 3600.0,
+                      tick_s: float = 15.0) -> SimTrace:
+    """A step spike: the breaking-point shape that exposes herd
+    scale-up. ``bench.py scaler`` replays this one and reports the SLO
+    recovery time."""
+
+    def rps(t: float) -> float:
+        return spike_rps if at_s <= t < at_s + spike_dur_s else base_rps
+
+    return SimTrace("flash_crowd", duration_s, rps, tick_s=tick_s,
+                    event_at_s=at_s)
+
+
+def pod_kill_trace(rps: float = 90.0, duration_s: float = 3600.0,
+                   kills: Tuple[Tuple[float, int], ...] = ((900.0, 1),
+                                                          (1800.0, 2)),
+                   tick_s: float = 15.0) -> SimTrace:
+    """Steady load with abrupt pod deaths: in-flight work on the victims
+    must still reach exactly one terminal state (cold replay), and the
+    controller must backfill within the transient window."""
+    return SimTrace("pod_kill", duration_s, lambda t: rps, tick_s=tick_s,
+                    kills=kills, event_at_s=kills[0][0] if kills else None)
+
+
+@dataclasses.dataclass
+class SimPod:
+    """One simulated pod actor. No threads, no sockets: state advances
+    only inside :meth:`FleetSim.step`."""
+
+    pid: int
+    state: str = "warming"            # warming | serving | draining | dead
+    warm_at: float = 0.0
+    queue: List[Tuple[int, float]] = dataclasses.field(
+        default_factory=list)         # (rid, arrival_t)
+    inbound_tick: int = 0             # migrations accepted THIS tick
+    cost_hr: float = 1.0
+
+
+@dataclasses.dataclass
+class SimReport:
+    """Everything the policy invariants and the bench economics need,
+    recorded per tick. ``violations()`` is the CI gate."""
+
+    trace: str
+    tick_s: float
+    cfg: "scaler_mod.ScalerConfig"
+    max_inbound: int
+    transient_window_s: float
+    # recorded timelines
+    steps: List[Tuple[float, int]] = dataclasses.field(
+        default_factory=list)         # (t, executed delta)
+    inbound_max: List[int] = dataclasses.field(default_factory=list)
+    slo_ok: List[bool] = dataclasses.field(default_factory=list)
+    replicas: List[int] = dataclasses.field(default_factory=list)
+    # request ledger
+    created: int = 0
+    completed: int = 0
+    errors: int = 0
+    double_terminal: int = 0
+    migrated: int = 0
+    cold_replays: int = 0
+    # economics
+    pod_hours: float = 0.0
+    counters: Dict[str, float] = dataclasses.field(default_factory=dict)
+    event_at_s: Optional[float] = None
+
+    # -- derived -----------------------------------------------------------
+
+    def direction_changes(self) -> List[Tuple[float, float, int]]:
+        """(t_prev, t_flip, new_dir) for every executed reversal."""
+        out, last = [], None
+        for t, delta in self.steps:
+            d = 1 if delta > 0 else -1
+            if last is not None and d != last[1]:
+                out.append((last[0], t, d))
+            last = (t, d)
+        return out
+
+    def flips_per_hour(self) -> float:
+        span_h = max(1e-9, len(self.slo_ok) * self.tick_s / 3600.0)
+        return len(self.direction_changes()) / span_h
+
+    def recovery_s(self, settle_ticks: int = 3) -> Optional[float]:
+        """Seconds from the trace's event (spike onset / first kill) to
+        the first ``settle_ticks``-long run of SLO-compliant ticks; None
+        when the trace has no event or the fleet never recovers."""
+        if self.event_at_s is None:
+            return None
+        start = int(self.event_at_s / self.tick_s)
+        run = 0
+        for i in range(start, len(self.slo_ok)):
+            run = run + 1 if self.slo_ok[i] else 0
+            if run >= settle_ticks:
+                t_ok = (i - settle_ticks + 1) * self.tick_s
+                return max(0.0, t_ok - self.event_at_s)
+        return None
+
+    def slo_compliance(self) -> float:
+        return (sum(self.slo_ok) / len(self.slo_ok)) if self.slo_ok \
+            else 1.0
+
+    def violations(self, max_flips_per_hr: Optional[float] = None
+                   ) -> List[str]:
+        """The policy invariants, as human-readable findings. Empty =
+        the control held its contract on this trace."""
+        cfg = self.cfg
+        out: List[str] = []
+        # herd guard: no executed step beyond the cap, either direction
+        for t, delta in self.steps:
+            if abs(delta) > cfg.max_step:
+                out.append(f"herd: step {delta:+d} at t={t:.0f}s exceeds "
+                           f"max_step {cfg.max_step}")
+        # anti-flap: a reversal must wait out the ENTERED direction's
+        # cool-down, and reversals per hour stay under the declared bound
+        for t_prev, t_flip, new_dir in self.direction_changes():
+            need = cfg.cooldown_up_s if new_dir > 0 else cfg.cooldown_down_s
+            if t_flip - t_prev < need - 1e-6:
+                out.append(f"flap: direction change at t={t_flip:.0f}s "
+                           f"only {t_flip - t_prev:.0f}s after the "
+                           f"previous step (needs {need:.0f}s)")
+        if max_flips_per_hr is None:
+            both = cfg.cooldown_up_s + cfg.cooldown_down_s
+            max_flips_per_hr = (2.0 * 3600.0 / both + 1.0) if both > 0 \
+                else 4.0
+        if self.flips_per_hour() > max_flips_per_hr:
+            out.append(f"flap: {self.flips_per_hour():.1f} direction "
+                       f"changes/hour exceeds the bound "
+                       f"{max_flips_per_hr:.1f}")
+        # migrate storm: inbound ships per pod per tick stay capped
+        for i, n in enumerate(self.inbound_max):
+            if n > self.max_inbound:
+                out.append(f"storm: {n} inbound migrations on one pod in "
+                           f"tick {i} (cap {self.max_inbound})")
+        # exactly-once terminal accounting across scale-down and kills
+        if self.completed + self.errors != self.created:
+            out.append(f"ledger: {self.created} created but "
+                       f"{self.completed} completed + {self.errors} "
+                       f"errors")
+        if self.double_terminal:
+            out.append(f"ledger: {self.double_terminal} requests reached "
+                       f"a terminal state twice")
+        if self.errors:
+            out.append(f"errors: {self.errors} requests failed")
+        # SLO recovery within the declared transient window
+        if self.event_at_s is not None:
+            rec = self.recovery_s()
+            if rec is None:
+                out.append("recovery: SLO never re-converged after the "
+                           "trace event")
+            elif rec > self.transient_window_s:
+                out.append(f"recovery: {rec:.0f}s after the event "
+                           f"exceeds the declared transient window "
+                           f"{self.transient_window_s:.0f}s")
+        return out
+
+
+class FleetSim:
+    """Simulated pod fleet driven by virtual time. One model pool by
+    default; ``tiers`` maps tier name -> $/pod-hour to exercise the
+    cheapest-first preference. Deterministic: the only randomness is the
+    fault injector's seeded streams."""
+
+    def __init__(self, trace: SimTrace,
+                 cfg: Optional[scaler_mod.ScalerConfig] = None,
+                 pricer: Optional[scaler_mod.PerfPricer] = None,
+                 pod_rps: Optional[float] = None,
+                 warmup_s: Optional[float] = None,
+                 max_inbound: Optional[int] = None,
+                 initial_replicas: int = 2,
+                 static_replicas: Optional[int] = None,
+                 budget_frac: float = 0.05,
+                 transient_window_s: float = 900.0,
+                 aot_banked: bool = True):
+        from ..kvnet.migrate import migrate_max_inbound
+
+        self.trace = trace
+        self.cfg = cfg or scaler_mod.ScalerConfig()
+        self.pricer = pricer or scaler_mod.PerfPricer()
+        self.pod_rps = pod_rps if pod_rps is not None else (
+            self.pricer.pod_rps() or 30.0)
+        self.warmup_s = warmup_s if warmup_s is not None else \
+            (self.pricer.WARM_START_S if aot_banked
+             else self.pricer.COLD_START_S)
+        self.max_inbound = max_inbound if max_inbound is not None \
+            else migrate_max_inbound()
+        self.budget_frac = budget_frac
+        self.static_replicas = static_replicas
+        self.now = 0.0
+        self.scaler = scaler_mod.Scaler(
+            self.cfg, pricer=self.pricer, clock=lambda: self.now)
+        self.pods: List[SimPod] = []
+        self._next_pid = 0
+        self._next_rid = 0
+        self._terminal: Dict[int, int] = {}
+        self._backlog: List[Tuple[int, float]] = []
+        self._burn_hist: List[float] = []
+        n0 = static_replicas if static_replicas is not None \
+            else initial_replicas
+        for _ in range(max(1, n0)):
+            self._spawn(warm=True)
+        self.report = SimReport(
+            trace=trace.name, tick_s=trace.tick_s, cfg=self.cfg,
+            max_inbound=self.max_inbound,
+            transient_window_s=transient_window_s,
+            event_at_s=trace.event_at_s)
+
+    # -- fleet actions ------------------------------------------------------
+
+    def _spawn(self, warm: bool = False) -> SimPod:
+        p = SimPod(pid=self._next_pid,
+                   state="serving" if warm else "warming",
+                   warm_at=self.now if warm else self.now + self.warmup_s,
+                   cost_hr=self.pricer.cost_per_hr())
+        self._next_pid += 1
+        self.pods.append(p)
+        return p
+
+    def _serving(self) -> List[SimPod]:
+        return [p for p in self.pods if p.state == "serving"]
+
+    def _alive_count(self) -> int:
+        return sum(p.state in ("serving", "warming") for p in self.pods)
+
+    def _kill(self, n: int) -> None:
+        """Abrupt pod death: queued work cold-replays (the ladder's rung
+        3) — re-enqueued, NOT terminal, so the exactly-once ledger still
+        closes when a survivor completes it."""
+        victims = [p for p in self._serving()][-n:]
+        for p in victims:
+            self._backlog.extend(p.queue)
+            self.report.cold_replays += len(p.queue)
+            p.queue = []
+            p.state = "dead"
+
+    def seed_queue(self, pid: int, n: int) -> None:
+        """Pre-load ``n`` in-flight requests onto one pod (ledger-
+        tracked): the simultaneous-drain regression uses this to make
+        the victims actually hold work when the drain begins."""
+        for p in self.pods:
+            if p.pid == pid:
+                for _ in range(max(0, n)):
+                    rid = self._next_rid
+                    self._next_rid += 1
+                    self.report.created += 1
+                    p.queue.append((rid, self.now))
+                return
+
+    def drain(self, pids: Sequence[int]) -> None:
+        """Begin draining the named pods (the 3-pod simultaneous-drain
+        regression drives this directly). Draining pods take no new
+        arrivals; their queues ship through the migration step under the
+        per-peer inbound cap."""
+        want = set(pids)
+        for p in self.pods:
+            if p.pid in want and p.state == "serving":
+                p.state = "draining"
+
+    def _apply(self, d: scaler_mod.Decision) -> bool:
+        if d.delta > 0:
+            for _ in range(d.delta):
+                self._spawn()
+        elif d.delta < 0:
+            # victims: youngest, most expensive serving pods first (the
+            # cheapest-first preference, inverted for shrink)
+            victims = sorted(self._serving(),
+                             key=lambda p: (-p.cost_hr, -p.pid))
+            self.drain([p.pid for p in victims[:-d.delta]])
+        return True
+
+    # -- the migration step (drain ladder, storm-capped) --------------------
+
+    def _migrate_step(self) -> None:
+        inj = rz_faults.get()
+        targets = sorted(self._serving(), key=lambda p: (p.cost_hr, p.pid))
+        for p in self.pods:
+            if p.state != "draining":
+                continue
+            remaining: List[Tuple[int, float]] = []
+            for item in p.queue:
+                shipped = False
+                if inj.active and inj.should_fail(
+                        rz_faults.MIGRATE_SHIP):
+                    # chaos: the ship never leaves the pod — cold replay
+                    # (rung 3), still exactly-once
+                    self._backlog.append(item)
+                    self.report.cold_replays += 1
+                    continue
+                for t in targets:
+                    # per-peer concurrent-inbound cap: a saturated peer
+                    # answers busy (429) and the shipper tries the next —
+                    # unshipped work simply waits for the next tick
+                    if t.inbound_tick < self.max_inbound:
+                        t.inbound_tick += 1
+                        t.queue.append(item)
+                        self.report.migrated += 1
+                        shipped = True
+                        break
+                if not shipped:
+                    if targets:
+                        remaining.append(item)   # every peer busy: retry
+                    else:
+                        self._backlog.append(item)   # no peer: cold rung
+                        self.report.cold_replays += 1
+            p.queue = remaining
+            if not p.queue:
+                p.state = "dead"
+
+    # -- one tick -----------------------------------------------------------
+
+    def _terminate(self, rid: int, ok: bool) -> None:
+        n = self._terminal.get(rid, 0) + 1
+        self._terminal[rid] = n
+        if n > 1:
+            self.report.double_terminal += 1
+            return
+        if ok:
+            self.report.completed += 1
+        else:
+            self.report.errors += 1
+
+    def step(self) -> None:
+        trace, rep = self.trace, self.report
+        t = self.now
+        # 1) warm-ups complete
+        for p in self.pods:
+            if p.state == "warming" and t >= p.warm_at:
+                p.state = "serving"
+            p.inbound_tick = 0
+        serving = self._serving()
+        # 2) arrivals (plus cold-replay backlog) distribute round-robin;
+        # past the trace end only the settle drain runs — no new demand
+        n_new = int(round(trace.rps_fn(t) * trace.tick_s)) \
+            if t < trace.duration_s else 0
+        arrivals = list(self._backlog)
+        self._backlog = []
+        for _ in range(n_new):
+            rid = self._next_rid
+            self._next_rid += 1
+            rep.created += 1
+            arrivals.append((rid, t))
+        if serving:
+            for i, item in enumerate(arrivals):
+                serving[i % len(serving)].queue.append(item)
+        else:
+            self._backlog = arrivals
+        # 2b) trace events: pod kills land mid-tick, AFTER arrivals — a
+        # victim dies holding fresh in-flight work, so the exactly-once
+        # ledger actually audits the cold-replay rung
+        for (kt, n) in trace.kills:
+            if t <= kt < t + trace.tick_s:
+                self._kill(n)
+        # 3) drain ladder ships under the per-peer inbound cap
+        self._migrate_step()
+        # 4) service: each serving pod completes up to its tick capacity
+        cap = max(1, int(self.pod_rps * trace.tick_s))
+        served = late = 0
+        for p in self._serving():
+            take, p.queue = p.queue[:cap], p.queue[cap:]
+            for rid, t0 in take:
+                self._terminate(rid, ok=True)
+                served += 1
+                if t - t0 >= trace.tick_s:
+                    late += 1
+        waiting = sum(len(p.queue) for p in self._serving()) \
+            + len(self._backlog)
+        live = served + waiting
+        frac_late = ((late + waiting) / live) if live else 0.0
+        burn = min(100.0, frac_late / self.budget_frac)
+        self._burn_hist.append(burn)
+        slow_n = max(1, int(3600.0 / trace.tick_s))
+        slow_burn = sum(self._burn_hist[-slow_n:]) \
+            / len(self._burn_hist[-slow_n:])
+        rep.slo_ok.append(frac_late <= self.budget_frac)
+        # 5) the REAL controller ticks (chaos sites included); executed
+        # steps reach the report through the instrumented _apply
+        if self.static_replicas is None:
+            sig = scaler_mod.PoolSignal(
+                model="sim", role="both",
+                replicas=self._alive_count(),
+                burn=burn, slow_burn=slow_burn,
+                breach=burn >= 14.4 and slow_burn >= 1.0,
+                rps=trace.rps_fn(t) if t < trace.duration_s else 0.0)
+            self.scaler.run_tick([sig], self._apply, now=t)
+        # 6) bookkeeping
+        rep.inbound_max.append(max(
+            (p.inbound_tick for p in self.pods), default=0))
+        rep.replicas.append(self._alive_count())
+        rep.pod_hours += sum(
+            p.cost_hr for p in self.pods
+            if p.state in ("serving", "warming", "draining")) \
+            * trace.tick_s / 3600.0 / max(
+                1e-9, self.pricer.cost_per_hr())
+        self.now += trace.tick_s
+
+    def run(self) -> SimReport:
+        ticks = int(self.trace.duration_s / self.trace.tick_s)
+        for _ in range(ticks):
+            self.step()
+        # settle: drain the tail so the terminal ledger closes — every
+        # request still queued when the trace ends completes (bounded by
+        # total work, so this always terminates while capacity exists)
+        settle = 0
+        while (self._backlog or any(
+                p.queue for p in self.pods if p.state != "dead")) \
+                and settle < 10_000:
+            self.step()
+            settle += 1
+        self.report.counters = self.scaler.stats.snapshot()
+        return self.report
+
+
+def _record_steps(sim: FleetSim) -> None:
+    """Wrap the sim's apply to record EXECUTED steps (post-discipline)
+    into the report — what the herd/flap invariants audit."""
+    inner = sim._apply
+
+    def wrapped(d: scaler_mod.Decision) -> bool:
+        ok = inner(d)
+        if ok and d.delta != 0:
+            sim.report.steps.append((sim.now, d.delta))
+        return ok
+
+    sim._apply = wrapped   # type: ignore[method-assign]
+
+
+def run_fleet_sim(trace: SimTrace, **kw) -> SimReport:
+    """Build, instrument, and run one simulation; the one-call entry the
+    tests and ``bench.py scaler`` share."""
+    sim = FleetSim(trace, **kw)
+    _record_steps(sim)
+    return sim.run()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,579 @@
+"""Fleet autoscaler: SLO-burn driven sizing with anti-flap control
+discipline.
+
+The reference stack scales with KEDA ScaledObjects off queue depth; this
+controller closes the same loop from the signals the repo already
+exports: each pool's **fast SLO burn rate** (``obs/slo.py``, aggregated
+per backend on cova's ``/fleet.conformance``) plus the offered load,
+priced against PERF_MODEL.json capacity (``scripts/project_breakpoints``
+math). Prefill pools are sized from TTFT burn, decode pools from TPOT
+burn — the disaggregated roles fail independently, so they scale
+independently.
+
+The hard part of an autoscaler is not sizing, it is *stability*; the
+failure modes are flapping, herd scale-up, and migrate storms. The
+control contract, enforced by construction and proven by the trace-driven
+fleet simulator (``orchestrate/load_sim.py``):
+
+- **asymmetric cool-downs** — a scale-up is legal
+  ``SHAI_SCALER_COOLDOWN_UP_S`` (default 60 s) after the pool's last
+  executed step, a scale-down only ``SHAI_SCALER_COOLDOWN_DOWN_S``
+  (default 600 s) after it: fast up, slow down, and an oscillating burn
+  signal cannot alternate directions within the entered direction's
+  window;
+- **hysteresis band** — up only above ``up_burn`` (default 2.0× budget
+  burn), down only below ``down_burn`` (default 0.5×); the dead band
+  between them absorbs noise instead of echoing it;
+- **herd guard** — per-tick replica delta is clamped to
+  ``SHAI_SCALER_MAX_STEP`` (default 4); every clamp counts
+  ``shai_scaler_herd_capped_total``;
+- **drain via migration** — scale-down victims drain through the live
+  migration ladder (PR 15), and the per-peer concurrent-inbound cap
+  (``SHAI_MIGRATE_MAX_INBOUND``, ``kvnet.migrate``) keeps a bin-packing
+  sweep from storming one survivor.
+
+Cold-start pricing: a pool whose pods boot from banked AOT artifacts
+(``core/aot.py``) warms in seconds, a cold pool pays full compile — the
+pricer feeds that lead time to the simulator and to capacity planning.
+Cost awareness: ``chip_cost_per_hr`` in models.json extends cova's
+weighted order to $/token, and the scaler prefers growing the cheapest
+pool whose SLO holds (:func:`cheapest_first`).
+
+Decision metrics (``shai_scaler_*``, exported through ``/stats`` →
+``"scaler"`` and scanned by ``scripts/check_metrics_docs.py``):
+``shai_scaler_decisions_total`` (ticks evaluated),
+``shai_scaler_scale_up_total`` / ``shai_scaler_scale_down_total``
+(executed steps), ``shai_scaler_holds_total`` (cool-down/hysteresis
+suppressions), ``shai_scaler_flaps_total`` (executed direction
+reversals — rising means the bands are too tight),
+``shai_scaler_herd_capped_total`` (steps clamped — rising means the step
+cap is undersized for the load swings), and
+``shai_scaler_apply_failed_total`` (actuator failures; the decision is
+retried next tick).
+
+Chaos sites (``resilience.faults``): ``scale.decide`` corrupts a tick's
+decision into a spurious max-step scale-up the discipline must absorb;
+``scale.apply`` fails the actuator — the controller keeps its cool-down
+state UNCOMMITTED so the same decision retries next tick instead of
+wedging.
+
+Thread contract (``analysis/contract.py``): all mutable controller state
+(:class:`ScalerStats` counters, the per-pool state map) lives under
+``_lock``; the decision kernel itself is pure host arithmetic, declared
+hot — no I/O, no device sync, no lock held across either.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..resilience import faults as rz_faults
+
+log = logging.getLogger(__name__)
+
+#: matches scripts/project_breakpoints.py — requests price as one prefill
+#: plus (GEN_TOKENS - 1) decode steps
+GEN_TOKENS = 16
+
+#: the exported counter families (serve/metrics naming discipline;
+#: scripts/check_metrics_docs.py scans them here)
+METRIC_FAMILIES = (
+    "shai_scaler_decisions_total", "shai_scaler_scale_up_total",
+    "shai_scaler_scale_down_total", "shai_scaler_holds_total",
+    "shai_scaler_flaps_total", "shai_scaler_herd_capped_total",
+    "shai_scaler_apply_failed_total",
+)
+
+
+def scaler_enabled() -> bool:
+    """``SHAI_SCALER=1`` arms the controller; default off — a fleet
+    without it keeps the static replica counts its manifests declare."""
+    from ..obs.util import env_flag
+
+    return bool(env_flag("SHAI_SCALER", False))
+
+
+# -- configuration ------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ScalerConfig:
+    """The control contract's tunables. The defaults are the tested
+    discipline; :meth:`from_env` overlays the operator knobs. A config
+    with zero cool-downs and collapsed bands is the *de-tuned* control
+    the simulator's negative test proves flappy — keep it in tests."""
+
+    target_burn: float = 1.0      # steady-state burn the pool steers to
+    up_burn: float = 2.0          # hysteresis upper band: grow above it
+    down_burn: float = 0.5        # hysteresis lower band: shrink below it
+    cooldown_up_s: float = 60.0   # fast up
+    cooldown_down_s: float = 600.0   # slow down
+    max_step: int = 4             # herd guard: per-tick replica delta cap
+    min_replicas: int = 1
+    max_replicas: int = 64
+    target_util: float = 0.8      # capacity sizing headroom
+
+    @classmethod
+    def from_env(cls) -> "ScalerConfig":
+        from ..obs.util import env_float, env_int
+
+        return cls(
+            cooldown_up_s=max(0.0, env_float(
+                "SHAI_SCALER_COOLDOWN_UP_S", cls.cooldown_up_s)),
+            cooldown_down_s=max(0.0, env_float(
+                "SHAI_SCALER_COOLDOWN_DOWN_S", cls.cooldown_down_s)),
+            max_step=max(1, env_int("SHAI_SCALER_MAX_STEP",
+                                    cls.max_step)),
+        )
+
+    @classmethod
+    def detuned(cls) -> "ScalerConfig":
+        """No hysteresis, no cool-downs — the naive threshold controller
+        every cloud postmortem warns about. Exists so the simulator can
+        PROVE the flap invariant catches the bug class (the harness
+        acceptance test), never for production use."""
+        return cls(up_burn=1.0, down_burn=1.0, cooldown_up_s=0.0,
+                   cooldown_down_s=0.0)
+
+
+# -- capacity pricing (PERF_MODEL.json) ---------------------------------------
+
+def _default_perf_model_path() -> str:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), "PERF_MODEL.json")
+
+
+class PerfPricer:
+    """Capacity and cost pricing off the committed roofline model —
+    deviceless, so the simulator and the controller share one view of
+    what a pod is worth. Mirrors ``scripts/project_breakpoints.py``:
+    component times divide by the calibrated roofline efficiency
+    ``eta``, a request costs one prefill plus ``GEN_TOKENS - 1`` decode
+    steps at the component's batch width."""
+
+    #: warm-up lead times the scaler charges a new pod before it serves:
+    #: a pod booting from banked AOT artifacts (core/aot.py) loads
+    #: executables instead of compiling them
+    COLD_START_S = 90.0
+    WARM_START_S = 8.0
+
+    def __init__(self, model: Optional[Dict[str, Any]] = None,
+                 path: str = ""):
+        if model is None:
+            try:
+                with open(path or _default_perf_model_path()) as f:
+                    model = json.load(f)
+            except Exception:
+                log.warning("PERF_MODEL unavailable — capacity pricing "
+                            "degrades to burn-only control", exc_info=True)
+                model = {}
+        self.model = model
+        try:
+            self.eta = float(
+                model.get("calibration", {}).get("eta_roofline") or 0.6)
+        except (TypeError, ValueError):
+            self.eta = 0.6
+        self.eta = max(0.05, min(self.eta, 1.0))
+
+    def _component(self, name: str) -> Optional[Tuple[float, int]]:
+        comp = (self.model.get("components") or {}).get(name)
+        if not isinstance(comp, dict):
+            return None
+        try:
+            t = float(comp["t_roofline_s"]) / self.eta
+            b = max(1, int(comp.get("batch", 1)))
+        except (KeyError, TypeError, ValueError):
+            return None
+        return (t, b) if t > 0 else None
+
+    def pod_rps(self, role: str = "both",
+                decode: str = "vllm_decode_b8",
+                prefill: str = "llama1b_prefill",
+                gen_tokens: int = GEN_TOKENS) -> Optional[float]:
+        """Steady-state requests/s one pod of ``role`` sustains, or None
+        when the model lacks the components (control degrades to
+        burn-only sizing)."""
+        dec = self._component(decode)
+        pre = self._component(prefill)
+        if role == "prefill":
+            if pre is None:
+                return None
+            t_pre, b_pre = pre
+            return b_pre / t_pre
+        if role == "decode":
+            if dec is None:
+                return None
+            t_dec, b_dec = dec
+            return b_dec / (max(1, gen_tokens - 1) * t_dec)
+        if dec is None or pre is None:
+            return None
+        t_dec, b_dec = dec
+        t_pre, _ = pre
+        t_req = t_pre + (gen_tokens - 1) * t_dec
+        return b_dec / t_req
+
+    def replicas_for(self, rps: float, role: str = "both",
+                     util: float = 0.8, **kw) -> Optional[int]:
+        """Pods needed to serve ``rps`` at ``util`` fractional loading
+        (the headroom that keeps burn near target instead of at the
+        cliff edge)."""
+        cap = self.pod_rps(role=role, **kw)
+        if cap is None or cap <= 0 or rps <= 0:
+            return None
+        return max(1, int(math.ceil(rps / (cap * max(0.1, util)))))
+
+    def warmup_s(self, aot_root: str = "") -> float:
+        """Lead time before a new pod serves: pods booting from a banked
+        AOT artifact set (``core/aot.py`` manifest present) load
+        executables; cold pods pay the full compile."""
+        if aot_root:
+            try:
+                from ..core.aot import AotCache
+
+                if AotCache(aot_root).keys():
+                    return self.WARM_START_S
+            except Exception:
+                log.debug("AOT bank probe failed", exc_info=True)
+        return self.COLD_START_S
+
+    def cost_per_hr(self, model_cfg: Optional[Dict[str, Any]] = None
+                    ) -> float:
+        """$/pod-hour: models.json ``chip_cost_per_hr`` wins (per-tier
+        pricing), else the PERF_MODEL hw cost, else 1.0."""
+        if isinstance(model_cfg, dict):
+            try:
+                v = float(model_cfg.get("chip_cost_per_hr"))
+                if v > 0:
+                    return v
+            except (TypeError, ValueError):
+                pass
+        try:
+            v = float((self.model.get("hw") or {}).get("cost_hr"))
+            if v > 0:
+                return v
+        except (TypeError, ValueError):
+            pass
+        return 1.0
+
+    def cost_per_mtok(self, model_cfg: Optional[Dict[str, Any]] = None,
+                      role: str = "both",
+                      gen_tokens: int = GEN_TOKENS, **kw
+                      ) -> Optional[float]:
+        """$ per million generated tokens at full pod loading — the
+        $/token view cova's weighted order and the scaler's
+        cheapest-first preference key on."""
+        rps = self.pod_rps(role=role, gen_tokens=gen_tokens, **kw)
+        if rps is None or rps <= 0:
+            return None
+        tok_hr = rps * gen_tokens * 3600.0
+        return self.cost_per_hr(model_cfg) / tok_hr * 1e6
+
+
+def cheapest_first(pools: Sequence[Tuple],
+                   models: Dict[str, Dict[str, Any]],
+                   pricer: Optional[PerfPricer] = None) -> List[Tuple]:
+    """Order pool keys ``(model, geometry, role)`` by ascending
+    $/pod-hour (models.json ``chip_cost_per_hr``), name-stable on ties:
+    when several pools can absorb growth at equal SLO, the scaler and
+    the simulator grow the cheap tier first — the $/token discipline
+    cova's weighted order applies to routing, applied to capacity."""
+    pricer = pricer or PerfPricer(model={})
+
+    def cost_of(key: Tuple) -> float:
+        return pricer.cost_per_hr(models.get(str(key[0])))
+
+    return sorted(pools, key=lambda k: (cost_of(k), tuple(map(str, k))))
+
+
+# -- signals ------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PoolSignal:
+    """One pool's observed state for one tick. ``burn`` is the fast-burn
+    of the role's governing objective (TTFT for prefill, TPOT for
+    decode, their max for combined pods — :func:`role_burn`); ``rps``
+    is offered load for capacity sizing (<= 0 = unknown)."""
+
+    model: str
+    geometry: str = ""
+    role: str = "both"
+    replicas: int = 1
+    burn: float = 0.0
+    slow_burn: float = 0.0
+    breach: bool = False
+    rps: float = -1.0
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.model, self.geometry, self.role)
+
+
+def role_burn(slo: Optional[Dict[str, Any]], role: str) -> float:
+    """The burn signal a role scales on, from an ``obs.slo`` snapshot
+    (or cova's per-backend conformance entry): prefill pools answer for
+    TTFT, decode pools for TPOT, combined pods for whichever is worse.
+    Falls back to ``slo_fast_burn_max`` when only the conformance
+    aggregate is present; 0.0 (healthy) when the pod exports no SLO."""
+    if not isinstance(slo, dict):
+        return 0.0
+
+    def f(key: str) -> float:
+        try:
+            v = slo.get(key)
+            return float(v) if v is not None else 0.0
+        except (TypeError, ValueError):
+            return 0.0
+
+    ttft, tpot = f("ttft_fast_burn"), f("tpot_fast_burn")
+    if role == "prefill":
+        got = ttft
+    elif role == "decode":
+        got = tpot
+    else:
+        got = max(ttft, tpot)
+    return got if got > 0 else f("slo_fast_burn_max")
+
+
+# -- decision metrics ---------------------------------------------------------
+
+class ScalerStats:
+    """The ``shai_scaler_*`` counters: written on every tick by the
+    control loop, snapshotted by ``/stats`` scrapes — lock-guarded, the
+    same contract as :class:`kvnet.migrate.MigrateStats`."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {
+            "decisions": 0, "scale_up": 0, "scale_down": 0, "holds": 0,
+            "flaps": 0, "herd_capped": 0, "apply_failed": 0,
+        }
+
+    def count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] += n
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {k: float(v) for k, v in self._counts.items()}
+
+
+# -- the controller -----------------------------------------------------------
+
+@dataclasses.dataclass
+class _PoolState:
+    replicas: int = 1
+    last_dir: int = 0            # -1 / 0 / +1: last EXECUTED direction
+    last_step_at: float = float("-inf")   # time of last executed step
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One pool's verdict for one tick. ``delta`` is already herd-capped
+    and cool-down gated — the actuator applies it verbatim."""
+
+    key: Tuple[str, str, str]
+    current: int
+    desired: int
+    delta: int
+    reason: str
+    capped: bool = False
+    held: bool = False
+
+
+class Scaler:
+    """Per-(model, geometry, role) replica controller. Deviceless and
+    deterministic: time comes from the injected ``clock`` (the simulator
+    drives virtual hours in milliseconds), randomness only from the
+    fault injector's seeded streams. The decision kernel
+    (:meth:`_decide_pool`) is pure arithmetic on the signal — declared
+    hot in the shai-lint contract."""
+
+    def __init__(self, cfg: Optional[ScalerConfig] = None,
+                 pricer: Optional[PerfPricer] = None,
+                 stats: Optional[ScalerStats] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg or ScalerConfig.from_env()
+        self.pricer = pricer
+        self.stats = stats or ScalerStats()
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._pools: Dict[Tuple[str, str, str], _PoolState] = {}
+
+    # -- pure decision kernel (declared hot: host arithmetic only) ---------
+
+    def _decide_pool(self, sig: PoolSignal, st: _PoolState, now: float
+                     ) -> Decision:
+        cfg = self.cfg
+        # shai-lint: allow(host-sync) PoolSignal.replicas is a plain
+        # Python int off the fleet snapshot — no device value enters
+        # this kernel
+        cur = max(cfg.min_replicas, int(sig.replicas))
+        need: Optional[int] = None
+        if self.pricer is not None and sig.rps > 0:
+            need = self.pricer.replicas_for(sig.rps, role=sig.role,
+                                            util=cfg.target_util)
+        desired, reason = cur, "steady"
+        want_up = sig.breach or sig.burn >= cfg.up_burn \
+            or (need is not None and need > cur)
+        want_down = (not sig.breach and sig.burn <= cfg.down_burn
+                     and sig.slow_burn <= cfg.target_burn
+                     and (need is None or need < cur) and cur
+                     > cfg.min_replicas)
+        if want_up:
+            # burn-proportional step (bounded 2x) vs the capacity view:
+            # take the larger — an SLO on fire must not wait for the
+            # load estimate to catch up
+            by_burn = cur + 1
+            if sig.burn > cfg.target_burn > 0:
+                # shai-lint: allow(host-sync) pure float arithmetic on
+                # the host-side burn signal — nothing device-backed here
+                by_burn = int(math.ceil(
+                    cur * min(sig.burn / cfg.target_burn, 2.0)))
+            desired = max(by_burn, need or 0, cur + 1)
+            reason = "burn" if by_burn >= (need or 0) else "capacity"
+        elif want_down:
+            desired = max(cfg.min_replicas, need if need is not None
+                          else cur - 1)
+            reason = "capacity" if need is not None else "idle"
+        # chaos: a corrupted decision — spurious max-step scale-up — the
+        # discipline below (herd cap, bounds, cool-downs on later ticks)
+        # must absorb; deterministic via the injector's seeded stream
+        inj = rz_faults.get()
+        if inj.active and inj.should_fail(rz_faults.SCALE_DECIDE):
+            desired, reason = cur + cfg.max_step, "chaos-decide"
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        delta = desired - cur
+        capped = held = False
+        if abs(delta) > cfg.max_step:
+            # herd guard: never more than max_step per tick, in either
+            # direction — a thundering scale-up is as destabilizing as a
+            # mass drain
+            delta = cfg.max_step if delta > 0 else -cfg.max_step
+            desired = cur + delta
+            capped = True
+        if delta > 0 and now - st.last_step_at < cfg.cooldown_up_s:
+            delta, desired, held = 0, cur, True
+        elif delta < 0 and now - st.last_step_at < cfg.cooldown_down_s:
+            # the asymmetric window: a down inside cooldown_down_s of ANY
+            # executed step is suppressed — an oscillating signal cannot
+            # alternate directions within the entered direction's window
+            delta, desired, held = 0, cur, True
+        return Decision(sig.key, cur, desired, delta, reason,
+                        capped=capped, held=held)
+
+    # -- tick --------------------------------------------------------------
+
+    def tick(self, signals: Sequence[PoolSignal],
+             now: Optional[float] = None) -> List[Decision]:
+        """Evaluate every pool. Pure relative to controller state: no
+        I/O, no apply — :meth:`run_tick` drives the actuator."""
+        now = self.clock() if now is None else now
+        out: List[Decision] = []
+        with self._lock:
+            for sig in signals:
+                st = self._pools.setdefault(sig.key, _PoolState(
+                    replicas=max(self.cfg.min_replicas, sig.replicas)))
+                d = self._decide_pool(sig, st, now)
+                out.append(d)
+        for d in out:
+            self.stats.count("decisions")
+            if d.held:
+                self.stats.count("holds")
+            elif d.capped:
+                # only clamps that will actually execute count — a capped
+                # wish suppressed by a cool-down is a hold, not a herd
+                # event (the runbook keys sizing the step cap off this)
+                self.stats.count("herd_capped")
+        return out
+
+    def commit(self, d: Decision, now: Optional[float] = None) -> None:
+        """Record one EXECUTED decision (the actuator succeeded): the
+        cool-down clock restarts, a direction reversal counts a flap.
+        An apply failure must NOT commit — the same decision then
+        recomputes and retries next tick."""
+        if d.delta == 0:
+            return
+        now = self.clock() if now is None else now
+        direction = 1 if d.delta > 0 else -1
+        with self._lock:
+            st = self._pools.setdefault(d.key, _PoolState())
+            flapped = st.last_dir != 0 and direction != st.last_dir
+            st.replicas = d.desired
+            st.last_dir = direction
+            st.last_step_at = now
+        self.stats.count("scale_up" if direction > 0 else "scale_down")
+        if flapped:
+            self.stats.count("flaps")
+
+    def run_tick(self, signals: Sequence[PoolSignal],
+                 apply_fn: Callable[[Decision], bool],
+                 now: Optional[float] = None) -> List[Decision]:
+        """One full control cycle: decide, actuate, commit. ``apply_fn``
+        returns truthiness (False/raise = the actuator failed — counted,
+        NOT committed, retried next tick). The ``scale.apply`` chaos
+        site fails the actuate step deterministically."""
+        now = self.clock() if now is None else now
+        decisions = self.tick(signals, now=now)
+        inj = rz_faults.get()
+        for d in decisions:
+            if d.delta == 0:
+                continue
+            ok = False
+            try:
+                if inj.active:
+                    inj.raise_at(rz_faults.SCALE_APPLY)
+                ok = bool(apply_fn(d))
+            except Exception:
+                log.warning("scaler: apply failed for %s — will retry "
+                            "next tick", d.key, exc_info=True)
+            if ok:
+                self.commit(d, now=now)
+            else:
+                self.stats.count("apply_failed")
+        publish(self.snapshot())
+        return decisions
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/stats`` → ``"scaler"`` section: counters plus per-pool
+        controller state (what a human asks first: which pools, which
+        direction, when last moved)."""
+        with self._lock:
+            pools = {
+                "/".join(p for p in k if p): {
+                    "replicas": st.replicas, "last_dir": st.last_dir,
+                    "last_step_at": st.last_step_at,
+                } for k, st in self._pools.items()}
+        return {"counters": self.stats.snapshot(), "pools": pools,
+                "config": {
+                    "up_burn": self.cfg.up_burn,
+                    "down_burn": self.cfg.down_burn,
+                    "cooldown_up_s": self.cfg.cooldown_up_s,
+                    "cooldown_down_s": self.cfg.cooldown_down_s,
+                    "max_step": self.cfg.max_step,
+                }}
+
+
+# -- /stats publication seam --------------------------------------------------
+
+_pub_lock = threading.Lock()
+_published: Optional[Dict[str, Any]] = None
+
+
+def publish(snap: Optional[Dict[str, Any]]) -> None:
+    """Bank the controller's latest snapshot for ``/stats`` → ``scaler``
+    (the controller may run in-process with cova or a sidecar; pods
+    without one simply omit the section)."""
+    global _published
+    with _pub_lock:
+        _published = snap
+
+
+def published() -> Optional[Dict[str, Any]]:
+    with _pub_lock:
+        return dict(_published) if _published else None

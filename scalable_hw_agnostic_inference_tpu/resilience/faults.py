@@ -51,6 +51,15 @@ Sites threaded through the stack (grep for the constant):
   (``kvnet.migrate.publish_entries``): error -> the migrated blocks are
   refused, forcing the warm-resume rung down to recompute-on-peer (the
   manifest is still accepted; the resumed request re-prefills).
+- :data:`SCALE_DECIDE` — the fleet autoscaler's decision kernel
+  (``orchestrate.scaler``): error -> the tick emits a deliberately WRONG
+  decision (a spurious max-step scale-up) instead of the computed one —
+  the control discipline (hysteresis, cool-downs, herd cap) must absorb
+  it and re-converge on subsequent ticks;
+- :data:`SCALE_APPLY` — the autoscaler's apply step: error -> the
+  decision is made but never lands (a failed kubectl / actuator RPC);
+  the controller must NOT commit its cool-down state and must retry the
+  same decision next tick instead of wedging.
 
 The module-level injector is built once from ``SHAI_FAULTS`` /
 ``SHAI_FAULTS_SEED`` and replaced at runtime via :func:`configure` (the
@@ -81,6 +90,11 @@ MIGRATE_RESTORE = "migrate.restore"
 # the probed holder looks dead (breaker-counted), the admission ladder
 # degrades to recompute — never a request failure
 KVFABRIC_PROBE = "kvfabric.probe"
+# the fleet autoscaler (orchestrate.scaler): decide -> a corrupted
+# decision the control discipline must absorb; apply -> the actuator
+# fails and the tick must retry, not wedge
+SCALE_DECIDE = "scale.decide"
+SCALE_APPLY = "scale.apply"
 
 KINDS = ("delay", "stall", "error", "drop")
 

@@ -192,7 +192,16 @@ class ModelService:
         KV run into the local tier and bank the manifest for its replay.
         Returns the ack dict, or None when this pod cannot accept
         migrations (the route then 404s and the shipper degrades to the
-        cold-replay rung)."""
+        cold-replay rung). Raises ``kvnet.migrate.MigrateBusy`` when the
+        inbox is saturated (the route answers 429 + Retry-After and the
+        shipper tries another peer)."""
+        return None
+
+    def migrate_busy(self):
+        """Retry-After seconds when the migration inbox is saturated —
+        the route 429s BEFORE reading the (potentially tens-of-MB)
+        envelope body; None = accepting. Default None: services without
+        an inbox never push back."""
         return None
 
     def pending_handoff(self) -> bool:
@@ -717,6 +726,20 @@ def create_app(
         heads = service.affinity_heads()
         if heads:
             out.setdefault("kvtier", {})["aff_heads"] = heads
+        # fleet autoscaler (PR 19): the controller's latest decision
+        # snapshot — counters (shai_scaler_* families), per-pool state,
+        # and the control contract it ran under — published through the
+        # orchestrate.scaler module seam by an in-process controller
+        # (cova-colocated or the sim harness); pods without one simply
+        # omit the section
+        try:
+            from ..orchestrate.scaler import published as _scaler_pub
+
+            sc = _scaler_pub()
+            if sc:
+                out["scaler"] = sc
+        except Exception:
+            pass
         # disaggregated serving (kvnet): the pod's role — what cova's
         # disagg router partitions the fleet by — plus the transport
         # counters when the pod participates in the network KV plane
@@ -883,6 +906,15 @@ def create_app(
         if drainer.draining:
             raise HTTPError(503, "pod is draining; pick another peer",
                             headers={"retry-after": "1"})
+        # migrate-storm guard (cheap pre-body probe): a saturated inbox /
+        # concurrent-inbound cap answers 429 so a simultaneous multi-pod
+        # drain spreads over the other survivors — the shipper's
+        # ship_any treats this as "try the next peer", never a failure
+        busy_s = service.migrate_busy()
+        if busy_s is not None:
+            raise HTTPError(429, "migration inbox saturated; try "
+                                 "another peer",
+                            headers={"retry-after": f"{float(busy_s):g}"})
         body = request.body
         if not body:
             raise HTTPError(400, "empty migration envelope")
@@ -913,6 +945,13 @@ def create_app(
                 None, _accept)
         except kv_migrate_mod.MigrateError as e:
             raise HTTPError(400, f"bad migration envelope: {e}")
+        except kv_migrate_mod.MigrateBusy as e:
+            # check-then-accept race closed at the real accept gate: a
+            # concurrent burst past the pre-body probe still 429s here
+            raise HTTPError(429, "migration inbox saturated; try "
+                                 "another peer",
+                            headers={"retry-after":
+                                     f"{e.retry_after_s:g}"})
         if ack is None:
             raise HTTPError(404, "this pod does not accept migrations")
         return ack

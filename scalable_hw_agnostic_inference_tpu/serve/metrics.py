@@ -169,6 +169,10 @@ _MIGRATE_COUNTERS = {
                   "migrate: ladder degradations (no peer, refused "
                   "restore, unencodable blocks) — each one recomputed "
                   "instead of failing"),
+    "busy": ("shai_migrate_peer_busy_total",
+             "migrate: 429 answers from saturated peers (inbox full or "
+             "at SHAI_MIGRATE_MAX_INBOUND) — back-pressure the shipper "
+             "routed around, never a failure"),
 }
 #: KV fabric (kvnet.directory.KvFabricStats snapshot keys): the fleet-
 #: wide prefix-pool counters. Runbook: rising stale_holders = the

@@ -690,8 +690,11 @@ class VllmService(ModelService):
         peer = ""
         ack = None
         try:
-            peer = migmod.resolve_migrate_peer(own)
-            if man and peer:
+            # more than one candidate: a 429-busy survivor (saturated
+            # inbox during a simultaneous drain) means try the NEXT one,
+            # not fall to the cold rung
+            peers = migmod.resolve_migrate_peers(own)
+            if man and peers:
                 if own:
                     # the warm-pull rung: this pod holds /kv/blocks open
                     # through the drain, so a peer missing blocks can
@@ -710,7 +713,9 @@ class VllmService(ModelService):
                     entries = tier.get_run(
                         [int(h) for h in man["hashes"]])
                 with obs_trace.span("migrate_ship", annotation=False):
-                    ack = self._kvnet.ship(peer, man, entries)
+                    landed = self._kvnet.ship_any(peers, man, entries)
+                if landed is not None:
+                    peer, ack = landed
         except Exception:
             log.exception("migrate ship failed — degrading to client "
                           "replay")
@@ -801,12 +806,34 @@ class VllmService(ModelService):
             return None
         if not isinstance(manifest, dict) or not manifest.get("prompt_ids"):
             raise migmod.MigrateError("manifest has no prompt_ids")
-        restored = migmod.restore_entries(
-            eng.cache.tier, manifest, entries, eng.obs.migrate,
-            kvnet=self._kvnet)
-        rid = inbox.put(manifest)
-        eng.obs.migrate.count("received")
-        return {"accepted": True, "resume": rid, "restored": int(restored)}
+        # migrate-storm guard: at the concurrent-inbound cap (or a full
+        # inbox) this pod answers 429 so a bin-packing drain sweep spreads
+        # over the other survivors instead of storming this one
+        if not inbox.begin_accept(migmod.migrate_max_inbound()):
+            raise migmod.MigrateBusy()
+        try:
+            restored = migmod.restore_entries(
+                eng.cache.tier, manifest, entries, eng.obs.migrate,
+                kvnet=self._kvnet)
+            rid = inbox.put(manifest)
+            eng.obs.migrate.count("received")
+            return {"accepted": True, "resume": rid,
+                    "restored": int(restored)}
+        finally:
+            inbox.end_accept()
+
+    def migrate_busy(self):
+        """Retry-After seconds when this pod should 429 an inbound
+        migration (saturated inbox / at the concurrent-inbound cap);
+        None = accepting. The route probes this BEFORE reading the
+        envelope body."""
+        from ...kvnet import migrate as migmod
+
+        inbox = getattr(self, "_migrate_inbox", None)
+        if inbox is None:
+            return None
+        return 1.0 if inbox.saturated(migmod.migrate_max_inbound()) \
+            else None
 
     def pending_handoff(self) -> bool:
         """Hold the drain's server open while the host tier still banks

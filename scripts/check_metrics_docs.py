@@ -59,6 +59,9 @@ SCAN_FILES = (
     # "shai_trace"/"shai_span" are not metric names)
     os.path.join(PKG, "obs", "flight.py"),
     os.path.join(PKG, "obs", "autopsy.py"),
+    # the autoscaler's shai_scaler_* family (control-decision counters —
+    # the runbook's flap-vs-herd diagnosis depends on these being doc'd)
+    os.path.join(PKG, "orchestrate", "scaler.py"),
 )
 README = os.path.join(ROOT, "README.md")
 

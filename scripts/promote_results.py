@@ -53,7 +53,18 @@ KEYS = {"sd": "sd21_img_s",
         # shared-system-prompt load — the peer-probe rung pulls the run
         # from the holder pod instead of re-prefilling; token-exactness
         # asserted in-line, errors REQUIRED 0 (bench.py kvfabric)
-        "kvfabric": "kvfabric_warm_ttft_ratio"}
+        "kvfabric": "kvfabric_warm_ttft_ratio",
+        # SLO-burn autoscaler (PR 19): flash-crowd SLO recovery time from
+        # the deviceless trace-driven fleet simulator, PLUS the diurnal
+        # pod-hours ratio (scaled vs static-peak cost at equal
+        # compliance) lifted from the same line; errors REQUIRED 0
+        # (bench.py scaler). A tuple value = (primary from ``value``,
+        # *extras lifted from the line dict by field name).
+        "scaler": ("scaler_recovery_s", "scaler_pod_hours_ratio")}
+
+#: trace-driven simulator benches measure the CONTROL LAW, not the chip —
+#: a cpu run IS the measurement, so the cpu-platform guard does not apply
+DEVICELESS = frozenset({"scaler"})
 
 
 def _load_results() -> dict:
@@ -79,6 +90,16 @@ def is_real(v) -> bool:
             and v["platform"] != "cpu")
 
 
+def is_publishable(key: str, v) -> bool:
+    """is_real, except DEVICELESS keys accept any platform stamp (a
+    well-formed entry still requires one — provenance is never waived)."""
+    if key in DEVICELESS:
+        return (isinstance(v, dict) and "error" not in v
+                and isinstance(v.get("value"), (int, float))
+                and isinstance(v.get("platform"), str))
+    return is_real(v)
+
+
 def _atomic_dump(obj, path: str) -> None:
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
@@ -91,9 +112,14 @@ def main() -> None:
     bench, published = {}, {}
     for k, base_key in KEYS.items():
         v = res.get(k)
-        if is_real(v):
+        if is_publishable(k, v):
             bench[k] = v
-            published[base_key] = v["value"]
+            keys = base_key if isinstance(base_key, tuple) else (base_key,)
+            published[keys[0]] = v["value"]
+            for extra in keys[1:]:
+                # extras ride the bench line under their published name
+                if isinstance(v.get(extra), (int, float)):
+                    published[extra] = v[extra]
     if not bench:
         return
     _atomic_dump(bench, os.path.join(ROOT, "BENCH_onchip.json"))
@@ -124,5 +150,7 @@ if __name__ == "__main__":
             # "bench already done"
             print("usage: promote_results.py --check <key>", file=sys.stderr)
             sys.exit(2)
-        sys.exit(0 if is_real(_load_results().get(sys.argv[2])) else 1)
+        sys.exit(0 if is_publishable(sys.argv[2],
+                                     _load_results().get(sys.argv[2]))
+                 else 1)
     main()

@@ -371,6 +371,62 @@ def test_kvfabric_bench_acceptance_on_cpu_tiny():
     assert out["off_ttft_p50_ms"] > 0 and out["on_ttft_p50_ms"] > 0
 
 
+def test_scaler_key_promotes_recovery_and_pod_hours():
+    # PR-19 tentpole: the autoscaler bench publishes BOTH the recovery
+    # time (the line's value) and the pod-hours ratio (lifted from the
+    # line dict by field name via the KEYS tuple), and dispatches as its
+    # own variant
+    assert promote.KEYS["scaler"] == ("scaler_recovery_s",
+                                      "scaler_pod_hours_ratio")
+    bspec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(ROOT, "bench.py"))
+    bench = importlib.util.module_from_spec(bspec)
+    bspec.loader.exec_module(bench)
+    assert bench._which_from_argv(["bench.py", "scaler"]) == "scaler"
+    assert bench._which_from_argv(["bench.py", "--inner", "scaler",
+                                   "--cpu"]) == "scaler"
+    assert bench.UNITS_BY_BENCH["scaler"] == "s"
+
+
+def test_scaler_is_deviceless_publishable_on_cpu():
+    # the simulator measures the control law, not the chip: a cpu-stamped
+    # scaler entry publishes, while the same stamp on any other key stays
+    # rejected (the ADVICE r3 guard is narrowed, not removed)
+    e = _entry(metric="scaler flash-crowd recovery (deviceless sim)",
+               unit="s", platform="cpu", scaler_pod_hours_ratio=0.7)
+    assert "scaler" in promote.DEVICELESS
+    assert promote.is_publishable("scaler", e)
+    assert not promote.is_real(e)
+    assert not promote.is_publishable("llama", e)
+    # provenance is never waived: a platform-less entry still rejects
+    bare = dict(e)
+    del bare["platform"]
+    assert not promote.is_publishable("scaler", bare)
+    assert not promote.is_publishable("scaler", _entry(error="boom"))
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_scaler_bench_acceptance_on_cpu_tiny():
+    """The PR-19 acceptance numbers, measured: the flash-crowd replay
+    recovers SLO (value > 0), the scaled diurnal fleet costs measurably
+    fewer pod-hours than the static-peak fleet at equal compliance
+    (ratio < 1), and no simulated request failed (errors REQUIRED 0 —
+    the exactly-once terminal contract; the control invariants are
+    asserted inside the bench, a violating run never prints a line)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--inner",
+         "scaler", "--cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu" and out["unit"] == "s"
+    assert out["errors"] == 0, out
+    assert out["value"] > 0
+    assert 0 < out["scaler_pod_hours_ratio"] < 1.0, out
+    assert out["scaled_slo_compliance"] >= 0.95
+    assert out["static_peak_replicas"] >= 2
+
+
 @pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_disagg_bench_acceptance_on_cpu_tiny():
     """The PR-14 acceptance number, measured: under the long mixed-prompt

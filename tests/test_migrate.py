@@ -201,7 +201,7 @@ def test_metrics_collector_exports_migrate_family():
             EngineTelemetryCollector(lambda: tele, "t").collect()}
     for fam in ("shai_migrate_shipped", "shai_migrate_received",
                 "shai_migrate_resumed", "shai_migrate_failed",
-                "shai_migrate_fallbacks"):
+                "shai_migrate_fallbacks", "shai_migrate_peer_busy"):
         assert fam in fams, fam
     assert fams["shai_migrate_resumed"].samples[0].value == 2.0
     # engine-less telemetry exports nothing
@@ -213,7 +213,7 @@ def test_metrics_collector_exports_migrate_family():
     assert set(migmod.METRIC_FAMILIES) == {
         "shai_migrate_shipped_total", "shai_migrate_received_total",
         "shai_migrate_resumed_total", "shai_migrate_failed_total",
-        "shai_migrate_fallbacks_total"}
+        "shai_migrate_fallbacks_total", "shai_migrate_peer_busy_total"}
 
 
 # -- engine-level differential: THE oracle ------------------------------------
