@@ -46,7 +46,7 @@ UNITS_BY_BENCH = {"llama": "tokens/sec", "t5": "sequences/sec",
                   "vllm": "tokens/sec", "kvtier": "x", "qos": "x",
                   "disagg": "x", "ragged": "tokens/sec",
                   "fused": "x", "migrate": "ms", "kvfabric": "x",
-                  "scaler": "s",
+                  "scaler": "s", "hedge": "x",
                   "sd": "images/sec", "sd8": "images/sec",
                   "flux": "images/sec"}
 # $/hr: v5e-1 on-demand (us-central, 1 chip) vs the reference's inf2.xlarge
@@ -77,8 +77,8 @@ def _which_from_argv(argv) -> str:
     if any(a.startswith("llama") for a in argv):
         return "llama"
     for k in ("vllm", "kvtier", "qos", "disagg", "ragged", "fused",
-              "migrate", "kvfabric", "scaler", "flux", "t5", "mllama",
-              "sd8"):
+              "migrate", "kvfabric", "scaler", "hedge", "flux", "t5",
+              "mllama", "sd8"):
         if k in argv:
             return k
     return "sd"
@@ -1574,6 +1574,63 @@ def bench_scaler(tiny: bool) -> dict:
     }
 
 
+def bench_hedge(tiny: bool) -> dict:
+    """Request-reliability line: hedged dispatch under the fleet retry
+    budget, deviceless and trace-driven (``orchestrate/load_sim.py``).
+
+    One pod of four runs at 20% speed — the classic tail-amplification
+    setup: round-robin keeps feeding it, and every request routed there
+    waits out its deepening queue. The A/B replays the SAME steady trace
+    twice: hedging off (the seed behavior), then hedging on with the
+    retry budget funding one tail duplicate per stuck request
+    (``retry_pct`` of primary traffic, the cova discipline). The
+    promoted value is ``p99_off / p99_on`` — how much tail the hedge
+    buys at a bounded (<= 1 + pct) attempt amplification.
+
+    Hard gates, not just numbers: ``errors`` REQUIRED 0 on both runs,
+    ``duplicate_executions`` REQUIRED 0 (the loser of every hedge race
+    is absorbed by the pod-side idempotency model, never completed
+    twice), and every :meth:`SimReport.violations` invariant — including
+    the retry-amplification bound — must hold. ``tiny`` shortens the
+    trace; the reliability machinery (the REAL ``resilience.hedge``
+    classes) is identical.
+    """
+    from scalable_hw_agnostic_inference_tpu.orchestrate import load_sim
+
+    dur = 600.0 if tiny else 1800.0
+    trace = load_sim.SimTrace("slow_pod", dur, lambda t: 4.0, tick_s=15.0)
+    kw = dict(static_replicas=4, slow_pods={0: 0.2}, pod_rps=3.0)
+    off = load_sim.run_fleet_sim(trace, **kw)
+    on = load_sim.run_fleet_sim(trace, hedge=True, retry_pct=0.3, **kw)
+    for tag, rep in (("hedge-off", off), ("hedge-on", on)):
+        viol = rep.violations()
+        assert not viol, f"{tag} invariants violated: {viol}"
+    errors = off.errors + on.errors
+    assert errors == 0, f"{errors} simulated requests failed"
+    dupes = off.double_terminal + on.double_terminal
+    assert dupes == 0, f"{dupes} requests executed to completion twice"
+    p99_off, p99_on = off.latency_p99(), on.latency_p99()
+    assert p99_on > 0, "hedged run completed nothing"
+    ratio = round(p99_off / p99_on, 3)
+    base = _published("hedge_p99_ratio")
+    return {
+        "metric": "hedged-dispatch tail rescue (one 5x-slow pod of 4, "
+                  "p99 hedge-off/hedge-on, deviceless sim)",
+        "value": ratio,
+        "unit": "x",
+        "vs_baseline": round(ratio / base, 3) if base else 1.0,
+        "hedge_p99_ratio": ratio,
+        "p99_off_s": round(p99_off, 1),
+        "p99_on_s": round(p99_on, 1),
+        "hedges_fired": on.hedges,
+        "hedges_deduped": on.deduped,
+        "attempts": on.attempts,
+        "created": on.created,
+        "errors": errors,              # MUST be 0
+        "duplicate_executions": dupes,  # MUST be 0
+    }
+
+
 def bench_flux(tiny: bool) -> dict:
     """Flux (rectified-flow DiT) txt2img on ONE chip.
 
@@ -1838,7 +1895,7 @@ def inner_main() -> None:
            "qos": bench_qos, "disagg": bench_disagg,
            "ragged": bench_ragged, "fused": bench_fused,
            "migrate": bench_migrate, "kvfabric": bench_kvfabric,
-           "scaler": bench_scaler,
+           "scaler": bench_scaler, "hedge": bench_hedge,
            "flux": bench_flux, "t5": bench_t5,
            "mllama": bench_mllama, "sd": bench_sd, "sd8": bench_sd8}[
         _which_from_argv(sys.argv)](tiny)

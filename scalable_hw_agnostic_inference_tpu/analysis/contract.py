@@ -445,6 +445,42 @@ DEFAULT_CONTRACT = Contract(
             lock_guarded={"_pools": "_lock"},
             owning_modules=("orchestrate/scaler.py",),
         ),
+        # Request reliability (PR 20): the idempotency cache takes writes
+        # from every keyed lane thread and reads from scrapes; joiners
+        # park on per-entry events strictly OUTSIDE the lock.
+        "IdempotencyCache": ClassPolicy(
+            immutable_after_init=("max_entries", "ttl_s", "_clock",
+                                  "_lock"),
+            lock_guarded={"_entries": "_lock", "_counts": "_lock"},
+            owning_modules=("resilience/idempotency.py",),
+            instance_markers=("idem.", ".idem"),
+        ),
+        # cova's hedge/budget/poison state is shared between the async
+        # dispatch path and scrape threads; every mutation is a leaf
+        # under the instance lock — the hot_locks entries below keep
+        # httpx (and anything else blocking) out from under them.
+        "RetryBudget": ClassPolicy(
+            immutable_after_init=("pct", "burst", "window", "_lock"),
+            lock_guarded={"_tokens": "_lock", "_counts": "_lock"},
+            owning_modules=("resilience/hedge.py",),
+        ),
+        "HedgeGovernor": ClassPolicy(
+            immutable_after_init=("default_s", "min_s", "max_s",
+                                  "min_samples", "_lock"),
+            lock_guarded={"_lat": "_lock"},
+            owning_modules=("resilience/hedge.py",),
+        ),
+        "PoisonRegistry": ClassPolicy(
+            immutable_after_init=("k", "max_entries", "_lock"),
+            lock_guarded={"_counts": "_lock", "_stats": "_lock"},
+            owning_modules=("resilience/hedge.py",),
+        ),
+        "HedgeStats": ClassPolicy(
+            immutable_after_init=("_lock",),
+            lock_guarded={"_counts": "_lock",
+                          "_follow_depth_max": "_lock"},
+            owning_modules=("resilience/hedge.py",),
+        ),
     },
     dict_guards={
         # serve.app closure state shared between the event loop and lane/
@@ -514,6 +550,16 @@ DEFAULT_CONTRACT = Contract(
             # would freeze the control loop behind one slow pod
             "ScalerStats._lock",
             "Scaler._lock",
+            # request reliability: the idempotency cache fronts every
+            # keyed request (joiners wait on entry events OUTSIDE the
+            # lock), and the hedge/budget/poison locks sit on cova's
+            # dispatch hot path — an httpx call under any of them would
+            # serialize the fan-out behind one slow pod
+            "IdempotencyCache._lock",
+            "RetryBudget._lock",
+            "HedgeGovernor._lock",
+            "PoisonRegistry._lock",
+            "HedgeStats._lock",
         ),
         # The declared partial order is EMPTY on purpose: the control
         # plane's design rule is "no lock nesting at all" — every

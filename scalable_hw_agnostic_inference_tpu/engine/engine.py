@@ -395,7 +395,8 @@ class LLMEngine:
                     orig_n_prompt: int = -1,
                     parent_rid: int = -1,
                     kv_holders: Optional[Sequence[str]] = None,
-                    traceparent: str = "") -> int:
+                    traceparent: str = "",
+                    idem_key: str = "") -> int:
         params = (params or SamplingParams()).clamp(self.ecfg)
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -468,7 +469,8 @@ class LLMEngine:
                                     parent_rid=parent_rid,
                                     kv_holders=[str(u) for u in
                                                 (kv_holders or [])],
-                                    traceparent=str(traceparent or "")))
+                                    traceparent=str(traceparent or ""),
+                                    idem_key=str(idem_key or "")))
         return rid
 
     def fanout_siblings(self, rid: int) -> List[int]:
@@ -528,6 +530,10 @@ class LLMEngine:
             "rng_step": int(self._step_count),
             "hashes": [int(h) for h in hashes],
         }
+        if req.idem_key:
+            # the key survives migration: the peer's resume admits under
+            # the SAME key, so a duplicated resume replay dedupes there
+            man["idem_key"] = req.idem_key
         if p.logprobs and lps is not None:
             man["lps"] = list(lps)
         return man
@@ -2226,6 +2232,7 @@ class LLMEngine:
             t_submit=victim.req.t_submit,
             t_admit=victim.req.t_admit,
             t_first=victim.req.t_first,
+            idem_key=victim.req.idem_key,
             already_lp=(victim.req.already_lp + victim.lps
                         if p.logprobs else [])))
 

@@ -102,7 +102,7 @@ class EngineLoop:
                already_lp: Optional[list] = None,
                orig_n_prompt: int = -1,
                kv_holders: Optional[Sequence[str]] = None,
-               traceparent: str = "") -> Future:
+               traceparent: str = "", idem_key: str = "") -> Future:
         """Enqueue a request; the future resolves to a :class:`Finished`.
 
         ``prefix``: optional soft-prefix embeddings [P, dim] (vision tokens,
@@ -126,7 +126,7 @@ class EngineLoop:
             (list(prompt_ids), params or SamplingParams(),
              (prefix, cross_states, cross_len, on_token, deadline_at,
               priority, tenant, already_generated, already_lp,
-              orig_n_prompt, kv_holders, traceparent), fut))
+              orig_n_prompt, kv_holders, traceparent, idem_key), fut))
         # close the put-after-drain window: if the loop died between our
         # _stop check and the put, nobody will ever drain this item
         if self._stop.is_set():
@@ -223,7 +223,7 @@ class EngineLoop:
             else:
                 (prefix, cross_states, cross_len, on_token, deadline_at,
                  priority, tenant, already_generated, already_lp,
-                 orig_n_prompt, kv_holders, traceparent) = extras
+                 orig_n_prompt, kv_holders, traceparent, idem_key) = extras
                 try:
                     rid = self.engine.add_request(
                         ids, params, prefix=prefix,
@@ -232,7 +232,8 @@ class EngineLoop:
                         priority=priority, tenant=tenant,
                         already_generated=already_generated,
                         already_lp=already_lp, orig_n_prompt=orig_n_prompt,
-                        kv_holders=kv_holders, traceparent=traceparent)
+                        kv_holders=kv_holders, traceparent=traceparent,
+                        idem_key=idem_key)
                     with self._futures_lock:
                         self._futures[rid] = fut
                 except Exception as e:  # bad request (e.g. empty prompt)

@@ -84,6 +84,13 @@ class Request:
     # re-queued remainder is the same tenant's same-priority work.
     priority: int = 1
     tenant: str = ""
+    # request reliability (resilience.idempotency): the request's
+    # idempotency key as minted/forwarded by cova — attribution only at
+    # this layer (the serving layer owns the dedup cache), but it rides
+    # the Request so the migration manifest can carry it and a resumed
+    # duplicate dedupes on the peer through the SAME key. Survives
+    # preemption. "" = keyless (replay protection off for this request).
+    idem_key: str = ""
     # KV fabric (kvnet.directory): holder URLs the router believes hold
     # this prompt's leading KV run — a pushed-down directory slice. A
     # HINT only: the peer-probe rung tries them under its wall budget

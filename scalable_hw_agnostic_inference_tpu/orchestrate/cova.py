@@ -23,6 +23,7 @@ import os
 import random
 import re
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..kvnet.directory import REPLICA_TARGET, KvDirectory
@@ -31,6 +32,7 @@ from ..obs import autopsy as obs_autopsy
 from ..obs import trace as obs_trace
 from ..obs.flight import FlightRecorder
 from ..resilience import faults as rz_faults
+from ..resilience import hedge as rz_hedge
 from ..resilience.breaker import CircuitBreaker
 from ..serve.asgi import App, HTTPError, Request, Response
 
@@ -198,6 +200,25 @@ class CovaClient:
         self._kv_dir = KvDirectory()
         self._fab_hot_n = env_int("SHAI_KVFABRIC_HOT_N", 3)
         self._fab_busy = False          # ONE maintenance pass in flight
+        # request reliability (resilience.hedge): SHAI_HEDGE=1 arms
+        # hedged dispatch, the fleet retry budget, and poison quarantine.
+        # OFF is a strict no-op gate — the unarmed path sends no
+        # idempotency header and walks the ranked order exactly as before
+        # (differential-tested). A CLIENT-supplied key is still forwarded
+        # with hedging off: per-pod dedup is an independent feature.
+        from ..obs.util import env_flag
+
+        self.hedge_on = bool(env_flag("SHAI_HEDGE", False))
+        self.retry_budget = rz_hedge.RetryBudget(
+            pct=env_float("SHAI_RETRY_BUDGET_PCT", 0.1))
+        self.hedge_governor = rz_hedge.HedgeGovernor(
+            default_s=env_float("SHAI_HEDGE_DELAY_S", 0.35))
+        self.poison = rz_hedge.PoisonRegistry(k=env_int("SHAI_POISON_K", 2))
+        self.hstats = rz_hedge.HedgeStats()
+        # migration-follow chain cap: two mutually-draining pods can
+        # ping-pong a resume handle — the chain is bounded, counted
+        # (shai_route_follow_depth), and degrades to a cold replay
+        self.route_follow_max = env_int("SHAI_ROUTE_FOLLOW_MAX", 4)
 
     def url_of(self, name: str) -> str:
         if name not in self.models:
@@ -232,7 +253,30 @@ class CovaClient:
         a recovering backend in lockstep."""
         return 0.05 * (2 ** attempt) * (1.0 + 0.5 * self._rng.random())
 
-    async def post(self, name: str, route: str, payload: Dict) -> Dict:
+    @staticmethod
+    def _upstream_error(what: str, r) -> HTTPError:
+        """A pod's non-200 answer → the HTTPError cova surfaces.
+
+        Backpressure classes keep the pod's OWN status — a migrate-inbox
+        429 or an admission/drain 503 used to flatten to a generic 502,
+        hiding "come back later" behind "broken" — and the pod's
+        ``Retry-After`` rides through to the end client so ITS backoff
+        can honor the pod's pacing. Everything else stays a 502 gateway
+        error; the true upstream status is kept on the exception
+        (``upstream_status``) for the poison classifier, which must tell
+        an engine-crash 500 apart from connect-phase unreachability."""
+        status = r.status_code if r.status_code in (429, 503) else 502
+        hdrs = None
+        ra = r.headers.get("retry-after")
+        if ra:
+            hdrs = {"retry-after": str(ra)}
+        err = HTTPError(status, f"{what} -> {r.status_code}: "
+                                f"{r.text[:200]}", headers=hdrs)
+        err.upstream_status = r.status_code
+        return err
+
+    async def post(self, name: str, route: str, payload: Dict,
+                   headers: Optional[Dict[str, str]] = None) -> Dict:
         import httpx
 
         br = self.breaker_of(name)
@@ -251,7 +295,10 @@ class CovaClient:
         # active (or tracing off) → NOOP span, no header, zero overhead.
         with obs_trace.span(f"hop:{route}", annotation=False, peer=name):
             tp = obs_trace.current_traceparent()
-            headers = {"traceparent": tp} if tp else None
+            hdrs = dict(headers) if headers else {}
+            if tp:
+                hdrs["traceparent"] = tp
+            headers = hdrs or None
             try:
                 while True:
                     try:
@@ -290,9 +337,7 @@ class CovaClient:
                                              f"{type(e).__name__}: {e}")
                     br.record_success()
                     if r.status_code != 200:
-                        raise HTTPError(
-                            502, f"{name}{route} -> {r.status_code}: "
-                                 f"{r.text[:200]}")
+                        raise self._upstream_error(f"{name}{route}", r)
                     return r.json()
             except BaseException:
                 # A CancelledError (or anything the httpx clauses above
@@ -303,6 +348,16 @@ class CovaClient:
                 # unaffected.
                 br.release_probe()
                 raise
+
+    async def _post_k(self, name: str, route: str, payload: Dict,
+                      headers: Optional[Dict[str, str]] = None) -> Dict:
+        """:meth:`post` with the ``headers`` kwarg elided when empty.
+        Test doubles and subclasses stub ``post(name, route, payload)``
+        with a three-argument signature; the unarmed walk (no idempotency
+        key in flight) must keep calling it exactly that way."""
+        if headers:
+            return await self.post(name, route, payload, headers=headers)
+        return await self.post(name, route, payload)
 
     async def fleet(self) -> Dict[str, Any]:
         """Every configured model's ``/stats`` in one fan-out: served
@@ -394,6 +449,20 @@ class CovaClient:
         out["kvfabric"] = self._kv_dir.snapshot()
         if self._kv_dir.size():
             self._kick_fabric_maintenance()
+        # request reliability: hedge/budget/poison counters plus the
+        # quarantine gossip. Any peer advertising its OWN quarantine set
+        # through its stats surface is adopted (merge ratchets, never
+        # lowers) — one router's crash-loop protects the whole fleet
+        for st in results.values():
+            rel = st.get("reliability") if isinstance(st, dict) else None
+            if isinstance(rel, dict) and \
+                    isinstance(rel.get("poison_fingerprints"), list):
+                self.poison.merge(rel["poison_fingerprints"])
+        rel = {**self.hstats.snapshot(), **self.retry_budget.snapshot(),
+               **self.poison.snapshot(),
+               "hedging": bool(self.hedge_on),
+               "poison_fingerprints": self.poison.quarantined()}
+        out["reliability"] = rel
         return out
 
     async def trace_shards(self, trace_id: str) -> Dict[str, Any]:
@@ -595,7 +664,8 @@ class CovaClient:
                                prefill_pods: List[str],
                                decode_pods: List[str],
                                fleet: Dict[str, Any],
-                               holders: Optional[List[str]] = None
+                               holders: Optional[List[str]] = None,
+                               headers: Optional[Dict[str, str]] = None
                                ) -> Optional[Dict[str, Any]]:
         """The disaggregated path: prefill on a prefill-role pod (affinity
         first — a repeat prompt's KV is already banked there), then hand
@@ -662,14 +732,18 @@ class CovaClient:
                     + [n for n in decode_pods if n in ov])
         for name in ranked_d:
             try:
-                out = await self.post(name, "/generate", body)
+                # the idempotency key rides the DECODE stage only (the
+                # charged, generation-producing attempt); a prefill
+                # handoff cached under the key could go stale
+                out = await self._post_k(name, "/generate", body,
+                                         headers=headers)
             except HTTPError:
                 continue
             if isinstance(out, dict) and out.get("migrated"):
                 # the decode pod migrated mid-drain: follow the handoff
                 # (warm resume on its peer, cold replay otherwise)
                 followed = await self._follow_migration(
-                    prompt, params, out, {name}, fleet)
+                    prompt, params, out, {name}, fleet, headers=headers)
                 followed["routed_by"] = "migrated"
                 followed.setdefault("prefill_model", pf_name)
                 return followed
@@ -690,11 +764,11 @@ class CovaClient:
                 return n
         return None
 
-    async def _post_url(self, url: str, route: str,
-                        payload: Dict) -> Dict:
+    async def _post_url(self, url: str, route: str, payload: Dict,
+                        headers: Optional[Dict[str, str]] = None) -> Dict:
         """POST to a raw peer URL (a migration handoff naming a pod this
         orchestrator does not route by name). http(s) only; failures are
-        HTTPError 502 — the caller degrades down the replay ladder."""
+        HTTPError — the caller degrades down the replay ladder."""
         import httpx
 
         if not url.startswith(("http://", "https://")):
@@ -704,58 +778,88 @@ class CovaClient:
         # leg of the SAME request, so its server-side spans join the trace
         with obs_trace.span(f"hop:{route}", annotation=False):
             tp = obs_trace.current_traceparent()
-            headers = {"traceparent": tp} if tp else None
+            hdrs = dict(headers) if headers else {}
+            if tp:
+                hdrs["traceparent"] = tp
             try:
                 r = await self._http().post(f"{url.rstrip('/')}{route}",
-                                            json=payload, headers=headers)
+                                            json=payload,
+                                            headers=hdrs or None)
             except httpx.HTTPError as e:
                 raise HTTPError(502, f"{url}{route} failed: "
                                      f"{type(e).__name__}: {e}")
             if r.status_code != 200:
-                raise HTTPError(502, f"{url}{route} -> {r.status_code}: "
-                                     f"{r.text[:200]}")
+                raise self._upstream_error(f"{url}{route}", r)
             return r.json()
 
     async def _follow_migration(self, prompt: str, params: Dict[str, Any],
                                 handoff: Dict[str, Any], exclude,
-                                fleet: Dict[str, Any]) -> Dict[str, Any]:
+                                fleet: Dict[str, Any],
+                                headers: Optional[Dict[str, str]] = None
+                                ) -> Dict[str, Any]:
         """Follow a ``migrated`` handoff (the draining pod shipped the
         request's state to a peer): replay the resume handle against the
-        peer — the warm rung, KV restored from the migrated blocks — and
+        peer — the warm rung, KV restored from the migrated blocks —
+        following successive re-migrations up to ``SHAI_ROUTE_FOLLOW_MAX``
+        hops (two mutually-draining pods can ping-pong a resume handle;
+        the chain depth feeds the ``shai_route_follow_depth`` gauge), then
         degrade to a cold prompt replay against any remaining
         decode-capable backend. The request fails only when NO capable
         pod exists (the ladder's last rung)."""
-        peer = str(handoff.get("peer") or "")
-        resume = handoff.get("resume")
-        if peer and resume:
+        exclude = set(exclude)
+        cur = handoff
+        depth = 0
+        while True:
+            peer = str(cur.get("peer") or "")
+            resume = cur.get("resume")
+            if not (peer and resume):
+                break
+            depth += 1
+            self.hstats.note_follow_depth(depth)
+            if depth > self.route_follow_max:
+                log.warning("migration follow chain exceeded %d hops — "
+                            "replaying cold", self.route_follow_max)
+                break
             name = self._name_of_url(peer)
             try:
                 if name is not None:
-                    out = await self.post(name, "/generate",
-                                          {"resume": resume})
+                    out = await self._post_k(name, "/generate",
+                                             {"resume": resume},
+                                             headers=headers)
                     out["model"] = name
+                elif headers:
+                    out = await self._post_url(peer, "/generate",
+                                               {"resume": resume},
+                                               headers=headers)
+                    out.setdefault("model", peer)
                 else:
+                    # same three-argument-stub compatibility as _post_k
                     out = await self._post_url(peer, "/generate",
                                                {"resume": resume})
                     out.setdefault("model", peer)
-                if not (isinstance(out, dict) and out.get("migrated")):
-                    return out
-                # the peer's OWN drain re-migrated the replay: a raw
-                # handoff must never reach the client — degrade to the
-                # cold replay below (same guard the cold rung runs)
-                log.warning("migration resume against %s re-migrated — "
-                            "replaying cold", peer)
             except HTTPError:
                 log.warning("migration resume against %s failed — "
                             "replaying cold", peer)
-        # cold rung: full prompt replay, the draining pod excluded
+                break
+            if not (isinstance(out, dict) and out.get("migrated")):
+                return out
+            # the peer's OWN drain re-migrated the replay: a raw handoff
+            # must never reach the client — follow the NEW handle (the
+            # warm state moved with it), depth-capped above
+            log.warning("migration resume against %s re-migrated — "
+                        "following (hop %d)", peer, depth)
+            if name is not None:
+                exclude.add(name)
+            cur = out
+        # cold rung: full prompt replay, every draining pod excluded
         last: Optional[HTTPError] = None
         for name in self.weighted_order():
             if name in exclude or self._role_of(name, fleet) == "prefill":
                 continue
             try:
-                out = await self.post(name, "/generate",
-                                      {"prompt": prompt, **params})
+                out = await self._post_k(name, "/generate",
+                                         {"prompt": prompt, **params},
+                                         headers=headers)
             except HTTPError as e:
                 last = e
                 continue
@@ -766,8 +870,111 @@ class CovaClient:
         raise last if last is not None else HTTPError(
             502, "request migrated but no peer could resume or replay it")
 
+    # -- request reliability (SHAI_HEDGE): hedged dispatch, retry budget,
+    # -- poison quarantine ---------------------------------------------------
+
+    @staticmethod
+    def _is_abnormal(e: HTTPError) -> bool:
+        """Did this attempt die ABNORMALLY — the poison signal? Yes for a
+        pod answering 500 (engine crash / watchdog abort surfaced by the
+        serve layer) and for the connection breaking mid-exchange (the
+        read-phase ``failed`` 502: the engine likely died under the
+        request). No for deadline 504s, admission/drain sheds (429/503),
+        and connect-phase unreachability — those indict the pod or the
+        deadline, not the request payload."""
+        if getattr(e, "upstream_status", 0) == 500:
+            return True
+        return e.status == 502 and " failed: " in str(e.detail)
+
+    def _quarantine_error(self, fp: str) -> HTTPError:
+        return HTTPError(
+            422, f"request quarantined as poison: fingerprint {fp} killed "
+                 f"{self.poison.k} engine attempt(s) abnormally; fix the "
+                 f"payload or restart the orchestrator to clear the "
+                 f"quarantine (shai_poison_* counters have the story)")
+
+    async def _attempt(self, name: str, body: Dict[str, Any],
+                       hdrs: Optional[Dict[str, str]],
+                       fp: Optional[str]) -> Dict[str, Any]:
+        """One armed attempt: POST, abnormal-death classification into
+        the poison registry, and the primary-latency feed that tunes the
+        hedge governor's adaptive p95 delay."""
+        t0 = time.monotonic()
+        try:
+            out = await self._post_k(name, "/generate", body, headers=hdrs)
+        except HTTPError as e:
+            if fp is not None and self._is_abnormal(e):
+                self.poison.note_abnormal(fp)
+            raise
+        self.hedge_governor.note(time.monotonic() - t0)
+        return out
+
+    async def _hedged_post(self, primary: str, pending: List[str],
+                           body_of, hdrs: Optional[Dict[str, str]],
+                           fp: Optional[str]) -> Tuple[str, Dict[str, Any]]:
+        """The hedged first rung: launch the primary and, if it has not
+        resolved within the governor's adaptive p95 delay, fire ONE hedge
+        at the next-ranked pod (budget-gated; ``hedge.fire`` chaos site).
+        The first SUCCESS wins; the loser is cancelled — a duplicate that
+        already landed on its pod is absorbed by that pod's idempotency
+        cache under the shared key, so nothing executes to completion
+        twice. Both legs failing surfaces the last failure; abnormal
+        deaths on EITHER leg feed the poison registry. The hedged pod is
+        consumed from ``pending`` so the retry walk never re-posts it."""
+        t0 = time.monotonic()
+        p_task = asyncio.ensure_future(
+            self._post_k(primary, "/generate", body_of(primary),
+                         headers=hdrs))
+        tasks: "Dict[asyncio.Future, str]" = {p_task: primary}
+        try:
+            await asyncio.wait({p_task},
+                               timeout=self.hedge_governor.hedge_delay_s())
+            if not p_task.done() and pending:
+                inj = rz_faults.get()
+                await inj.asleep_at(rz_faults.HEDGE_FIRE)
+                if inj.should_fail(rz_faults.HEDGE_FIRE):
+                    log.warning("hedge.fire fault: hedge suppressed")
+                elif not p_task.done() and self.retry_budget.try_spend():
+                    hname = pending.pop(0)
+                    self.hstats.count("fired")
+                    h_task = asyncio.ensure_future(
+                        self._post_k(hname, "/generate", body_of(hname),
+                                     headers=hdrs))
+                    tasks[h_task] = hname
+            last: Optional[HTTPError] = None
+            live = set(tasks)
+            while live:
+                done, live = await asyncio.wait(
+                    live, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    try:
+                        out = t.result()
+                    except HTTPError as e:
+                        if fp is not None and self._is_abnormal(e):
+                            self.poison.note_abnormal(fp)
+                        last = e
+                        continue
+                    if t is p_task:
+                        self.hedge_governor.note(time.monotonic() - t0)
+                    else:
+                        self.hstats.count("wins")
+                    return tasks[t], out
+            raise last if last is not None else HTTPError(
+                502, f"{primary}/generate: hedged dispatch resolved "
+                     f"nothing")
+        finally:
+            losers = [t for t in tasks if not t.done()]
+            for t in losers:
+                t.cancel()
+            if losers:
+                self.hstats.count("cancelled", len(losers))
+                # absorb the cancellations (post()'s BaseException clause
+                # releases any breaker probe slot they hold)
+                await asyncio.gather(*losers, return_exceptions=True)
+
     async def generate(self, prompt: str, params: Dict[str, Any],
-                       names: Optional[List[str]] = None) -> Dict[str, Any]:
+                       names: Optional[List[str]] = None,
+                       idem_key: str = "") -> Dict[str, Any]:
         """Route ONE generation to the best backend. Disaggregated first:
         with a prefill-role AND a decode-capable backend live, prefill
         runs on the prefill tier and the warm KV reference hands off to a
@@ -775,10 +982,29 @@ class CovaClient:
         stage declines — monolithic routing: prefix-affinity first (the
         pod already holding this prompt's warm KV), weighted order as the
         fallback; a failed backend falls through to the next instead of
-        failing the request."""
+        failing the request.
+
+        With ``SHAI_HEDGE=1`` the monolithic walk is hedged and budgeted:
+        known-poison fingerprints are rejected 422 before any pod sees
+        them, every attempt carries ONE idempotency key (``idem_key`` from
+        the client, minted otherwise) so duplicates dedupe per-pod, the
+        first rung may fire a tail hedge, and retries after retryable
+        failures (connect 502 / drain 503 / migrate-busy 429) draw from
+        the fleet retry budget. Off (the default) this path is a strict
+        no-op: no header minted, identical walk."""
         order = self.weighted_order(names)
         if not order:
             raise HTTPError(400, "no text-generation models configured")
+        key = str(idem_key or "")
+        fp: Optional[str] = None
+        if self.hedge_on:
+            fp = rz_hedge.fingerprint(prompt, params)
+            if self.poison.is_quarantined(fp):
+                self.poison.note_rejected()
+                raise self._quarantine_error(fp)
+            if not key:
+                key = uuid.uuid4().hex
+        hdrs = {rz_hedge.HEDGE_HEADER: key} if key else None
         fleet = await self._fleet_for_routing()
         # KV fabric: resolve the prompt's chain head via the affinity
         # digest, then its directory-confirmed holders. Holder URLs ride
@@ -801,7 +1027,8 @@ class CovaClient:
         if prefill_pods and decodable:
             out = await self._generate_disagg(prompt, params, prefill_pods,
                                               decodable, fleet,
-                                              holders=holder_names)
+                                              holders=holder_names,
+                                              headers=hdrs)
             if out is not None:
                 return out
         if not decodable:
@@ -809,31 +1036,63 @@ class CovaClient:
                                  "configured backend is prefill-role)")
         ranked, warm = self.rank_backends(prompt, decodable, fleet,
                                           holders=holder_names)
-        last: Optional[HTTPError] = None
-        for name in ranked:
+
+        def body_of(n: str) -> Dict[str, Any]:
             body = {"prompt": prompt, **params}
             if holder_urls:
                 # push the directory slice down, the target itself
                 # excluded (it needs PEERS to pull from, not its own
                 # address back)
-                push = [u for u in holder_urls
-                        if u != self.url_of(name)][:3]
+                push = [u for u in holder_urls if u != self.url_of(n)][:3]
                 if push:
                     body["kv_holders"] = push
+            return body
+
+        last: Optional[HTTPError] = None
+        pending = list(ranked)
+        attempt_no = 0
+        while pending:
+            name = pending.pop(0)
+            if self.hedge_on:
+                if attempt_no == 0:
+                    self.retry_budget.note_primary()
+                elif not self.retry_budget.try_spend():
+                    break   # budget dry: stop the walk, surface the last
+            attempt_no += 1
             try:
-                out = await self.post(name, "/generate", body)
+                if not self.hedge_on:
+                    out = await self._post_k(name, "/generate",
+                                             body_of(name), headers=hdrs)
+                elif attempt_no == 1 and pending:
+                    name, out = await self._hedged_post(
+                        name, pending, body_of, hdrs, fp)
+                else:
+                    out = await self._attempt(name, body_of(name), hdrs, fp)
             except HTTPError as e:
                 last = e
+                if self.hedge_on:
+                    # after the Kth abnormal death the fingerprint is
+                    # quarantined — answer 422 NOW instead of crash-
+                    # looping yet another pod on the same payload
+                    if fp is not None and self.poison.is_quarantined(fp):
+                        self.poison.note_rejected()
+                        raise self._quarantine_error(fp) from e
+                    if e.status not in (429, 502, 503):
+                        raise   # deadline 504 / 4xx: never retried
                 continue
             if isinstance(out, dict) and out.get("migrated"):
                 # the pod is draining and shipped this request's state to
                 # a peer — follow the handoff (resume warm, replay cold)
                 followed = await self._follow_migration(
-                    prompt, params, out, {name}, fleet)
+                    prompt, params, out, {name}, fleet, headers=hdrs)
                 followed["routed_by"] = "migrated"
                 return followed
             out["model"] = name
             out["routed_by"] = "affinity" if name in warm else "weighted"
+            if key and self.hedge_on:
+                # surface the (possibly minted) key so the client can
+                # replay idempotently on ITS OWN retries
+                out.setdefault("idempotency_key", key)
             return out
         raise last if last is not None else HTTPError(
             502, "no backend accepted the request")
@@ -994,7 +1253,12 @@ def create_cova_app(models_path: str) -> App:
                   ("temperature", "top_k", "top_p", "max_new_tokens",
                    "logprobs")
                   if k in body}
-        return await client.generate(prompt, params, body.get("models"))
+        # a client-supplied idempotency key rides the whole route (hedges,
+        # retries, migration resumes dedupe under it pod-side); absent and
+        # with SHAI_HEDGE=1, cova mints one
+        key = request.headers.get(rz_hedge.HEDGE_HEADER, "")
+        return await client.generate(prompt, params, body.get("models"),
+                                     idem_key=key)
 
     @app.post("/compare")
     async def compare(request: Request):

@@ -229,6 +229,15 @@ class SimReport:
     double_terminal: int = 0
     migrated: int = 0
     cold_replays: int = 0
+    # request reliability (PR 20): client-retry / hedge / poison modeling
+    attempts: int = 0                 # pod-service attempts (incl. dupes)
+    retries: int = 0                  # budget-funded re-enqueues
+    hedges: int = 0                   # budget-funded tail duplicates
+    deduped: int = 0                  # duplicate attempts absorbed
+    quarantined: int = 0              # poison requests answered 422
+    retry_pct: float = 0.0
+    retry_burst: float = 0.0
+    latencies: List[float] = dataclasses.field(default_factory=list)
     # economics
     pod_hours: float = 0.0
     counters: Dict[str, float] = dataclasses.field(default_factory=dict)
@@ -269,6 +278,17 @@ class SimReport:
         return (sum(self.slo_ok) / len(self.slo_ok)) if self.slo_ok \
             else 1.0
 
+    def latency_p99(self) -> float:
+        """Nearest-rank p99 of completed-request latencies (same
+        definition as ``bench.py``'s ``_pctl``); 0 when nothing
+        completed."""
+        xs = sorted(self.latencies)
+        if not xs:
+            return 0.0
+        idx = max(0, min(len(xs) - 1,
+                         int(round(0.99 * len(xs) + 0.5)) - 1))
+        return xs[idx]
+
     def violations(self, max_flips_per_hr: Optional[float] = None
                    ) -> List[str]:
         """The policy invariants, as human-readable findings. Empty =
@@ -302,15 +322,30 @@ class SimReport:
                 out.append(f"storm: {n} inbound migrations on one pod in "
                            f"tick {i} (cap {self.max_inbound})")
         # exactly-once terminal accounting across scale-down and kills
-        if self.completed + self.errors != self.created:
+        # (quarantined is a legitimate terminal class: the poison request
+        # was ANSWERED — with a 422 — not lost)
+        if self.completed + self.errors + self.quarantined != self.created:
             out.append(f"ledger: {self.created} created but "
                        f"{self.completed} completed + {self.errors} "
-                       f"errors")
+                       f"errors + {self.quarantined} quarantined")
         if self.double_terminal:
             out.append(f"ledger: {self.double_terminal} requests reached "
                        f"a terminal state twice")
         if self.errors:
             out.append(f"errors: {self.errors} requests failed")
+        # retry-storm guard: with client retries / hedging modeled, total
+        # attempt amplification stays inside the token-bucket bound —
+        # (1 + pct)·created plus the one-time burst (cold replays are the
+        # migration ladder's, not the client's, so they get their own
+        # allowance)
+        if self.attempts and (self.retry_pct > 0 or self.hedges):
+            bound = self.created * (1.0 + self.retry_pct) \
+                + self.retry_burst + self.cold_replays
+            if self.attempts > bound + 1e-6:
+                out.append(f"amplification: {self.attempts} attempts for "
+                           f"{self.created} requests exceeds "
+                           f"(1+{self.retry_pct:g})*created + burst "
+                           f"{self.retry_burst:g}")
         # SLO recovery within the declared transient window
         if self.event_at_s is not None:
             rec = self.recovery_s()
@@ -340,8 +375,17 @@ class FleetSim:
                  static_replicas: Optional[int] = None,
                  budget_frac: float = 0.05,
                  transient_window_s: float = 900.0,
-                 aot_banked: bool = True):
+                 aot_banked: bool = True,
+                 crash_pids: Sequence[int] = (),
+                 poison_rids: Sequence[int] = (),
+                 slow_pods: Optional[Dict[int, float]] = None,
+                 hedge: bool = False,
+                 hedge_delay_s: Optional[float] = None,
+                 retry_pct: float = 0.0,
+                 retry_burst: float = 2.0,
+                 poison_k: int = 2):
         from ..kvnet.migrate import migrate_max_inbound
+        from ..resilience.hedge import PoisonRegistry, RetryBudget
 
         self.trace = trace
         self.cfg = cfg or scaler_mod.ScalerConfig()
@@ -364,6 +408,27 @@ class FleetSim:
         self._terminal: Dict[int, int] = {}
         self._backlog: List[Tuple[int, float]] = []
         self._burn_hist: List[float] = []
+        # request reliability modeling (PR 20; all default-off — the
+        # PR-19 traces replay tick-for-tick unchanged): crash_pids die
+        # abnormally under every service attempt, poison_rids crash ANY
+        # pod, slow_pods maps pid -> service-capacity multiplier, hedge
+        # duplicates tail-stuck work, retry_pct funds the client-retry
+        # token bucket (the REAL resilience.hedge classes run here)
+        self.crash_pids = set(crash_pids)
+        self.poison_rids = set(poison_rids)
+        self.speed = dict(slow_pods or {})
+        self.hedge = bool(hedge)
+        self.hedge_delay_s = hedge_delay_s if hedge_delay_s is not None \
+            else 1.5 * trace.tick_s
+        self.retry_pct = float(retry_pct)
+        self.retry_budget = RetryBudget(pct=self.retry_pct,
+                                        burst=retry_burst)
+        self.poison = PoisonRegistry(k=poison_k)
+        self._rel_on = bool(self.crash_pids or self.poison_rids
+                            or self.speed or self.hedge
+                            or self.retry_pct > 0)
+        self._hedged: set = set()          # rids already duplicated once
+        self._avoid: Dict[int, set] = {}   # rid -> pids that failed it
         n0 = static_replicas if static_replicas is not None \
             else initial_replicas
         for _ in range(max(1, n0)):
@@ -372,7 +437,8 @@ class FleetSim:
             trace=trace.name, tick_s=trace.tick_s, cfg=self.cfg,
             max_inbound=self.max_inbound,
             transient_window_s=transient_window_s,
-            event_at_s=trace.event_at_s)
+            event_at_s=trace.event_at_s,
+            retry_pct=self.retry_pct, retry_burst=float(retry_burst))
 
     # -- fleet actions ------------------------------------------------------
 
@@ -477,16 +543,88 @@ class FleetSim:
 
     # -- one tick -----------------------------------------------------------
 
-    def _terminate(self, rid: int, ok: bool) -> None:
+    def _terminate(self, rid: int, ok: bool,
+                   quarantined: bool = False) -> None:
         n = self._terminal.get(rid, 0) + 1
         self._terminal[rid] = n
         if n > 1:
             self.report.double_terminal += 1
             return
-        if ok:
+        if quarantined:
+            self.report.quarantined += 1
+        elif ok:
             self.report.completed += 1
         else:
             self.report.errors += 1
+
+    # -- request reliability modeling (PR 20) -------------------------------
+
+    def _place(self, item: Tuple[int, float], serving: List[SimPod],
+               i: int) -> None:
+        """Avoid-aware placement: a retry never goes back to a pod that
+        already failed it (cova's ranked walk excludes the failed pod) —
+        round-robin over the rest; all-avoided degrades to plain
+        round-robin."""
+        rid = item[0]
+        avoid = self._avoid.get(rid)
+        cands = [p for p in serving if p.pid not in avoid] if avoid \
+            else serving
+        if not cands:
+            cands = serving
+        cands[i % len(cands)].queue.append(item)
+
+    def _hedge_step(self, t: float) -> None:
+        """Tail hedging: a request stuck in one pod's queue past the
+        hedge delay is duplicated ONCE onto the least-loaded other
+        serving pod, budget permitting. The duplicate that loses the
+        race is absorbed by the dedup check in :meth:`_serve_one` —
+        never a second completion."""
+        serving = self._serving()
+        if len(serving) < 2:
+            return
+        for p in serving:
+            for rid, t0 in p.queue:
+                if t - t0 < self.hedge_delay_s or rid in self._hedged \
+                        or self._terminal.get(rid):
+                    continue
+                if not self.retry_budget.try_spend():
+                    return      # budget dry: no more hedges this tick
+                self._hedged.add(rid)
+                self.report.hedges += 1
+                target = min((q for q in serving if q is not p),
+                             key=lambda q: (len(q.queue), q.pid))
+                target.queue.append((rid, t0))
+
+    def _serve_one(self, p: SimPod, rid: int, t0: float,
+                   t: float) -> bool:
+        """One service attempt under the reliability model. Returns True
+        when the attempt COMPLETED work (success or absorbed duplicate)
+        — the SLO served/late accounting keys on that."""
+        rep = self.report
+        rep.attempts += 1
+        if self._terminal.get(rid):
+            # the pod-side idempotency cache absorbs the duplicate: it
+            # consumed a service slot but never double-completes
+            rep.deduped += 1
+            return True
+        if p.pid not in self.crash_pids and rid not in self.poison_rids:
+            self._terminate(rid, ok=True)
+            rep.latencies.append(t - t0 + self.trace.tick_s)
+            return True
+        # abnormal death (engine crash under this request)
+        n = self.poison.note_abnormal(f"r{rid}")
+        self._avoid.setdefault(rid, set()).add(p.pid)
+        if n >= self.poison.k:
+            # Kth abnormal attempt: quarantined, answered 422 — terminal
+            self._terminate(rid, ok=False, quarantined=True)
+            return False
+        if self.retry_budget.try_spend():
+            rep.retries += 1
+            self._backlog.append((rid, t0))
+        else:
+            # budget dry: the failure surfaces instead of self-amplifying
+            self._terminate(rid, ok=False)
+        return False
 
     def step(self) -> None:
         trace, rep = self.trace, self.report
@@ -508,9 +646,16 @@ class FleetSim:
             self._next_rid += 1
             rep.created += 1
             arrivals.append((rid, t))
+        if n_new and self._rel_on:
+            # primary traffic feeds the retry budget (pct tokens each)
+            self.retry_budget.note_primary(n_new)
         if serving:
-            for i, item in enumerate(arrivals):
-                serving[i % len(serving)].queue.append(item)
+            if self._rel_on:
+                for i, item in enumerate(arrivals):
+                    self._place(item, serving, i)
+            else:
+                for i, item in enumerate(arrivals):
+                    serving[i % len(serving)].queue.append(item)
         else:
             self._backlog = arrivals
         # 2b) trace events: pod kills land mid-tick, AFTER arrivals — a
@@ -521,13 +666,23 @@ class FleetSim:
                 self._kill(n)
         # 3) drain ladder ships under the per-peer inbound cap
         self._migrate_step()
+        # 3b) tail hedging (reliability modeling; off by default)
+        if self.hedge:
+            self._hedge_step(t)
         # 4) service: each serving pod completes up to its tick capacity
+        # (slow pods run at their declared fraction of it)
         cap = max(1, int(self.pod_rps * trace.tick_s))
         served = late = 0
         for p in self._serving():
-            take, p.queue = p.queue[:cap], p.queue[cap:]
+            cap_p = max(1, int(cap * self.speed.get(p.pid, 1.0))) \
+                if self.speed else cap
+            take, p.queue = p.queue[:cap_p], p.queue[cap_p:]
             for rid, t0 in take:
-                self._terminate(rid, ok=True)
+                if self._rel_on:
+                    if not self._serve_one(p, rid, t0, t):
+                        continue    # crashed/quarantined: not "served"
+                else:
+                    self._terminate(rid, ok=True)
                 served += 1
                 if t - t0 >= trace.tick_s:
                     late += 1
@@ -576,6 +731,9 @@ class FleetSim:
             self.step()
             settle += 1
         self.report.counters = self.scaler.stats.snapshot()
+        if self._rel_on:
+            self.report.counters.update(self.retry_budget.snapshot())
+            self.report.counters.update(self.poison.snapshot())
         return self.report
 
 

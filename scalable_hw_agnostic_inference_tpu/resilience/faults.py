@@ -60,6 +60,20 @@ Sites threaded through the stack (grep for the constant):
   decision is made but never lands (a failed kubectl / actuator RPC);
   the controller must NOT commit its cool-down state and must retry the
   same decision next tick instead of wedging.
+- :data:`HEDGE_FIRE` — cova's hedged-dispatch rung (``resilience.hedge``
+  via ``orchestrate.cova``): delay -> added latency between "the primary
+  looks slow" and the hedge actually launching, error -> the hedge is
+  suppressed (the primary must still win or fail on its own) — so chaos
+  tests drive BOTH the hedge-fired and hedge-denied paths
+  deterministically;
+- :data:`IDEMP_LOOKUP` — the per-pod idempotency-cache lookup
+  (``resilience.idempotency``): error -> the lookup degrades to a cache
+  MISS (the request executes; at-most-once degrades to at-least-once,
+  never to a dropped request), delay -> a slow lookup;
+- :data:`POISON_MARK` — the poison-registry mark after an abnormal
+  engine death (``resilience.hedge.PoisonRegistry``): error -> the mark
+  is lost (the quarantine needs one more abnormal attempt), so tests
+  prove the K-threshold counts MARKS, not attempts.
 
 The module-level injector is built once from ``SHAI_FAULTS`` /
 ``SHAI_FAULTS_SEED`` and replaced at runtime via :func:`configure` (the
@@ -95,6 +109,12 @@ KVFABRIC_PROBE = "kvfabric.probe"
 # fails and the tick must retry, not wedge
 SCALE_DECIDE = "scale.decide"
 SCALE_APPLY = "scale.apply"
+# request reliability (resilience.hedge / resilience.idempotency via
+# orchestrate.cova and serve.app): hedge launch, per-pod idempotency
+# lookup, and the poison-registry mark after an abnormal engine death
+HEDGE_FIRE = "hedge.fire"
+IDEMP_LOOKUP = "idemp.lookup"
+POISON_MARK = "poison.mark"
 
 KINDS = ("delay", "stall", "error", "drop")
 

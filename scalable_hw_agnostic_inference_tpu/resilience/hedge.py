@@ -1,0 +1,258 @@
+"""Fleet retry discipline: hedged dispatch, a token-bucket retry budget,
+and poison-request quarantine (used by ``orchestrate.cova``).
+
+Three classic fleet-killers, one module:
+
+- **Tail amplification** — a single slow pod drags p99 for every request
+  routed there. :class:`HedgeGovernor` tracks recent primary latencies
+  and, once the primary attempt outlives the adaptive p95 delay, cova
+  fires ONE hedge to the next-ranked healthy pod; first winner answers,
+  the loser is cancelled.
+- **Retry storms** — naive retries turn a brownout into an outage by
+  multiplying offered load exactly when capacity dipped.
+  :class:`RetryBudget` is a token bucket fed by *primary* traffic
+  (``SHAI_RETRY_BUDGET_PCT`` tokens per primary attempt, default 0.1):
+  every hedge and every retry spends one token, so fleet-wide attempt
+  amplification is bounded at ``1 + pct`` (plus the small initial
+  burst) no matter how degraded the fleet is — a starved budget sheds
+  instead of self-amplifying.
+- **Poison requests** — a request that crashes an engine gets faithfully
+  re-routed and crashes the next pod. :class:`PoisonRegistry`
+  fingerprints each request; an attempt that dies *abnormally* (engine
+  crash, watchdog stall — NOT deadline timeouts, NOT 429/503 sheds)
+  marks the fingerprint, and after ``SHAI_POISON_K`` marks the request
+  is quarantined: answered 422 with a diagnostic instead of crash-
+  looping a third pod. Registries merge through ``/fleet`` so one pod's
+  quarantine protects the whole fleet.
+
+Exported counters (cova's ``/fleet`` -> ``"reliability"``;
+``scripts/check_metrics_docs.py`` scans the families here):
+``shai_hedge_fired_total`` / ``shai_hedge_wins_total`` /
+``shai_hedge_cancelled_total`` (hedges launched / hedges that answered
+first / losers cancelled), ``shai_retry_budget_spent_total`` /
+``shai_retry_budget_exhausted_total`` (tokens drawn / attempts denied —
+the runbook split: exhausted rising means the FLEET is browning out,
+while ``shai_poison_quarantined_total`` rising means a CLIENT payload is
+bad), ``shai_poison_marked_total`` / ``shai_poison_quarantined_total`` /
+``shai_poison_rejected_total`` (abnormal deaths marked / fingerprints
+crossing K / requests answered 422), and ``shai_route_follow_depth``
+(deepest migration-handoff chain cova has followed — bounded by
+``SHAI_ROUTE_FOLLOW_MAX``).
+
+Chaos sites (``resilience.faults``): ``hedge.fire`` delays or suppresses
+the hedge launch; ``poison.mark`` loses a mark (the quarantine needs one
+more abnormal attempt). ``idemp.lookup`` lives with the cache in
+``resilience.idempotency``.
+
+Threading: cova is async but the serve layer may share these from lane
+threads; every mutation moves under the instance ``_lock`` (declared HOT
+in ``analysis/contract.py`` — no I/O, no HTTP, nothing blocking under
+any of them; the PR-14 httpx-under-lock lesson).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Dict, Iterable, List, Optional
+
+from . import faults
+
+#: header cova mints/forwards so pod-side dedup and charge-once work
+#: (kept in sync with resilience.idempotency.IDEMP_HEADER)
+HEDGE_HEADER = "x-shai-idempotency-key"
+
+
+def fingerprint(prompt: str, params: Optional[Dict[str, Any]] = None) -> str:
+    """Stable request fingerprint for the poison registry: the prompt
+    plus the sampling params (sorted, JSON-normalized). Short on purpose
+    — it names the request in diagnostics and ``/fleet`` payloads."""
+    h = hashlib.sha256()
+    h.update(prompt.encode("utf-8", "replace"))
+    if params:
+        h.update(json.dumps(params, sort_keys=True,
+                            default=str).encode("utf-8"))
+    return h.hexdigest()[:16]
+
+
+class RetryBudget:
+    """Token bucket fed by primary traffic: ``pct`` tokens per primary
+    attempt, one token per hedge/retry. The initial balance equals
+    ``burst`` so a cold orchestrator can still retry its very first
+    failures, and the bank is capped at the last ``window`` primaries'
+    worth of allowance (``pct * window``) — a long healthy stretch can't
+    pre-pay an unbounded storm. Total spend is ``<= burst +
+    pct * primaries`` by construction (inflow is exactly ``pct`` per
+    primary), which is the fleet amplification invariant the chaos sim
+    audits."""
+
+    def __init__(self, pct: float = 0.1, burst: float = 2.0,
+                 window: int = 600):
+        self.pct = max(0.0, float(pct))
+        self.burst = max(0.0, float(burst))
+        self.window = max(1, int(window))
+        self._lock = threading.Lock()
+        self._tokens = self.burst
+        self._counts = {"spent": 0, "exhausted": 0}
+
+    def note_primary(self, n: int = 1) -> None:
+        with self._lock:
+            self._tokens = min(self._tokens + self.pct * n,
+                               max(self.burst, self.pct * self.window))
+
+    def try_spend(self, cost: float = 1.0) -> bool:
+        with self._lock:
+            if self._tokens + 1e-9 >= cost:
+                self._tokens -= cost
+                self._counts["spent"] += 1
+                return True
+            self._counts["exhausted"] += 1
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {"shai_retry_budget_spent_total":
+                    float(self._counts["spent"]),
+                    "shai_retry_budget_exhausted_total":
+                    float(self._counts["exhausted"]),
+                    "retry_budget_tokens": round(self._tokens, 3)}
+
+
+class HedgeGovernor:
+    """Adaptive hedge delay: p95 of a bounded window of recent primary
+    latencies, clamped to ``[min_s, max_s]``; ``default_s`` until the
+    window has enough samples to mean anything."""
+
+    def __init__(self, default_s: float = 0.35, min_s: float = 0.02,
+                 max_s: float = 30.0, window: int = 256,
+                 min_samples: int = 8):
+        self.default_s = float(default_s)
+        self.min_s = float(min_s)
+        self.max_s = float(max_s)
+        self.min_samples = int(min_samples)
+        self._lock = threading.Lock()
+        self._lat: "deque[float]" = deque(maxlen=int(window))
+
+    def note(self, latency_s: float) -> None:
+        if latency_s >= 0:
+            with self._lock:
+                self._lat.append(float(latency_s))
+
+    def hedge_delay_s(self) -> float:
+        with self._lock:
+            xs = sorted(self._lat)
+        if len(xs) < self.min_samples:
+            return max(self.min_s, min(self.max_s, self.default_s))
+        # nearest-rank p95 (same definition as bench.py's _pctl)
+        idx = max(0, min(len(xs) - 1, int(round(0.95 * len(xs) + 0.5)) - 1))
+        return max(self.min_s, min(self.max_s, xs[idx]))
+
+
+class PoisonRegistry:
+    """Bounded fingerprint -> abnormal-death-count table with a K
+    threshold. ``merge`` adopts a peer's quarantine set (the ``/fleet``
+    gossip), so one pod's crash-loop protects every router."""
+
+    def __init__(self, k: int = 2, max_entries: int = 512):
+        self.k = max(1, int(k))
+        self.max_entries = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._counts: "OrderedDict[str, int]" = OrderedDict()
+        self._stats = {"marked": 0, "quarantined": 0, "rejected": 0}
+
+    def note_abnormal(self, fp: str) -> int:
+        """Record one abnormal death for ``fp``; returns the new count.
+        The ``poison.mark`` chaos site can lose the mark (returns the
+        OLD count) — proving the K threshold counts marks, not
+        attempts."""
+        inj = faults.get()
+        if inj.should_fail(faults.POISON_MARK):
+            with self._lock:
+                return self._counts.get(fp, 0)
+        with self._lock:
+            n = self._counts.get(fp, 0) + 1
+            self._counts[fp] = n
+            self._counts.move_to_end(fp)
+            self._stats["marked"] += 1
+            if n == self.k:
+                self._stats["quarantined"] += 1
+            while len(self._counts) > self.max_entries:
+                self._counts.popitem(last=False)
+            return n
+
+    def is_quarantined(self, fp: str) -> bool:
+        with self._lock:
+            return self._counts.get(fp, 0) >= self.k
+
+    def note_rejected(self) -> None:
+        with self._lock:
+            self._stats["rejected"] += 1
+
+    def quarantined(self) -> List[str]:
+        """Fingerprints at/over threshold — the ``/fleet`` gossip set."""
+        with self._lock:
+            return [fp for fp, n in self._counts.items() if n >= self.k]
+
+    def merge(self, fps: Iterable[str]) -> int:
+        """Adopt peer-quarantined fingerprints (idempotent: already-known
+        entries only ratchet UP to the threshold)."""
+        n_new = 0
+        with self._lock:
+            for fp in fps:
+                fp = str(fp)
+                if not fp:
+                    continue
+                if self._counts.get(fp, 0) < self.k:
+                    if fp not in self._counts:
+                        n_new += 1
+                    self._counts[fp] = self.k
+                    self._counts.move_to_end(fp)
+            while len(self._counts) > self.max_entries:
+                self._counts.popitem(last=False)
+        return n_new
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {"shai_poison_marked_total": float(self._stats["marked"]),
+                    "shai_poison_quarantined_total":
+                    float(self._stats["quarantined"]),
+                    "shai_poison_rejected_total":
+                    float(self._stats["rejected"]),
+                    "poison_entries": float(len(self._counts))}
+
+
+class HedgeStats:
+    """The hedge/routing counters cova's dispatch path writes and
+    ``/fleet`` scrapes — lock-guarded, the ScalerStats contract."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {
+            "fired": 0, "wins": 0, "cancelled": 0,
+        }
+        self._follow_depth_max = 0
+
+    def count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def note_follow_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self._follow_depth_max:
+                self._follow_depth_max = depth
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {"shai_hedge_fired_total": float(self._counts["fired"]),
+                    "shai_hedge_wins_total": float(self._counts["wins"]),
+                    "shai_hedge_cancelled_total":
+                    float(self._counts["cancelled"]),
+                    "shai_route_follow_depth":
+                    float(self._follow_depth_max)}

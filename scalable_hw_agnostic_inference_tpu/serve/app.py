@@ -296,6 +296,18 @@ def create_app(
     # concurrency inside its budget
     from ..obs.util import env_int as _env_int
 
+    # request reliability (resilience.idempotency): the bounded per-pod
+    # completion cache keyed duplicates replay from. Consulted ONLY for
+    # requests carrying X-SHAI-Idempotency-Key — keyless traffic never
+    # touches it (the strict no-op gate), and non-idempotent replay stays
+    # forbidden without a key (the PR-3 contract).
+    from ..obs.util import env_float as _env_float
+    from ..resilience import idempotency as rz_idemp
+
+    idem = rz_idemp.IdempotencyCache(
+        max_entries=_env_int("SHAI_IDEMP_CACHE", 1024),
+        ttl_s=_env_float("SHAI_IDEMP_TTL_S", 600.0))
+
     ledger = rz_qos.TenantLedger.from_env()
     gate = AdmissionGate(
         OverloadThresholds(max_queue_depth=cfg.admit_max_queue,
@@ -312,6 +324,7 @@ def create_app(
     # engine telemetry → /metrics: TTFT/TPOT/queue-wait histograms + step
     # gauges/counters, resolved lazily at scrape time
     pub.attach_engine_telemetry(service.engine_telemetry)
+    pub.attach_idempotency(lambda: idem)
     # the model lane: probes never queue behind it. Width 1 serializes device
     # access; engine-backed services widen it (their infer only enqueues).
     lane = concurrent.futures.ThreadPoolExecutor(
@@ -319,7 +332,7 @@ def create_app(
 
     app.state.update(cfg=cfg, service=service, collector=collector, publisher=pub,
                      status=state, flight=flight, gate=gate, drainer=drainer,
-                     ledger=ledger)
+                     ledger=ledger, idem=idem)
     # lifecycle probes and scrape surfaces must not ring the flight
     # recorder; /kv/blocks is probe-class too — a decode fleet pulling KV
     # runs would otherwise evict real request timelines from the ring
@@ -603,19 +616,63 @@ def create_app(
             return Response({"status": "unhealthy", "error": err}, status=503)
         return {"status": "ready"}
 
+    async def _idem_replay_or_claim(key: str):
+        """Consult the completion cache for a keyed request: a cached
+        result (or a joined in-flight one) comes back as the response;
+        None means this caller owns the execution. Joiners park on the
+        entry's event OFF the event loop — the idempotency lock is HOT
+        and the wait is unbounded-ish (the original's own deadline/600s
+        backstop bounds it in practice)."""
+        inj = rz_faults.get()
+        await inj.asleep_at(rz_faults.IDEMP_LOOKUP)
+        st, entry = idem.begin(key)
+        if st == "new":
+            return None
+        if st == "done":
+            return dict(entry.result, idempotent_replay=True)
+        loop = asyncio.get_running_loop()
+        woke = await loop.run_in_executor(None, entry.event.wait, 600.0)
+        if entry.state == "done" and entry.result is not None:
+            return dict(entry.result, idempotent_replay=True)
+        if not woke:
+            raise HTTPError(
+                409, f"duplicate of an in-flight request (key {key!r}) "
+                     f"that has not completed; retry later")
+        # the original failed — failures are not cached, this duplicate
+        # legitimately runs its own attempt
+        return None
+
     @app.post(service.infer_route)
     async def task_infer(request: Request):
         _require_ready()
+        # request reliability: keyed duplicates replay/join instead of
+        # re-executing — BEFORE admission and _InferScope, so a replay
+        # never charges the tenant ledger a second time
+        key = request.headers.get(rz_idemp.IDEMP_HEADER, "")
+        if key:
+            if not rz_idemp.valid_key(key):
+                raise HTTPError(400, "bad idempotency key (want "
+                                     "[A-Za-z0-9_.:-]{1,128})")
+            cached = await _idem_replay_or_claim(key)
+            if cached is not None:
+                return cached
         payload = request.json()
+        if key:
+            payload["idem_key"] = key
         t0 = time.perf_counter()
-        scope = _InferScope(request)
-        with scope:
-            # annotation=False: this span is held across an await on the
-            # event loop; the device-trace view comes from the engine's own
-            # prefill/decode annotations on the lane thread
-            with obs_trace.span("model_infer", annotation=False):
-                out = await _run_model(service.infer, payload)
-        scope.charge(out)
+        try:
+            scope = _InferScope(request)
+            with scope:
+                # annotation=False: this span is held across an await on the
+                # event loop; the device-trace view comes from the engine's
+                # own prefill/decode annotations on the lane thread
+                with obs_trace.span("model_infer", annotation=False):
+                    out = await _run_model(service.infer, payload)
+            scope.charge(out)
+        except BaseException:
+            if key:
+                idem.fail(key)
+            raise
         dt = time.perf_counter() - t0
         collector.record(dt)
         pub.publish(dt)
@@ -627,6 +684,10 @@ def create_app(
             pub.publish_engine(tele)
         if isinstance(out, dict):
             out.setdefault("latency_s", round(dt, 4))
+        if key and isinstance(out, dict):
+            # publish AFTER the latency stamp so a replay is byte-equal
+            # to the original response (modulo the replay marker)
+            idem.complete(key, out)
         return out
 
     @app.post("/benchmark")
@@ -686,6 +747,12 @@ def create_app(
         if gate.shed_total:
             out["shed"] = {"total": gate.shed_total,
                            **gate.shed_by_reason()}
+        # request reliability: the completion cache's counters — present
+        # only once a keyed request touched it, so keyless pods keep
+        # their exact pre-existing /stats shape
+        isnap = idem.snapshot()
+        if any(isnap.values()):
+            out["idempotency"] = isnap
         try:
             svc = service.extra_stats()
         except Exception:

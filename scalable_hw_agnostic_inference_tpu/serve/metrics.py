@@ -390,6 +390,60 @@ class EngineTelemetryCollector:
                     yield g
 
 
+#: request-reliability (resilience.idempotency): cache-counter key ->
+#: exported family; the gauge rides separately below
+_IDEMP_COUNTERS = {
+    "replayed_total": ("shai_idemp_replayed_total",
+                       "keyed duplicates answered from the completion "
+                       "cache (no re-execution, no second charge)"),
+    "joined_total": ("shai_idemp_joined_total",
+                     "keyed duplicates that joined an in-flight "
+                     "execution"),
+    "misses_total": ("shai_idemp_misses_total",
+                     "new idempotency keys (executions claimed)"),
+    "evicted_total": ("shai_idemp_evicted_total",
+                      "entries dropped by the bound or the TTL sweep"),
+    "lookup_errors_total": ("shai_idemp_lookup_errors_total",
+                            "lookups degraded to a miss (at-least-once "
+                            "fallback)"),
+}
+_IDEMP_ENTRIES = ("shai_idemp_entries",
+                  "live completion-cache entries (bounded by "
+                  "SHAI_IDEMP_CACHE)")
+
+
+class IdempotencyCollector:
+    """Prometheus collector over ``resilience.idempotency``'s per-pod
+    completion cache — same lazy-provider contract as
+    :class:`EngineTelemetryCollector`."""
+
+    def __init__(self, provider: Callable[[], Any], app: str):
+        self.provider = provider
+        self.app = app
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        try:
+            cache = self.provider()
+            snap = cache.snapshot() if cache is not None else None
+        except Exception:
+            return
+        if snap is None:
+            return
+        for key, (name, doc) in _IDEMP_COUNTERS.items():
+            c = CounterMetricFamily(name, doc, labels=["app"])
+            c.add_metric([self.app], float(snap.get(key, 0)))
+            yield c
+        g = GaugeMetricFamily(_IDEMP_ENTRIES[0], _IDEMP_ENTRIES[1],
+                              labels=["app"])
+        g.add_metric([self.app], float(snap.get("entries", 0)))
+        yield g
+
+
 class MetricsPublisher:
     """Publishes the request counter + latency signals for one serving pod."""
 
@@ -551,6 +605,15 @@ class MetricsPublisher:
         if not (_HAVE_PROM and self.registry is not None):
             return False
         self.registry.register(EngineTelemetryCollector(provider, self.app))
+        return True
+
+    def attach_idempotency(self, provider: Callable[[], Any]) -> bool:
+        """Register the per-pod idempotency cache's counter families
+        (``shai_idemp_*``) — the lazy-provider contract of
+        :meth:`attach_engine_telemetry`."""
+        if not (_HAVE_PROM and self.registry is not None):
+            return False
+        self.registry.register(IdempotencyCollector(provider, self.app))
         return True
 
     def publish_engine(self, tele: Any) -> None:

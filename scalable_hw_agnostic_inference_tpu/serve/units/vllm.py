@@ -555,6 +555,7 @@ class VllmService(ModelService):
             cross_len=cross_len, deadline_at=self._deadline_at(),
             kv_holders=kv_holders,
             traceparent=obs_trace.current_traceparent() or "",
+            idem_key=str(payload.get("idem_key") or ""),
             **self._qos_kw()))
         if self._engine.cache.prefix_caching:
             # advertise warmth ONLY for the /generate path cova routes,
@@ -781,7 +782,8 @@ class VllmService(ModelService):
                 tenant=str(man.get("tenant") or ""),
                 already_generated=already,
                 already_lp=man.get("lps"), orig_n_prompt=n_prompt,
-                traceparent=obs_trace.current_traceparent() or ""))
+                traceparent=obs_trace.current_traceparent() or "",
+                idem_key=str(man.get("idem_key") or "")))
         if isinstance(out, dict) and out.get("migrated"):
             # this pod's OWN drain re-migrated the replay: it did not
             # complete here — the handoff must not read as a resume

@@ -62,6 +62,12 @@ SCAN_FILES = (
     # the autoscaler's shai_scaler_* family (control-decision counters —
     # the runbook's flap-vs-herd diagnosis depends on these being doc'd)
     os.path.join(PKG, "orchestrate", "scaler.py"),
+    # request reliability (PR 20): the shai_hedge_*/shai_retry_budget_*/
+    # shai_poison_* families (cova's /fleet) and the shai_idemp_* family
+    # (per-pod cache) — the brownout-vs-poison runbook split depends on
+    # every one of these being documented
+    os.path.join(PKG, "resilience", "hedge.py"),
+    os.path.join(PKG, "resilience", "idempotency.py"),
 )
 README = os.path.join(ROOT, "README.md")
 

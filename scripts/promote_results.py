@@ -60,11 +60,16 @@ KEYS = {"sd": "sd21_img_s",
         # compliance) lifted from the same line; errors REQUIRED 0
         # (bench.py scaler). A tuple value = (primary from ``value``,
         # *extras lifted from the line dict by field name).
-        "scaler": ("scaler_recovery_s", "scaler_pod_hours_ratio")}
+        "scaler": ("scaler_recovery_s", "scaler_pod_hours_ratio"),
+        # hedged retries under the fleet retry budget (PR 20): p99 tail
+        # rescue with one slow pod, hedge-off/hedge-on ratio from the
+        # deviceless fleet simulator; errors AND duplicate executions
+        # REQUIRED 0 (bench.py hedge)
+        "hedge": "hedge_p99_ratio"}
 
 #: trace-driven simulator benches measure the CONTROL LAW, not the chip —
 #: a cpu run IS the measurement, so the cpu-platform guard does not apply
-DEVICELESS = frozenset({"scaler"})
+DEVICELESS = frozenset({"scaler", "hedge"})
 
 
 def _load_results() -> dict:

@@ -445,3 +445,56 @@ def test_disagg_bench_acceptance_on_cpu_tiny():
     assert out["blocks_shipped"] > 0
     assert out["decode_tier"]["restored"] > 0
     assert out["decode_tier"]["errors"] == 0
+
+
+def test_hedge_key_promotes_p99_ratio():
+    # PR-20 tentpole: the hedged-dispatch bench publishes the tail-rescue
+    # ratio and dispatches as its own deviceless variant
+    assert promote.KEYS["hedge"] == "hedge_p99_ratio"
+    bspec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(ROOT, "bench.py"))
+    bench = importlib.util.module_from_spec(bspec)
+    bspec.loader.exec_module(bench)
+    assert bench._which_from_argv(["bench.py", "hedge"]) == "hedge"
+    assert bench._which_from_argv(["bench.py", "--inner", "hedge",
+                                   "--cpu"]) == "hedge"
+    assert bench.UNITS_BY_BENCH["hedge"] == "x"
+
+
+def test_hedge_is_deviceless_publishable_on_cpu():
+    # same waiver as scaler: the simulator measures the retry discipline,
+    # not the chip — a cpu stamp publishes for hedge and ONLY for the
+    # deviceless keys
+    e = _entry(metric="hedged-dispatch tail rescue (deviceless sim)",
+               unit="x", platform="cpu", hedge_p99_ratio=4.0)
+    assert "hedge" in promote.DEVICELESS
+    assert promote.is_publishable("hedge", e)
+    assert not promote.is_real(e)
+    assert not promote.is_publishable("llama", e)
+    bare = dict(e)
+    del bare["platform"]
+    assert not promote.is_publishable("hedge", bare)
+    assert not promote.is_publishable("hedge", _entry(error="boom"))
+
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+def test_hedge_bench_acceptance_on_cpu_tiny():
+    """The PR-20 acceptance numbers, measured: with one 5x-slow pod the
+    hedged run's p99 beats the unhedged run (ratio > 1), no simulated
+    request failed (errors REQUIRED 0 — the crash-looping pod is rescued
+    by budgeted duplicates, not error'd), and NO request executed to
+    completion twice (duplicate_executions REQUIRED 0 — the dedup
+    contract); the amplification invariant is asserted inside the bench,
+    a violating run never prints a line."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--inner",
+         "hedge", "--cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu" and out["unit"] == "x"
+    assert out["errors"] == 0, out
+    assert out["duplicate_executions"] == 0, out
+    assert out["value"] > 1.0, out
+    assert out["hedges_fired"] > 0 and out["hedges_deduped"] > 0
+    assert out["attempts"] <= out["created"] * 1.3 + 2 + 1e-6, out

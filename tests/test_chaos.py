@@ -105,6 +105,7 @@ def stack():
 # deadlines under slow steps
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_deadline_exceeded_under_step_delay_is_terminal_504(stack):
     """Slow engine steps + a tight per-request deadline: the request must

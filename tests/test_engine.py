@@ -106,6 +106,7 @@ def test_engine_greedy_matches_plain_generate(tiny_model):
         f"paged engine {fin.token_ids} != contiguous path {expected}")
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_engine_continuous_batching_parity(tiny_model):
     """Staggered admissions must not change any sequence's greedy output."""
     cfg, model, params = tiny_model

@@ -69,6 +69,7 @@ def assert_finished_equal(a, b):
 # vanilla decode parity
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.parametrize("sp", [
     SamplingParams(temperature=0.0, max_new_tokens=8),
     pytest.param(SamplingParams(temperature=0.9, top_k=5, max_new_tokens=8),
@@ -185,6 +186,7 @@ def test_async_mixed_join_finish_schedule(tiny_model, monkeypatch):
         assert sa[rid] == sb[rid]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_async_preemption_parity_and_pool_balance(tiny_model, monkeypatch):
     """A pool sized to force recompute-preemption: the async path must
     flush around the preempting grow path and still match token-for-token

@@ -84,6 +84,7 @@ MIXED = [[1, 5, 9], [2] * 20, [7, 3] * 14, [4]]  # mixed lengths, on purpose
 # fused step: token-exact vs the laddered ragged engine
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.parametrize("sp", [
     SamplingParams(temperature=0.0, max_new_tokens=8, logprobs=2),
     pytest.param(SamplingParams(temperature=0.9, top_k=5, max_new_tokens=8),
@@ -107,6 +108,7 @@ def test_fused_matches_laddered_oracle(tiny_model, monkeypatch, sp,
     assert pool_balanced(a) and pool_balanced(b)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_fused_chunked_prefill_parity(tiny_model, monkeypatch):
     # prompt > largest bucket: the fused engine defers intermediate
     # chunks onto decode dispatches and runs the final chunk through a

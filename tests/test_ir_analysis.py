@@ -333,6 +333,7 @@ class TestLiveTree:
         for name, members in DEFAULT_CONTRACT.ir.compositions.items():
             assert set(members) <= set(DEFAULT_CONTRACT.ir.programs), name
 
+    @pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
     def test_live_tree_is_clean(self, live_findings):
         fresh = [f for f in live_findings if not f.allowed]
         assert not fresh, "\n".join(f.render() for f in fresh)

@@ -440,10 +440,12 @@ def _fabric_differential(tiny_model, monkeypatch, quant=False,
     return fabric
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_fabric_differential_greedy(tiny_model, monkeypatch):
     _fabric_differential(tiny_model, monkeypatch)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_fabric_differential_lockstep_discipline(tiny_model, monkeypatch):
     _fabric_differential(tiny_model, monkeypatch, async_decode=False)
 
@@ -453,6 +455,7 @@ def test_fabric_differential_async_discipline(tiny_model, monkeypatch):
     _fabric_differential(tiny_model, monkeypatch, async_decode=True)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_fabric_differential_int8_byte_exact(tiny_model, monkeypatch):
     eng = _fabric_differential(tiny_model, monkeypatch, quant=True)
     assert eng.cache.tier.quant
@@ -521,6 +524,7 @@ def test_fabric_probe_priced_out_by_deadline(tiny_model, monkeypatch):
 
 # -- chaos: kvfabric.probe fault site -----------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_chaos_probe_fault_degrades_token_exact_and_opens_breaker(
         tiny_model, monkeypatch):
     """SHAI_FAULTS site kvfabric.probe: every injected probe failure

@@ -597,10 +597,12 @@ def _handoff_differential(tiny_model, monkeypatch, quant=False,
     return dec
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_handoff_differential_greedy(tiny_model, monkeypatch):
     _handoff_differential(tiny_model, monkeypatch)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_handoff_differential_lockstep_discipline(tiny_model, monkeypatch):
     _handoff_differential(tiny_model, monkeypatch, async_decode=False)
 
@@ -610,10 +612,12 @@ def test_handoff_differential_async_discipline(tiny_model, monkeypatch):
     _handoff_differential(tiny_model, monkeypatch, async_decode=True)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_handoff_differential_int8_byte_exact(tiny_model, monkeypatch):
     _handoff_differential(tiny_model, monkeypatch, quant=True)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_handoff_fetch_fault_degrades_to_recompute(tiny_model, monkeypatch):
     """The fetch fails (injected kvnet.fetch fault): the decode pod's tier
     stays cold, generation recomputes, tokens still match the monolithic

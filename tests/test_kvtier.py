@@ -106,6 +106,7 @@ def _differential(tiny_model, monkeypatch, sp, seed=2, n=4, rounds=2,
     return on
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_differential_greedy_eviction_replay(tiny_model, monkeypatch):
     # small pool + replay rounds: round 2 re-admits prompts whose blocks
     # were evicted (demoted) in round 1 — the restore path must be exact

@@ -276,10 +276,12 @@ def _migrate_differential(tiny_model, monkeypatch, quant=False,
     return fin, done[rid2]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_migrate_differential_greedy(tiny_model, monkeypatch):
     _migrate_differential(tiny_model, monkeypatch, async_decode=False)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_migrate_differential_int8_byte_exact(tiny_model, monkeypatch):
     _migrate_differential(tiny_model, monkeypatch, quant=True,
                           async_decode=False)
@@ -296,6 +298,7 @@ def test_migrate_differential_async_int8(tiny_model, monkeypatch):
                           async_decode=True)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_migrate_restore_fault_degrades_to_recompute(tiny_model,
                                                      monkeypatch):
     """`migrate.restore=error` forces the recompute-on-peer rung: the
@@ -372,6 +375,7 @@ def test_migrate_preserves_qos_and_deadline(tiny_model, monkeypatch):
     assert man["params"]["max_new_tokens"] < 16  # the REMAINING budget
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_migrate_logprobs_survive(tiny_model, monkeypatch):
     """Logprob entries emitted before the migration ride the manifest;
     the resumed Finished carries one entry per output token, matching
@@ -399,6 +403,7 @@ def test_migrate_logprobs_survive(tiny_model, monkeypatch):
         == [e["token"] for e in fo.logprobs]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_migrate_streams_exactly_once(tiny_model, monkeypatch):
     """on_token fires exactly once per output token across the migration:
     the dying engine streams through the pending token, the resumed

@@ -244,6 +244,7 @@ def test_vae_decode_encode_shapes():
 # pipeline
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_txt2img_end_to_end_tiny():
     variant = sd_mod.SDVariant.tiny()
     unet = sd_mod.UNet2DCondition(variant.unet, dtype=jnp.float32)

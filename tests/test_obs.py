@@ -369,6 +369,7 @@ def spec_app():
     return cfg, service, create_app(cfg, service)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_http_traceparent_ingest_emit_and_flight(spec_app):
     cfg, service, app = spec_app

@@ -397,6 +397,7 @@ def _prompts(cfg, n, rng, lens=(5, 9, 14)):
             for _ in range(n)]
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.parametrize("async_on", ["0", "1"])
 @pytest.mark.parametrize("sp", [
     SamplingParams(temperature=0.0, max_new_tokens=6),
@@ -422,6 +423,7 @@ def test_qos_off_differential_token_exact(tiny_model, monkeypatch,
     assert on == base
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 def test_qos_off_preemption_differential(tiny_model, monkeypatch):
     """Preemption pressure (tight pool) with QoS on but uniform priority:
     the victim choice key degenerates to the FIFO engine's and tokens stay

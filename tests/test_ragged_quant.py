@@ -199,6 +199,7 @@ def assert_finished_equal(a, b):
 MIXED = [[1, 5, 9], [2] * 20, [7, 3] * 14, [4]]  # mixed lengths, on purpose
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.parametrize("sp", [
     SamplingParams(temperature=0.0, max_new_tokens=8, logprobs=2),
     pytest.param(SamplingParams(temperature=0.9, top_k=5, max_new_tokens=8),

@@ -1,0 +1,749 @@
+"""Request reliability (PR 20): end-to-end idempotency, hedged retries
+under the fleet retry budget, and poison-request quarantine.
+
+Three layers, one contract:
+
+- the resilience primitives (``resilience/hedge.py``,
+  ``resilience/idempotency.py``) hold their invariants in isolation —
+  the budget's amplification bound, the cache's at-most-once lifecycle,
+  the K-mark quarantine threshold (chaos sites included);
+- cova's armed ``/generate`` walk composes them against stub pods: the
+  ``SHAI_HEDGE=0`` + no-key path is a STRICT no-op (differential-
+  tested), keys ride every hop, ``Retry-After`` propagates with the
+  pod's own status, a slow primary is hedged and the loser cancelled,
+  a crash-looping payload answers 422 after exactly K abnormal deaths,
+  and two mutually-draining pods cannot ping-pong a resume forever;
+- the trace-driven fleet simulator proves the fleet-scale invariants
+  in CI: a crash-looping pod produces ZERO non-poison errors under the
+  budget, attempt amplification stays within ``1 + pct``, and the
+  reliability-off defaults replay PR-19 traces untouched.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from scalable_hw_agnostic_inference_tpu.orchestrate import load_sim
+from scalable_hw_agnostic_inference_tpu.orchestrate.cova import CovaClient
+from scalable_hw_agnostic_inference_tpu.resilience import faults as rz_faults
+from scalable_hw_agnostic_inference_tpu.resilience import hedge as rz_hedge
+from scalable_hw_agnostic_inference_tpu.resilience import (
+    idempotency as rz_idemp,
+)
+from scalable_hw_agnostic_inference_tpu.serve.asgi import HTTPError
+
+from test_serve_http import make_client, wait_ready
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    rz_faults.reset()
+    yield
+    rz_faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# resilience primitives
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_stable_and_param_sensitive():
+    a = rz_hedge.fingerprint("hello", {"temperature": 0.0, "top_k": 1})
+    # stable across call order of the params dict
+    b = rz_hedge.fingerprint("hello", {"top_k": 1, "temperature": 0.0})
+    assert a == b and len(a) == 16
+    assert rz_hedge.fingerprint("hello", {"temperature": 0.5}) != a
+    assert rz_hedge.fingerprint("other", {"temperature": 0.0}) != a
+    assert rz_hedge.fingerprint("hello") == rz_hedge.fingerprint("hello", {})
+
+
+def test_retry_budget_burst_inflow_and_amplification_invariant():
+    b = rz_hedge.RetryBudget(pct=0.1, burst=2.0)
+    # cold start: exactly the burst is spendable
+    assert b.try_spend() and b.try_spend()
+    assert not b.try_spend()
+    snap = b.snapshot()
+    assert snap["shai_retry_budget_spent_total"] == 2.0
+    assert snap["shai_retry_budget_exhausted_total"] == 1.0
+    # inflow is pct per primary: 10 primaries fund exactly one more token
+    for _ in range(10):
+        b.note_primary()
+    assert b.try_spend() and not b.try_spend()
+    # THE invariant: total spend <= burst + pct * primaries, however the
+    # spends and primaries interleave
+    b2 = rz_hedge.RetryBudget(pct=0.25, burst=1.0)
+    primaries = spent = 0
+    for i in range(200):
+        b2.note_primary()
+        primaries += 1
+        if i % 2 and b2.try_spend():
+            spent += 1
+    assert spent <= b2.burst + b2.pct * primaries + 1e-9
+
+
+def test_retry_budget_bank_ceiling_bounds_prepaid_storms():
+    b = rz_hedge.RetryBudget(pct=0.1, burst=2.0, window=50)
+    b.note_primary(100_000)  # a very long healthy stretch
+    assert b.tokens <= max(b.burst, b.pct * b.window) + 1e-9
+    spent = 0
+    while b.try_spend():
+        spent += 1
+    assert spent <= int(b.pct * b.window) + 1  # not 10k pre-paid retries
+
+
+def test_hedge_governor_default_then_adaptive_p95():
+    g = rz_hedge.HedgeGovernor(default_s=0.35, min_s=0.05, max_s=1.0,
+                               min_samples=8)
+    assert g.hedge_delay_s() == pytest.approx(0.35)
+    for _ in range(100):
+        g.note(0.5)
+    assert g.hedge_delay_s() == pytest.approx(0.5)
+    for _ in range(500):
+        g.note(0.001)       # fast fleet: delay clamps at min_s
+    assert g.hedge_delay_s() == pytest.approx(0.05)
+    g2 = rz_hedge.HedgeGovernor(default_s=0.1, max_s=2.0, min_samples=1)
+    g2.note(50.0)
+    assert g2.hedge_delay_s() == pytest.approx(2.0)  # max_s clamp
+    g2.note(-1.0)           # negative latencies are dropped, not stored
+    assert len(g2._lat) == 1
+
+
+def test_poison_registry_threshold_merge_and_bound():
+    p = rz_hedge.PoisonRegistry(k=2, max_entries=4)
+    assert p.note_abnormal("fp1") == 1
+    assert not p.is_quarantined("fp1")
+    assert p.note_abnormal("fp1") == 2
+    assert p.is_quarantined("fp1")
+    assert p.quarantined() == ["fp1"]
+    # gossip merge: a peer's quarantine lands at threshold, idempotently
+    assert p.merge(["fp2", "fp2", ""]) == 1
+    assert p.is_quarantined("fp2")
+    assert p.merge(["fp2"]) == 0
+    p.note_rejected()
+    snap = p.snapshot()
+    assert snap["shai_poison_marked_total"] == 2.0
+    assert snap["shai_poison_quarantined_total"] == 1.0
+    assert snap["shai_poison_rejected_total"] == 1.0
+    # bounded: old fingerprints age out FIFO past max_entries
+    for i in range(10):
+        p.note_abnormal(f"x{i}")
+    assert p.snapshot()["poison_entries"] <= 4.0
+
+
+def test_poison_mark_fault_loses_a_mark():
+    """The ``poison.mark`` chaos site drops a mark: quarantine then needs
+    one MORE abnormal attempt — the K threshold counts marks landed, not
+    attempts observed."""
+    p = rz_hedge.PoisonRegistry(k=2)
+    rz_faults.configure("poison.mark=error#1")  # exactly one lost mark
+    try:
+        assert p.note_abnormal("fp") == 0       # lost
+        assert p.note_abnormal("fp") == 1
+        assert not p.is_quarantined("fp")
+        assert p.note_abnormal("fp") == 2       # third attempt quarantines
+        assert p.is_quarantined("fp")
+    finally:
+        rz_faults.reset()
+
+
+def test_hedge_stats_counters_and_follow_depth():
+    h = rz_hedge.HedgeStats()
+    h.count("fired")
+    h.count("cancelled", 2)
+    h.note_follow_depth(3)
+    h.note_follow_depth(1)  # gauge keeps the max
+    snap = h.snapshot()
+    assert snap["shai_hedge_fired_total"] == 1.0
+    assert snap["shai_hedge_wins_total"] == 0.0
+    assert snap["shai_hedge_cancelled_total"] == 2.0
+    assert snap["shai_route_follow_depth"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# idempotency cache lifecycle
+# ---------------------------------------------------------------------------
+
+def test_idemp_key_grammar():
+    assert rz_idemp.valid_key("abc-123_x.y:z")
+    assert rz_idemp.valid_key("a" * 128)
+    assert not rz_idemp.valid_key("a" * 129)
+    assert not rz_idemp.valid_key("")
+    assert not rz_idemp.valid_key("has spaces")
+    assert not rz_idemp.valid_key("newline\n")
+
+
+def test_idemp_replay_and_join_lifecycle():
+    c = rz_idemp.IdempotencyCache(max_entries=8)
+    st, e = c.begin("k1")
+    assert st == "new"
+    # a duplicate while in flight JOINS (same entry, event not yet set)
+    st2, e2 = c.begin("k1")
+    assert st2 == "inflight" and e2 is e and not e2.event.is_set()
+    c.complete("k1", {"generated_text": "hi", "n_tokens": 2})
+    assert e.event.is_set() and e.state == "done"
+    # a duplicate after completion REPLAYS the cached result
+    st3, e3 = c.begin("k1")
+    assert st3 == "done" and e3.result["generated_text"] == "hi"
+    snap = c.snapshot()
+    assert snap["misses_total"] == 1.0
+    assert snap["joined_total"] == 1.0
+    assert snap["replayed_total"] == 1.0
+    assert snap["entries"] == 1.0
+
+
+def test_idemp_failure_clears_claim_so_retry_reexecutes():
+    c = rz_idemp.IdempotencyCache()
+    st, e = c.begin("k")
+    assert st == "new"
+    st2, joined = c.begin("k")
+    assert st2 == "inflight"
+    c.fail("k")
+    # the joiner wakes, sees a non-done entry, and runs its own attempt
+    assert joined.event.is_set() and joined.state != "done"
+    st3, _ = c.begin("k")
+    assert st3 == "new"   # the claim is gone — a real retry re-executes
+    assert c.snapshot()["misses_total"] == 2.0
+
+
+def test_idemp_ttl_and_capacity_bounds():
+    now = [0.0]
+    c = rz_idemp.IdempotencyCache(max_entries=3, ttl_s=10.0,
+                                  clock=lambda: now[0])
+    for i in range(3):
+        c.begin(f"k{i}")
+        c.complete(f"k{i}", {"i": i})
+    # capacity: a 4th key evicts the oldest DONE entry
+    c.begin("k3")
+    c.complete("k3", {"i": 3})
+    snap = c.snapshot()
+    assert snap["entries"] == 3.0 and snap["evicted_total"] == 1.0
+    assert c.begin("k0")[0] == "new"      # k0 was the victim
+    c.fail("k0")
+    # TTL: past freshness every done entry purges on the next lookup
+    now[0] = 11.0
+    assert c.begin("fresh")[0] == "new"
+    assert c.snapshot()["entries"] == 1.0  # only the new claim remains
+    # all-inflight eviction still bounds the table (oldest claim goes,
+    # its joiners wake on a failed entry and re-execute)
+    c2 = rz_idemp.IdempotencyCache(max_entries=2)
+    _, e0 = c2.begin("a")
+    c2.begin("b")
+    c2.begin("c")
+    assert c2.snapshot()["entries"] == 2.0
+    assert e0.event.is_set() and e0.state == "failed"
+
+
+def test_idemp_lookup_fault_degrades_to_miss():
+    """``idemp.lookup`` error: at-most-once degrades to at-least-once —
+    the request EXECUTES (never dropped), and its completion still lands
+    through the upsert."""
+    c = rz_idemp.IdempotencyCache()
+    c.begin("k")
+    c.complete("k", {"x": 1})
+    rz_faults.configure("idemp.lookup=error#1")
+    try:
+        st, e = c.begin("k")     # a cached result is there, but lookup died
+        assert st == "new"       # degraded: caller executes again
+        c.complete("k", {"x": 2})  # upsert lands the fresh completion
+    finally:
+        rz_faults.reset()
+    st2, e2 = c.begin("k")
+    assert st2 == "done" and e2.result == {"x": 2}
+    assert c.snapshot()["lookup_errors_total"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# cova's armed walk against stub pods
+# ---------------------------------------------------------------------------
+
+class _Resp:
+    def __init__(self, status=200, body=None, headers=None):
+        self.status_code = status
+        self._body = {} if body is None else body
+        self.headers = headers or {}
+        self.text = json.dumps(self._body)
+
+    def json(self):
+        return self._body
+
+
+def _install_pods(monkeypatch, handlers, stats=None):
+    """Monkeypatch ``httpx.AsyncClient`` with stub pods. ``handlers``
+    maps base URL -> ``async fn(route, payload, headers) -> _Resp`` (or
+    raises an httpx error). Returns the shared call log of
+    ``(base, route, payload, headers)`` tuples — attempts are logged
+    BEFORE the handler runs, so failed attempts count too."""
+    import httpx
+
+    calls = []
+
+    class _FakeAsync:
+        def __init__(self, *a, **kw):
+            pass
+
+        async def post(self, url, json=None, headers=None, **kw):
+            for base, fn in handlers.items():
+                if url.startswith(base):
+                    route = url[len(base):]
+                    calls.append((base, route, json, dict(headers or {})))
+                    return await fn(route, json, dict(headers or {}))
+            raise httpx.ConnectError(f"no stub pod for {url}")
+
+        async def get(self, url, **kw):
+            return _Resp(200, dict(stats or {}))
+
+        async def aclose(self):
+            pass
+
+    monkeypatch.setattr(httpx, "AsyncClient", _FakeAsync)
+    return calls
+
+
+def _cova(models):
+    c = CovaClient(models)
+    # pin the routing snapshot so tests never depend on the /stats poll
+    c._fleet_cache = {"models": {}, "overloaded": []}
+    c._fleet_cache_at = time.monotonic()
+    c.fleet_cache_ttl_s = 1e9
+    return c
+
+
+async def _ok(route, payload, headers):
+    return _Resp(200, {"generated_text": "ok", "n_tokens": 4})
+
+
+@pytest.mark.asyncio
+async def test_unarmed_walk_is_a_strict_noop(monkeypatch):
+    """SHAI_HEDGE off + no client key: the differential gate — no
+    idempotency header on the wire, exactly one attempt, no minted key
+    in the response. Byte-identical to the pre-reliability walk."""
+    monkeypatch.delenv("SHAI_HEDGE", raising=False)
+    calls = _install_pods(monkeypatch, {"http://a:1": _ok})
+    c = _cova({"a": {"url": "http://a:1", "task": "text-generation"}})
+    out = await c.generate("hi", {"max_new_tokens": 4})
+    assert out["generated_text"] == "ok" and out["model"] == "a"
+    assert len(calls) == 1
+    assert rz_hedge.HEDGE_HEADER not in calls[0][3]
+    assert "idempotency_key" not in out
+    snap = c.retry_budget.snapshot()
+    assert snap["shai_retry_budget_spent_total"] == 0.0
+    assert c.hstats.snapshot()["shai_hedge_fired_total"] == 0.0
+
+
+@pytest.mark.asyncio
+async def test_client_key_forwarded_even_with_hedging_off(monkeypatch):
+    """Per-pod dedup is an independent feature: a CLIENT-supplied key is
+    forwarded with hedging off (no minting, no response echo)."""
+    monkeypatch.delenv("SHAI_HEDGE", raising=False)
+    calls = _install_pods(monkeypatch, {"http://a:1": _ok})
+    c = _cova({"a": {"url": "http://a:1", "task": "text-generation"}})
+    out = await c.generate("hi", {"max_new_tokens": 4}, idem_key="ck-1")
+    assert calls[0][3][rz_hedge.HEDGE_HEADER] == "ck-1"
+    assert "idempotency_key" not in out
+
+
+@pytest.mark.asyncio
+async def test_armed_generate_mints_and_surfaces_key(monkeypatch):
+    monkeypatch.setenv("SHAI_HEDGE", "1")
+    calls = _install_pods(monkeypatch, {"http://a:1": _ok})
+    c = _cova({"a": {"url": "http://a:1", "task": "text-generation"}})
+    out = await c.generate("hi", {"max_new_tokens": 4})
+    key = out["idempotency_key"]
+    assert rz_idemp.valid_key(key)
+    assert calls[0][3][rz_hedge.HEDGE_HEADER] == key
+    # a client key is never replaced by a minted one
+    out2 = await c.generate("hi", {"max_new_tokens": 4}, idem_key="mine-1")
+    assert out2["idempotency_key"] == "mine-1"
+    assert calls[1][3][rz_hedge.HEDGE_HEADER] == "mine-1"
+
+
+@pytest.mark.asyncio
+async def test_retry_after_and_status_propagate_through_cova(monkeypatch):
+    """A pod's backpressure answer keeps its OWN status (429/503) and its
+    Retry-After header rides through to the end client; a pod 500 stays a
+    502 gateway error but keeps the true status for the poison
+    classifier."""
+    monkeypatch.delenv("SHAI_HEDGE", raising=False)
+    answer = {}
+
+    async def pod(route, payload, headers):
+        return _Resp(answer["status"], {"detail": "x"}, answer.get("hdrs"))
+
+    _install_pods(monkeypatch, {"http://a:1": pod})
+    c = _cova({"a": {"url": "http://a:1", "task": "text-generation"}})
+    for status, ra in ((503, "7"), (429, "3")):
+        answer.update(status=status, hdrs={"retry-after": ra})
+        with pytest.raises(HTTPError) as ei:
+            await c.generate("hi", {})
+        assert ei.value.status == status
+        assert ei.value.headers["retry-after"] == ra
+        assert ei.value.upstream_status == status
+    answer.update(status=500, hdrs=None)
+    with pytest.raises(HTTPError) as ei:
+        await c.generate("hi", {})
+    assert ei.value.status == 502
+    assert ei.value.upstream_status == 500
+
+
+@pytest.mark.asyncio
+async def test_hedge_fires_and_winner_cancels_loser(monkeypatch):
+    monkeypatch.setenv("SHAI_HEDGE", "1")
+    monkeypatch.setenv("SHAI_HEDGE_DELAY_S", "0.02")
+
+    async def slow(route, payload, headers):
+        await asyncio.sleep(5.0)
+        return _Resp(200, {"generated_text": "slow"})
+
+    async def fast(route, payload, headers):
+        return _Resp(200, {"generated_text": "fast", "n_tokens": 4})
+
+    calls = _install_pods(monkeypatch, {"http://a:1": slow,
+                                        "http://b:1": fast})
+    c = _cova({"a": {"url": "http://a:1", "task": "text-generation",
+                     "weight": 5},
+               "b": {"url": "http://b:1", "task": "text-generation",
+                     "weight": 1}})
+    t0 = time.monotonic()
+    out = await c.generate("hi", {"max_new_tokens": 4})
+    assert time.monotonic() - t0 < 2.0   # never waited out the slow pod
+    assert out["generated_text"] == "fast" and out["model"] == "b"
+    snap = c.hstats.snapshot()
+    assert snap["shai_hedge_fired_total"] == 1.0
+    assert snap["shai_hedge_wins_total"] == 1.0
+    assert snap["shai_hedge_cancelled_total"] == 1.0
+    assert c.retry_budget.snapshot()["shai_retry_budget_spent_total"] == 1.0
+    # both legs carried the SAME key — the pod-side dedup contract
+    keys = {h[rz_hedge.HEDGE_HEADER] for _, _, _, h in calls}
+    assert len(keys) == 1
+
+
+@pytest.mark.asyncio
+async def test_hedge_fire_fault_suppresses_hedge(monkeypatch):
+    """The ``hedge.fire`` chaos site: a suppressed hedge degrades to
+    waiting out the primary — never an error."""
+    monkeypatch.setenv("SHAI_HEDGE", "1")
+    monkeypatch.setenv("SHAI_HEDGE_DELAY_S", "0.02")
+
+    async def slowish(route, payload, headers):
+        await asyncio.sleep(0.15)
+        return _Resp(200, {"generated_text": "primary"})
+
+    _install_pods(monkeypatch, {"http://a:1": slowish, "http://b:1": _ok})
+    c = _cova({"a": {"url": "http://a:1", "task": "text-generation",
+                     "weight": 5},
+               "b": {"url": "http://b:1", "task": "text-generation"}})
+    rz_faults.configure("hedge.fire=error")
+    try:
+        out = await c.generate("hi", {})
+    finally:
+        rz_faults.reset()
+    assert out["generated_text"] == "primary" and out["model"] == "a"
+    assert c.hstats.snapshot()["shai_hedge_fired_total"] == 0.0
+
+
+@pytest.mark.asyncio
+async def test_retry_budget_exhaustion_stops_the_walk(monkeypatch):
+    """With the budget dry, a retryable failure is NOT walked to the next
+    pod — the last failure surfaces and the denial is counted. Shedding
+    beats self-amplifying."""
+    monkeypatch.setenv("SHAI_HEDGE", "1")
+
+    async def shed(route, payload, headers):
+        return _Resp(503, {"detail": "draining"}, {"retry-after": "2"})
+
+    calls = _install_pods(monkeypatch, {"http://a:1": shed,
+                                        "http://b:1": _ok})
+    c = _cova({"a": {"url": "http://a:1", "task": "text-generation",
+                     "weight": 5},
+               "b": {"url": "http://b:1", "task": "text-generation"}})
+    c.retry_budget = rz_hedge.RetryBudget(pct=0.0, burst=0.0)
+    with pytest.raises(HTTPError) as ei:
+        await c.generate("hi", {})
+    assert ei.value.status == 503
+    assert all(base == "http://a:1" for base, _, _, _ in calls)
+    snap = c.retry_budget.snapshot()
+    assert snap["shai_retry_budget_exhausted_total"] >= 1.0
+    assert snap["shai_retry_budget_spent_total"] == 0.0
+
+
+@pytest.mark.asyncio
+async def test_poison_quarantine_after_exactly_k_abnormal_deaths(
+        monkeypatch):
+    """The chaos contract: a payload that 500s the engine is quarantined
+    after exactly K abnormal attempts — the K+1th submission answers 422
+    WITHOUT any pod seeing it, with the fingerprint in the diagnostic."""
+    monkeypatch.setenv("SHAI_HEDGE", "1")
+    monkeypatch.setenv("SHAI_POISON_K", "2")
+
+    async def crash(route, payload, headers):
+        return _Resp(500, {"detail": "engine crashed"})
+
+    calls = _install_pods(monkeypatch, {"http://a:1": crash})
+    c = _cova({"a": {"url": "http://a:1", "task": "text-generation"}})
+    prompt, params = "rm -rf the engine", {"max_new_tokens": 4}
+    fp = rz_hedge.fingerprint(prompt, params)
+
+    with pytest.raises(HTTPError) as e1:     # mark 1 of K
+        await c.generate(prompt, params)
+    assert e1.value.status == 502
+    with pytest.raises(HTTPError) as e2:     # mark 2 = K -> 422 NOW
+        await c.generate(prompt, params)
+    assert e2.value.status == 422 and fp in str(e2.value.detail)
+    with pytest.raises(HTTPError) as e3:     # quarantined: no pod attempt
+        await c.generate(prompt, params)
+    assert e3.value.status == 422
+    assert len(calls) == 2                   # exactly K engine attempts
+    snap = c.poison.snapshot()
+    assert snap["shai_poison_marked_total"] == 2.0
+    assert snap["shai_poison_quarantined_total"] == 1.0
+    assert snap["shai_poison_rejected_total"] == 2.0
+    # an innocent prompt still routes (and fails only on the pod's 500,
+    # never on quarantine)
+    with pytest.raises(HTTPError) as e4:
+        await c.generate("innocent", params)
+    assert e4.value.status == 502
+
+
+@pytest.mark.asyncio
+async def test_timeouts_and_sheds_are_not_poison(monkeypatch):
+    """Slow or unlucky requests never quarantine: deadline 504s and
+    drain/admission sheds leave the poison registry untouched."""
+    import httpx
+
+    monkeypatch.setenv("SHAI_HEDGE", "1")
+
+    async def slow_pod(route, payload, headers):
+        raise httpx.ReadTimeout("read budget exceeded")
+
+    calls = _install_pods(monkeypatch, {"http://a:1": slow_pod})
+    c = _cova({"a": {"url": "http://a:1", "task": "text-generation"}})
+    for _ in range(3):
+        with pytest.raises(HTTPError) as ei:
+            await c.generate("slow prompt", {})
+        assert ei.value.status == 504        # surfaced, never retried
+    assert len(calls) == 3
+    assert c.poison.snapshot()["shai_poison_marked_total"] == 0.0
+
+
+@pytest.mark.asyncio
+async def test_migration_follow_depth_is_capped(monkeypatch):
+    """Two mutually-draining pods ping-pong a resume handle; the follow
+    chain is bounded by SHAI_ROUTE_FOLLOW_MAX, the depth gauge records
+    the overflow, and the request terminates instead of looping."""
+    monkeypatch.delenv("SHAI_HEDGE", raising=False)
+    monkeypatch.setenv("SHAI_ROUTE_FOLLOW_MAX", "3")
+
+    def draining(peer_url):
+        async def pod(route, payload, headers):
+            return _Resp(200, {"migrated": True, "peer": peer_url,
+                               "resume": {"v": 1}})
+        return pod
+
+    calls = _install_pods(monkeypatch, {
+        "http://a:1": draining("http://b:1"),
+        "http://b:1": draining("http://a:1")})
+    c = _cova({"a": {"url": "http://a:1", "task": "text-generation",
+                     "weight": 5},
+               "b": {"url": "http://b:1", "task": "text-generation"}})
+    with pytest.raises(HTTPError) as ei:
+        await c.generate("hi", {})
+    assert ei.value.status == 502
+    assert "no peer could resume" in str(ei.value.detail)
+    # initial dispatch + exactly cap follows, then the chain breaks
+    assert len(calls) == 4
+    assert c.hstats.snapshot()["shai_route_follow_depth"] == 4.0
+
+
+@pytest.mark.asyncio
+async def test_fleet_gossips_and_adopts_peer_quarantines(monkeypatch):
+    """/fleet carries the reliability section and MERGES peer-quarantined
+    fingerprints, so one pod's crash-loop protects every router."""
+    monkeypatch.setenv("SHAI_HEDGE", "1")
+    peer_fp = "feedfacedeadbeef"
+    _install_pods(
+        monkeypatch, {"http://a:1": _ok},
+        stats={"reliability": {"poison_fingerprints": [peer_fp]}})
+    c = CovaClient({"a": {"url": "http://a:1",
+                          "task": "text-generation"}})
+    out = await c.fleet()
+    rel = out["reliability"]
+    assert rel["hedging"] is True
+    assert peer_fp in rel["poison_fingerprints"]
+    assert c.poison.is_quarantined(peer_fp)
+    for key in ("shai_hedge_fired_total", "shai_retry_budget_spent_total",
+                "shai_poison_quarantined_total", "shai_route_follow_depth"):
+        assert key in rel
+
+
+# ---------------------------------------------------------------------------
+# fleet simulator: the CI chaos invariants
+# ---------------------------------------------------------------------------
+
+def _steady(duration_s=600.0, rps=4.0):
+    return load_sim.SimTrace("steady", duration_s, lambda t: rps,
+                             tick_s=15.0)
+
+
+def test_fleet_sim_crash_pod_zero_errors_under_budget():
+    """A crash-looping pod produces ZERO non-poison errors: every victim
+    retries (once — the failed pod is avoided) under the budget, and
+    attempt amplification stays within 1 + pct + burst."""
+    rep = load_sim.run_fleet_sim(_steady(), static_replicas=3, pod_rps=3.0,
+                                 crash_pids=[0], retry_pct=0.5)
+    assert rep.violations() == []
+    assert rep.errors == 0 and rep.quarantined == 0
+    assert rep.retries > 0
+    assert rep.attempts <= rep.created * 1.5 + rep.retry_burst + 1e-6
+    assert rep.counters["shai_retry_budget_spent_total"] > 0
+
+
+def test_fleet_sim_poison_request_quarantined_after_k():
+    rep = load_sim.run_fleet_sim(_steady(), static_replicas=3, pod_rps=3.0,
+                                 poison_rids=[5], retry_pct=0.5,
+                                 poison_k=2)
+    assert rep.violations() == []
+    assert rep.quarantined == 1 and rep.errors == 0
+    assert rep.counters["shai_poison_marked_total"] == 2.0
+    assert rep.counters["shai_poison_quarantined_total"] == 1.0
+
+
+def test_fleet_sim_hedge_rescues_tail_without_duplicates():
+    rep = load_sim.run_fleet_sim(_steady(), static_replicas=4, pod_rps=3.0,
+                                 slow_pods={0: 0.2}, hedge=True,
+                                 retry_pct=0.3)
+    assert rep.violations() == []
+    assert rep.errors == 0
+    assert rep.hedges > 0
+    # every hedge that lost the race deduped against the terminal state —
+    # the exactly-once ledger (inside violations()) holds regardless
+    assert rep.deduped <= rep.hedges
+
+
+def test_fleet_sim_reliability_off_is_the_pr19_simulator():
+    """Defaults replay the PR-19 traces untouched: no retries, hedges,
+    or quarantines, and no reliability counters in the report."""
+    rep = load_sim.run_fleet_sim(_steady())
+    assert rep.errors == 0
+    assert rep.retries == rep.hedges == rep.quarantined == 0
+    assert rep.deduped == 0
+    assert "shai_retry_budget_spent_total" not in rep.counters
+    assert "shai_poison_marked_total" not in rep.counters
+
+
+# ---------------------------------------------------------------------------
+# the key survives migration (engine manifest round-trip)
+# ---------------------------------------------------------------------------
+
+def test_idem_key_survives_migration_manifest():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from scalable_hw_agnostic_inference_tpu.engine import EngineConfig
+    from scalable_hw_agnostic_inference_tpu.engine.engine import (
+        LLMEngine,
+        SamplingParams,
+    )
+    from scalable_hw_agnostic_inference_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    eng = LLMEngine(cfg, params, EngineConfig(
+        max_model_len=64, max_num_seqs=2, block_size=8,
+        context_encoding_buckets=(16,), max_new_tokens=8))
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    prompt = [int(x) for x in
+              np.random.default_rng(0).integers(2, 500, 12)]
+    rid = eng.add_request(list(prompt), sp, idem_key="mig-key-1")
+    man = eng.snapshot_sequence(rid)       # queued -> cold manifest
+    assert man["idem_key"] == "mig-key-1"
+    # the peer re-admits under the SAME key (serve.units.vllm's resume
+    # path), and ITS drain manifest still carries it — two hops deep
+    rid2 = eng.add_request(
+        man["prompt_ids"], sp, already_generated=man["generated"],
+        orig_n_prompt=man["n_prompt"],
+        idem_key=str(man.get("idem_key") or ""))
+    man2 = eng.snapshot_sequence(rid2)
+    assert man2["idem_key"] == "mig-key-1"
+    # a keyless request's manifest omits the field entirely
+    rid3 = eng.add_request(list(prompt[:8]), sp)
+    assert "idem_key" not in eng.snapshot_sequence(rid3)
+    while eng.has_work:
+        for _ in eng.step():
+            pass
+    eng.finish_pending()
+
+
+# ---------------------------------------------------------------------------
+# serve layer: replay / join / charge-once on the real pod surface
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
+@pytest.mark.asyncio
+async def test_serve_keyed_replay_join_and_charge_once():
+    """The pod-side contract end to end: a keyed duplicate replays the
+    cached result (``served`` does not move — ONE execution, ONE ledger
+    charge), concurrent duplicates join the in-flight attempt, and a
+    malformed key is a 400, never a silent pass-through."""
+    from scalable_hw_agnostic_inference_tpu.models.registry import get_model
+    from scalable_hw_agnostic_inference_tpu.serve.app import create_app
+    from scalable_hw_agnostic_inference_tpu.utils.env import ServeConfig
+
+    cfg = ServeConfig(app="llm", model_id="tiny", device="cpu",
+                      max_new_tokens=8, vllm_config="/nonexistent.yaml")
+    app = create_app(cfg, get_model("vllm")(cfg))
+    async with make_client(app) as c:
+        r = await wait_ready(c, timeout=300.0)
+        assert r.status_code == 200, r.text
+        hdr = {rz_idemp.IDEMP_HEADER: "rel-key-1"}
+        body = {"prompt": "hello world", "temperature": 0.0,
+                "max_new_tokens": 4}
+        r1 = await c.post("/generate", json=body, headers=hdr)
+        assert r1.status_code == 200, r1.text
+        b1 = r1.json()
+        assert "idempotent_replay" not in b1
+        r2 = await c.post("/generate", json=body, headers=hdr)
+        b2 = r2.json()
+        assert b2["idempotent_replay"] is True
+        assert b2["generated_text"] == b1["generated_text"]
+        assert b2["n_tokens"] == b1["n_tokens"]
+        stats = (await c.get("/stats")).json()
+        assert stats["served"] == 1          # replay charged nothing
+        idem = stats["idempotency"]
+        assert idem["replayed_total"] == 1.0
+        assert idem["misses_total"] == 1.0
+
+        r = await c.post("/generate", json=body,
+                         headers={rz_idemp.IDEMP_HEADER: "bad key !"})
+        assert r.status_code == 400
+
+        # concurrent duplicates: one executes, the other joins/replays
+        hdr2 = {rz_idemp.IDEMP_HEADER: "rel-key-2"}
+        body2 = {"prompt": "another prompt", "temperature": 0.0,
+                 "max_new_tokens": 4}
+        ra, rb = await asyncio.gather(
+            c.post("/generate", json=body2, headers=hdr2),
+            c.post("/generate", json=body2, headers=hdr2))
+        assert ra.status_code == rb.status_code == 200
+        ja, jb = ra.json(), rb.json()
+        assert ja["generated_text"] == jb["generated_text"]
+        markers = [ja.get("idempotent_replay"), jb.get("idempotent_replay")]
+        assert markers.count(True) == 1
+        stats = (await c.get("/stats")).json()
+        assert stats["served"] == 2          # still one execution per key
+        idem = stats["idempotency"]
+        assert idem["misses_total"] == 2.0
+        assert idem["joined_total"] + idem["replayed_total"] == 2.0
+        # keyless traffic never consults the cache (strict no-op gate)
+        r = await c.post("/generate", json=body2)
+        assert r.status_code == 200
+        assert "idempotent_replay" not in r.json()
+        idem2 = (await c.get("/stats")).json()["idempotency"]
+        assert idem2["misses_total"] == 2.0

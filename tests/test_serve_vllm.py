@@ -97,6 +97,7 @@ def make_service(tmp_path=None, **env_over):
     return cfg, get_model("vllm")(cfg)
 
 
+@pytest.mark.slow  # tier-1 budget: see scripts/check_tier1_budget.py
 @pytest.mark.asyncio
 async def test_vllm_service_generate_and_batching():
     cfg, service = make_service()
